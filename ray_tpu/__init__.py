@@ -1,0 +1,219 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Capability surface of the reference Ray runtime (tasks, actors, objects, gang
+scheduling, fault tolerance + the Data/Train/Tune/Serve/RLlib libraries),
+re-designed TPU-first: a single conductor control plane with slice-aware
+resources, direct worker-to-worker task push, shared-memory host objects, and
+JAX/XLA/pjit/Pallas for everything on-device (see ray_tpu.parallel,
+ray_tpu.models, ray_tpu.train, ...).
+
+Public core API mirrors /root/reference/python/ray/_private/worker.py:
+init :1214, get :2523, put :2655, wait :2720, kill :2901.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from . import exceptions  # noqa: F401
+from ._private import worker as _worker_mod
+from ._private.conductor import Conductor
+from ._private.object_store import ObjectRef  # noqa: F401
+from ._private.worker import Worker
+from .actor import ActorClass, ActorHandle, exit_actor, get_actor  # noqa: F401
+from .remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+_conductor: Optional[Conductor] = None
+
+
+def is_initialized() -> bool:
+    return _worker_mod.global_worker is not None
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default",
+         session_dir: Optional[str] = None,
+         worker_env: Optional[Dict[str, str]] = None,
+         ignore_reinit_error: bool = False) -> Dict[str, Any]:
+    """Start a local cluster (conductor in-process) or connect to an existing
+    one via ``address="host:port"``."""
+    global _conductor
+    if is_initialized():
+        if ignore_reinit_error:
+            return {"address": _worker_mod.global_worker.conductor_address}
+        raise RuntimeError("ray_tpu.init() already called; "
+                           "use ignore_reinit_error=True to ignore")
+    if session_dir is None:
+        session_dir = os.path.join(
+            tempfile.gettempdir(), "ray_tpu",
+            f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+    os.makedirs(session_dir, exist_ok=True)
+
+    if address is None:
+        total: Dict[str, float] = dict(resources or {})
+        total.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                      else (os.cpu_count() or 1)))
+        tpus = _detect_tpu_chips()
+        if tpus and "TPU" not in total:
+            total["TPU"] = float(tpus)
+        # Workers must not grab the (single-client) TPU runtime: the driver
+        # owns the chips; tasks needing device access use the driver-held
+        # mesh (ray_tpu.parallel) or explicit TPU-resource actors.
+        wenv = {"JAX_PLATFORMS": "cpu"}
+        wenv.update(worker_env or {})
+        _conductor = Conductor(total, session_dir, worker_env=wenv).start()
+        conductor_address = _conductor.address
+        # Pre-start workers so first tasks don't pay process cold-start
+        # (reference: WorkerPool prestarts language workers, worker_pool.h:156)
+        _conductor.handler.prestart_workers(min(int(total.get("CPU", 1)), 4))
+    else:
+        host, port = address.rsplit(":", 1)
+        conductor_address = (host, int(port))
+
+    w = Worker(mode="driver", conductor_address=conductor_address,
+               session_dir=session_dir)
+    _worker_mod.global_worker = w
+    atexit.register(shutdown)
+    return {"address": conductor_address, "session_dir": session_dir}
+
+
+def _detect_tpu_chips() -> int:
+    """TPU chip detection — analog of the reference's
+    python/ray/_private/accelerators/tpu.py:102-119 (reads /dev/accel* and
+    GCE metadata). Here: env override, /dev/accel*, then the axon platform."""
+    if os.environ.get("RAY_TPU_CHIPS"):
+        return int(os.environ["RAY_TPU_CHIPS"])
+    import glob
+
+    accels = glob.glob("/dev/accel*")
+    if accels:
+        return len(accels)
+    if "axon" in os.environ.get("JAX_PLATFORMS", "") or \
+            "tpu" in os.environ.get("JAX_PLATFORMS", ""):
+        return 1
+    return 0
+
+
+def shutdown() -> None:
+    global _conductor
+    w = _worker_mod.global_worker
+    if w is not None:
+        w.shutdown()
+        _worker_mod.global_worker = None
+    if _conductor is not None:
+        _conductor.stop()
+        _conductor = None
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes (reference
+    python/ray/_private/worker.py `remote`)."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("remote() takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+
+    def wrap(fn_or_cls):
+        return _make_remote(fn_or_cls, kwargs)
+
+    return wrap
+
+
+def _make_remote(fn_or_cls, options: Dict[str, Any]):
+    if isinstance(fn_or_cls, type):
+        return ActorClass(fn_or_cls, options)
+    return RemoteFunction(fn_or_cls, options)
+
+
+def put(value: Any) -> ObjectRef:
+    return _require_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None):
+    return _require_worker().get(refs, timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return _require_worker().wait(refs, num_returns=num_returns,
+                                  timeout=timeout, fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    w = _require_worker()
+    w.conductor.call("kill_actor", actor.actor_id, no_restart, timeout=30.0)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Best-effort cancellation: pending tasks get a TaskCancelledError."""
+    w = _require_worker()
+    if w._is_pending_local(ref.id):
+        w.store.put_error(ref.id, exceptions.TaskCancelledError(str(ref)))
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _require_worker().conductor.call("cluster_resources", timeout=30.0)
+
+
+def available_resources() -> Dict[str, float]:
+    return _require_worker().conductor.call("available_resources",
+                                            timeout=30.0)
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _require_worker().conductor.call("nodes", timeout=30.0)
+
+
+def _require_worker() -> Worker:
+    w = _worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+class _RuntimeContext:
+    @property
+    def worker_id(self) -> str:
+        return _require_worker().worker_id
+
+    @property
+    def job_id(self) -> str:
+        return _require_worker().job_id
+
+    @property
+    def is_driver(self) -> bool:
+        return _require_worker().mode == "driver"
+
+    @property
+    def actor_id(self) -> Optional[str]:
+        rt = _require_worker()._actor_runtime
+        return rt.actor_id if rt else None
+
+    def get_actor_handle(self) -> Optional[ActorHandle]:
+        w = _require_worker()
+        rt = w._actor_runtime
+        if rt is None:
+            return None
+        return ActorHandle(rt.actor_id, w.address)
+
+
+def get_runtime_context() -> _RuntimeContext:
+    return _RuntimeContext()
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "put", "get", "wait",
+    "kill", "cancel", "get_actor", "exit_actor", "cluster_resources",
+    "available_resources", "nodes", "get_runtime_context", "ObjectRef",
+    "ActorClass", "ActorHandle", "exceptions", "__version__",
+]

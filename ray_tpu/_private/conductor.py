@@ -1,0 +1,664 @@
+"""Conductor: the cluster control plane.
+
+TPU-native consolidation of the reference's GCS server + raylet scheduling
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.h:78 composition — node /
+actor / job / placement-group / KV / health managers — and
+src/ray/raylet/scheduling/cluster_task_manager.cc). Per SURVEY.md §7 we merge
+the two: TPU slices are homogeneous and topology-known, so a single authority
+holds the resource view and grants worker leases directly; there is no
+spillback protocol. Workers are leased to submitters which then push tasks
+*directly* worker-to-worker (the reference's direct task transport design,
+direct_task_transport.h:75 — kept, because it is the right hot path).
+
+Responsibilities:
+- worker pool per node: pre-start/spawn Python worker processes, lease/return
+  (reference worker_pool.h:156 / PopWorker :343)
+- actor management: creation (conductor-mediated like gcs_actor_manager.cc:255),
+  named actors, restart-on-death with max_restarts
+- internal KV + simple pubsub (gcs_kv_manager.cc)
+- placement groups: atomic bundle reservation (PACK/SPREAD/STRICT_*)
+- health: reap dead worker processes, publish deaths, restart actors
+- task-event buffer for the state API (gcs_task_manager.cc)
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
+from .rpc import ClientPool, RpcServer
+
+WORKER_START_TIMEOUT_S = float(os.environ.get("RAY_TPU_WORKER_START_TIMEOUT", 60))
+
+
+@dataclass
+class WorkerRecord:
+    worker_id: str
+    node_id: str
+    address: Optional[Tuple[str, int]] = None
+    pid: Optional[int] = None
+    state: str = "STARTING"  # STARTING | IDLE | BUSY | ACTOR | DEAD
+    proc: Optional[subprocess.Popen] = None
+    resources: Dict[str, float] = field(default_factory=dict)  # held while leased
+
+
+@dataclass
+class ActorRecord:
+    actor_id: str
+    name: Optional[str]
+    namespace: str
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    worker_id: Optional[str] = None
+    address: Optional[Tuple[str, int]] = None
+    spec: Optional[bytes] = None  # pickled (cls, args, kwargs, options)
+    restarts_remaining: int = 0
+    max_task_retries: int = 0
+    resources: Dict[str, float] = field(default_factory=dict)
+    death_cause: Optional[str] = None
+    num_restarts: int = 0
+    placement_group_id: Optional[str] = None
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: str
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "CREATED"  # CREATED | REMOVED
+    name: Optional[str] = None
+
+
+@dataclass
+class NodeRecord:
+    node_id: str
+    total: Dict[str, float]
+    available: Dict[str, float]
+    address: Optional[Tuple[str, int]] = None  # node agent RPC (None = inline)
+    alive: bool = True
+
+
+class ConductorHandler:
+    """RPC handler — every public method is remotely callable."""
+
+    def __init__(self, resources: Dict[str, float], session_dir: str,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._nodes: Dict[str, NodeRecord] = {}
+        self._workers: Dict[str, WorkerRecord] = {}
+        self._actors: Dict[str, ActorRecord] = {}
+        self._named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> id
+        self._pgs: Dict[str, PlacementGroupRecord] = {}
+        self._kv: Dict[str, Dict[bytes, bytes]] = {}
+        self._subs: Dict[str, List[Tuple[str, int]]] = {}  # channel -> addrs
+        self._task_events: List[Dict[str, Any]] = []
+        self._session_dir = session_dir
+        self._worker_env = dict(worker_env or {})
+        self._clients = ClientPool()
+        self._stopped = False
+        self._waiting_leases = 0
+        self.address: Optional[Tuple[str, int]] = None  # set by Conductor
+
+        head = NodeRecord(node_id=NodeID().hex(), total=dict(resources),
+                          available=dict(resources))
+        self._nodes[head.node_id] = head
+        self._head_node_id = head.node_id
+
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="conductor-monitor", daemon=True)
+
+    # ------------------------------------------------------------------ nodes
+
+    def register_node(self, node_id: str, resources: Dict[str, float],
+                      address: Tuple[str, int]) -> None:
+        with self._cv:
+            self._nodes[node_id] = NodeRecord(node_id=node_id,
+                                              total=dict(resources),
+                                              available=dict(resources),
+                                              address=tuple(address))
+            self._cv.notify_all()
+
+    def cluster_resources(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.total.items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"node_id": n.node_id, "alive": n.alive, "total": n.total,
+                     "available": n.available} for n in self._nodes.values()]
+
+    # ---------------------------------------------------------------- workers
+
+    def register_worker(self, worker_id: str, address: Tuple[str, int],
+                        pid: int) -> None:
+        with self._cv:
+            w = self._workers.get(worker_id)
+            if w is None:
+                w = WorkerRecord(worker_id=worker_id, node_id=self._head_node_id)
+                self._workers[worker_id] = w
+            w.address = tuple(address)
+            w.pid = pid
+            if w.state == "STARTING":
+                w.state = "IDLE"
+            self._cv.notify_all()
+
+    def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None) -> WorkerRecord:
+        """Start a worker subprocess (reference: WorkerPool starting
+        default_worker.py, worker_pool.h:343)."""
+        worker_id = WorkerID().hex()
+        host, port = self.address
+        env = dict(os.environ)
+        env.update(self._worker_env)
+        if env_extra:
+            env.update(env_extra)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_CONDUCTOR"] = f"{host}:{port}"
+        env["RAY_TPU_SESSION_DIR"] = self._session_dir
+        logs = os.path.join(self._session_dir, "logs")
+        os.makedirs(logs, exist_ok=True)
+        out = open(os.path.join(logs, f"worker-{worker_id[:12]}.log"), "ab")
+        # -S skips `site` (whose sitecustomize registers the TPU PJRT plugin
+        # and imports all of jax — ~2s of cold-start the worker doesn't need;
+        # workers are host-side, the driver owns the chips). Site packages are
+        # re-exposed via PYTHONPATH. Set RAY_TPU_WORKER_FULL_SITE=1 in
+        # worker_env for workers that must see the TPU runtime.
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+        if env.get("RAY_TPU_WORKER_FULL_SITE") != "1":
+            import site
+
+            paths = list(site.getsitepackages())
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            paths.append(repo_root)
+            if env.get("PYTHONPATH"):
+                paths.append(env["PYTHONPATH"])
+            env["PYTHONPATH"] = os.pathsep.join(paths)
+            cmd.insert(1, "-S")
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        w = WorkerRecord(worker_id=worker_id, node_id=self._head_node_id,
+                         proc=proc)
+        self._workers[worker_id] = w
+        return w
+
+    def _acquire_resources(self, node: NodeRecord, req: Dict[str, float]) -> bool:
+        for k, v in req.items():
+            if node.available.get(k, 0.0) + 1e-9 < v:
+                return False
+        for k, v in req.items():
+            node.available[k] = node.available.get(k, 0.0) - v
+        return True
+
+    def _release_resources(self, node: NodeRecord, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            node.available[k] = node.available.get(k, 0.0) + v
+
+    def lease_worker(self, resources: Dict[str, float],
+                     placement_group_id: Optional[str] = None,
+                     timeout: Optional[float] = None) -> Tuple[str, Tuple[str, int]]:
+        """Grant an idle worker (spawning if below capacity), holding
+        `resources` against the node until return_worker."""
+        deadline = time.monotonic() + (timeout if timeout is not None
+                                       else WORKER_START_TIMEOUT_S)
+        resources = dict(resources or {})
+        resources.setdefault("CPU", 1.0)
+        if placement_group_id is not None:
+            # resources come out of the PG's pre-reserved bundle pool
+            resources = {f"_pg_{placement_group_id}_{k}": v
+                         for k, v in resources.items()}
+        with self._cv:
+            self._waiting_leases += 1
+            try:
+                return self._lease_locked(resources, deadline)
+            finally:
+                self._waiting_leases -= 1
+
+    def _lease_locked(self, resources, deadline):
+            while True:
+                if self._stopped:
+                    raise RuntimeError("conductor stopped")
+                node = self._nodes[self._head_node_id]
+                if self._acquire_resources(node, resources):
+                    w = self._take_idle_or_spawn(deadline)
+                    if w is not None:
+                        w.state = "BUSY"
+                        w.resources = resources
+                        return w.worker_id, w.address
+                    self._release_resources(node, resources)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no worker available for {resources} within timeout; "
+                        f"available={node.available}")
+                self._cv.wait(min(remaining, 0.1))
+
+    def _take_idle_or_spawn(self, deadline: float) -> Optional[WorkerRecord]:
+        """Must hold lock. Returns a registered IDLE worker or None."""
+        for w in self._workers.values():
+            if w.state == "IDLE":
+                return w
+        n_starting = sum(1 for w in self._workers.values()
+                         if w.state == "STARTING")
+        # spawn enough for every lease currently waiting (parallel cold-start)
+        want = max(1, self._waiting_leases)
+        for _ in range(max(0, want - n_starting)):
+            self._spawn_worker()
+        while time.monotonic() < deadline and not self._stopped:
+            for w in self._workers.values():
+                if w.state == "IDLE":
+                    return w
+            self._cv.wait(0.05)
+        return None
+
+    def return_worker(self, worker_id: str) -> None:
+        with self._cv:
+            w = self._workers.get(worker_id)
+            if w is None or w.state == "DEAD":
+                return
+            node = self._nodes[w.node_id]
+            self._release_resources(node, w.resources)
+            w.resources = {}
+            if w.state == "BUSY":
+                w.state = "IDLE"
+            self._cv.notify_all()
+
+    def prestart_workers(self, n: int) -> None:
+        with self._cv:
+            for _ in range(n):
+                self._spawn_worker()
+
+    def list_workers(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
+                     "address": w.address, "node_id": w.node_id}
+                    for w in self._workers.values()]
+
+    # ----------------------------------------------------------------- actors
+
+    def create_actor(self, spec_bytes: bytes, name: Optional[str],
+                     namespace: str, resources: Dict[str, float],
+                     max_restarts: int, max_task_retries: int,
+                     placement_group_id: Optional[str] = None,
+                     get_if_exists: bool = False) -> Dict[str, Any]:
+        """GCS-mediated actor creation (reference gcs_actor_manager.cc:255,280)."""
+        with self._cv:
+            if name is not None:
+                existing = self._named_actors.get((namespace, name))
+                if existing is not None:
+                    rec = self._actors[existing]
+                    if rec.state != "DEAD":
+                        if get_if_exists:
+                            return self._actor_info_locked(rec)
+                        raise ValueError(
+                            f"actor name {name!r} already taken in namespace "
+                            f"{namespace!r}")
+            actor_id = ActorID().hex()
+            rec = ActorRecord(actor_id=actor_id, name=name, namespace=namespace,
+                              spec=spec_bytes,
+                              restarts_remaining=max_restarts,
+                              max_task_retries=max_task_retries,
+                              resources=dict(resources or {}),
+                              placement_group_id=placement_group_id)
+            self._actors[actor_id] = rec
+            if name is not None:
+                self._named_actors[(namespace, name)] = actor_id
+        self._place_actor(actor_id)
+        with self._lock:
+            return self._actor_info_locked(self._actors[actor_id])
+
+    def _place_actor(self, actor_id: str) -> None:
+        """Lease a dedicated worker and instantiate the actor on it."""
+        with self._lock:
+            rec = self._actors[actor_id]
+            spec, res, pg = rec.spec, rec.resources, rec.placement_group_id
+        try:
+            worker_id, address = self.lease_worker(res, placement_group_id=pg)
+        except (TimeoutError, RuntimeError) as e:
+            with self._cv:
+                rec.state = "DEAD"
+                rec.death_cause = f"scheduling failed: {e}"
+                self._cv.notify_all()
+            return
+        client = self._clients.get(address)
+        try:
+            client.call("become_actor", actor_id, spec,
+                        timeout=WORKER_START_TIMEOUT_S)
+        except Exception as e:  # creation failed on the worker
+            self.return_worker(worker_id)
+            with self._cv:
+                rec.state = "DEAD"
+                rec.death_cause = f"__init__ failed: {e}"
+                self._cv.notify_all()
+            return
+        with self._cv:
+            w = self._workers.get(worker_id)
+            if w is not None:
+                w.state = "ACTOR"
+            rec.worker_id = worker_id
+            rec.address = address
+            rec.state = "ALIVE"
+            self._cv.notify_all()
+        self.publish("actor_state", {"actor_id": actor_id, "state": "ALIVE"})
+
+    def get_actor_info(self, actor_id: Optional[str] = None,
+                       name: Optional[str] = None,
+                       namespace: str = "default",
+                       wait_alive_timeout: float = 0.0) -> Dict[str, Any]:
+        deadline = time.monotonic() + wait_alive_timeout
+        with self._cv:
+            while True:
+                if actor_id is None:
+                    aid = self._named_actors.get((namespace, name))
+                    if aid is None:
+                        raise ValueError(
+                            f"no actor named {name!r} in namespace {namespace!r}")
+                else:
+                    aid = actor_id
+                rec = self._actors.get(aid)
+                if rec is None:
+                    raise ValueError(f"unknown actor {aid}")
+                if rec.state == "ALIVE" or rec.state == "DEAD" \
+                        or time.monotonic() >= deadline:
+                    return self._actor_info_locked(rec)
+                self._cv.wait(min(0.1, max(0.0, deadline - time.monotonic())))
+
+    def _actor_info_locked(self, rec: ActorRecord) -> Dict[str, Any]:
+        return {"actor_id": rec.actor_id, "state": rec.state,
+                "address": rec.address, "name": rec.name,
+                "namespace": rec.namespace, "death_cause": rec.death_cause,
+                "max_task_retries": rec.max_task_retries,
+                "num_restarts": rec.num_restarts}
+
+    def list_actors(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [self._actor_info_locked(r) for r in self._actors.values()]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        with self._cv:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return
+            if no_restart:
+                rec.restarts_remaining = 0
+            worker_id = rec.worker_id
+            w = self._workers.get(worker_id) if worker_id else None
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        elif w is not None and w.pid is not None:
+            try:
+                os.kill(w.pid, 9)
+            except OSError:
+                pass
+        # monitor loop will observe the death and finalize state
+
+    def report_actor_exit(self, actor_id: str, cause: str) -> None:
+        """Graceful exit (__ray_terminate__)."""
+        with self._cv:
+            rec = self._actors.get(actor_id)
+            if rec is None:
+                return
+            rec.state = "DEAD"
+            rec.death_cause = cause
+            rec.restarts_remaining = 0
+            if rec.worker_id:
+                w = self._workers.get(rec.worker_id)
+                if w is not None and w.state == "ACTOR":
+                    w.state = "DEAD"
+                    # monitor skips DEAD workers, so release the lease here
+                    self._release_resources(self._nodes[w.node_id],
+                                            w.resources)
+                    w.resources = {}
+            self._cv.notify_all()
+        self.publish("actor_state", {"actor_id": actor_id, "state": "DEAD"})
+
+    # ------------------------------------------------------------------- KV
+
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: str = "default") -> bool:
+        with self._lock:
+            ns = self._kv.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(namespace, {}).get(key)
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._kv.get(namespace, {}).pop(key, None) is not None
+
+    def kv_keys(self, prefix: bytes = b"", namespace: str = "default") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._kv.get(namespace, {}) if k.startswith(prefix)]
+
+    # ---------------------------------------------------------------- pubsub
+
+    def subscribe(self, channel: str, address: Tuple[str, int]) -> None:
+        with self._lock:
+            subs = self._subs.setdefault(channel, [])
+            if tuple(address) not in subs:
+                subs.append(tuple(address))
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, []))
+        for addr in subs:
+            try:
+                self._clients.get(addr).notify("on_published", channel, message)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------- placement groups
+
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str = "PACK",
+                               name: Optional[str] = None) -> str:
+        """Atomically reserve bundle resources (reference 2PC
+        gcs_placement_group_scheduler.cc — single-authority here, so plain
+        transactional reserve)."""
+        pg_id = PlacementGroupID().hex()
+        with self._cv:
+            node = self._nodes[self._head_node_id]
+            total_req: Dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total_req[k] = total_req.get(k, 0) + v
+            if not self._acquire_resources(node, total_req):
+                raise ValueError(
+                    f"placement group infeasible: need {total_req}, "
+                    f"available {node.available}")
+            # expose per-PG pool as synthetic node resources
+            for b in bundles:
+                for k, v in b.items():
+                    pk = f"_pg_{pg_id}_{k}"
+                    node.total[pk] = node.total.get(pk, 0) + v
+                    node.available[pk] = node.available.get(pk, 0) + v
+            self._pgs[pg_id] = PlacementGroupRecord(pg_id=pg_id,
+                                                    bundles=bundles,
+                                                    strategy=strategy, name=name)
+            self._cv.notify_all()
+        return pg_id
+
+    def placement_group_ready(self, pg_id: str) -> bool:
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            return pg is not None and pg.state == "CREATED"
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        with self._cv:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            node = self._nodes[self._head_node_id]
+            total_req: Dict[str, float] = {}
+            for b in pg.bundles:
+                for k, v in b.items():
+                    total_req[k] = total_req.get(k, 0) + v
+                    pk = f"_pg_{pg_id}_{k}"
+                    node.total.pop(pk, None)
+                    node.available.pop(pk, None)
+            self._release_resources(node, total_req)
+            self._cv.notify_all()
+
+    def list_placement_groups(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"pg_id": p.pg_id, "bundles": p.bundles,
+                     "strategy": p.strategy, "state": p.state, "name": p.name}
+                    for p in self._pgs.values()]
+
+    # ------------------------------------------------------------ task events
+
+    def report_task_events(self, events: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            self._task_events.extend(events)
+            if len(self._task_events) > 100_000:
+                del self._task_events[:len(self._task_events) - 100_000]
+
+    def get_task_events(self, limit: int = 10_000) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._task_events[-limit:]
+
+    # ------------------------------------------------------------------ misc
+
+    def ping(self) -> str:
+        return "pong"
+
+    def session_info(self) -> Dict[str, Any]:
+        return {"session_dir": self._session_dir,
+                "head_node_id": self._head_node_id}
+
+    # --------------------------------------------------------------- monitor
+
+    def _monitor_loop(self) -> None:
+        """Reap dead worker processes; restart actors (reference
+        gcs_health_check_manager.cc + gcs_actor_manager worker-death path)."""
+        while not self._stopped:
+            time.sleep(0.2)
+            dead: List[WorkerRecord] = []
+            with self._cv:
+                for w in self._workers.values():
+                    if w.state == "DEAD":
+                        continue
+                    alive = True
+                    if w.proc is not None:
+                        alive = w.proc.poll() is None
+                    elif w.pid is not None:
+                        try:
+                            os.kill(w.pid, 0)
+                        except OSError:
+                            alive = False
+                    if not alive:
+                        w.state = "DEAD"
+                        node = self._nodes[w.node_id]
+                        self._release_resources(node, w.resources)
+                        w.resources = {}
+                        dead.append(w)
+                        if w.address:
+                            self._clients.invalidate(w.address)
+                self._cv.notify_all()
+            for w in dead:
+                self._on_worker_death(w)
+
+    def _on_worker_death(self, w: WorkerRecord) -> None:
+        restart: List[str] = []
+        with self._cv:
+            for rec in self._actors.values():
+                if rec.worker_id == w.worker_id and rec.state == "ALIVE":
+                    if rec.restarts_remaining != 0:
+                        if rec.restarts_remaining > 0:
+                            rec.restarts_remaining -= 1
+                        rec.state = "RESTARTING"
+                        rec.num_restarts += 1
+                        restart.append(rec.actor_id)
+                    else:
+                        rec.state = "DEAD"
+                        rec.death_cause = "worker process died"
+            self._cv.notify_all()
+        for actor_id in restart:
+            self.publish("actor_state",
+                         {"actor_id": actor_id, "state": "RESTARTING"})
+            threading.Thread(target=self._place_actor, args=(actor_id,),
+                             daemon=True).start()
+        for rec in list(self._actors.values()):
+            if rec.state == "DEAD" and rec.worker_id == w.worker_id:
+                self.publish("actor_state",
+                             {"actor_id": rec.actor_id, "state": "DEAD"})
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            workers = list(self._workers.values())
+            self._cv.notify_all()
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 3.0
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(max(0.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+        self._clients.close_all()
+
+
+class Conductor:
+    """Hosts a ConductorHandler on an RpcServer (in-process head or
+    standalone via conductor_main)."""
+
+    def __init__(self, resources: Dict[str, float], session_dir: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.handler = ConductorHandler(resources, session_dir,
+                                        worker_env=worker_env)
+        self.server = RpcServer(self.handler, host=host, port=port,
+                                max_workers=32)
+        self.handler.address = self.server.address
+
+    def start(self) -> "Conductor":
+        self.server.start()
+        self.handler._monitor.start()
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def stop(self) -> None:
+        self.handler.stop()
+        self.server.stop()

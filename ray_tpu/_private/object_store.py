@@ -1,0 +1,309 @@
+"""Ownership-based object store.
+
+TPU-native replacement for the reference's plasma store + memory store
+(/root/reference/src/ray/object_manager/plasma/, src/ray/core_worker/
+store_provider/). Design differences, deliberate (SURVEY.md §7):
+
+- No store daemon. The process that *creates* a value holds it (ownership, cf.
+  reference reference_count.h:61); peers fetch from the holder via RPC. Large
+  host objects are written to POSIX shared memory so same-host readers map them
+  zero-copy — the role plasma plays — but the segment is owned by the creating
+  worker, not a daemon. Device arrays never pass through here: they live in HBM
+  and move via ICI/DCN collectives inside jitted programs (ray_tpu.parallel).
+- Values above SHM_THRESHOLD go to shm (one segment per object, buffers
+  8-byte aligned); below, they stay inline in the holder's heap and ride the
+  RPC reply on fetch.
+- Eviction: holder-side LRU cap (RAY_TPU_OBJECT_STORE_CAP bytes); evicted or
+  lost objects can be reconstructed from lineage by the owner's TaskManager.
+
+An optional C++ store (ray_tpu/_native/shm_store.cc) provides the same segment
+layout with a slab allocator; object_store transparently uses it when built.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .ids import ObjectID
+
+SHM_THRESHOLD = int(os.environ.get("RAY_TPU_SHM_THRESHOLD", 100 * 1024))
+STORE_CAP = int(os.environ.get("RAY_TPU_OBJECT_STORE_CAP", 2 * 1024**3))
+_ALIGN = 8
+
+
+class ObjectRef:
+    """Handle to a (possibly not-yet-computed) remote value.
+
+    `locator` is the RPC address of the process that holds (or will hold) the
+    value; `owner` is the address of the submitting process, which keeps the
+    task lineage for reconstruction.
+    """
+
+    __slots__ = ("id", "locator", "owner", "__weakref__")
+
+    def __init__(self, id: ObjectID | str | None = None,
+                 locator: Optional[Tuple[str, int]] = None,
+                 owner: Optional[Tuple[str, int]] = None):
+        if isinstance(id, ObjectID):
+            self.id = id.hex()
+        else:
+            self.id = id if id is not None else ObjectID().hex()
+        self.locator = tuple(locator) if locator else None
+        self.owner = tuple(owner) if owner else None
+
+    def hex(self) -> str:
+        return self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id[:12]}…)"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.locator, self.owner))
+
+    # await support (used by serve/data async paths)
+    def __await__(self):
+        from . import worker as _w
+
+        value = yield from _w.global_worker.get_async(self).__await__()
+        return value
+
+    def future(self):
+        from . import worker as _w
+
+        return _w.global_worker.get_future(self)
+
+
+@dataclass
+class _Entry:
+    meta: Optional[bytes] = None
+    buffers: Optional[List[memoryview]] = None
+    shm_name: Optional[str] = None
+    layout: Optional[List[Tuple[int, int]]] = None  # (offset, size) per buffer
+    shm: Optional[shared_memory.SharedMemory] = None
+    nbytes: int = 0
+    error: Optional[BaseException] = None
+    ready: bool = False
+    last_access: float = field(default_factory=time.monotonic)
+    pinned: int = 0
+
+
+class LocalObjectStore:
+    """Per-process store: holds objects this process created, caches fetched
+    ones, and provides blocking get with readiness signaling."""
+
+    def __init__(self):
+        self._entries: Dict[str, _Entry] = {}
+        self._cv = threading.Condition()
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._bytes = 0
+        # objects for which only a placeholder exists (awaiting task result)
+        self._deserialized_cache: Dict[str, Any] = {}
+
+    # ---------- write paths ----------
+
+    def put_value(self, object_id: str, value: Any) -> int:
+        """Serialize and store; returns total bytes."""
+        meta, buffers = serialization.serialize(value)
+        total = sum(b.nbytes for b in buffers)
+        e = _Entry(meta=meta, nbytes=len(meta) + total)
+        if total >= SHM_THRESHOLD:
+            size = 0
+            layout = []
+            for b in buffers:
+                off = (size + _ALIGN - 1) // _ALIGN * _ALIGN
+                layout.append((off, b.nbytes))
+                size = off + b.nbytes
+            shm = shared_memory.SharedMemory(create=True, size=max(size, 1))
+            for (off, n), b in zip(layout, buffers):
+                shm.buf[off:off + n] = b.cast("B")[:] if b.format != "B" else b[:]
+            e.shm, e.shm_name, e.layout = shm, shm.name, layout
+        else:
+            e.buffers = [memoryview(bytes(b)) for b in buffers]
+        e.ready = True
+        with self._cv:
+            self._entries[object_id] = e
+            self._bytes += e.nbytes
+            self._deserialized_cache[object_id] = value
+            self._cv.notify_all()
+        self._maybe_evict()
+        return e.nbytes
+
+    def put_serialized(self, object_id: str, meta: bytes,
+                       buffers: List[memoryview]) -> None:
+        e = _Entry(meta=meta, buffers=[memoryview(bytes(b)) for b in buffers],
+                   nbytes=len(meta) + sum(b.nbytes for b in buffers), ready=True)
+        with self._cv:
+            self._entries[object_id] = e
+            self._bytes += e.nbytes
+            self._cv.notify_all()
+        self._maybe_evict()
+
+    def put_shm_reference(self, object_id: str, meta: bytes, shm_name: str,
+                          layout: List[Tuple[int, int]]) -> None:
+        """Record a fetched same-host shm object (zero-copy read path)."""
+        e = _Entry(meta=meta, shm_name=shm_name, layout=layout,
+                   nbytes=len(meta), ready=True)
+        with self._cv:
+            self._entries[object_id] = e
+            self._bytes += e.nbytes
+            self._cv.notify_all()
+
+    def put_error(self, object_id: str, error: BaseException) -> None:
+        e = _Entry(error=error, ready=True)
+        with self._cv:
+            self._entries[object_id] = e
+            self._cv.notify_all()
+
+    def invalidate(self, object_id: str) -> None:
+        """Drop a (possibly pending) entry so waiters see it as missing."""
+        with self._cv:
+            e = self._entries.pop(object_id, None)
+            self._deserialized_cache.pop(object_id, None)
+            if e is not None:
+                self._bytes -= e.nbytes
+                self._free_entry(e)
+            self._cv.notify_all()
+
+    # ---------- read paths ----------
+
+    def contains(self, object_id: str) -> bool:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e is not None and e.ready
+
+    def wait_ready(self, object_id: str, timeout: Optional[float]) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                e = self._entries.get(object_id)
+                if e is not None and e.ready:
+                    return True
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining if remaining is None or remaining < 0.2 else 0.2)
+
+    def get_local(self, object_id: str) -> Any:
+        """Deserialize a ready local entry (raises stored errors)."""
+        with self._cv:
+            if object_id in self._deserialized_cache:
+                return self._deserialized_cache[object_id]
+            e = self._entries.get(object_id)
+            if e is None or not e.ready:
+                raise KeyError(object_id)
+            e.last_access = time.monotonic()
+            if e.error is not None:
+                raise e.error
+        if e.shm_name is not None:
+            shm = e.shm or self._attach(e.shm_name)
+            bufs = [memoryview(shm.buf)[off:off + n] for off, n in e.layout]
+        else:
+            bufs = e.buffers or []
+        value = serialization.deserialize(e.meta, bufs)
+        with self._cv:
+            self._deserialized_cache[object_id] = value
+        return value
+
+    def export(self, object_id: str) -> Tuple[bytes, Optional[str],
+                                              Optional[List[Tuple[int, int]]],
+                                              Optional[List[bytes]]]:
+        """For serving a fetch RPC: (meta, shm_name, layout, inline_buffers)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.ready:
+                raise KeyError(object_id)
+            if e.error is not None:
+                raise e.error
+            e.last_access = time.monotonic()
+        if e.shm_name is not None:
+            return e.meta, e.shm_name, e.layout, None
+        return e.meta, None, None, [bytes(b) for b in (e.buffers or [])]
+
+    # ---------- lifetime ----------
+
+    def pin(self, object_id: str) -> None:
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None:
+                e.pinned += 1
+
+    def unpin(self, object_id: str) -> None:
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None and e.pinned > 0:
+                e.pinned -= 1
+
+    def delete(self, object_id: str) -> None:
+        with self._cv:
+            e = self._entries.pop(object_id, None)
+            self._deserialized_cache.pop(object_id, None)
+        if e is not None:
+            with self._cv:
+                self._bytes -= e.nbytes
+            self._free_entry(e)
+
+    def _free_entry(self, e: _Entry) -> None:
+        if e.shm is not None:
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except FileNotFoundError:
+                pass
+            except OSError:
+                pass
+
+    def _attach(self, name: str) -> shared_memory.SharedMemory:
+        with self._cv:
+            shm = self._attached.get(name)
+            if shm is not None:
+                return shm
+        shm = shared_memory.SharedMemory(name=name)
+        with self._cv:
+            self._attached[name] = shm
+        return shm
+
+    def _maybe_evict(self) -> None:
+        with self._cv:
+            if self._bytes <= STORE_CAP:
+                return
+            entries = sorted(
+                ((oid, e) for oid, e in self._entries.items()
+                 if e.ready and e.pinned == 0 and e.error is None),
+                key=lambda kv: kv[1].last_access)
+            for oid, e in entries:
+                if self._bytes <= STORE_CAP * 0.8:
+                    break
+                self._entries.pop(oid, None)
+                self._deserialized_cache.pop(oid, None)
+                self._bytes -= e.nbytes
+                self._free_entry(e)
+
+    def stats(self) -> Dict[str, int]:
+        with self._cv:
+            return {"num_objects": len(self._entries), "bytes": self._bytes}
+
+    def shutdown(self) -> None:
+        with self._cv:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._deserialized_cache.clear()
+            attached = list(self._attached.values())
+            self._attached.clear()
+        for e in entries:
+            self._free_entry(e)
+        for shm in attached:
+            try:
+                shm.close()
+            except OSError:
+                pass

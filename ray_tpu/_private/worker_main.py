@@ -1,0 +1,46 @@
+"""Worker process entrypoint — analog of the reference's
+python/ray/_private/workers/default_worker.py (parse addresses, connect,
+run the task loop :254,:289). Spawned by the conductor's worker pool."""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+def main() -> None:
+    conductor = os.environ["RAY_TPU_CONDUCTOR"]
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+    host, port = conductor.rsplit(":", 1)
+    print(f"[worker {worker_id[:8]}] connecting to conductor {host}:{port}",
+          flush=True)
+
+    from . import worker as worker_mod
+    from .worker import Worker
+
+    w = Worker(mode="worker", conductor_address=(host, int(port)),
+               session_dir=session_dir, worker_id=worker_id)
+    worker_mod.global_worker = w
+    w.conductor.call("register_worker", worker_id, w.address, os.getpid(),
+                     timeout=30.0)
+
+    def _term(signum, frame):
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+
+    # Park the main thread; all work arrives via the RPC server. Exit if the
+    # conductor connection dies (our cluster is gone).
+    while True:
+        time.sleep(1.0)
+        try:
+            if w.conductor._closed:
+                os._exit(0)
+        except Exception:
+            os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""ray_tpu.models: flagship model families, TPU-first.
+
+The reference ships no models of its own (Ray wraps user torch modules);
+the rebuild's north-star workloads (BASELINE.md) need a flagship LM, so
+GPT-2 lives here as a pure-functional JAX implementation with first-class
+sharding rules for every mesh axis the parallel layer exposes.
+"""
+from .gpt2 import (  # noqa: F401
+    GPT2Config,
+    gpt2_forward,
+    gpt2_init,
+    gpt2_loss,
+    gpt2_partition_specs,
+)

@@ -1,0 +1,188 @@
+"""GPT-2 in pure functional JAX, designed for the MXU and named-axis meshes.
+
+The reference's GPT-2 benchmarks wrap HuggingFace torch models in DDP
+(/root/reference/release/air_tests/air_benchmarks/ — workload defs only);
+here the model itself is framework code: a pytree of arrays + jit-able
+forward, with a PartitionSpec tree (`gpt2_partition_specs`) giving the
+megatron-style TP layout (attention and MLP split on the `tp` axis, 2D
+[fsdp, tp] sharding for the big matmuls) so the same function runs dp-only,
+fsdp, tp, or combinations by changing only the mesh.
+
+TPU-first choices: bf16 params/activations by default with fp32 layernorm
+stats (ops.layers), flash attention (ops.attention — Pallas on TPU), weight
+tying for the LM head, static shapes throughout, no python control flow in
+the jitted path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import flash_attention
+from ..ops.layers import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    dtype: Any = jnp.bfloat16
+    # pad vocab up so the embedding matmul tiles cleanly on the MXU / tp axis
+    vocab_pad_multiple: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def padded_vocab(self) -> int:
+        m = self.vocab_pad_multiple
+        return (self.vocab_size + m - 1) // m * m
+
+    @staticmethod
+    def small() -> "GPT2Config":  # 125M — the benchmark flagship
+        return GPT2Config()
+
+    @staticmethod
+    def medium() -> "GPT2Config":
+        return GPT2Config(num_layers=24, num_heads=16, d_model=1024)
+
+    @staticmethod
+    def tiny() -> "GPT2Config":  # test/dry-run size
+        return GPT2Config(vocab_size=512, max_seq_len=128, num_layers=2,
+                          num_heads=4, d_model=128)
+
+
+def gpt2_init(config: GPT2Config, key: jax.Array) -> Params:
+    """Initialize parameters (GPT-2 scheme: N(0, 0.02), residual projections
+    scaled by 1/sqrt(2*n_layers))."""
+    c = config
+    k_iter = iter(jax.random.split(key, 4 + 12 * c.num_layers))
+
+    def norm(k, *shape, scale=0.02):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * scale).astype(c.dtype)
+
+    resid_scale = 0.02 / np.sqrt(2 * c.num_layers)
+    params: Params = {
+        "wte": norm(next(k_iter), c.padded_vocab, c.d_model),
+        "wpe": norm(next(k_iter), c.max_seq_len, c.d_model, scale=0.01),
+        "ln_f": {"scale": jnp.ones(c.d_model, c.dtype),
+                 "bias": jnp.zeros(c.d_model, c.dtype)},
+        "blocks": [],
+    }
+    for _ in range(c.num_layers):
+        params["blocks"].append({
+            "ln_1": {"scale": jnp.ones(c.d_model, c.dtype),
+                     "bias": jnp.zeros(c.d_model, c.dtype)},
+            "attn": {
+                "qkv": norm(next(k_iter), c.d_model, 3 * c.d_model),
+                "qkv_b": jnp.zeros(3 * c.d_model, c.dtype),
+                "proj": norm(next(k_iter), c.d_model, c.d_model,
+                             scale=resid_scale),
+                "proj_b": jnp.zeros(c.d_model, c.dtype),
+            },
+            "ln_2": {"scale": jnp.ones(c.d_model, c.dtype),
+                     "bias": jnp.zeros(c.d_model, c.dtype)},
+            "mlp": {
+                "fc": norm(next(k_iter), c.d_model, 4 * c.d_model),
+                "fc_b": jnp.zeros(4 * c.d_model, c.dtype),
+                "proj": norm(next(k_iter), 4 * c.d_model, c.d_model,
+                             scale=resid_scale),
+                "proj_b": jnp.zeros(c.d_model, c.dtype),
+            },
+        })
+    return params
+
+
+def _block(x: jax.Array, p: Params, config: GPT2Config) -> jax.Array:
+    c = config
+    b, t, _ = x.shape
+    h = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    qkv = jnp.dot(h, p["attn"]["qkv"],
+                  preferred_element_type=jnp.float32).astype(c.dtype)
+    qkv = qkv + p["attn"]["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, c.num_heads, c.head_dim)
+    k = k.reshape(b, t, c.num_heads, c.head_dim)
+    v = v.reshape(b, t, c.num_heads, c.head_dim)
+    a = flash_attention(q, k, v, True).reshape(b, t, c.d_model)
+    a = jnp.dot(a, p["attn"]["proj"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    x = x + a + p["attn"]["proj_b"]
+
+    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    h = jnp.dot(h, p["mlp"]["fc"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    h = jax.nn.gelu(h + p["mlp"]["fc_b"])
+    h = jnp.dot(h, p["mlp"]["proj"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    return x + h + p["mlp"]["proj_b"]
+
+
+def gpt2_forward(params: Params, tokens: jax.Array,
+                 config: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, padded_vocab] (fp32)."""
+    c = config
+    t = tokens.shape[1]
+    x = params["wte"][tokens] + params["wpe"][:t]
+    for p in params["blocks"]:
+        x = _block(x, p, c)
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    # tied LM head
+    return jnp.dot(x, params["wte"].T, preferred_element_type=jnp.float32)
+
+
+def gpt2_loss(params: Params, tokens: jax.Array, targets: jax.Array,
+              config: GPT2Config,
+              remat: bool = False) -> jax.Array:
+    """Mean next-token cross-entropy. Padded-vocab logits are masked."""
+    fwd = gpt2_forward
+    if remat:
+        fwd = jax.checkpoint(gpt2_forward, static_argnums=(2,))
+    logits = fwd(params, tokens, config)
+    if config.padded_vocab != config.vocab_size:
+        neg = jnp.full((config.padded_vocab - config.vocab_size,), -1e30,
+                       dtype=logits.dtype)
+        logits = logits.at[..., config.vocab_size:].set(neg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def gpt2_partition_specs(config: GPT2Config) -> Params:
+    """PartitionSpec tree for the params: megatron TP layout with fsdp on
+    the other matmul dimension. With tp=1/fsdp=1 every spec collapses to
+    replicated, so one tree serves all mesh shapes."""
+    block = {
+        "ln_1": {"scale": P(), "bias": P()},
+        "attn": {
+            "qkv": P("fsdp", "tp"),     # column-parallel
+            "qkv_b": P("tp"),
+            "proj": P("tp", "fsdp"),    # row-parallel
+            "proj_b": P(),
+        },
+        "ln_2": {"scale": P(), "bias": P()},
+        "mlp": {
+            "fc": P("fsdp", "tp"),      # column-parallel
+            "fc_b": P("tp"),
+            "proj": P("tp", "fsdp"),    # row-parallel
+            "proj_b": P(),
+        },
+    }
+    return {
+        "wte": P("tp", "fsdp"),
+        "wpe": P(None, "fsdp"),
+        "ln_f": {"scale": P(), "bias": P()},
+        "blocks": [block for _ in range(config.num_layers)],
+    }

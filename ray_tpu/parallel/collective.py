@@ -1,0 +1,332 @@
+"""Host-level collective groups over the conductor control plane.
+
+API surface mirrors the reference's ray.util.collective
+(/root/reference/python/ray/util/collective/collective.py —
+init_collective_group :120, create_collective_group :151, allreduce :258,
+barrier :298, reduce :329, broadcast :373, allgather :423, reducescatter
+:472, send :531, recv :594). The reference backs these with cupy-NCCL /
+pygloo groups rendezvoused through a named NCCLUniqueIDStore actor
+(collective_group/nccl_collective_group.py:28-50).
+
+TPU-native split (SURVEY.md §5.8): tensors that live on device move inside
+jitted programs via XLA collectives over ICI/DCN — there is no out-of-band
+device channel to manage. What remains for a host API is *small host-side
+state* (metrics, rendezvous payloads, eval aggregates), so the backend here
+is the conductor's KV store: every rank in a group executes the same
+sequence of collective calls; per-call sequence numbers key the KV slots,
+rank 0 performs reductions, and slots are acknowledged + garbage-collected.
+This trades bandwidth for zero extra moving parts — exactly right for the
+control-plane payloads this API is for.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_NS = "collective"
+_POLL_S = 0.002
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: _tree_reduce(np.add, xs),
+    ReduceOp.PRODUCT: lambda xs: _tree_reduce(np.multiply, xs),
+    ReduceOp.MIN: lambda xs: _tree_reduce(np.minimum, xs),
+    ReduceOp.MAX: lambda xs: _tree_reduce(np.maximum, xs),
+}
+
+
+def _tree_reduce(op, xs: List[Any]):
+    out = xs[0]
+    for x in xs[1:]:
+        out = op(out, x)
+    return out
+
+
+def _kv():
+    from .._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called before collectives")
+    return w.conductor
+
+
+def _put(key: str, value: Any) -> None:
+    _kv().call("kv_put", key.encode(), pickle.dumps(value, protocol=5), True,
+               _NS, timeout=None)
+
+
+def _get_blocking(key: str, timeout: Optional[float] = None) -> Any:
+    deadline = None if timeout is None else time.monotonic() + timeout
+    kv = _kv()
+    poll = _POLL_S
+    while True:
+        raw = kv.call("kv_get", key.encode(), _NS, timeout=None)
+        if raw is not None:
+            return pickle.loads(raw)
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(f"collective key {key} not produced in time")
+        time.sleep(poll)
+        poll = min(poll * 1.5, 0.05)
+
+
+def _del(key: str) -> None:
+    _kv().call("kv_del", key.encode(), _NS, timeout=None)
+
+
+@dataclass
+class _Group:
+    name: str
+    world_size: int
+    rank: int
+    seq: int = 0
+    p2p_seq: Dict[tuple, int] = field(default_factory=dict)
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+
+_groups: Dict[str, _Group] = {}
+_groups_lock = threading.Lock()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "kv",
+                          group_name: str = "default") -> None:
+    """Join `group_name` as `rank` of `world_size` (reference
+    collective.py:120). Blocks until every rank has joined."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized")
+        _groups[group_name] = _Group(group_name, world_size, rank)
+    _put(f"{group_name}/join/{rank}", True)
+    for r in range(world_size):
+        _get_blocking(f"{group_name}/join/{r}")
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend: str = "kv",
+                            group_name: str = "default"):
+    """Declarative variant (reference collective.py:151): tell each actor to
+    join the group, driver-side."""
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have equal length")
+    refs = [a.ray_tpu_collective_init.remote(world_size, r, backend,
+                                                group_name)
+            for a, r in zip(actors, ranks)]
+    from .. import get as ray_get
+
+    ray_get(refs)
+
+
+class CollectiveActorMixin:
+    """Mix into actor classes used with create_collective_group (gives the
+    driver a hook method to make the actor join the group)."""
+
+    def ray_tpu_collective_init(self, world_size: int, rank: int,
+                                    backend: str, group_name: str) -> bool:
+        init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        kv = _kv()
+        for key in kv.call("kv_keys", f"{group_name}/".encode(), _NS,
+                           timeout=None):
+            kv.call("kv_del", key, _NS, timeout=None)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _groups_lock:
+        return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def _group(group_name: str) -> _Group:
+    with _groups_lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(f"collective group {group_name!r} not initialized "
+                           "in this process")
+    return g
+
+
+def _gather_to_root(g: _Group, seq: int, value: Any, root: int = 0
+                    ) -> Optional[List[Any]]:
+    """Every rank contributes; root returns the rank-ordered list."""
+    _put(f"{g.name}/{seq}/in/{g.rank}", value)
+    if g.rank != root:
+        return None
+    vals = [_get_blocking(f"{g.name}/{seq}/in/{r}")
+            for r in range(g.world_size)]
+    for r in range(g.world_size):
+        _del(f"{g.name}/{seq}/in/{r}")
+    return vals
+
+
+def _bcast_from_root(g: _Group, seq: int, value: Any, root: int = 0) -> Any:
+    """Root publishes; everyone reads; root GCs after all acks."""
+    if g.rank == root:
+        _put(f"{g.name}/{seq}/out", value)
+        out = value
+    else:
+        out = _get_blocking(f"{g.name}/{seq}/out")
+    _put(f"{g.name}/{seq}/ack/{g.rank}", True)
+    if g.rank == root:
+        for r in range(g.world_size):
+            _get_blocking(f"{g.name}/{seq}/ack/{r}")
+        for r in range(g.world_size):
+            _del(f"{g.name}/{seq}/ack/{r}")
+        _del(f"{g.name}/{seq}/out")
+    return out
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    """Reference collective.py:258. Returns the reduced array (the reference
+    mutates in place; numpy inputs here are written in place too when
+    possible)."""
+    g = _group(group_name)
+    seq = g.next_seq()
+    vals = _gather_to_root(g, seq, np.asarray(tensor))
+    reduced = _REDUCERS[op](vals) if vals is not None else None
+    out = _bcast_from_root(g, seq, reduced)
+    try:
+        np.copyto(tensor, out)
+    except (TypeError, ValueError):
+        pass
+    return out
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    """Reference collective.py:329 — result only meaningful on dst_rank."""
+    g = _group(group_name)
+    seq = g.next_seq()
+    vals = _gather_to_root(g, seq, np.asarray(tensor), root=0)
+    reduced = _REDUCERS[op](vals) if vals is not None else None
+    # root 0 computes; ship to dst via the broadcast slot, all ranks sync.
+    out = _bcast_from_root(g, seq, reduced)
+    if g.rank == dst_rank:
+        try:
+            np.copyto(tensor, out)
+        except (TypeError, ValueError):
+            pass
+        return out
+    return tensor
+
+
+def barrier(group_name: str = "default") -> None:
+    """Reference collective.py:298."""
+    g = _group(group_name)
+    seq = g.next_seq()
+    _gather_to_root(g, seq, True)
+    _bcast_from_root(g, seq, True)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Reference collective.py:373."""
+    g = _group(group_name)
+    seq = g.next_seq()
+    if g.rank == src_rank:
+        _put(f"{g.name}/{seq}/out", np.asarray(tensor))
+        out = np.asarray(tensor)
+    else:
+        out = _get_blocking(f"{g.name}/{seq}/out")
+        try:
+            np.copyto(tensor, out)
+        except (TypeError, ValueError):
+            pass
+    _put(f"{g.name}/{seq}/ack/{g.rank}", True)
+    if g.rank == src_rank:
+        for r in range(g.world_size):
+            _get_blocking(f"{g.name}/{seq}/ack/{r}")
+        for r in range(g.world_size):
+            _del(f"{g.name}/{seq}/ack/{r}")
+        _del(f"{g.name}/{seq}/out")
+    return out
+
+
+def allgather(tensor_list: Optional[list], tensor,
+              group_name: str = "default") -> list:
+    """Reference collective.py:423: gathers every rank's tensor to all
+    ranks. Returns the rank-ordered list (also written into tensor_list)."""
+    g = _group(group_name)
+    seq = g.next_seq()
+    vals = _gather_to_root(g, seq, np.asarray(tensor))
+    out = _bcast_from_root(g, seq, vals)
+    if tensor_list is not None:
+        tensor_list[:] = out
+    return out
+
+
+def reducescatter(tensor, tensor_list: Optional[list] = None,
+                  group_name: str = "default", op: str = ReduceOp.SUM):
+    """Reference collective.py:472: reduce a list of world_size tensors and
+    scatter one shard per rank. `tensor_list` is this rank's contribution
+    (world_size chunks); the reduced chunk for this rank is returned (and
+    copied into `tensor`)."""
+    g = _group(group_name)
+    if tensor_list is None:
+        tensor_list = list(np.array_split(np.asarray(tensor), g.world_size))
+    seq = g.next_seq()
+    vals = _gather_to_root(g, seq, [np.asarray(t) for t in tensor_list])
+    if vals is not None:
+        reduced = [_REDUCERS[op]([v[i] for v in vals])
+                   for i in range(g.world_size)]
+    else:
+        reduced = None
+    chunks = _bcast_from_root(g, seq, reduced)
+    out = chunks[g.rank]
+    try:
+        np.copyto(tensor, out)
+    except (TypeError, ValueError):
+        pass
+    return out
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Reference collective.py:531 — point-to-point."""
+    g = _group(group_name)
+    key = (g.rank, dst_rank)
+    seq = g.p2p_seq[key] = g.p2p_seq.get(key, 0) + 1
+    _put(f"{g.name}/p2p/{g.rank}->{dst_rank}/{seq}", np.asarray(tensor))
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    """Reference collective.py:594."""
+    g = _group(group_name)
+    key = (src_rank, g.rank)
+    seq = g.p2p_seq[key] = g.p2p_seq.get(key, 0) + 1
+    out = _get_blocking(f"{g.name}/p2p/{src_rank}->{g.rank}/{seq}")
+    _del(f"{g.name}/p2p/{src_rank}->{g.rank}/{seq}")
+    try:
+        np.copyto(tensor, out)
+    except (TypeError, ValueError):
+        pass
+    return out

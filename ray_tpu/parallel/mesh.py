@@ -1,0 +1,104 @@
+"""Named-axis device mesh construction.
+
+The reference expresses multi-worker layout with placement groups +
+`TPU-v4-8-head`-style resources (python/ray/_private/accelerators/tpu.py:75)
+and leaves intra-model parallelism to whatever the user wraps (SURVEY.md
+§2.3: only DP exists natively). Here the mesh IS the first-class object:
+every parallelism strategy (dp/fsdp/pp/tp/sp/ep) is a named axis of one
+`jax.sharding.Mesh`, XLA inserts the collectives, and ICI/DCN placement
+falls out of device order (`mesh_utils.create_device_mesh` optimizes
+axis-to-torus assignment on real TPU slices).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order: data-like axes outermost (cross-slice / DCN friendly),
+# model axes innermost (ICI-bandwidth hungry: tp/sp want nearest neighbors).
+MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each named axis; -1 on exactly one axis means "fill with
+    the remaining devices" (like torch DeviceMesh / GSPMD conventions)."""
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    def sizes(self, n_devices: int) -> Dict[str, int]:
+        vals = {f.name: getattr(self, f.name) for f in fields(self)}
+        fill = [k for k, v in vals.items() if v == -1]
+        if len(fill) > 1:
+            raise ValueError(f"only one axis may be -1, got {fill}")
+        fixed = 1
+        for k, v in vals.items():
+            if v != -1:
+                if v <= 0:
+                    raise ValueError(f"axis {k} must be positive or -1, got {v}")
+                fixed *= v
+        if fill:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            vals[fill[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh axes product {fixed} != device count {n_devices}")
+        return {k: vals[k] for k in MESH_AXES}
+
+    def build(self, devices: Optional[Sequence[Any]] = None) -> Mesh:
+        return make_mesh(self, devices)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[Any]] = None,
+              **axis_sizes: int) -> Mesh:
+    """Build a `jax.sharding.Mesh` with canonical named axes.
+
+    make_mesh(MeshConfig(dp=2, tp=4))  or  make_mesh(dp=2, tp=4).
+    On TPU hardware, device order is topology-optimized so the innermost
+    axes land on ICI nearest-neighbor rings.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis kwargs, not both")
+    if devices is None:
+        devices = jax.devices()
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices, dtype=object).ravel())
+    except (ValueError, AssertionError, NotImplementedError):
+        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """Shorthand: named_sharding(mesh, 'dp', None) ==
+    NamedSharding(mesh, PartitionSpec('dp', None))."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def host_local_array_to_global(mesh: Mesh, spec: P, host_arrays):
+    """Assemble per-host shards into a global jax.Array (multi-host path;
+    analog of the reference relying on torch DDP to scatter). Single-host:
+    jax.device_put with the target sharding."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(host_arrays, sharding)
+    return jax.make_array_from_process_local_data(sharding, host_arrays)

@@ -1,0 +1,52 @@
+"""@remote functions — analog of the reference's
+python/ray/remote_function.py (RemoteFunction._remote :266): wrap a callable,
+give it `.remote()` returning ObjectRefs, and `.options()` for per-call
+overrides."""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ._private import worker as worker_mod
+from ._private.worker import DEFAULT_MAX_RETRIES
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__} cannot be called directly; "
+            f"use {self._fn.__name__}.remote(...)")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        opts = dict(self._options)
+        opts.update(overrides)
+        return RemoteFunction(self._fn, opts)
+
+    def remote(self, *args, **kwargs):
+        w = worker_mod.global_worker
+        if w is None:
+            raise RuntimeError("ray_tpu.init() must be called first")
+        o = self._options
+        resources = dict(o.get("resources") or {})
+        if o.get("num_cpus") is not None:
+            resources["CPU"] = float(o["num_cpus"])
+        if o.get("num_tpus") is not None:
+            resources["TPU"] = float(o["num_tpus"])
+        pg = o.get("placement_group")
+        pg_id = getattr(pg, "id", pg) if pg is not None else None
+        return w.submit_task(
+            self._fn, args, kwargs,
+            name=o.get("name") or self._fn.__name__,
+            num_returns=int(o.get("num_returns", 1)),
+            resources=resources,
+            max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
+            placement_group_id=pg_id)
+
+    @property
+    def underlying_function(self):
+        return self._fn

@@ -1,0 +1,27 @@
+"""ray_tpu.train: distributed training on TPU meshes.
+
+Capability surface of Ray Train (reference python/ray/train/ — SURVEY.md
+§2.4, §3.4): a trainer that gang-schedules a worker group, a session API
+(`report`, `get_checkpoint`, `get_dataset_shard`), checkpoint management,
+and config dataclasses. TPU-native twist: the "backend" is not an NCCL
+process group (train/torch/config.py:64-117) but a named-axis jax Mesh;
+intra-step communication is XLA collectives, so the trainer's job reduces
+to placement + rendezvous + fault tolerance + checkpoint/report plumbing —
+and, single-controller SPMD on one host, running the jitted step over all
+local chips directly.
+"""
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+    ShardingConfig,
+)
+from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from .trainer import JaxTrainer, Result, TrainStep  # noqa: F401

@@ -1,0 +1,172 @@
+"""Checkpointing: directory-based `Checkpoint` + top-K `CheckpointManager`.
+
+API mirrors the reference's ray.train.Checkpoint
+(python/ray/air/_internal + train/_internal/checkpoint_manager.py —
+SURVEY.md §5.4): a checkpoint is a directory; managers keep top-K by a
+score attribute. Pytree save/load is numpy-backed (`save_pytree` /
+`load_pytree`) with a tensorstore/orbax escape hatch deliberately avoided
+for the host-local path: one .npz + one pickle of treedef is faster to
+restore for flagship-model sizes and has no async machinery to misuse.
+Device arrays are pulled to host (jax.device_get) at save; `load_pytree`
+returns numpy — callers re-shard with device_put/make_array (the mesh may
+differ across restarts, the elastic story per SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class Checkpoint:
+    """A directory of files (reference ray.train.Checkpoint)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            pickle.dump(data, f, protocol=5)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            return self.path
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def __repr__(self) -> str:
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
+    """Flatten a pytree of arrays to {name}.npz + {name}.tree.pkl."""
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    host_leaves = [np.asarray(jax.device_get(x)) for x in leaves]
+    arrays = {f"leaf_{i}": a for i, a in enumerate(host_leaves)}
+    tmp = os.path.join(directory, f".{name}.npz.tmp")
+    np.savez(tmp, **arrays)
+    os.replace(tmp, os.path.join(directory, f"{name}.npz"))
+    with open(os.path.join(directory, f"{name}.tree.pkl"), "wb") as f:
+        pickle.dump(treedef, f, protocol=5)
+
+
+def load_pytree(directory: str, name: str = "state") -> Any:
+    with open(os.path.join(directory, f"{name}.tree.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    data = np.load(os.path.join(directory, f"{name}.npz"), allow_pickle=False)
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+@dataclass(order=True)
+class _Tracked:
+    score: float
+    index: int
+    checkpoint: Checkpoint = field(compare=False)
+    metrics: Dict[str, Any] = field(compare=False, default_factory=dict)
+
+
+class CheckpointManager:
+    """Keeps top-K checkpoints by metric under a root dir (reference
+    train/_internal/checkpoint_manager.py driven by CheckpointConfig)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.root = root
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: List[_Tracked] = []
+        self._index = 0
+        os.makedirs(root, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
+        """Move `checkpoint` under the managed root and apply retention."""
+        metrics = dict(metrics or {})
+        dst = os.path.join(self.root, f"checkpoint_{self._index:06d}")
+        if checkpoint.path != dst:
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            # same-filesystem rename when possible, else copy
+            try:
+                os.replace(checkpoint.path, dst)
+            except OSError:
+                shutil.copytree(checkpoint.path, dst)
+                shutil.rmtree(checkpoint.path, ignore_errors=True)
+        ckpt = Checkpoint(dst)
+        with open(os.path.join(dst, "metrics.json"), "w") as f:
+            json.dump(_json_safe(metrics), f)
+        if self.score_attribute and self.score_attribute in metrics:
+            score = float(metrics[self.score_attribute])
+            if self.score_order == "min":
+                score = -score
+        else:
+            score = float(self._index)  # fall back to recency
+        self._tracked.append(_Tracked(score, self._index, ckpt, metrics))
+        self._index += 1
+        self._apply_retention()
+        return ckpt
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None:
+            return
+        while len(self._tracked) > self.num_to_keep:
+            worst = min(self._tracked)
+            self._tracked.remove(worst)
+            shutil.rmtree(worst.checkpoint.path, ignore_errors=True)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked).checkpoint
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=lambda t: t.index).checkpoint
+
+    def list_checkpoints(self) -> List[Tuple[Checkpoint, Dict[str, Any]]]:
+        return [(t.checkpoint, t.metrics)
+                for t in sorted(self._tracked, key=lambda t: t.index)]
+
+
+def _json_safe(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, (np.floating, np.integer)):
+            out[k] = v.item()
+        elif isinstance(v, (int, float, str, bool, type(None))):
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out

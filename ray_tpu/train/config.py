@@ -1,0 +1,73 @@
+"""Config dataclasses — API surface of the reference's
+python/ray/air/config.py (ScalingConfig/RunConfig/CheckpointConfig/
+FailureConfig) plus the TPU-native ShardingConfig the reference cannot
+express (SURVEY.md §2.3: reference parallelism is DP-only; TP/PP/SP/EP
+delegated to wrapped frameworks)."""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """Reference air/config.py ScalingConfig: num_workers + resources.
+    Here: worker processes for host-side work; chips belong to the mesh."""
+
+    num_workers: int = 1
+    use_tpu: bool = True
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ShardingConfig:
+    """Named mesh axis sizes (new capability; -1 fills remaining devices).
+    Maps 1:1 onto parallel.MeshConfig."""
+
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+    remat: bool = False  # jax.checkpoint the model forward
+
+    def mesh_config(self):
+        from ..parallel.mesh import MeshConfig
+
+        return MeshConfig(dp=self.dp, fsdp=self.fsdp, pp=self.pp,
+                          sp=self.sp, ep=self.ep, tp=self.tp)
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference air/config.py CheckpointConfig (keep top-K by metric)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class FailureConfig:
+    """Reference air/config.py FailureConfig."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class RunConfig:
+    """Reference air/config.py RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        return os.path.join(base, self.name or "experiment")

@@ -1,0 +1,83 @@
+"""Train session: the API a `train_fn` sees while running under a trainer.
+
+Mirrors the reference's _TrainSession
+(python/ray/train/_internal/session.py — report :661, get_checkpoint :748,
+get_dataset_shard :1054) with the same thread-local access pattern:
+`ray_tpu.train.report(metrics, checkpoint=...)` from anywhere inside the
+training function.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+@dataclass
+class TrainContext:
+    world_size: int = 1
+    rank: int = 0
+    experiment_name: str = "default"
+    trial_dir: str = ""
+    dataset_shards: Dict[str, Any] = field(default_factory=dict)
+    latest_checkpoint: Optional[Checkpoint] = None
+    # set by the trainer: called with (metrics, checkpoint)
+    _report_fn: Optional[Callable[[Dict[str, Any], Optional[Checkpoint]],
+                                  None]] = None
+    _stop_requested: bool = False
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+def _set_session(ctx: Optional[TrainContext]) -> None:
+    _local.ctx = ctx
+
+
+def _get_session() -> Optional[TrainContext]:
+    return getattr(_local, "ctx", None)
+
+
+def get_context() -> TrainContext:
+    ctx = _get_session()
+    if ctx is None:
+        raise RuntimeError("No train session active — call inside a "
+                           "train_fn run by JaxTrainer/Tuner")
+    return ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Reference session.py:661. Reports metrics (and optionally a
+    checkpoint) to the controlling trainer/tuner. Raises StopIteration-like
+    control via the trainer if the trial was stopped (e.g. by a scheduler)."""
+    ctx = get_context()
+    if ctx._report_fn is not None:
+        ctx._report_fn(dict(metrics), checkpoint)
+    if ctx._stop_requested:
+        raise StopTrial()
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Reference session.py:748 — resume checkpoint, if any."""
+    return get_context().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """Reference session.py:1054 — this worker's dataset shard."""
+    return get_context().dataset_shards.get(name)
+
+
+class StopTrial(Exception):
+    """Raised inside train_fn when the controller stops the trial (analog
+    of the reference's session-finish control flow)."""

@@ -1,0 +1,306 @@
+"""JaxTrainer: the Train-equivalent (reference TorchTrainer →
+DataParallelTrainer → BackendExecutor → WorkerGroup, SURVEY.md §3.4),
+redesigned for single-controller SPMD on TPU meshes.
+
+Where the reference runs N actor processes each owning one GPU and
+rendezvousing an NCCL group (train/torch/config.py:64-117), a TPU host
+drives all its chips from one process and XLA owns the collectives; the
+N-process shape only reappears across hosts. So:
+
+- mode="spmd" (default): train_fn runs in-process against the global mesh
+  built from ShardingConfig. Zero serialization on the step path; the
+  trainer contributes session plumbing (report/checkpoint/datasets),
+  retention, and failure retries from the last checkpoint.
+- mode="workers": ScalingConfig.num_workers actor processes (gang-placed
+  via a STRICT_PACK placement group) each run train_fn with
+  rank/world_size, mirroring BackendExecutor.start_training
+  (backend_executor.py:427) for host-side (CPU) data/eval work and
+  multi-host topologies. Worker reports stream back to the driver through
+  the actor channel; rank 0's checkpoints win (reference semantics).
+
+TrainStep builds the jitted SPMD update: shard params by the model's
+PartitionSpec tree, batch by ('dp','fsdp'), donate the state, and let XLA
+insert psum/reduce-scatter — the step the reference delegates to torch DDP
+(train_loop_utils.py:158 prepare_model).
+"""
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                     ScalingConfig, ShardingConfig)
+from .session import StopTrial, TrainContext, _set_session
+
+
+@dataclass
+class Result:
+    """Reference air/result.py Result."""
+
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[BaseException] = None
+    path: str = ""
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class TrainStep:
+    """Jitted SPMD train step over a mesh.
+
+    loss_fn(params, batch) -> scalar; optimizer is an optax
+    GradientTransformation. param_specs is a PartitionSpec pytree matching
+    params (e.g. models.gpt2_partition_specs); data axes default to
+    ('dp','fsdp') batch sharding.
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, mesh: Mesh,
+                 param_specs: Any, data_spec: P = P(("dp", "fsdp"))):
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.param_specs = param_specs
+        self.data_spec = data_spec
+
+        def step(state, batch):
+            def loss_of(p):
+                return loss_fn(p, batch)
+
+            loss, grads = jax.value_and_grad(loss_of)(state["params"])
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], state["params"])
+            import optax
+
+            params = optax.apply_updates(state["params"], updates)
+            new_state = {"params": params, "opt_state": opt_state,
+                         "step": state["step"] + 1}
+            return new_state, {"loss": loss}
+
+        self._step = step
+        self._jitted = None
+
+    def init_state(self, params: Any) -> Dict[str, Any]:
+        """Shard params onto the mesh and build optimizer state with
+        matching sharding (optimizer moments inherit the param layout)."""
+        params = jax.device_put(params, self._shardings(self.param_specs))
+        with self.mesh:
+            opt_state = jax.jit(
+                self.optimizer.init,
+                in_shardings=(self._shardings(self.param_specs),))(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jax.device_put(np.int64(0))}
+
+    def _shardings(self, spec_tree):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def __call__(self, state, batch):
+        if self._jitted is None:
+            batch_sh = jax.tree.map(
+                lambda _: NamedSharding(self.mesh, self.data_spec), batch)
+            self._jitted = jax.jit(self._step, donate_argnums=(0,),
+                                   in_shardings=(None, batch_sh),
+                                   )
+        batch = jax.device_put(
+            batch, jax.tree.map(
+                lambda _: NamedSharding(self.mesh, self.data_spec), batch))
+        with self.mesh:
+            return self._jitted(state, batch)
+
+
+class JaxTrainer:
+    """fit() runs train_fn under a session (reference BaseTrainer.fit,
+    base_trainer.py:567)."""
+
+    def __init__(self, train_fn: Callable[[Dict[str, Any]], None], *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 sharding_config: Optional[ShardingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 mode: str = "spmd"):
+        self.train_fn = train_fn
+        self.train_loop_config = dict(train_loop_config or {})
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.sharding_config = sharding_config or ShardingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = dict(datasets or {})
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.mode = mode
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self) -> Result:
+        storage = self.run_config.resolved_storage_path()
+        os.makedirs(storage, exist_ok=True)
+        cc = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(storage, "checkpoints"),
+            num_to_keep=cc.num_to_keep,
+            score_attribute=cc.checkpoint_score_attribute,
+            score_order=cc.checkpoint_score_order)
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        latest = self.resume_from_checkpoint
+        while True:
+            try:
+                if self.mode == "workers" and \
+                        self.scaling_config.num_workers > 1:
+                    result = self._fit_workers(manager, latest, storage)
+                else:
+                    result = self._fit_spmd(manager, latest, storage)
+                result.path = storage
+                return result
+            except BaseException as e:  # noqa: BLE001
+                attempt += 1
+                latest = manager.latest_checkpoint or latest
+                if max_failures >= 0 and attempt > max_failures:
+                    return Result(error=e, checkpoint=latest, path=storage,
+                                  metrics={})
+                # elastic story = checkpoint-restart (SURVEY.md §7):
+                # re-run train_fn from the newest checkpoint.
+
+    # ----------------------------------------------------------- spmd mode
+
+    def _fit_spmd(self, manager: CheckpointManager,
+                  latest: Optional[Checkpoint], storage: str) -> Result:
+        history: List[Dict[str, Any]] = []
+        last_metrics: Dict[str, Any] = {}
+
+        def report_fn(metrics: Dict[str, Any],
+                      checkpoint: Optional[Checkpoint]) -> None:
+            nonlocal last_metrics
+            metrics = dict(metrics)
+            metrics.setdefault("_time", time.time())
+            history.append(metrics)
+            last_metrics = metrics
+            if checkpoint is not None:
+                manager.register(checkpoint, metrics)
+
+        ctx = TrainContext(
+            world_size=1, rank=0,
+            experiment_name=self.run_config.name or "default",
+            trial_dir=storage,
+            dataset_shards=self._shard_datasets(0, 1),
+            latest_checkpoint=latest,
+            _report_fn=report_fn)
+        cfg = dict(self.train_loop_config)
+        cfg["sharding_config"] = self.sharding_config
+        _set_session(ctx)
+        try:
+            self.train_fn(cfg)
+        except StopTrial:
+            pass
+        finally:
+            _set_session(None)
+        return Result(metrics=last_metrics,
+                      checkpoint=manager.best_checkpoint
+                      or manager.latest_checkpoint or latest,
+                      metrics_history=history)
+
+    # --------------------------------------------------------- worker mode
+
+    def _fit_workers(self, manager: CheckpointManager,
+                     latest: Optional[Checkpoint], storage: str) -> Result:
+        import ray_tpu
+
+        n = self.scaling_config.num_workers
+        bundles = [dict(self.scaling_config.resources_per_worker or
+                        {"CPU": 1.0}) for _ in range(n)]
+        from ..util.placement_group import placement_group, \
+            remove_placement_group
+
+        pg = placement_group(bundles, strategy="STRICT_PACK")
+        pg.wait()
+
+        @ray_tpu.remote
+        class _TrainWorker:
+            """One rank of the group (reference WorkerGroup worker,
+            _internal/worker_group.py:102)."""
+
+            def __init__(self, rank: int, world: int):
+                self.rank, self.world = rank, world
+                self.reports: List[Any] = []
+
+            def run(self, fn_bytes: bytes, cfg: Dict[str, Any],
+                    trial_dir: str, shards: Dict[str, Any],
+                    latest_path: Optional[str]) -> List[Any]:
+                from ray_tpu._private import serialization
+                from ray_tpu.train.session import (TrainContext,
+                                                   _set_session, StopTrial)
+                from ray_tpu.train.checkpoint import Checkpoint as Ckpt
+
+                fn = serialization.loads(fn_bytes)
+                out: List[Any] = []
+
+                def report_fn(metrics, checkpoint):
+                    out.append((metrics,
+                                checkpoint.path if checkpoint else None))
+
+                ctx = TrainContext(
+                    world_size=self.world, rank=self.rank,
+                    trial_dir=trial_dir, dataset_shards=shards,
+                    latest_checkpoint=(Ckpt(latest_path)
+                                       if latest_path else None),
+                    _report_fn=report_fn)
+                _set_session(ctx)
+                try:
+                    fn(cfg)
+                except StopTrial:
+                    pass
+                finally:
+                    _set_session(None)
+                return out
+
+        from .._private import serialization
+
+        fn_bytes = serialization.dumps(self.train_fn)
+        cfg = dict(self.train_loop_config)
+        cfg["sharding_config"] = self.sharding_config
+        workers = [_TrainWorker.options(placement_group=pg)
+                   .remote(rank=i, world=n) for i in range(n)]
+        try:
+            refs = [w.run.remote(
+                fn_bytes, cfg, storage, self._shard_datasets(i, n),
+                latest.path if latest else None)
+                for i, w in enumerate(workers)]
+            all_reports = ray_tpu.get(refs)
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            remove_placement_group(pg)
+        history, last_metrics = [], {}
+        for metrics, ckpt_path in all_reports[0]:  # rank 0 wins
+            history.append(metrics)
+            last_metrics = metrics
+            if ckpt_path:
+                manager.register(Checkpoint(ckpt_path), metrics)
+        return Result(metrics=last_metrics,
+                      checkpoint=manager.best_checkpoint
+                      or manager.latest_checkpoint or latest,
+                      metrics_history=history)
+
+    # ------------------------------------------------------------ datasets
+
+    def _shard_datasets(self, rank: int, world: int) -> Dict[str, Any]:
+        shards: Dict[str, Any] = {}
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                shards[name] = ds.streaming_split(world)[rank]
+            elif world > 1 and hasattr(ds, "__getitem__"):
+                shards[name] = ds[rank::world]
+            else:
+                shards[name] = ds
+        return shards

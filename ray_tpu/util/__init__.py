@@ -1,0 +1,8 @@
+"""ray_tpu.util: distributed utilities layered on the core API (reference
+python/ray/util/ — SURVEY.md §2.3)."""
+from .actor_pool import ActorPool  # noqa: F401
+from .placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)

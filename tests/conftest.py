@@ -1,0 +1,53 @@
+"""Test fixtures — analog of the reference's python/ray/tests/conftest.py
+(ray_start_regular / ray_start_cluster built on cluster_utils.Cluster).
+
+TPU-specific: JAX tests run on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8), the unit-test analog of
+the reference's fake-GPU mode (SURVEY.md §4)."""
+from __future__ import annotations
+
+import os
+
+# The axon sitecustomize force-sets JAX_PLATFORMS, so env vars alone are
+# not enough: set XLA_FLAGS before backend init, then override the platform
+# through jax.config (wins regardless of env).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Module-scoped cluster for cheap tests."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    """8-device CPU mesh for sharding tests."""
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, f"expected >=8 virtual cpu devices, got {devices}"
+    yield devices[:8]

@@ -1,0 +1,161 @@
+"""Core task/object API tests — modeled on the reference's
+python/ray/tests/test_basic.py coverage areas."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_numpy_large(ray_start_regular):
+    x = np.arange(1_000_000, dtype=np.float32)  # 4 MB -> shm path
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    a = ray_tpu.put(10)
+    b = add.remote(a, 5)
+    c = add.remote(b, ray_tpu.put(1))
+    assert ray_tpu.get(c) == 16
+
+
+def test_task_large_result(ray_start_regular):
+    @ray_tpu.remote
+    def make(n):
+        return np.ones(n, dtype=np.float64)
+
+    ref = make.remote(500_000)  # 4 MB
+    out = ray_tpu.get(ref)
+    assert out.shape == (500_000,)
+    assert float(out.sum()) == 500_000.0
+
+
+def test_many_parallel_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_task_exception_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(exc.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert isinstance(ei.value.cause, ValueError)
+    assert "kaboom" in str(ei.value)
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_wait_timeout_empty(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], timeout=0.2)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.3)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+
+        return rt.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_task_passing_ref_between_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def produce():
+        return np.full(300_000, 7.0)  # large -> stays on producer worker
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr[0]) + float(arr[-1])
+
+    out = consume.remote(produce.remote())
+    assert ray_tpu.get(out) == 14.0
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU") == 4.0
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2, name="custom").remote()) == 1
+
+
+def test_reinit_error(ray_start_regular):
+    with pytest.raises(RuntimeError):
+        ray_tpu.init()
+    ray_tpu.init(ignore_reinit_error=True)
