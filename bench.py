@@ -1,0 +1,80 @@
+"""Headline benchmark: GPT-2 125M training throughput, tokens/sec/chip.
+
+Runs the full JaxTrainer TrainStep (fwd+bwd+adamw, donated state, bf16
+params, flash attention) on all local devices with a dp mesh, and prints
+ONE JSON line {metric, value, unit, vs_baseline}.
+
+Baseline: the reference has no in-repo absolute numbers (BASELINE.md —
+nightly metrics go to an external DB); the north-star is "within 1.3x of
+Ray+NCCL+A100" on GPT-2 125M DDP. We take 140k tokens/sec/chip as the
+A100-class reference point (bf16+flash-attention GPT-2 124M DDP, public
+nanoGPT-scale numbers), so vs_baseline = measured / 140000.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REF_TOKENS_PER_SEC_PER_CHIP = 140_000.0
+
+
+def main() -> None:
+    import optax
+
+    from ray_tpu.models.gpt2 import (GPT2Config, gpt2_init, gpt2_loss,
+                                     gpt2_partition_specs)
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+    from ray_tpu.train.trainer import TrainStep
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = GPT2Config.small() if on_tpu else GPT2Config.tiny()
+    seq = cfg.max_seq_len if on_tpu else 64
+    per_chip_batch = 16 if on_tpu else 2
+    warmup, iters = (5, 30) if on_tpu else (2, 5)
+
+    devices = jax.devices()
+    mesh = make_mesh(MeshConfig(dp=-1), devices=devices)
+    n_chips = len(devices)
+
+    step = TrainStep(
+        lambda p, b: gpt2_loss(p, b["tokens"], b["targets"], cfg),
+        optax.adamw(3e-4, weight_decay=0.1), mesh,
+        gpt2_partition_specs(cfg))
+    state = step.init_state(gpt2_init(cfg, jax.random.PRNGKey(0)))
+
+    rng = np.random.default_rng(0)
+    batch_np = rng.integers(
+        0, cfg.vocab_size, (per_chip_batch * n_chips, seq + 1),
+        dtype=np.int32)
+    batch = {"tokens": jnp.asarray(batch_np[:, :-1]),
+             "targets": jnp.asarray(batch_np[:, 1:])}
+    tokens_per_step = per_chip_batch * n_chips * seq
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tok_per_sec_per_chip = tokens_per_step * iters / dt / n_chips
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip" if on_tpu
+        else f"gpt2_tiny_train_tokens_per_sec_per_chip_{platform}",
+        "value": round(tok_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tok_per_sec_per_chip
+                             / REF_TOKENS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

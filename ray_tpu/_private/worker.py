@@ -634,7 +634,14 @@ class ActorRuntime:
     def _run_one(self, item) -> None:
         method, args, kwargs, return_ids, done_cb = item
         try:
-            fn = getattr(self.instance, method)
+            if method == "__ray_tpu_col_init__":
+                # universal hook so create_collective_group works on any
+                # actor class (reference declarative mode, collective.py:151)
+                from ray_tpu.util import collective as _collective
+
+                fn = _collective.init_collective_group
+            else:
+                fn = getattr(self.instance, method)
             args = tuple(self.worker._materialize(a) for a in args)
             kwargs = {k: self.worker._materialize(v)
                       for k, v in kwargs.items()}

@@ -1,0 +1,96 @@
+"""ray_tpu.util.collective — analog of the reference's
+python/ray/util/collective tests (KV-rendezvous host plane +
+device-mesh plane)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group="g"):
+        col.init_collective_group(self.world, self.rank, group_name="g")
+        return self.rank
+
+    def do_allreduce(self):
+        x = np.full((4,), float(self.rank + 1), dtype=np.float32)
+        return col.allreduce(x, group_name="g")
+
+    def do_broadcast(self):
+        x = (np.arange(3, dtype=np.float32) if self.rank == 0
+             else np.zeros(3, dtype=np.float32))
+        return col.broadcast(x, src_rank=0, group_name="g")
+
+    def do_allgather(self):
+        return col.allgather(np.array([self.rank], np.int64), group_name="g")
+
+    def do_reducescatter(self):
+        x = np.arange(4, dtype=np.float32) + self.rank
+        return col.reducescatter(x, group_name="g")
+
+    def do_sendrecv(self):
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name="g")
+            return None
+        out = np.zeros(1)
+        col.recv(out, src_rank=0, group_name="g")
+        return out
+
+    def do_barrier(self):
+        col.barrier(group_name="g")
+        return True
+
+
+@pytest.fixture
+def group2(ray_start_regular):
+    actors = [Rank.remote(r, 2) for r in range(2)]
+    ray_tpu.get([a.setup.remote() for a in actors])
+    yield actors
+
+
+def test_allreduce(group2):
+    res = ray_tpu.get([a.do_allreduce.remote() for a in group2])
+    for r in res:
+        np.testing.assert_allclose(r, np.full((4,), 3.0))
+
+
+def test_broadcast_allgather(group2):
+    res = ray_tpu.get([a.do_broadcast.remote() for a in group2])
+    for r in res:
+        np.testing.assert_allclose(r, np.arange(3, dtype=np.float32))
+    res = ray_tpu.get([a.do_allgather.remote() for a in group2])
+    for r in res:
+        assert [int(x[0]) for x in r] == [0, 1]
+
+
+def test_reducescatter_sendrecv_barrier(group2):
+    res = ray_tpu.get([a.do_reducescatter.remote() for a in group2])
+    # sum = [1,3,5,7]; rank r gets chunk r (2 elems each)
+    np.testing.assert_allclose(res[0], [1.0, 3.0])
+    np.testing.assert_allclose(res[1], [5.0, 7.0])
+    res = ray_tpu.get([a.do_sendrecv.remote() for a in group2])
+    np.testing.assert_allclose(res[1], [42.0])
+    assert all(ray_tpu.get([a.do_barrier.remote() for a in group2]))
+
+
+def test_declarative_create_group(ray_start_regular):
+    actors = [Rank.remote(r, 2) for r in range(2)]
+    col.create_collective_group(actors, 2, [0, 1], group_name="g")
+    res = ray_tpu.get([a.do_allreduce.remote() for a in actors])
+    for r in res:
+        np.testing.assert_allclose(r, np.full((4,), 3.0))
+
+
+def test_device_allreduce(ray_start_regular, cpu_mesh8):
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=8), devices=cpu_mesh8)
+    x = np.ones((8, 4), np.float32)
+    out = np.asarray(col.device_allreduce(x, mesh, axis="dp"))
+    np.testing.assert_allclose(out, np.full((8, 4), 8.0))
