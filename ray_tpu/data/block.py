@@ -1,0 +1,194 @@
+"""Blocks: the unit of data movement in ray_tpu.data.
+
+The reference stores blocks as Arrow tables in plasma
+(python/ray/data/_internal/ — `Block = Union[pa.Table, pd.DataFrame]`);
+here a block IS a pyarrow.Table in the host object store, with a
+`BlockAccessor` providing the format conversions (arrow/pandas/numpy
+batches, rows, slicing, sort/merge primitives) the executor and iterators
+need. Tensor columns use Arrow lists with fixed shape metadata so numpy
+round-trips are zero-copy where pyarrow allows.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+DEFAULT_BATCH_SIZE = 1024
+# reference: DataContext.target_max_block_size = 128MiB
+TARGET_MAX_BLOCK_SIZE = 128 * 1024 * 1024
+
+
+def _np_to_arrow(col: np.ndarray) -> pa.Array:
+    if col.ndim == 1:
+        return pa.array(col)
+    # tensor column: fixed-size lists with the shape stashed in metadata
+    width = int(np.prod(col.shape[1:]))
+    flat = col.reshape(len(col), width)
+    arr = pa.FixedSizeListArray.from_arrays(
+        pa.array(flat.ravel()), width)
+    return arr
+
+
+class BlockAccessor:
+    """Format bridge for one block (reference
+    python/ray/data/_internal/arrow_block.py ArrowBlockAccessor)."""
+
+    def __init__(self, block: Block):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(BlockAccessor.batch_to_block(block))
+
+    # --- construction -----------------------------------------------------
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        """dict-of-columns / pandas / arrow / list-of-rows -> pa.Table."""
+        import pandas as pd
+
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+        if isinstance(batch, dict):
+            cols, names, shapes = [], [], {}
+            for name, col in batch.items():
+                col = np.asarray(col)
+                names.append(name)
+                cols.append(_np_to_arrow(col))
+                if col.ndim > 1:
+                    shapes[name] = col.shape[1:]
+            t = pa.table(dict(zip(names, cols)))
+            if shapes:
+                meta = {f"shape:{k}".encode():
+                        repr(tuple(v)).encode() for k, v in shapes.items()}
+                t = t.replace_schema_metadata(
+                    {**(t.schema.metadata or {}), **meta})
+            return t
+        if isinstance(batch, list):
+            if batch and isinstance(batch[0], dict):
+                keys = list(batch[0].keys())
+                return BlockAccessor.batch_to_block(
+                    {k: np.asarray([row[k] for row in batch]) for k in keys})
+            return pa.table({"item": pa.array(batch)})
+        raise TypeError(f"cannot convert {type(batch)} to a block")
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]]) -> Block:
+        return BlockAccessor.batch_to_block(list(rows))
+
+    # --- basic props ------------------------------------------------------
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def column_names(self) -> List[str]:
+        return self._table.column_names
+
+    # --- conversions ------------------------------------------------------
+    def _tensor_shape(self, name: str):
+        import ast
+
+        meta = self._table.schema.metadata or {}
+        raw = meta.get(f"shape:{name}".encode())
+        if not raw:
+            return None
+        try:
+            # literal_eval only: metadata round-trips through files, so it
+            # is untrusted input
+            shape = ast.literal_eval(raw.decode())
+        except (ValueError, SyntaxError):
+            return None
+        return shape if isinstance(shape, tuple) else None
+
+    def to_numpy(self, columns: Optional[Sequence[str]] = None
+                 ) -> Dict[str, np.ndarray]:
+        cols = columns or self._table.column_names
+        out = {}
+        for name in cols:
+            col = self._table.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.combine_chunks().flatten().to_numpy(
+                    zero_copy_only=False)
+                width = col.type.list_size
+                arr = flat.reshape(self._table.num_rows, width)
+                shape = self._tensor_shape(name)
+                if shape:
+                    arr = arr.reshape((self._table.num_rows,) + shape)
+            else:
+                arr = col.to_numpy(zero_copy_only=False)
+            out[name] = arr
+        return out
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_batch(self, batch_format: str = "numpy"):
+        if batch_format in ("numpy", "numpy_items"):
+            return self.to_numpy()
+        if batch_format in ("pandas", "pd"):
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._table
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # --- row access -------------------------------------------------------
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        np_cols = self.to_numpy()
+        names = list(np_cols)
+        for i in range(self.num_rows()):
+            yield {n: np_cols[n][i] for n in names}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_rows(self, indices: Sequence[int]) -> Block:
+        return self._table.take(pa.array(indices, type=pa.int64()))
+
+    # --- merge/sort primitives (for repartition / sort / shuffle) --------
+    @staticmethod
+    def concat(blocks: Sequence[Block]) -> Block:
+        blocks = list(blocks)
+        nonempty = [b for b in blocks if b.num_rows > 0]
+        if not nonempty:
+            # preserve schema from an empty input so downstream column ops
+            # still see the dataset's columns
+            return blocks[0].slice(0, 0) if blocks else pa.table({})
+        return pa.concat_tables(nonempty, promote_options="permissive")
+
+    def sort(self, key: str, descending: bool = False) -> Block:
+        order = "descending" if descending else "ascending"
+        return self._table.sort_by([(key, order)])
+
+    def sample_keys(self, key: str, n: int) -> np.ndarray:
+        if self._table.num_rows == 0:
+            return np.array([])
+        vals = self._table.column(key).to_numpy(zero_copy_only=False)
+        idx = np.random.default_rng(0).choice(
+            len(vals), size=min(n, len(vals)), replace=False)
+        return vals[idx]
+
+
+def batches_of(block: Block, batch_size: Optional[int],
+               batch_format: str = "numpy") -> Iterator[Any]:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if batch_size is None or batch_size >= n:
+        if n > 0:
+            yield acc.to_batch(batch_format)
+        return
+    for start in range(0, n, batch_size):
+        yield BlockAccessor(
+            acc.slice(start, min(start + batch_size, n))
+        ).to_batch(batch_format)

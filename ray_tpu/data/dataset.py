@@ -1,0 +1,489 @@
+"""Dataset: lazy, distributed data over ray_tpu tasks.
+
+Reference: python/ray/data/dataset.py (Dataset, 5,142 lines) — lazy
+logical plan, streaming execution, per-shard iteration for trainers.
+Same capability surface here: transforms build a LogicalOp chain,
+`iter_batches`/`take`/`write_*` trigger streaming execution, and
+`streaming_split`/`split` produce per-worker shards for the
+Train-equivalent (`get_dataset_shard`).
+"""
+from __future__ import annotations
+
+import builtins
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple, Union)
+
+import numpy as np
+import pyarrow as pa
+
+from . import plan as P
+from .block import Block, BlockAccessor, batches_of
+from .executor import StreamingExecutor, execute
+
+
+class Dataset:
+    def __init__(self, ops: List[P.LogicalOp]):
+        self._ops = ops
+        self._materialized: Optional[List[Any]] = None  # block refs
+
+    # --- plan builders ----------------------------------------------------
+    def _chain(self, op: P.LogicalOp) -> "Dataset":
+        return Dataset(self._ops + [op])
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._chain(P.MapRows("map", fn))
+
+    def map_batches(self, fn: Union[Callable, type], *,
+                    batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    concurrency: Optional[int] = None,
+                    **_ignored) -> "Dataset":
+        """fn: batch->batch, or a callable class (constructed once per
+        worker — the reference's ActorPoolStrategy)."""
+        if isinstance(fn, type):
+            return self._chain(P.MapBatches(
+                "map_batches", None, batch_size, batch_format,
+                fn_constructor=fn, concurrency=concurrency))
+        return self._chain(P.MapBatches("map_batches", fn, batch_size,
+                                        batch_format,
+                                        concurrency=concurrency))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return self._chain(P.FlatMap("flat_map", fn))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return self._chain(P.Filter("filter", fn))
+
+    def add_column(self, col: str, fn: Callable) -> "Dataset":
+        return self._chain(P.AddColumn("add_column", col, fn))
+
+    def drop_columns(self, cols: Sequence[str]) -> "Dataset":
+        return self._chain(P.DropColumns("drop_columns", tuple(cols)))
+
+    def select_columns(self, cols: Sequence[str]) -> "Dataset":
+        return self._chain(P.SelectColumns("select_columns", tuple(cols)))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._chain(P.RenameColumns("rename_columns",
+                                           tuple(mapping.items())))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._chain(P.Repartition("repartition", num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._chain(P.RandomShuffle("random_shuffle", seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._chain(P.Sort("sort", key, descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._chain(P.Limit("limit", n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        branches = [tuple(self._source_ops())]
+        branches += [tuple(o._source_ops()) for o in others]
+        return Dataset([P.Union("union", tuple(branches))])
+
+    def _source_ops(self) -> List[P.LogicalOp]:
+        """Ops to re-execute this dataset lazily — materialized refs are
+        reused rather than recomputed."""
+        if self._materialized is not None:
+            return [P.FromBlocks("materialized", tuple(self._materialized))]
+        return self._ops
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        import ray_tpu
+
+        a = self.materialize()._materialized
+        b = other.materialize()._materialized
+
+        def zip_blocks(x, y):
+            xt, yt = BlockAccessor(x).to_arrow(), BlockAccessor(y).to_arrow()
+            if xt.num_rows != yt.num_rows:
+                raise ValueError("zip: block row counts differ; "
+                                 "repartition first")
+            for name in yt.column_names:
+                out_name = name
+                while out_name in xt.column_names:
+                    out_name += "_1"  # disambiguate (reference zip suffix)
+                xt = xt.append_column(out_name, yt.column(name))
+            return xt
+
+        if len(a) != len(b):
+            raise ValueError("zip: datasets must have equal block counts; "
+                             "repartition first")
+        z = ray_tpu.remote(zip_blocks)
+        return Dataset(
+            [P.FromBlocks("zip", tuple(z.remote(x, y)
+                                       for x, y in zip(a, b)))])
+
+    # --- execution --------------------------------------------------------
+    def _block_refs(self) -> Iterator[Any]:
+        if self._materialized is not None:
+            return iter(self._materialized)
+        return execute(self._ops)
+
+    def _ensure_refs(self) -> List[Any]:
+        """Execute once and cache — metadata ops (count/schema/...) must
+        not re-run the plan on every call."""
+        if self._materialized is None:
+            self._materialized = list(execute(self._ops))
+        return self._materialized
+
+    def materialize(self) -> "Dataset":
+        if self._materialized is None:
+            refs = list(self._block_refs())
+            ds = Dataset([P.FromBlocks("materialized", tuple(refs))])
+            ds._materialized = refs
+            return ds
+        return self
+
+    def _blocks(self) -> Iterator[Block]:
+        import ray_tpu
+
+        for ref in self._block_refs():
+            yield ray_tpu.get(ref)
+
+    # --- consumption ------------------------------------------------------
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for block in self.limit(n)._blocks():
+            out.extend(BlockAccessor(block).iter_rows())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for block in self._blocks():
+            out.extend(BlockAccessor(block).iter_rows())
+        return out
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy") -> Any:
+        rows = self.take(batch_size)
+        if not rows:
+            schema = self.schema()
+            empty = schema.empty_table() if schema is not None \
+                else BlockAccessor.from_rows([])
+            return BlockAccessor(empty).to_batch(batch_format)
+        return BlockAccessor(
+            BlockAccessor.from_rows(rows)).to_batch(batch_format)
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        import ray_tpu
+
+        cnt = ray_tpu.remote(lambda b: b.num_rows)
+        return sum(ray_tpu.get([cnt.remote(r) for r in self._ensure_refs()]))
+
+    def num_blocks(self) -> int:
+        return len(self._ensure_refs())
+
+    def schema(self) -> Optional[pa.Schema]:
+        import ray_tpu
+
+        for ref in self._ensure_refs():
+            return BlockAccessor(ray_tpu.get(ref)).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def size_bytes(self) -> int:
+        import ray_tpu
+
+        sz = ray_tpu.remote(lambda b: b.nbytes)
+        return sum(ray_tpu.get([sz.remote(r) for r in self._ensure_refs()]))
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
+                     drop_last: bool = False) -> Iterator[Any]:
+        from .iterator import iter_batches as _ib
+
+        return _ib(self._block_refs(), batch_size=batch_size,
+                   batch_format=batch_format,
+                   prefetch_batches=prefetch_batches,
+                   local_shuffle_buffer_size=local_shuffle_buffer_size,
+                   local_shuffle_seed=local_shuffle_seed,
+                   drop_last=drop_last)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           device: Optional[str] = None,
+                           **kw) -> Iterator[Any]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            yield {k: torch.as_tensor(np.ascontiguousarray(v)).to(
+                device or "cpu") for k, v in batch.items()}
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, **kw) -> Iterator[Any]:
+        """TPU-native: double-buffered host->HBM transfer; with a
+        `sharding`, batches land already laid out for the mesh."""
+        from .iterator import iter_jax_batches as _ijb
+
+        return _ijb(self._block_refs(), batch_size=batch_size,
+                    sharding=sharding, **kw)
+
+    # --- shards / splits --------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        refs = list(self._block_refs())
+        if len(refs) < n or equal:
+            # repartition the already-produced refs; do not re-run the plan
+            src = Dataset([P.FromBlocks("split_src", tuple(refs))])
+            refs = list(src.repartition(n)._block_refs())
+        groups: List[List[Any]] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            groups[i % n].append(ref)
+        return [Dataset([P.FromBlocks(f"split_{i}", tuple(g))])
+                for i, g in enumerate(groups)]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """Per-worker iterators over disjoint shards (reference
+        streaming_split, used by get_dataset_shard)."""
+        from .iterator import DataIterator
+
+        return [DataIterator(shard) for shard in self.split(n, equal=equal)]
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        ds = ds.materialize()
+        total = ds.count()
+        n_test = int(total * test_size) if isinstance(test_size, float) \
+            else int(test_size)
+        train = ds.limit(total - n_test)
+        # drop the first total-n_test rows for the test split
+        test = _drop_head(ds, total - n_test)
+        return train.materialize(), test.materialize()
+
+    # --- groupby / aggregates --------------------------------------------
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def _agg_all(self, exprs: List[Tuple[str, str]]) -> Dict[str, Any]:
+        """Global aggregate via per-block partials + driver combine."""
+        import ray_tpu
+
+        def partial(block, exprs=tuple(exprs)):
+            out = {}
+            for col, how in exprs:
+                v = block.column(col).to_numpy(zero_copy_only=False)
+                if how == "sum":
+                    out[(col, how)] = (v.sum(), len(v))
+                elif how == "min":
+                    out[(col, how)] = (v.min() if len(v) else None, len(v))
+                elif how == "max":
+                    out[(col, how)] = (v.max() if len(v) else None, len(v))
+                elif how in ("mean", "std"):
+                    out[(col, how)] = (v.sum(), (v ** 2).sum(), len(v))
+                elif how == "count":
+                    out[(col, how)] = (len(v),)
+            return out
+
+        t = ray_tpu.remote(partial)
+        parts = ray_tpu.get([t.remote(r) for r in self._block_refs()])
+        result: Dict[str, Any] = {}
+        for col, how in exprs:
+            vals = [p[(col, how)] for p in parts if p.get((col, how))]
+            if how == "sum":
+                result[f"sum({col})"] = sum(v[0] for v in vals)
+            elif how == "min":
+                result[f"min({col})"] = min(v[0] for v in vals
+                                            if v[0] is not None)
+            elif how == "max":
+                result[f"max({col})"] = max(v[0] for v in vals
+                                            if v[0] is not None)
+            elif how == "count":
+                result[f"count({col})"] = sum(v[0] for v in vals)
+            elif how == "mean":
+                n = sum(v[2] for v in vals)
+                result[f"mean({col})"] = sum(v[0] for v in vals) / max(n, 1)
+            elif how == "std":
+                n = sum(v[2] for v in vals)
+                s1 = sum(v[0] for v in vals)
+                s2 = sum(v[1] for v in vals)
+                mean = s1 / max(n, 1)
+                var = s2 / max(n, 1) - mean ** 2
+                result[f"std({col})"] = float(np.sqrt(max(var, 0.0)))
+        return result
+
+    def sum(self, col: str):
+        return self._agg_all([(col, "sum")])[f"sum({col})"]
+
+    def min(self, col: str):
+        return self._agg_all([(col, "min")])[f"min({col})"]
+
+    def max(self, col: str):
+        return self._agg_all([(col, "max")])[f"max({col})"]
+
+    def mean(self, col: str):
+        return self._agg_all([(col, "mean")])[f"mean({col})"]
+
+    def std(self, col: str):
+        return self._agg_all([(col, "std")])[f"std({col})"]
+
+    # --- conversion / writing --------------------------------------------
+    def to_pandas(self):
+        return BlockAccessor.concat(list(self._blocks())).to_pandas()
+
+    def to_arrow_refs(self) -> List[Any]:
+        return list(self._block_refs())
+
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        import os
+
+        import ray_tpu
+
+        os.makedirs(path, exist_ok=True)
+
+        def write_block(block, i, path=path, fmt=fmt):
+            import pyarrow.csv as pacsv
+            import pyarrow.parquet as pq
+
+            f = os.path.join(path, f"part-{i:05d}.{fmt}")
+            if fmt == "parquet":
+                pq.write_table(block, f)
+            elif fmt == "csv":
+                pacsv.write_csv(block, f)
+            elif fmt == "json":
+                import json as _json
+
+                rows = list(BlockAccessor(block).iter_rows())
+                with open(f, "w") as fh:
+                    for r in rows:
+                        fh.write(_json.dumps(
+                            {k: (v.tolist() if isinstance(v, np.ndarray)
+                                 else (v.item() if isinstance(
+                                     v, np.generic) else v))
+                             for k, v in r.items()}) + "\n")
+            return f
+
+        w = ray_tpu.remote(write_block)
+        ray_tpu.get([w.remote(ref, i)
+                     for i, ref in enumerate(self._block_refs())])
+
+    def stats(self) -> str:
+        stages = P.fuse(self._ops)
+        return " -> ".join(getattr(s, "name", type(s).__name__)
+                           for s in stages)
+
+    def __repr__(self):
+        return f"Dataset(ops={[o.name for o in self._ops]})"
+
+
+def _drop_head(ds: Dataset, n: int) -> Dataset:
+    import ray_tpu
+
+    refs = list(ds._block_refs())
+    cnt = ray_tpu.remote(lambda b: b.num_rows)
+    counts = ray_tpu.get([cnt.remote(r) for r in refs])
+    sl = ray_tpu.remote(lambda b, s, e: BlockAccessor(b).slice(s, e))
+    out, skipped = [], 0
+    for ref, rows in zip(refs, counts):
+        if skipped + rows <= n:
+            skipped += rows
+            continue
+        if skipped < n:
+            out.append(sl.remote(ref, n - skipped, rows))
+            skipped = n
+        else:
+            out.append(ref)
+    return Dataset([P.FromBlocks("tail", tuple(out))])
+
+
+class GroupedData:
+    """Hash-free groupby: range-partition on the key via sort-shuffle then
+    per-partition pandas groupby (reference _internal/planner/aggregate.py
+    sort-based aggregation)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, exprs: List[Tuple[str, str]]) -> Dataset:
+        import ray_tpu
+
+        key = self._key
+        sorted_ds = self._ds.sort(key)
+
+        def agg_block(block, key=key, exprs=tuple(exprs)):
+            import pandas as pd
+
+            df = BlockAccessor(block).to_pandas()
+            if df.empty:
+                return pa.table({})
+            agg_map: Dict[str, List[str]] = {}
+            for col, how in exprs:
+                agg_map.setdefault(col, []).append(how)
+            g = df.groupby(key, sort=True).agg(agg_map)
+            g.columns = [f"{how}({col})" for col, how in
+                         ((c, h) for c, hs in agg_map.items() for h in hs)]
+            g = g.reset_index()
+            return pa.Table.from_pandas(g, preserve_index=False)
+
+        t = ray_tpu.remote(agg_block)
+        refs = [t.remote(r) for r in sorted_ds._block_refs()]
+        return Dataset([P.FromBlocks("groupby_agg", tuple(refs))])
+
+    def count(self) -> Dataset:
+        ds = self._agg([(self._key, "count")])
+        return ds.rename_columns({f"count({self._key})": "count()"})
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([(col, "sum")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([(col, "min")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([(col, "max")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([(col, "mean")])
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        import ray_tpu
+
+        key = self._key
+        sorted_ds = self._ds.sort(key)
+
+        def apply_groups(block, key=key, fn=fn):
+            import pandas as pd
+
+            df = BlockAccessor(block).to_pandas()
+            if df.empty:
+                return pa.table({})
+            outs = [BlockAccessor.batch_to_block(fn(g))
+                    for _, g in df.groupby(key, sort=True)]
+            return BlockAccessor.concat(outs)
+
+        t = ray_tpu.remote(apply_groups)
+        return Dataset([P.FromBlocks(
+            "map_groups",
+            tuple(t.remote(r) for r in sorted_ds._block_refs()))])

@@ -1,0 +1,231 @@
+"""Read APIs — reference python/ray/data/read_api.py + datasource/
+(parquet/csv/json/text/binary/images/numpy readers as parallel read
+tasks). Each file (or range chunk) becomes one zero-arg read task; the
+streaming executor schedules them as ray_tpu tasks with backpressure.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pyarrow as pa
+
+from . import plan as P
+from .block import Block, BlockAccessor
+from .dataset import Dataset
+
+DEFAULT_PARALLELISM = 8
+
+
+def _expand_paths(paths: Union[str, Sequence[str]],
+                  suffixes: Optional[Sequence[str]] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, names in os.walk(p):
+                files += [os.path.join(root, n) for n in sorted(names)]
+        elif any(ch in p for ch in "*?["):
+            files += sorted(_glob.glob(p))
+        else:
+            files.append(p)
+    if suffixes:
+        files = [f for f in files
+                 if any(f.endswith(s) for s in suffixes)]
+    if not files:
+        raise FileNotFoundError(f"no input files for {paths}")
+    return files
+
+
+def _make_read(name: str, tasks: List[Callable[[], Block]]) -> Dataset:
+    return Dataset([P.Read(name, tuple(tasks))])
+
+
+# --- in-memory sources ----------------------------------------------------
+
+
+def range(n: int, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:  # noqa: A001
+    cuts = np.linspace(0, n, min(parallelism, max(n, 1)) + 1).astype(int)
+
+    def make(a: int, b: int):
+        return lambda: pa.table({"id": np.arange(a, b, dtype=np.int64)})
+
+    return _make_read("range",
+                      [make(int(a), int(b)) for a, b in zip(cuts, cuts[1:])])
+
+
+def range_tensor(n: int, *, shape=(1,),
+                 parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    cuts = np.linspace(0, n, min(parallelism, max(n, 1)) + 1).astype(int)
+
+    def make(a: int, b: int):
+        def read():
+            base = np.arange(a, b, dtype=np.int64).reshape((-1,) + (1,) *
+                                                           len(shape))
+            data = np.broadcast_to(base, (b - a,) + tuple(shape)).copy()
+            return BlockAccessor.batch_to_block({"data": data})
+
+        return read
+
+    return _make_read("range_tensor",
+                      [make(int(a), int(b)) for a, b in zip(cuts, cuts[1:])])
+
+
+def from_items(items: Sequence[Any], *,
+               parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    items = list(items)
+    chunks = np.array_split(np.arange(len(items)),
+                            min(parallelism, max(len(items), 1)))
+
+    def make(idx):
+        part = [items[i] for i in idx]
+        return lambda: BlockAccessor.batch_to_block(part)
+
+    return _make_read("from_items", [make(c) for c in chunks if len(c)])
+
+
+def from_numpy(arr: Union[np.ndarray, Dict[str, np.ndarray]], *,
+               parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    if isinstance(arr, np.ndarray):
+        arr = {"data": arr}
+    n = len(next(iter(arr.values())))
+    cuts = np.linspace(0, n, min(parallelism, max(n, 1)) + 1).astype(int)
+
+    def make(a: int, b: int):
+        part = {k: v[a:b] for k, v in arr.items()}
+        return lambda: BlockAccessor.batch_to_block(part)
+
+    return _make_read("from_numpy",
+                      [make(int(a), int(b)) for a, b in zip(cuts, cuts[1:])])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _make_read(
+        "from_pandas",
+        [(lambda d=df: pa.Table.from_pandas(d, preserve_index=False))
+         for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _make_read("from_arrow", [(lambda t=t: t) for t in tables])
+
+
+# --- file sources ---------------------------------------------------------
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 **_kw) -> Dataset:
+    files = _expand_paths(paths, (".parquet",))
+
+    def make(f):
+        def read():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f, columns=columns)
+
+        return read
+
+    return _make_read("read_parquet", [make(f) for f in files])
+
+
+def read_csv(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, (".csv",))
+
+    def make(f):
+        def read():
+            import pyarrow.csv as pacsv
+
+            return pacsv.read_csv(f)
+
+        return read
+
+    return _make_read("read_csv", [make(f) for f in files])
+
+
+def read_json(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, (".json", ".jsonl"))
+
+    def make(f):
+        def read():
+            import pyarrow.json as pajson
+
+            return pajson.read_json(f)
+
+        return read
+
+    return _make_read("read_json", [make(f) for f in files])
+
+
+def read_text(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            with open(f, "r") as fh:
+                lines = [ln.rstrip("\n") for ln in fh]
+            return pa.table({"text": lines})
+
+        return read
+
+    return _make_read("read_text", [make(f) for f in files])
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def read():
+            with open(f, "rb") as fh:
+                data = fh.read()
+            cols: Dict[str, Any] = {"bytes": [data]}
+            if include_paths:
+                cols["path"] = [f]
+            return pa.table(cols)
+
+        return read
+
+    return _make_read("read_binary_files", [make(f) for f in files])
+
+
+def read_numpy(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, (".npy",))
+
+    def make(f):
+        def read():
+            return BlockAccessor.batch_to_block({"data": np.load(f)})
+
+        return read
+
+    return _make_read("read_numpy", [make(f) for f in files])
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: str = "RGB", include_paths: bool = False,
+                **_kw) -> Dataset:
+    files = _expand_paths(
+        paths, (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"))
+
+    def make(f):
+        def read():
+            from PIL import Image
+
+            img = Image.open(f).convert(mode)
+            if size is not None:
+                img = img.resize(size)
+            cols: Dict[str, Any] = {"image": np.asarray(img)[None]}
+            if include_paths:
+                return BlockAccessor.batch_to_block(
+                    {**cols, "path": np.asarray([f])})
+            return BlockAccessor.batch_to_block(cols)
+
+        return read
+
+    return _make_read("read_images", [make(f) for f in files])

@@ -1,0 +1,247 @@
+"""Streaming executor: drives the fused plan over ray_tpu tasks.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:51 —
+a pull-based operator pipeline with bounded in-flight tasks per operator
+(backpressure) so datasets larger than memory stream through. Here each
+pipeline stage is a Python generator over block ObjectRefs; map stages
+keep at most `max_in_flight` tasks outstanding and yield refs in order;
+all-to-all stages (repartition/shuffle/sort) are two-phase
+split-per-input-block + merge-per-output-block shuffles, the same
+task-graph shape the reference plans.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from . import plan as P
+from .block import Block, BlockAccessor
+
+
+def _remote(fn: Callable, num_cpus: float = 1.0):
+    import ray_tpu
+
+    return ray_tpu.remote(num_cpus=num_cpus)(fn)
+
+
+# --- per-block task bodies (top-level so pickling is cheap) ---------------
+
+
+def _run_read_task(task: Callable[[], Block]) -> Block:
+    return task()
+
+
+def _run_stage(stage: P.FusedStage, block: Block) -> Block:
+    return stage(block)
+
+
+def _count_rows(block: Block) -> int:
+    return block.num_rows
+
+
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    return BlockAccessor(block).slice(start, end)
+
+
+def _split_block(block: Block, n: int, mode: str, seed: Optional[int],
+                 boundaries: Optional[List[Any]], key: Optional[str]
+                 ) -> List[Block]:
+    """Phase 1 of a shuffle: partition one block into n chunks. With
+    num_returns=n the worker stores each chunk separately; for n==1 the
+    single return must be the bare block, not a 1-list."""
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    if mode == "even":
+        cuts = np.linspace(0, rows, n + 1).astype(int)
+        chunks = [acc.slice(int(a), int(b))
+                  for a, b in zip(cuts, cuts[1:])]
+    elif mode == "random":
+        rng = np.random.default_rng(seed)
+        assign = rng.integers(0, n, rows)
+        chunks = [acc.take_rows(np.nonzero(assign == i)[0].tolist())
+                  for i in range(n)]
+    elif mode == "range":
+        vals = block.column(key).to_numpy(zero_copy_only=False)
+        assign = np.searchsorted(np.asarray(boundaries), vals, side="right")
+        chunks = [acc.take_rows(np.nonzero(assign == i)[0].tolist())
+                  for i in range(n)]
+    else:
+        raise ValueError(mode)
+    return chunks if n > 1 else chunks[0]
+
+
+def _merge_blocks(sort_key: Optional[str], descending: bool,
+                  shuffle_seed: Optional[int], *chunks: Block) -> Block:
+    """Phase 2: concat chunk i from every input (optionally sort/shuffle).
+    Chunks are passed as top-level args so they are real task dependencies
+    (dispatch waits for the split phase; no worker-starving in-task get)."""
+    out = BlockAccessor.concat(list(chunks))
+    if sort_key is not None and out.num_rows > 0:
+        out = BlockAccessor(out).sort(sort_key, descending)
+    if shuffle_seed is not None and out.num_rows > 0:
+        rng = np.random.default_rng(shuffle_seed)
+        perm = rng.permutation(out.num_rows).tolist()
+        out = BlockAccessor(out).take_rows(perm)
+    return out
+
+
+def _sample_block_keys(block: Block, key: str, n: int) -> np.ndarray:
+    return BlockAccessor(block).sample_keys(key, n)
+
+
+class StreamingExecutor:
+    """Executes a fused stage list, yielding output block refs in order."""
+
+    def __init__(self, stages: List[Any], *, max_in_flight: int = 8,
+                 default_shuffle_blocks: int = 8):
+        self.stages = stages
+        self.max_in_flight = max_in_flight
+        self.default_shuffle_blocks = default_shuffle_blocks
+
+    def run(self) -> Iterator[Any]:
+        """Yields ObjectRefs of output blocks."""
+        it: Optional[Iterator[Any]] = None
+        for stage in self.stages:
+            it = self._apply(stage, it)
+        assert it is not None, "empty plan"
+        return it
+
+    # --- stage drivers ----------------------------------------------------
+    def _apply(self, stage, upstream: Optional[Iterator[Any]]):
+        if isinstance(stage, P.FromBlocks):
+            return iter(stage.refs)
+        if isinstance(stage, P.Union):
+            return self._run_union(stage)
+        if isinstance(stage, P.Read):
+            return self._run_source(stage)
+        if isinstance(stage, P.FusedStage):
+            return self._run_map(stage, upstream)
+        if isinstance(stage, P.Repartition):
+            return self._run_shuffle(upstream, stage.num_blocks, "even",
+                                     None, None, None, None)
+        if isinstance(stage, P.RandomShuffle):
+            # an unseeded shuffle still needs a concrete merge-phase seed,
+            # otherwise the within-partition permutation is skipped
+            seed = stage.seed if stage.seed is not None else \
+                int.from_bytes(os.urandom(4), "little")
+            return self._run_shuffle(upstream, None, "random", stage.seed,
+                                     None, None, seed)
+        if isinstance(stage, P.Sort):
+            return self._run_sort(upstream, stage)
+        if isinstance(stage, P.Limit):
+            return self._run_limit(upstream, stage.n)
+        raise TypeError(f"unknown stage {stage}")
+
+    def _run_union(self, union: P.Union) -> Iterator[Any]:
+        for branch in union.branches:
+            yield from execute(list(branch),
+                               max_in_flight=self.max_in_flight)
+
+    def _run_source(self, read: P.Read) -> Iterator[Any]:
+        task = _remote(_run_read_task)
+        return self._windowed(
+            (task.remote(t) for t in read.read_tasks), self.max_in_flight)
+
+    def _run_map(self, stage: P.FusedStage,
+                 upstream: Iterator[Any]) -> Iterator[Any]:
+        task = _remote(_run_stage)
+        window = stage.concurrency or self.max_in_flight
+        return self._windowed(
+            (task.remote(stage, ref) for ref in upstream), window)
+
+    def _windowed(self, submissions: Iterator[Any],
+                  window: int) -> Iterator[Any]:
+        """Backpressure: keep at most `window` tasks in flight, yield refs
+        in submission order (ordered streaming, like the reference's
+        bundle queues)."""
+        import ray_tpu
+
+        buf: List[Any] = []
+        for ref in submissions:
+            buf.append(ref)
+            if len(buf) >= window:
+                ray_tpu.wait([buf[0]], num_returns=1)
+                yield buf.pop(0)
+        yield from buf
+
+    def _materialize_refs(self, upstream: Iterator[Any]) -> List[Any]:
+        return list(upstream)
+
+    def _run_shuffle(self, upstream, num_out, mode, seed, key,
+                     boundaries, merge_shuffle_seed) -> Iterator[Any]:
+        import ray_tpu
+
+        in_refs = self._materialize_refs(upstream)
+        if not in_refs:
+            return iter(())
+        n = num_out or max(len(in_refs), 1)
+        split = _remote(_split_block)
+        merge = _remote(_merge_blocks)
+        chunk_refs = []
+        for ref in in_refs:
+            rets = split.options(num_returns=n).remote(ref, n, mode, seed,
+                                                       boundaries, key)
+            chunk_refs.append(rets if isinstance(rets, list) else [rets])
+        # chunk_refs[i][j] = chunk j of input block i
+        out = []
+        for j in range(n):
+            seed_j = None if merge_shuffle_seed is None \
+                else merge_shuffle_seed + j
+            out.append(merge.remote(None, False, seed_j,
+                                    *[c[j] for c in chunk_refs]))
+        return iter(out)
+
+    def _run_sort(self, upstream, stage: P.Sort) -> Iterator[Any]:
+        import ray_tpu
+
+        in_refs = self._materialize_refs(upstream)
+        if not in_refs:
+            return iter(())
+        n = len(in_refs)
+        sample = _remote(_sample_block_keys)
+        sampled = [s for s in ray_tpu.get(
+            [sample.remote(r, stage.key, 16) for r in in_refs]) if len(s)]
+        if not sampled:
+            # every block is empty: nothing to range-partition
+            return iter(in_refs)
+        samples = np.sort(np.concatenate(sampled))
+        # n-1 ascending boundaries -> n range partitions (searchsorted
+        # requires ascending; descending output comes from reversing the
+        # partition order + per-partition descending merge sort)
+        idx = np.linspace(0, len(samples) - 1, n + 1).astype(int)[1:-1]
+        boundaries = samples[idx].tolist()
+        split = _remote(_split_block)
+        merge = _remote(_merge_blocks)
+        chunk_refs = []
+        for ref in in_refs:
+            rets = split.options(num_returns=n).remote(
+                ref, n, "range", None, boundaries, stage.key)
+            chunk_refs.append(rets if isinstance(rets, list) else [rets])
+        out = [merge.remote(stage.key, stage.descending, None,
+                            *[c[j] for c in chunk_refs]) for j in range(n)]
+        if stage.descending:
+            out.reverse()
+        return iter(out)
+
+    def _run_limit(self, upstream, n: int) -> Iterator[Any]:
+        import ray_tpu
+
+        count = _remote(_count_rows)
+        sl = _remote(_slice_block)
+        remaining = n
+        for ref in upstream:
+            if remaining <= 0:
+                break
+            rows = ray_tpu.get(count.remote(ref))
+            if rows <= remaining:
+                yield ref
+                remaining -= rows
+            else:
+                yield sl.remote(ref, 0, remaining)
+                remaining = 0
+
+
+def execute(logical_ops: List[P.LogicalOp], **kw) -> Iterator[Any]:
+    return StreamingExecutor(P.fuse(logical_ops), **kw).run()

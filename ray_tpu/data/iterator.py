@@ -1,0 +1,155 @@
+"""Batch iteration with prefetch + device transfer.
+
+Reference: python/ray/data/_internal/iterator/ (DataIterator,
+iter_batches with prefetch_batches, local shuffle buffer) and Train's
+per-worker shards. TPU-native addition: `iter_jax_batches` double-buffers
+host->HBM transfers (jax.device_put on the next batch while the current
+one computes) and can place batches directly into a mesh sharding so the
+training step never sees host data.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from queue import Queue
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor
+
+
+def _fetch_blocks(refs, prefetch: int) -> Iterator[Block]:
+    """Prefetch block fetches `prefetch` ahead of consumption."""
+    import ray_tpu
+
+    refs = list(refs) if not hasattr(refs, "__next__") else refs
+    window: deque = deque()
+    it = iter(refs)
+    done = False
+    while True:
+        while not done and len(window) <= prefetch:
+            try:
+                window.append(next(it))
+            except StopIteration:
+                done = True
+        if not window:
+            return
+        yield ray_tpu.get(window.popleft())
+
+
+def _rebatch(blocks: Iterator[Block], batch_size: Optional[int],
+             drop_last: bool) -> Iterator[Block]:
+    """Coalesce/slice blocks into exact batch_size row chunks."""
+    if batch_size is None:
+        yield from blocks
+        return
+    buf: List[Block] = []
+    buffered = 0
+    for b in blocks:
+        if b.num_rows == 0:
+            continue
+        buf.append(b)
+        buffered += b.num_rows
+        while buffered >= batch_size:
+            merged = BlockAccessor.concat(buf)
+            yield BlockAccessor(merged).slice(0, batch_size)
+            rest = BlockAccessor(merged).slice(batch_size, merged.num_rows)
+            buf = [rest] if rest.num_rows else []
+            buffered = rest.num_rows
+    if buffered and not drop_last:
+        yield BlockAccessor.concat(buf)
+
+
+def _local_shuffle(blocks: Iterator[Block], buffer_size: int,
+                   seed: Optional[int]) -> Iterator[Block]:
+    """Reservoir-style local shuffle (reference
+    local_shuffle_buffer_size): accumulate rows up to buffer_size, emit
+    random permutations."""
+    rng = np.random.default_rng(seed)
+    buf: List[Block] = []
+    rows = 0
+    for b in blocks:
+        buf.append(b)
+        rows += b.num_rows
+        if rows >= buffer_size:
+            merged = BlockAccessor.concat(buf)
+            perm = rng.permutation(merged.num_rows).tolist()
+            yield BlockAccessor(merged).take_rows(perm)
+            buf, rows = [], 0
+    if buf:
+        merged = BlockAccessor.concat(buf)
+        perm = rng.permutation(merged.num_rows).tolist()
+        yield BlockAccessor(merged).take_rows(perm)
+
+
+def iter_batches(refs, *, batch_size: Optional[int] = 256,
+                 batch_format: str = "numpy", prefetch_batches: int = 1,
+                 local_shuffle_buffer_size: Optional[int] = None,
+                 local_shuffle_seed: Optional[int] = None,
+                 drop_last: bool = False) -> Iterator[Any]:
+    blocks = _fetch_blocks(refs, prefetch_batches)
+    if local_shuffle_buffer_size:
+        blocks = _local_shuffle(blocks, local_shuffle_buffer_size,
+                                local_shuffle_seed)
+    for chunk in _rebatch(blocks, batch_size, drop_last):
+        yield BlockAccessor(chunk).to_batch(batch_format)
+
+
+def iter_jax_batches(refs, *, batch_size: Optional[int] = 256,
+                     sharding=None, dtypes: Optional[Dict[str, Any]] = None,
+                     drop_last: bool = True,
+                     **kw) -> Iterator[Any]:
+    """Double-buffered device feed: the next batch's device_put overlaps
+    the caller's compute on the current batch (host->HBM pipelining).
+
+    Note drop_last defaults to True here (unlike iter_batches): a ragged
+    final batch would trigger an XLA recompilation of the jitted step.
+    Pass drop_last=False if the tail rows matter more than compile churn.
+    """
+    import jax
+
+    def put(batch: Dict[str, np.ndarray]):
+        if dtypes:
+            batch = {k: v.astype(dtypes[k]) if k in dtypes else v
+                     for k, v in batch.items()}
+        if sharding is not None:
+            return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+        return {k: jax.device_put(v) for k, v in batch.items()}
+
+    host_iter = iter_batches(refs, batch_size=batch_size,
+                             batch_format="numpy", drop_last=drop_last, **kw)
+    pending = None
+    for batch in host_iter:
+        nxt = put(batch)  # async dispatch; completes while caller computes
+        if pending is not None:
+            yield pending
+        pending = nxt
+    if pending is not None:
+        yield pending
+
+
+class DataIterator:
+    """Handle given to each train worker by streaming_split (reference
+    python/ray/data/iterator.py DataIterator)."""
+
+    def __init__(self, ds):
+        self._ds = ds
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_torch_batches(**kw)
+
+    def iter_jax_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_jax_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self._ds.iter_rows()
+
+    def materialize(self):
+        return self._ds.materialize()
+
+    def count(self) -> int:
+        return self._ds.count()

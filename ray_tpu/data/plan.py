@@ -1,0 +1,225 @@
+"""Logical plan for ray_tpu.data — lazy operator list with fusion.
+
+Reference model: python/ray/data/_internal/logical/ builds a DAG of
+LogicalOperators, an optimizer fuses compatible Map* chains, and the
+physical layer turns each into task submissions
+(_internal/planner/plan_udf_map_op.py). Here the plan is a linear chain
+(sources with union/zip handled at the Dataset level), and `fuse()`
+produces FusedStage objects: one Python callable per stage applied
+block-by-block in a single task (the same one-task-per-block,
+fused-transform model the reference's physical optimizer achieves).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .block import Block, BlockAccessor
+
+# ---------------------------------------------------------------------------
+# Logical ops
+
+
+@dataclass(frozen=True)
+class LogicalOp:
+    name: str
+
+
+@dataclass(frozen=True)
+class Read(LogicalOp):
+    """Source: read_fn() returns a list of zero-arg block-producing tasks."""
+
+    read_tasks: Tuple[Callable[[], List[Block]], ...] = ()
+
+
+@dataclass(frozen=True)
+class FromBlocks(LogicalOp):
+    refs: Tuple[Any, ...] = ()  # ObjectRefs of materialized blocks
+
+
+@dataclass(frozen=True)
+class Union(LogicalOp):
+    """Lazy union: each branch is a full logical-op chain, executed (and
+    chained) only when the plan runs."""
+
+    branches: Tuple[Tuple[LogicalOp, ...], ...] = ()
+
+
+@dataclass(frozen=True)
+class MapBatches(LogicalOp):
+    fn: Callable = None
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_constructor: Optional[Callable] = None  # actor-mode callable class
+    concurrency: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class MapRows(LogicalOp):
+    fn: Callable = None
+
+
+@dataclass(frozen=True)
+class FlatMap(LogicalOp):
+    fn: Callable = None
+
+
+@dataclass(frozen=True)
+class Filter(LogicalOp):
+    fn: Callable = None
+
+
+@dataclass(frozen=True)
+class AddColumn(LogicalOp):
+    col: str = ""
+    fn: Callable = None
+
+
+@dataclass(frozen=True)
+class DropColumns(LogicalOp):
+    cols: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class SelectColumns(LogicalOp):
+    cols: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class RenameColumns(LogicalOp):
+    mapping: Tuple[Tuple[str, str], ...] = ()
+
+
+# all-to-all barriers
+@dataclass(frozen=True)
+class Repartition(LogicalOp):
+    num_blocks: int = 0
+
+
+@dataclass(frozen=True)
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Sort(LogicalOp):
+    key: str = ""
+    descending: bool = False
+
+
+@dataclass(frozen=True)
+class Limit(LogicalOp):
+    n: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Fusion: chain of per-block ops -> single callable
+
+
+ROW_LEVEL = (MapRows, FlatMap, Filter, AddColumn, DropColumns,
+             SelectColumns, RenameColumns)
+
+
+@dataclass
+class FusedStage:
+    """One task per block: block -> block, applying a fused op chain."""
+
+    ops: List[LogicalOp] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return "+".join(o.name for o in self.ops) or "identity"
+
+    @property
+    def concurrency(self) -> Optional[int]:
+        for o in self.ops:
+            if isinstance(o, MapBatches) and o.concurrency:
+                return o.concurrency
+        return None
+
+    def __call__(self, block: Block) -> Block:
+        for op in self.ops:
+            block = _apply_op(op, block)
+        return block
+
+
+def _apply_op(op: LogicalOp, block: Block) -> Block:
+    import numpy as np
+    import pyarrow as pa
+
+    acc = BlockAccessor(block)
+    if isinstance(op, MapBatches):
+        fn = op.fn
+        if op.fn_constructor is not None:
+            fn = _actor_callable_cache(op.fn_constructor)
+        out = []
+        from .block import batches_of
+
+        for batch in batches_of(block, op.batch_size, op.batch_format):
+            res = fn(batch)
+            out.append(BlockAccessor.batch_to_block(res))
+        return BlockAccessor.concat(out)
+    if isinstance(op, MapRows):
+        return BlockAccessor.from_rows([op.fn(r) for r in acc.iter_rows()])
+    if isinstance(op, FlatMap):
+        rows: List[Dict[str, Any]] = []
+        for r in acc.iter_rows():
+            rows.extend(op.fn(r))
+        return BlockAccessor.from_rows(rows)
+    if isinstance(op, Filter):
+        keep = [i for i, r in enumerate(acc.iter_rows()) if op.fn(r)]
+        return acc.take_rows(keep)
+    if isinstance(op, AddColumn):
+        col = op.fn(acc.to_batch("pandas"))
+        t = acc.to_arrow()
+        if op.col in t.column_names:
+            t = t.drop_columns([op.col])
+        return t.append_column(op.col, pa.array(np.asarray(col)))
+    if isinstance(op, DropColumns):
+        return acc.to_arrow().drop_columns(list(op.cols))
+    if isinstance(op, SelectColumns):
+        return acc.to_arrow().select(list(op.cols))
+    if isinstance(op, RenameColumns):
+        t = acc.to_arrow()
+        mapping = dict(op.mapping)
+        return t.rename_columns(
+            [mapping.get(c, c) for c in t.column_names])
+    raise TypeError(f"not a per-block op: {op}")
+
+
+_ACTOR_CALLABLES: Dict[Any, Any] = {}
+
+
+def _actor_callable_cache(ctor: Callable) -> Any:
+    """Callable-class UDFs are constructed once per worker process and
+    reused across blocks (the reference's actor-pool compute strategy,
+    python/ray/data/_internal/compute.py ActorPoolStrategy). Keyed by
+    qualified name — each task unpickles a distinct class object, so
+    id() would never hit."""
+    key = (getattr(ctor, "__module__", ""),
+           getattr(ctor, "__qualname__", repr(ctor)))
+    inst = _ACTOR_CALLABLES.get(key)
+    if inst is None:
+        inst = ctor()
+        _ACTOR_CALLABLES[key] = inst
+    return inst
+
+
+def fuse(ops: List[LogicalOp]) -> List[Any]:
+    """[LogicalOp] -> [source | FusedStage | barrier op] pipeline."""
+    stages: List[Any] = []
+    current: Optional[FusedStage] = None
+    for op in ops:
+        if isinstance(op, (Read, FromBlocks, Union, Repartition,
+                           RandomShuffle, Sort, Limit)):
+            if current is not None and current.ops:
+                stages.append(current)
+            current = None
+            stages.append(op)
+        else:
+            if current is None:
+                current = FusedStage()
+            current.ops.append(op)
+    if current is not None and current.ops:
+        stages.append(current)
+    return stages

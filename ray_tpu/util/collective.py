@@ -92,6 +92,11 @@ def create_collective_group(actors: Sequence[Any], world_size: int,
     or we invoke the built-in hook via __ray_tpu_col_init__."""
     from ray_tpu.actor import ActorMethod
 
+    if len(actors) != len(ranks):
+        raise ValueError(f"{len(actors)} actors but {len(ranks)} ranks")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(
+            f"ranks {sorted(ranks)} must cover 0..{world_size - 1} exactly")
     refs = []
     for actor, rank in zip(actors, ranks):
         refs.append(ActorMethod(actor, "__ray_tpu_col_init__").remote(
@@ -102,12 +107,30 @@ def create_collective_group(actors: Sequence[Any], world_size: int,
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    with _lock:
-        g = _groups.pop(group_name, None)
-    if g is not None:
+    """Collective teardown. Two closing barriers ensure every rank has
+    finished all prior ops before any key deletion (deleting peers' keys
+    while they are mid-collect would strand them until timeout); each
+    rank then deletes only its own contributions. The final barrier's
+    tiny b"" markers are deliberately leaked."""
+    g = _groups.get(group_name)
+    if g is None:
+        return
+    try:
+        barrier(group_name)
+        final_op = g.op_count  # the 2nd barrier's op_id
+        barrier(group_name)
         for key in _kv().call("kv_keys", f"col/{group_name}/".encode(),
                               _NS, timeout=30.0):
-            _kv().call("kv_del", key, _NS, timeout=30.0)
+            tail = key.rsplit(b"/", 1)[-1]
+            parts = key.split(b"/")
+            own = tail == str(g.rank).encode()
+            is_final_barrier = (len(parts) > 2
+                                and parts[2] == f"{final_op:08d}".encode())
+            if own and not is_final_barrier:
+                _kv().call("kv_del", key, _NS, timeout=30.0)
+    finally:
+        with _lock:
+            _groups.pop(group_name, None)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

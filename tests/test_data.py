@@ -1,0 +1,222 @@
+"""ray_tpu.data — Dataset transforms, shuffles, groupby, iteration
+(reference python/ray/data/tests/)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def _cluster(ray_start_shared):
+    yield
+
+
+def test_range_count_take():
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+    assert ds.num_blocks() > 1
+
+
+def test_map_filter_flatmap():
+    ds = rd.range(20).map(lambda r: {"id": r["id"] * 2})
+    assert ds.take(3) == [{"id": 0}, {"id": 2}, {"id": 4}]
+    ds = rd.range(20).filter(lambda r: r["id"] % 2 == 0)
+    assert ds.count() == 10
+    ds = rd.from_items([1, 2]).flat_map(
+        lambda r: [{"item": r["item"]}, {"item": r["item"] * 10}])
+    assert sorted(r["item"] for r in ds.take_all()) == [1, 2, 10, 20]
+
+
+def test_map_batches_formats():
+    ds = rd.range(32).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2}, batch_size=8)
+    rows = ds.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+    # pandas format
+    ds2 = rd.range(10).map_batches(
+        lambda df: df.assign(y=df["id"] + 1), batch_format="pandas")
+    assert ds2.take(2)[1]["y"] == 2
+
+
+def test_map_batches_callable_class():
+    class Doubler:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"id": batch["id"] * 2}
+
+    ds = rd.range(16).map_batches(Doubler, batch_size=4, concurrency=2)
+    assert sorted(r["id"] for r in ds.take_all()) == \
+        sorted(i * 2 for i in range(16))
+
+
+def test_columns_ops():
+    ds = rd.range(10).add_column("b", lambda df: df["id"] + 1)
+    assert ds.take(1)[0]["b"] == 1
+    assert set(ds.columns()) == {"id", "b"}
+    assert ds.select_columns(["b"]).columns() == ["b"]
+    assert ds.drop_columns(["b"]).columns() == ["id"]
+    assert ds.rename_columns({"id": "x"}).columns()[0] in ("x", "b")
+
+
+def test_repartition_shuffle_sort_limit():
+    ds = rd.range(100).repartition(4)
+    assert ds.num_blocks() == 4
+    assert ds.count() == 100
+
+    shuffled = rd.range(50).random_shuffle(seed=7)
+    ids = [r["id"] for r in shuffled.take_all()]
+    assert sorted(ids) == list(range(50))
+    assert ids != list(range(50))
+
+    ds = rd.from_items([{"v": x} for x in [5, 3, 8, 1, 9, 2]]).sort("v")
+    assert [r["v"] for r in ds.take_all()] == [1, 2, 3, 5, 8, 9]
+    desc = rd.from_items([{"v": x} for x in [5, 3, 8]]).sort(
+        "v", descending=True)
+    assert [r["v"] for r in desc.take_all()] == [8, 5, 3]
+
+    assert rd.range(100).limit(7).count() == 7
+
+
+def test_aggregates_and_groupby():
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(12)])
+    assert ds.sum("v") == sum(range(12))
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 11.0
+    assert abs(ds.mean("v") - 5.5) < 1e-9
+
+    g = ds.groupby("k").sum("v").take_all()
+    got = {r["k"]: r["sum(v)"] for r in g}
+    assert got == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+
+    cnt = ds.groupby("k").count().take_all()
+    assert all(r["count()"] == 4 for r in cnt)
+
+
+def test_iter_batches_and_split():
+    ds = rd.range(64)
+    batches = list(ds.iter_batches(batch_size=16))
+    assert len(batches) == 4
+    assert all(len(b["id"]) == 16 for b in batches)
+
+    shards = ds.split(4)
+    assert sum(s.count() for s in shards) == 64
+    its = ds.streaming_split(2)
+    total = sum(len(b["id"]) for it in its
+                for b in it.iter_batches(batch_size=8))
+    assert total == 64
+
+
+def test_local_shuffle_and_drop_last():
+    ds = rd.range(50)
+    b = list(ds.iter_batches(batch_size=20, drop_last=True))
+    assert len(b) == 2
+    b = list(ds.iter_batches(batch_size=20, local_shuffle_buffer_size=50,
+                             local_shuffle_seed=3))
+    all_ids = np.concatenate([x["id"] for x in b])
+    assert sorted(all_ids.tolist()) == list(range(50))
+
+
+def test_zip_union():
+    a = rd.range(10).repartition(2).materialize()
+    b = a.map(lambda r: {"y": r["id"] * 3}).materialize()
+    z = a.zip(b)
+    rows = z.take_all()
+    assert all(r["y"] == r["id"] * 3 for r in rows)
+    u = rd.range(5).union(rd.range(5))
+    assert u.count() == 10
+
+
+def test_tensor_columns():
+    arr = np.arange(24, dtype=np.float32).reshape(6, 2, 2)
+    ds = rd.from_numpy(arr)
+    batch = next(iter(ds.iter_batches(batch_size=6)))
+    assert batch["data"].shape == (6, 2, 2)
+    np.testing.assert_allclose(
+        np.sort(batch["data"].ravel()), np.arange(24, dtype=np.float32))
+
+
+def test_read_write_roundtrip(tmp_path):
+    ds = rd.range(30).map(lambda r: {"id": r["id"], "v": r["id"] * 1.5})
+    p = str(tmp_path / "pq")
+    ds.write_parquet(p)
+    back = rd.read_parquet(p)
+    assert back.count() == 30
+    assert abs(back.sum("v") - ds.sum("v")) < 1e-9
+
+    c = str(tmp_path / "csv")
+    ds.write_csv(c)
+    assert rd.read_csv(c).count() == 30
+
+    j = str(tmp_path / "json")
+    ds.write_json(j)
+    assert rd.read_json(j).count() == 30
+
+    t = str(tmp_path / "t.txt")
+    with open(t, "w") as f:
+        f.write("a\nb\nc\n")
+    assert rd.read_text(t).count() == 3
+
+
+def test_train_test_split():
+    tr, te = rd.range(100).train_test_split(0.2)
+    assert tr.count() == 80 and te.count() == 20
+    ids = sorted(r["id"] for r in tr.take_all() + te.take_all())
+    assert ids == list(range(100))
+
+
+def test_iter_jax_batches():
+    import jax
+
+    ds = rd.range(32)
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert len(batches) == 4
+    assert all(isinstance(b["id"], jax.Array) for b in batches)
+
+
+def test_sort_empty_and_empty_partition_schema():
+    # all rows filtered out: sort/groupby must not crash
+    ds = rd.range(10).filter(lambda r: False)
+    assert ds.sort("id").take_all() == []
+    assert ds.groupby("id").count().take_all() == []
+    # empty partitions keep the schema
+    ds = rd.range(3).repartition(8)
+    assert ds.select_columns(["id"]).count() == 3
+    assert ds.schema() is not None and "id" in ds.schema().names
+
+
+def test_sort_descending_balanced():
+    ds = rd.range(1000).repartition(8).sort("id", descending=True)
+    ids = [r["id"] for r in ds.take_all()]
+    assert ids == list(reversed(range(1000)))
+    # partitions stay balanced (no collapse into 2 blocks)
+    counts = [b.num_rows for b in ds._blocks()]
+    assert max(counts) < 500, counts
+
+
+def test_zip_name_collision():
+    a = rd.range(8).repartition(2).materialize()
+    z = a.zip(a)
+    rows = z.take_all()
+    assert all(r["id"] == r["id_1"] for r in rows)
+
+
+def test_union_lazy_with_limit():
+    calls = {"n": 0}
+    ds = rd.range(100).map(lambda r: r)
+    u = ds.union(rd.range(100))
+    assert u.limit(5).count() == 5
+    assert u.count() == 200
+
+
+def test_empty_tensor_batch():
+    ds = rd.from_numpy(np.ones((8, 3), np.float32)).map_batches(
+        lambda b: {"data": b["data"][:0]})
+    assert ds.count() == 0
