@@ -430,7 +430,11 @@ class Worker:
         spec_bytes = serialization.dumps((cls, args, kwargs, dict(options)))
         resources = dict(options.get("resources") or {})
         num_cpus = options.get("num_cpus")
-        resources["CPU"] = 1.0 if num_cpus is None else float(num_cpus)
+        # Reference semantics: actors default to 0 CPUs while running
+        # (python/ray/_private/ray_option_utils.py) so idle actors don't
+        # pin cluster CPUs — this is what makes 40k actors/cluster possible
+        # (release/benchmarks/README.md:10). Tasks keep the 1-CPU default.
+        resources["CPU"] = 0.0 if num_cpus is None else float(num_cpus)
         info = self.conductor.call(
             "create_actor", spec_bytes,
             options.get("name"), options.get("namespace", "default"),

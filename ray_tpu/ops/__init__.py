@@ -9,4 +9,6 @@ CPU test mesh (`interpret`/fallback) and real TPU chips (Mosaic).
 """
 from .attention import flash_attention, mha_reference  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .moe import load_balancing_loss, moe_ffn  # noqa: F401
 from .layers import layer_norm, rms_norm  # noqa: F401

@@ -1,0 +1,124 @@
+"""Mixture-of-Experts FFN with expert parallelism over the `ep` mesh axis.
+
+New capability relative to the reference — Ray has no EP/MoE support
+in-tree (SURVEY.md §2.3). Design follows the GShard/Switch recipe shaped
+for TPU: static capacity (no dynamic shapes — XLA needs fixed tiles for
+the MXU), dispatch/combine as einsums (MXU-friendly one-hot matmuls), and
+`jax.lax.all_to_all` over the `ep` axis to exchange token shards between
+expert shards, riding ICI.
+
+Data layout inside shard_map over `ep`:
+  tokens  x: [T, D]            (local shard of the batch*seq tokens)
+  experts  : E total, E/ep held locally as w_in [E_l, D, F], w_out [E_l, F, D]
+  dispatch : [T, E, C] one-hot → einsum → [E, C, D]
+  all_to_all: [ep, E_l, C, D] swap axis0 ↔ ep ranks → local experts now
+              hold every rank's C-slot block: [E_l, ep*C, D]
+  expert FFN, then the inverse all_to_all + combine einsum.
+
+Top-k routing with normalized probs; tokens overflowing an expert's
+capacity are dropped (their combine weight is 0 — standard Switch
+behavior; raise capacity_factor to trade memory for fewer drops).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _top_k_routing(logits: jax.Array, top_k: int, num_experts: int,
+                   capacity: int):
+    """Returns (dispatch [T,E,C] bool-ish float, combine [T,E,C] float)."""
+    t = logits.shape[0]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    topv, topi = jax.lax.top_k(probs, top_k)                     # [T, k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # expert one-hots per choice: [k, T, E]
+    onehot = jax.nn.one_hot(topi.T, num_experts, dtype=jnp.float32)
+    # position of each (choice, token) in its expert's queue — cumulative
+    # count over choices-major, token-minor order (GShard ordering)
+    flat = onehot.reshape(top_k * t, num_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat                        # [k*T, E]
+    pos = pos.reshape(top_k, t, num_experts)
+    in_cap = (pos < capacity).astype(jnp.float32) * onehot
+    pos_idx = jnp.einsum("kte,kte->kt", pos, onehot).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(jnp.clip(pos_idx, 0, capacity - 1),
+                                capacity, dtype=jnp.float32)     # [k,T,C]
+    disp_k = jnp.einsum("kte,ktc->ktec", in_cap, cap_onehot)     # [k,T,E,C]
+    dispatch = disp_k.sum(0)                                     # [T,E,C]
+    combine = jnp.einsum("ktec,kt->tec", disp_k, topv.T)
+    return dispatch, combine
+
+
+def moe_ffn(x: jax.Array, gate_w: jax.Array, w_in: jax.Array,
+            w_out: jax.Array, *, top_k: int = 2,
+            capacity_factor: float = 1.25,
+            axis_name: Optional[str] = None,
+            activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu,
+            return_router_logits: bool = False):
+    """MoE feed-forward. x: [T, D] (or [B, S, D], flattened internally).
+
+    gate_w: [D, E]. With axis_name=None (single shard): w_in [E, D, F],
+    w_out [E, F, D]. Under shard_map over `axis_name`: w_in [E/ep, D, F],
+    w_out [E/ep, F, D] — the local expert shard — and tokens are exchanged
+    with all_to_all.
+
+    With return_router_logits=True, returns (y, logits[T, E]) so the caller
+    can feed load_balancing_loss without recomputing the gate matmul.
+    """
+    orig_shape = x.shape
+    if x.ndim == 3:
+        x = x.reshape(-1, x.shape[-1])
+    t, d = x.shape
+
+    ep = 1 if axis_name is None else jax.lax.psum(1, axis_name)
+    e_local = w_in.shape[0]
+    e = e_local * ep
+    capacity = max(1, math.ceil(top_k * t * capacity_factor / e))
+
+    logits = x @ gate_w.astype(x.dtype)                          # [T, E]
+    dispatch, combine = _top_k_routing(logits, top_k, e, capacity)
+
+    xin = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    if axis_name is not None:
+        # [E, C, D] -> [ep, E_l, C, D]; swap the leading block axis across
+        # ranks so each rank holds all source ranks' slots for its experts
+        xin = xin.reshape(ep, e_local, capacity, d)
+        xin = jax.lax.all_to_all(xin, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+        xin = xin.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, d)
+
+    xin = xin.astype(x.dtype)
+    h = activation(jnp.einsum("ecd,edf->ecf", xin, w_in))
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)                   # [E_l,·,D]
+
+    if axis_name is not None:
+        out = out.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+        out = jax.lax.all_to_all(out, axis_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+        out = out.reshape(e, capacity, d)
+
+    y = jnp.einsum("tec,ecd->td", combine,
+                   out.astype(jnp.float32)).astype(x.dtype)
+    y = y.reshape(orig_shape)
+    if return_router_logits:
+        return y, logits
+    return y
+
+
+def load_balancing_loss(logits: jax.Array, top_k: int = 2) -> jax.Array:
+    """Switch-style auxiliary loss: E * sum_e(frac_tokens_e * mean_prob_e).
+    logits: [..., T, E] router logits."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    e = probs.shape[-1]
+    _, topi = jax.lax.top_k(probs, top_k)
+    counts = jax.nn.one_hot(topi, e, dtype=jnp.float32).sum(-2)  # [..., k→E]
+    frac = counts.reshape(-1, e).mean(0) / top_k
+    mean_prob = probs.reshape(-1, e).mean(0)
+    return e * jnp.sum(frac * mean_prob)
+
+
+__all__ = ["moe_ffn", "load_balancing_loss"]

@@ -1,0 +1,58 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence swap
+over the `sp` mesh axis.
+
+New capability relative to the reference, which has no sequence/context
+parallelism in-tree (SURVEY.md §5.7). Where ring attention (see
+ring_attention.py) rotates KV blocks around the ICI ring, Ulysses does two
+`all_to_all`s: gather the full sequence while scattering heads, run plain
+(flash) attention on H/sp full-length heads, then swap back. On TPU both
+all_to_alls ride ICI; Ulysses moves 2x less data than the ring when
+sp <= heads and composes with any attention kernel unchanged — the
+standard trade (ring scales past head count, Ulysses doesn't).
+
+Use inside shard_map with q,k,v sharded on the sequence axis:
+    out = ulysses_attention(q, k, v, axis_name="sp")   # [B, T/sp, H, D]
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from .attention import mha_reference
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T/sp, H, D] -> [B, T, H/sp, D]: scatter heads, gather seq."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T, H/sp, D] -> [B, T/sp, H, D]: inverse swap."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp", causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None) -> jax.Array:
+    """Exact attention over an sp-sharded sequence via head scattering.
+
+    Per-shard shapes q,k,v: [B, T/sp, H, D]; H must be divisible by the
+    `axis_name` mesh size. attn_fn(q, k, v, causal, sm_scale) defaults to
+    the XLA reference; pass ops.attention.flash_attention for the Pallas
+    kernel on TPU.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(f"heads {h} not divisible by sp axis size {sp}")
+    fn = attn_fn or mha_reference
+    qg, kg, vg = (_seq_to_heads(t, axis_name) for t in (q, k, v))
+    out = fn(qg, kg, vg, causal, sm_scale)     # [B, T, H/sp, D]
+    return _heads_to_seq(out, axis_name)       # [B, T/sp, H, D]
+
+
+__all__ = ["ulysses_attention"]

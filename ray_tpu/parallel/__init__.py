@@ -16,6 +16,12 @@ Public surface:
   broadcast, allgather, reducescatter, send, recv)
 - sharding helpers: named_sharding, with_sharding_constraint shortcuts
 """
+from .fsdp import fsdp_shardings, infer_fsdp_specs  # noqa: F401
+from .pipeline import (  # noqa: F401
+    make_pipeline_fn,
+    pipeline_apply,
+    stack_stage_params,
+)
 from .mesh import (  # noqa: F401
     MESH_AXES,
     MeshConfig,

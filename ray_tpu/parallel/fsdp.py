@@ -1,0 +1,63 @@
+"""FSDP sharding-rule inference: ZeRO-3-style parameter sharding as
+PartitionSpecs on the `fsdp` mesh axis.
+
+The reference passes FSDP through to torch (train/torch/train_loop_utils.py
+supports FSDP wrap; SURVEY.md §2.3) — wrapping, gathering and
+resharding are imperative torch-side work. On TPU the same semantics are
+one sharding annotation: shard each parameter's largest eligible dim on
+`fsdp`, and XLA's SPMD partitioner inserts the all-gather before use and
+reduce-scatter of grads — the ZeRO-3 schedule — automatically. Optimizer
+state inherits the param layout through TrainStep.init_state.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def infer_fsdp_specs(params: Any, fsdp_size: int, *,
+                     base_specs: Optional[Any] = None,
+                     axis_name: str = "fsdp",
+                     min_size_to_shard: int = 2 ** 16) -> Any:
+    """PartitionSpec pytree sharding each param's largest free dim on
+    `axis_name`.
+
+    base_specs: existing spec tree (e.g. tp shardings from the model) to
+    compose with — fsdp takes the largest dim not already sharded and
+    divisible by fsdp_size. Leaves smaller than `min_size_to_shard`
+    elements stay replicated (gather cost would beat the memory win).
+    """
+    if base_specs is None:
+        base_specs = jax.tree.map(lambda x: P(*([None] * np.ndim(x))),
+                                  params)
+
+    def leaf_spec(x, spec: P) -> P:
+        shape = np.shape(x)
+        spec = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+        if fsdp_size <= 1 or np.size(x) < min_size_to_shard:
+            return P(*spec)
+        cand = [i for i, (dim, s) in enumerate(zip(shape, spec))
+                if s is None and dim % fsdp_size == 0]
+        if not cand:
+            return P(*spec)
+        best = max(cand, key=lambda i: shape[i])
+        new = list(spec)
+        new[best] = axis_name
+        return P(*new)
+
+    return jax.tree.map(leaf_spec, params, base_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def fsdp_shardings(mesh: Mesh, params: Any, **kw) -> Any:
+    """NamedSharding tree for `params` on `mesh` (see infer_fsdp_specs)."""
+    axis = kw.get("axis_name", "fsdp")
+    specs = infer_fsdp_specs(params, mesh.shape.get(axis, 1), **kw)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda s: isinstance(s, P))
+
+
+__all__ = ["infer_fsdp_specs", "fsdp_shardings"]

@@ -1,0 +1,153 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over the `pp`
+mesh axis with `jax.lax.ppermute` activation transfer.
+
+New capability relative to the reference — Ray has no pipeline parallelism
+in-tree (SURVEY.md §2.3, §5.7); its role stops at gang-scheduling the
+processes that a user-supplied framework pipelines. Here the pipeline is a
+first-class functional transform: stage parameters are stacked on a leading
+axis and sharded over `pp`, activations circulate around the ICI ring with
+`ppermute`, and the whole schedule is one `lax.scan` under `shard_map`, so
+XLA overlaps the ring transfer of tick t with the stage compute of tick
+t+1 and autodiff through the scan gives pipelined backprop for free.
+
+Schedule: classic GPipe fill-drain. With S stages and M microbatches the
+scan runs M + S - 1 ticks; rank 0 feeds microbatch t at tick t, rank S-1
+emits microbatch t at tick t + S - 1. Bubble fraction = (S-1)/(M+S-1) —
+choose M >= 4*S to keep it under ~20%.
+
+Composes with dp/tp: `make_pipeline_fn` shard_maps over the full mesh, so
+the batch stays sharded on ('dp','fsdp') and stage params may carry tp
+shardings on their trailing dims.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array, *,
+                   num_microbatches: int,
+                   axis_name: str = "pp") -> jax.Array:
+    """Run a pipelined forward pass. Call INSIDE shard_map over `axis_name`.
+
+    stage_fn(params_for_one_stage, activation[mb, ...]) -> activation.
+    stage_params: this rank's stage parameters (leading stage axis already
+    consumed by shard_map).
+    x: the full local batch [batch, ...]; it is split into
+    `num_microbatches` equal microbatches along axis 0. Every rank receives
+    the same x (replicated over `axis_name`); only rank 0's copy is fed in.
+
+    Returns [batch, ...] outputs of the LAST stage, valid on every rank
+    (the last stage's outputs are broadcast with a masked psum).
+    """
+    pp = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    m = num_microbatches
+    if x.shape[0] % m != 0:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"num_microbatches {m}")
+    mb = x.shape[0] // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+
+    # Stages must be shape-preserving across ticks (the usual
+    # transformer-layer contract); fold embed/unembed into surrounding code.
+    out_shape = jax.eval_shape(stage_fn, stage_params, micro[0])
+    if out_shape.shape != micro.shape[1:]:
+        raise ValueError(
+            "pipeline_apply requires shape-preserving stages "
+            f"(input {micro.shape[1:]}, stage output {out_shape.shape}); "
+            "fold embed/unembed into the surrounding code")
+
+    state0 = jnp.zeros(out_shape.shape, out_shape.dtype)
+    outbuf0 = jnp.zeros((m, *out_shape.shape), out_shape.dtype)
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        recv, outbuf = carry
+        # rank 0 ingests microbatch t (clamped: ticks past M reuse the
+        # last microbatch; their results are never stored)
+        feed = micro[jnp.minimum(t, m - 1)].astype(out_shape.dtype)
+        inp = jnp.where(rank == 0, feed, recv)
+        out = stage_fn(stage_params, inp)
+        # last rank stores microbatch t-(pp-1) once the pipe is full
+        src = t - (pp - 1)
+        valid = (rank == pp - 1) & (src >= 0)
+        outbuf = jax.lax.cond(
+            valid,
+            lambda b: jax.lax.dynamic_update_index_in_dim(
+                b, out, jnp.maximum(src, 0), 0),
+            lambda b: b, outbuf)
+        recv_next = jax.lax.ppermute(out, axis_name, perm)
+        return (recv_next, outbuf), None
+
+    (_, outbuf), _ = jax.lax.scan(
+        tick, (state0, outbuf0), jnp.arange(m + pp - 1))
+    # broadcast last rank's outputs to all pp ranks
+    outbuf = jax.lax.psum(
+        jnp.where(rank == pp - 1, outbuf, jnp.zeros_like(outbuf)), axis_name)
+    return outbuf.reshape(m * mb, *out_shape.shape[1:])
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]) -> Any:
+    """Stack a list of per-stage param pytrees on a new leading axis, ready
+    to shard with PartitionSpec('pp', ...)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     mesh: Mesh, *, num_microbatches: int,
+                     data_axes=("dp", "fsdp"),
+                     param_spec: Optional[Any] = None,
+                     axis_name: str = "pp") -> Callable:
+    """Build fn(stacked_params, x) -> y shard_mapped over the mesh.
+
+    stacked_params: pytree with leading stage axis of size mesh.shape['pp']
+    (see stack_stage_params). x: global batch, sharded on `data_axes`.
+    param_spec: optional PartitionSpec pytree for the NON-stage dims of the
+    stacked params (e.g. tp shardings); the leading 'pp' axis is prepended.
+    """
+    pp = mesh.shape[axis_name]
+
+    def full_param_spec(stacked_params):
+        if param_spec is None:
+            return jax.tree.map(lambda _: P(axis_name), stacked_params)
+        return jax.tree.map(
+            lambda s: P(axis_name, *tuple(s)), param_spec,
+            is_leaf=lambda s: isinstance(s, P))
+
+    def run(stacked_params, x):
+        leading = {np.shape(leaf)[0] if np.ndim(leaf) else None
+                   for leaf in jax.tree.leaves(stacked_params)}
+        if leading != {pp}:
+            raise ValueError(
+                f"stacked_params leading (stage) axis must be "
+                f"mesh.shape['{axis_name}']={pp}, got {sorted(leading, key=str)}"
+                " — did you forget stack_stage_params()?")
+        pspec = full_param_spec(stacked_params)
+        xspec = P(data_axes)
+
+        def inner(params, xloc):
+            # shard_map keeps the stage axis (size 1 locally): squeeze it
+            params = jax.tree.map(lambda a: a[0], params)
+            return pipeline_apply(
+                stage_fn, params, xloc, num_microbatches=num_microbatches,
+                axis_name=axis_name)
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(pspec, xspec), out_specs=xspec,
+            check_vma=False)(stacked_params, x)
+
+    return run
+
+
+__all__ = ["pipeline_apply", "stack_stage_params", "make_pipeline_fn"]
