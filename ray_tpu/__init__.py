@@ -67,6 +67,10 @@ def init(address: Optional[str] = None, *,
         # owns the chips; tasks needing device access use the driver-held
         # mesh (ray_tpu.parallel) or explicit TPU-resource actors.
         wenv = {"JAX_PLATFORMS": "cpu"}
+        import sys as _sys
+
+        wenv["RAY_TPU_DRIVER_SYS_PATH"] = os.pathsep.join(
+            p for p in _sys.path if p and os.path.isdir(p))
         wenv.update(worker_env or {})
         _conductor = Conductor(total, session_dir, worker_env=wenv).start()
         conductor_address = _conductor.address

@@ -10,6 +10,14 @@ import time
 
 
 def main() -> None:
+    # Driver sys.path propagation: functions/classes pickled by reference
+    # (module-level defs) must be importable here — the analog of the
+    # reference's working_dir/py_modules runtime-env exposure.
+    extra = os.environ.get("RAY_TPU_DRIVER_SYS_PATH", "")
+    for p in reversed([p for p in extra.split(os.pathsep) if p]):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
     conductor = os.environ["RAY_TPU_CONDUCTOR"]
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")

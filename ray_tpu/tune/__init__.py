@@ -1,0 +1,55 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Capability surface of the reference's Ray Tune (python/ray/tune/ —
+SURVEY.md §2.4): Tuner.fit driving a trial-actor event loop, grid/random
+search with composable sample domains, ASHA / HyperBand / median-stopping
+/ PBT schedulers, function + class trainables reporting through the
+shared train session, experiment snapshots with Tuner.restore.
+
+TPU-first deltas: trials that train on-device use the driver-held mesh
+(one trial per host-process is the CPU-search story; chip-level search
+runs trials sequentially against the mesh the driver owns), and trial
+state is snapshotted through the same checkpoint layer as ray_tpu.train.
+"""
+from ..train.session import get_checkpoint, get_context, report  # noqa: F401
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    qloguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .trainable import Trainable  # noqa: F401
+from .tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    run,
+    with_parameters,
+    with_resources,
+)
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "run", "Trainable",
+    "with_parameters", "with_resources", "report", "get_checkpoint",
+    "get_context", "uniform", "quniform", "loguniform", "qloguniform",
+    "randint", "choice", "sample_from", "grid_search", "Searcher",
+    "BasicVariantGenerator", "TrialScheduler", "FIFOScheduler",
+    "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

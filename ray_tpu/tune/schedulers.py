@@ -1,0 +1,220 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), median
+stopping, HyperBand, and Population Based Training.
+
+API surface of the reference's python/ray/tune/schedulers/ —
+`async_hyperband.py` (ASHA), `median_stopping_rule.py`, `hyperband.py`,
+`pbt.py` — reduced to the decision protocol the controller consumes:
+on_trial_result -> CONTINUE | STOP | PAUSE, plus PBT's exploit directive
+carried on the scheduler object (the controller applies checkpoint
+transfer + config mutation; see tuner.py).
+"""
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]) -> None:
+        if getattr(self, "metric", None) is None and metric:
+            self.metric = metric
+        if mode:
+            self.mode = getattr(self, "mode", None) or mode
+
+    def on_trial_add(self, trial_id: str) -> None:
+        pass
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+    def exploit_directive(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """PBT hook: non-None => controller should clone src trial's
+        checkpoint into trial_id with the given config."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference trial_scheduler.py)."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference schedulers/async_hyperband.py): rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung is stopped
+    unless it is in the top 1/reduction_factor of results recorded there."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3.0):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t, self.grace_period = max_t, grace_period
+        self.rf = reduction_factor
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(int(t))
+            t *= reduction_factor
+        self._milestones = milestones
+        self._next_milestone: Dict[str, int] = {}
+
+    def on_trial_add(self, trial_id: str) -> None:
+        self._next_milestone[trial_id] = 0
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        idx = self._next_milestone.get(trial_id, 0)
+        if idx >= len(self._milestones) or t < self._milestones[idx]:
+            return CONTINUE
+        milestone = self._milestones[idx]
+        self._next_milestone[trial_id] = idx + 1
+        score = self._score(result)
+        rung = self._rungs[milestone]
+        rung.append(score)
+        if len(rung) < self.rf:
+            return CONTINUE  # not enough evidence yet
+        cutoff_rank = max(1, int(len(rung) / self.rf))
+        cutoff = sorted(rung, reverse=True)[cutoff_rank - 1]
+        return CONTINUE if score >= cutoff else STOP
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand collapses to ASHA under a single-authority
+    async controller (reference hyperband.py vs async_hyperband.py — the
+    async variant is the recommended one); kept as an alias surface."""
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of other
+    trials' running averages at the same time step (reference
+    median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        score = self._score(result)
+        self._history[trial_id].append(score)
+        t = result.get(self.time_attr, 0)
+        if t < self.grace_period:
+            return CONTINUE
+        others = [sum(h) / len(h) for tid, h in self._history.items()
+                  if tid != trial_id and h]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(self._history[trial_id])
+        return CONTINUE if best >= median else STOP
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference pbt.py): at each perturbation interval, trials in the
+    bottom quantile clone the checkpoint of a top-quantile trial and
+    continue with mutated hyperparameters."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = defaultdict(int)
+        self._latest: Dict[str, Dict[str, Any]] = {}
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._pending_exploit: Dict[str, Dict[str, Any]] = {}
+
+    def register_config(self, trial_id: str, config: Dict[str, Any]) -> None:
+        self._configs[trial_id] = dict(config)
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in out:
+                continue
+            if self._rng.random() < self.resample_p or not isinstance(
+                    out[key], (int, float)):
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(out[key])(out[key] * factor) \
+                    if isinstance(out[key], float) else \
+                    max(1, int(out[key] * factor))
+        return out
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        self._latest[trial_id] = dict(result)
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb[trial_id] < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        scores = {tid: self._score(r) for tid, r in self._latest.items()
+                  if self.metric in r}
+        if len(scores) < 2:
+            return CONTINUE
+        ordered = sorted(scores, key=scores.get)
+        k = max(1, int(len(ordered) * self.quantile))
+        bottom, top = ordered[:k], ordered[-k:]
+        if trial_id in bottom and trial_id not in top:
+            src = self._rng.choice(top)
+            new_cfg = self._mutate(self._configs.get(src, {}))
+            self._pending_exploit[trial_id] = {"source": src,
+                                               "config": new_cfg}
+            self._configs[trial_id] = new_cfg
+        return CONTINUE
+
+    def exploit_directive(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        return self._pending_exploit.pop(trial_id, None)
+
+
+__all__ = ["TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+           "HyperBandScheduler", "MedianStoppingRule",
+           "PopulationBasedTraining", "CONTINUE", "STOP", "PAUSE"]

@@ -1,0 +1,212 @@
+"""Search spaces + search algorithms.
+
+API surface of the reference's python/ray/tune/search/ — sample domains
+(`tune.uniform/loguniform/choice/randint/grid_search`, sample_space.py) and
+the default `BasicVariantGenerator` (basic_variant.py: cartesian grid
+expansion x num_samples random sampling). Plugin searchers (hyperopt/optuna
+/ax/...) are external packages in the reference; here the Searcher base
+class is the extension point and a native TPE-free `BasicVariantGenerator`
+covers grid+random.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+# ------------------------------------------------------------------ domains
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, low: float, high: float, log: bool = False,
+                 q: Optional[float] = None):
+        self.low, self.high, self.log, self.q = low, high, log, q
+
+    def sample(self, rng: random.Random) -> float:
+        if self.log:
+            v = math.exp(rng.uniform(math.log(self.low),
+                                     math.log(self.high)))
+        else:
+            v = rng.uniform(self.low, self.high)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randrange(self.low, self.high)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:  # spec-aware at resolve
+        raise NotImplementedError
+
+
+def uniform(low: float, high: float) -> Float:
+    return Float(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> Float:
+    return Float(low, high, q=q)
+
+
+def loguniform(low: float, high: float) -> Float:
+    return Float(low, high, log=True)
+
+
+def qloguniform(low: float, high: float, q: float) -> Float:
+    return Float(low, high, log=True, q=q)
+
+
+def randint(low: int, high: int) -> Integer:
+    return Integer(low, high)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[Dict[str, Any]], Any]) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    """Reference tune/search/variant_generator grid marker."""
+    return {"grid_search": list(values)}
+
+
+# ---------------------------------------------------------------- searchers
+
+
+class Searcher:
+    """Reference tune/search/searcher.py surface."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric, self.mode = metric, mode
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              config: Dict[str, Any]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+def _split_grid(space: Dict[str, Any], prefix=()) -> List[tuple]:
+    """Find (key_path, values) grid_search entries, depth-first."""
+    grids = []
+    for k, v in space.items():
+        if isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+            grids.append((prefix + (k,), v["grid_search"]))
+        elif isinstance(v, dict):
+            grids.extend(_split_grid(v, prefix + (k,)))
+    return grids
+
+
+def _set_path(d: Dict[str, Any], path: tuple, value: Any) -> None:
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _resolve(space: Dict[str, Any], rng: random.Random,
+             resolved: Dict[str, Any]) -> Dict[str, Any]:
+    """Sample every Domain leaf; SampleFrom sees the partially resolved
+    config (reference sample_from(lambda spec: ...) semantics)."""
+    out: Dict[str, Any] = {}
+    deferred: List[tuple] = []
+    for k, v in space.items():
+        if isinstance(v, SampleFrom):
+            deferred.append((k, v))
+        elif isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict):
+            out[k] = _resolve(v, rng, resolved)
+        else:
+            out[k] = copy.deepcopy(v)
+    resolved.update(out)
+    for k, v in deferred:
+        out[k] = v.fn(dict(resolved))
+        resolved[k] = out[k]
+    return out
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cartesian product x num_samples random samples (reference
+    tune/search/basic_variant.py)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None,
+                 points_to_evaluate: Optional[List[Dict[str, Any]]] = None):
+        super().__init__()
+        self._rng = random.Random(seed)
+        self._variants: Iterator[Dict[str, Any]] = iter(
+            self._generate(space, num_samples,
+                           list(points_to_evaluate or [])))
+
+    def _generate(self, space, num_samples, points):
+        for p in points:
+            cfg = dict(copy.deepcopy(space))
+            cfg.update(p)
+            yield self._sample_leaves(cfg)
+        grids = _split_grid(space)
+        for _ in range(num_samples):
+            if grids:
+                for combo in itertools.product(*(vals for _, vals in grids)):
+                    cfg = copy.deepcopy(space)
+                    for (path, _), val in zip(grids, combo):
+                        _set_path(cfg, path, val)
+                    yield self._sample_leaves(cfg)
+            else:
+                yield self._sample_leaves(copy.deepcopy(space))
+
+    def _sample_leaves(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        return _resolve(space, self._rng, {})
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return next(self._variants)
+        except StopIteration:
+            return None
+
+
+__all__ = [
+    "Domain", "Float", "Integer", "Categorical", "SampleFrom", "Searcher",
+    "BasicVariantGenerator", "uniform", "quniform", "loguniform",
+    "qloguniform", "randint", "choice", "sample_from", "grid_search",
+]

@@ -1,0 +1,214 @@
+"""Trainable protocol + the trial actor that hosts one trial.
+
+Reference surface: python/ray/tune/trainable/trainable.py (class API —
+setup/step/save_checkpoint/load_checkpoint; `train()` = one step) and
+function trainables reporting through the session
+(python/ray/tune/trainable/function_trainable.py). Both run inside a
+`_TrialActor` — the rebuild's analog of the Tune trial actor the
+TuneController manages (tune_controller.py:69) — which exposes a uniform
+step/save/restore RPC surface to the controller.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ..train.checkpoint import Checkpoint
+from ..train.session import StopTrial, TrainContext, _set_session
+
+
+class Trainable:
+    """Class API (reference trainable.py:293)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = dict(config or {})
+        self.iteration = 0
+        self.setup(self.config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict[str, Any]]:
+        return None
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+    def train(self) -> Dict[str, Any]:
+        result = self.step()
+        self.iteration += 1
+        return result
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        return False
+
+
+_DONE = object()
+
+
+class _FunctionRunner:
+    """Runs a function trainable in a thread; reports stream through a
+    queue, one per controller step() (reference function_trainable.py's
+    RunnerThread + inter-thread queue design)."""
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], None],
+                 config: Dict[str, Any], trial_dir: str,
+                 checkpoint: Optional[Checkpoint]):
+        self._fn = fn
+        self._config = config
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._error: Optional[BaseException] = None
+        self._ctx = TrainContext(
+            trial_dir=trial_dir, latest_checkpoint=checkpoint,
+            _report_fn=self._on_report)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._started = False
+        self._last_checkpoint: Optional[Checkpoint] = None
+
+    def _run(self) -> None:
+        _set_session(self._ctx)
+        try:
+            self._fn(self._config)
+        except StopTrial:
+            pass
+        except BaseException as e:  # noqa: BLE001
+            self._error = e
+        finally:
+            _set_session(None)
+            self._q.put(_DONE)
+
+    def _on_report(self, metrics: Dict[str, Any],
+                   checkpoint: Optional[Checkpoint]) -> None:
+        if checkpoint is not None:
+            self._last_checkpoint = checkpoint
+        self._q.put((metrics, checkpoint))
+
+    def step(self) -> Dict[str, Any]:
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        item = self._q.get()
+        if item is _DONE:
+            if self._error is not None:
+                raise self._error
+            return {"__done__": True}
+        metrics, ckpt = item
+        out = dict(metrics)
+        if ckpt is not None:
+            out["__checkpoint_path__"] = ckpt.path
+        return out
+
+    def stop(self) -> None:
+        self._ctx._stop_requested = True
+        # unblock the runner if it is mid-report
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+class _TrialActor:
+    """Uniform trial host: wraps a class Trainable or a function trainable
+    behind step/save/restore/stop (what TuneController drives)."""
+
+    def __init__(self, trainable_bytes: bytes, config: Dict[str, Any],
+                 trial_id: str, trial_dir: str,
+                 restore_path: Optional[str] = None):
+        from .._private import serialization
+
+        trainable = serialization.loads(trainable_bytes)
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self.iteration = 0
+        os.makedirs(trial_dir, exist_ok=True)
+        ckpt = Checkpoint(restore_path) if restore_path else None
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self._mode = "class"
+            self._obj = trainable(config)
+            if restore_path:
+                self._restore_class(restore_path)
+        else:
+            self._mode = "function"
+            self._obj = _FunctionRunner(trainable, config, trial_dir, ckpt)
+
+    # ------------------------------------------------------------------ step
+
+    def step(self) -> Dict[str, Any]:
+        try:
+            if self._mode == "class":
+                result = self._obj.train()
+                self.iteration = self._obj.iteration
+            else:
+                result = self._obj.step()
+                if not result.get("__done__"):
+                    self.iteration += 1
+        except BaseException:  # noqa: BLE001
+            return {"__error__": traceback.format_exc()}
+        result = dict(result)
+        result.setdefault("training_iteration", self.iteration)
+        result["trial_id"] = self.trial_id
+        return result
+
+    # ------------------------------------------------------------ checkpoint
+
+    def save(self) -> Optional[str]:
+        if self._mode == "class":
+            d = os.path.join(self.trial_dir,
+                             f"checkpoint_{self.iteration:06d}")
+            os.makedirs(d, exist_ok=True)
+            data = self._obj.save_checkpoint(d)
+            if data is not None:
+                import pickle
+
+                with open(os.path.join(d, "_trainable_state.pkl"),
+                          "wb") as f:
+                    pickle.dump({"data": data,
+                                 "iteration": self.iteration}, f)
+            return d
+        return (self._obj._last_checkpoint.path
+                if self._obj._last_checkpoint else None)
+
+    def _restore_class(self, path: str) -> None:
+        import pickle
+
+        state_file = os.path.join(path, "_trainable_state.pkl")
+        if os.path.exists(state_file):
+            with open(state_file, "rb") as f:
+                state = pickle.load(f)
+            self._obj.load_checkpoint(state["data"])
+            self._obj.iteration = state.get("iteration", 0)
+            self.iteration = self._obj.iteration
+        else:
+            self._obj.load_checkpoint(path)
+
+    def reset(self, new_config: Dict[str, Any],
+              restore_path: Optional[str] = None) -> bool:
+        """PBT exploit path: swap config (+ optionally weights) in place.
+        Only class trainables support in-place reset (reference
+        Trainable.reset_config)."""
+        if self._mode != "class":
+            return False
+        if not self._obj.reset_config(new_config):
+            return False
+        self._obj.config = dict(new_config)
+        if restore_path:
+            self._restore_class(restore_path)
+        return True
+
+    def stop(self) -> None:
+        if self._mode == "class":
+            self._obj.cleanup()
+        else:
+            self._obj.stop()
+
+
+__all__ = ["Trainable", "_TrialActor"]

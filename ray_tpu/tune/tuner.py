@@ -1,0 +1,458 @@
+"""Tuner + trial controller event loop.
+
+Reference: python/ray/tune/tuner.py (Tuner.fit) driving
+tune/execution/tune_controller.py:69 — an event loop that launches trial
+actors, collects their results, and applies searcher + scheduler
+decisions. Single-authority rebuild: trials are `_TrialActor`s (one worker
+process each, gang-scheduled through the conductor), the controller polls
+outstanding step() refs with ray_tpu.wait, and experiment state is
+JSON-snapshotted per iteration for cluster-crash resume
+(tune/execution/experiment_state.py semantics).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ..train.checkpoint import Checkpoint
+from ..train.config import RunConfig
+from ..train.trainer import Result
+from . import schedulers as sched_mod
+from .search import BasicVariantGenerator, Searcher
+from .schedulers import (CONTINUE, PAUSE, STOP, FIFOScheduler,
+                         PopulationBasedTraining, TrialScheduler)
+
+PENDING, RUNNING, TERMINATED, ERRORED = ("PENDING", "RUNNING",
+                                         "TERMINATED", "ERRORED")
+
+
+@dataclass
+class TuneConfig:
+    """Reference tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    time_budget_s: Optional[float] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    actor: Any = None
+    dir: str = ""
+
+    def metric_value(self, metric: str) -> Optional[float]:
+        v = self.last_result.get(metric)
+        return None if v is None else float(v)
+
+
+class ResultGrid:
+    """Reference tune/result_grid.py."""
+
+    def __init__(self, results: List[Result], trials: List[Trial],
+                 experiment_path: str):
+        self._results = results
+        self._trials = trials
+        self.experiment_path = experiment_path
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or getattr(self, "_default_metric", None)
+        mode = mode or getattr(self, "_default_mode", "max")
+        if metric is None:
+            raise ValueError("metric required (none set in TuneConfig)")
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        keyf = lambda r: float(r.metrics[metric])  # noqa: E731
+        return (max if mode == "max" else min)(scored, key=keyf)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics for r in self._results])
+
+
+def with_resources(trainable, resources: Dict[str, float]):
+    """Reference tune/tune.py with_resources: attach per-trial resources."""
+    trainable._tune_resources = dict(resources)
+    return trainable
+
+
+def with_parameters(trainable, **kwargs):
+    """Reference tune/trainable/util.py with_parameters."""
+    import functools
+
+    if isinstance(trainable, type):
+        class _Wrapped(trainable):  # type: ignore[misc]
+            def setup(self, config):
+                super().setup({**config, **kwargs})
+        _Wrapped.__name__ = trainable.__name__
+        return _Wrapped
+    fn = functools.partial(_call_with_params, trainable, kwargs)
+    return fn
+
+
+def _call_with_params(fn, params, config):
+    return fn(config, **params)
+
+
+class Tuner:
+    """Reference tune/tuner.py."""
+
+    def __init__(self, trainable, *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = trainable
+        self.param_space = dict(param_space or {})
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored_trials: List[Dict[str, Any]] = []
+
+    @classmethod
+    def restore(cls, path: str, trainable) -> "Tuner":
+        """Resume a crashed/interrupted experiment from its state snapshot
+        (reference Tuner.restore / experiment_state.py)."""
+        with open(os.path.join(path, "tuner_state.json")) as f:
+            state = json.load(f)
+        t = cls(trainable,
+                tune_config=TuneConfig(**state["tune_config"]),
+                run_config=RunConfig(name=state["name"],
+                                     storage_path=state["storage_path"]))
+        t._restored_trials = state["trials"]
+        return t
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self.tune_config
+        exp_dir = self.run_config.resolved_storage_path()
+        os.makedirs(exp_dir, exist_ok=True)
+
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=cfg.num_samples, seed=cfg.seed)
+        searcher.set_search_properties(cfg.metric, cfg.mode,
+                                       self.param_space)
+        scheduler = cfg.scheduler or FIFOScheduler()
+        scheduler.set_search_properties(cfg.metric, cfg.mode)
+
+        from .._private import serialization
+
+        trainable_bytes = serialization.dumps(self.trainable)
+        resources = getattr(self.trainable, "_tune_resources", {"CPU": 1.0})
+
+        max_concurrent = cfg.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+
+        trials: List[Trial] = []
+        # resume: completed trials come back as results, others re-run
+        rerun_configs: List[Dict[str, Any]] = []
+        for tstate in self._restored_trials:
+            if tstate["status"] == TERMINATED:
+                t = Trial(trial_id=tstate["trial_id"],
+                          config=tstate["config"], status=TERMINATED,
+                          last_result=tstate["last_result"],
+                          checkpoint_path=tstate.get("checkpoint_path"),
+                          dir=tstate.get("dir", ""))
+                trials.append(t)
+            else:
+                rerun_configs.append(tstate["config"])
+
+        ref_to_trial: Dict[Any, Trial] = {}
+        deadline = (time.monotonic() + cfg.time_budget_s
+                    if cfg.time_budget_s else None)
+        next_index = len(trials)
+        # restored experiments only re-run their unfinished trials; the
+        # searcher's sampling stream is not persisted (reference
+        # experiment_state.py restores trials, not searcher RNG state)
+        exhausted = bool(self._restored_trials)
+        ckpt_freq = self.run_config.checkpoint_config.checkpoint_frequency
+
+        def launch(trial: Trial) -> None:
+            actor_cls = ray_tpu.remote(_trial_actor_cls())
+            trial.actor = actor_cls.options(
+                num_cpus=resources.get("CPU", 1.0),
+                resources={k: v for k, v in resources.items()
+                           if k != "CPU"} or None).remote(
+                trainable_bytes, trial.config, trial.trial_id, trial.dir,
+                trial.checkpoint_path)
+            trial.status = RUNNING
+            scheduler.on_trial_add(trial.trial_id)
+            if isinstance(scheduler, PopulationBasedTraining):
+                scheduler.register_config(trial.trial_id, trial.config)
+            ref = trial.actor.step.remote()
+            ref_to_trial[ref] = trial
+
+        def finalize(trial: Trial, status: str,
+                     error: Optional[str] = None) -> None:
+            trial.status = status
+            trial.error = error
+            searcher.on_trial_complete(trial.trial_id, trial.last_result,
+                                       error=status == ERRORED)
+            scheduler.on_trial_complete(trial.trial_id, trial.last_result)
+            if trial.actor is not None:
+                try:
+                    ray_tpu.get(trial.actor.stop.remote(), timeout=5.0)
+                except Exception:
+                    pass
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
+            self._snapshot(exp_dir, trials)
+
+        while True:
+            # launch new trials up to concurrency
+            running = [t for t in trials if t.status == RUNNING]
+            while len(running) < max_concurrent and not exhausted:
+                if rerun_configs:
+                    config = rerun_configs.pop(0)
+                elif deadline and time.monotonic() > deadline:
+                    break
+                else:
+                    config = searcher.suggest(f"trial_{next_index:05d}")
+                    if config is None:
+                        exhausted = True
+                        break
+                trial = Trial(trial_id=f"trial_{next_index:05d}",
+                              config=config,
+                              dir=os.path.join(exp_dir,
+                                               f"trial_{next_index:05d}"))
+                next_index += 1
+                trials.append(trial)
+                launch(trial)
+                running = [t for t in trials if t.status == RUNNING]
+
+            outstanding = list(ref_to_trial.keys())
+            if not outstanding:
+                break
+            done, _ = ray_tpu.wait(outstanding, num_returns=1, timeout=1.0)
+            if deadline and time.monotonic() > deadline:
+                for ref in outstanding:
+                    trial = ref_to_trial.pop(ref)
+                    try:
+                        result = ray_tpu.get(ref)
+                        self._record(trial, result)
+                    except Exception:
+                        pass
+                    finalize(trial, TERMINATED)
+                break
+            if not done:
+                continue
+            ref = done[0]
+            trial = ref_to_trial.pop(ref)
+            try:
+                result = ray_tpu.get(ref)
+            except Exception as e:  # actor/worker death
+                trial.last_result.setdefault("training_iteration", 0)
+                finalize(trial, ERRORED, error=str(e))
+                continue
+
+            if result.get("__error__"):
+                finalize(trial, ERRORED, error=result["__error__"])
+                continue
+            if result.get("__done__"):
+                finalize(trial, TERMINATED)
+                continue
+
+            self._record(trial, result)
+            searcher.on_trial_result(trial.trial_id, result)
+            decision = CONTINUE
+            if cfg.metric and cfg.metric in result:
+                decision = scheduler.on_trial_result(trial.trial_id, result)
+            if self._stop_criteria_met(result):
+                decision = STOP
+            directive = scheduler.exploit_directive(trial.trial_id)
+            if directive is not None:
+                self._exploit(trial, trials, directive, trainable_bytes,
+                              resources, ref_to_trial)
+                continue
+            if decision == STOP:
+                # grab a final checkpoint for class trainables
+                try:
+                    path = ray_tpu.get(trial.actor.save.remote(),
+                                       timeout=30.0)
+                    if path:
+                        trial.checkpoint_path = path
+                except Exception:
+                    pass
+                finalize(trial, TERMINATED)
+            else:
+                if ckpt_freq and trial.last_result.get(
+                        "training_iteration", 0) % ckpt_freq == 0:
+                    try:
+                        path = ray_tpu.get(trial.actor.save.remote(),
+                                           timeout=30.0)
+                        if path:
+                            trial.checkpoint_path = path
+                    except Exception:
+                        pass
+                nref = trial.actor.step.remote()
+                ref_to_trial[nref] = trial
+
+        self._snapshot(exp_dir, trials)
+        results = []
+        for t in trials:
+            results.append(Result(
+                metrics=t.last_result,
+                checkpoint=(Checkpoint(t.checkpoint_path)
+                            if t.checkpoint_path else None),
+                error=RuntimeError(t.error) if t.error else None,
+                path=t.dir, metrics_history=t.history))
+        grid = ResultGrid(results, trials, exp_dir)
+        grid._default_metric = cfg.metric
+        grid._default_mode = cfg.mode
+        return grid
+
+    # -------------------------------------------------------------- helpers
+
+    def _record(self, trial: Trial, result: Dict[str, Any]) -> None:
+        if "__checkpoint_path__" in result:
+            trial.checkpoint_path = result.pop("__checkpoint_path__")
+        trial.last_result = result
+        trial.history.append(result)
+
+    def _stop_criteria_met(self, result: Dict[str, Any]) -> bool:
+        stop = getattr(self.run_config, "stop", None)
+        if stop is None:
+            return False
+        if callable(stop):
+            return bool(stop(result.get("trial_id", ""), result))
+        for k, v in stop.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _exploit(self, trial: Trial, trials: List[Trial],
+                 directive: Dict[str, Any], trainable_bytes: bytes,
+                 resources: Dict[str, float], ref_to_trial: Dict) -> None:
+        """PBT: clone source trial's checkpoint into `trial` with the
+        mutated config (reference pbt.py _exploit)."""
+        import ray_tpu
+
+        src = next((t for t in trials
+                    if t.trial_id == directive["source"]), None)
+        new_config = directive["config"]
+        src_path = None
+        if src is not None and src.actor is not None:
+            try:
+                src_path = ray_tpu.get(src.actor.save.remote(), timeout=60.0)
+                if src_path:
+                    src.checkpoint_path = src_path
+            except Exception:
+                src_path = src.checkpoint_path
+        elif src is not None:
+            src_path = src.checkpoint_path
+
+        reset_ok = False
+        if trial.actor is not None:
+            try:
+                reset_ok = ray_tpu.get(
+                    trial.actor.reset.remote(new_config, src_path),
+                    timeout=60.0)
+            except Exception:
+                reset_ok = False
+        if not reset_ok:
+            # restart the actor from the source checkpoint
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+            actor_cls = ray_tpu.remote(_trial_actor_cls())
+            trial.actor = actor_cls.options(
+                num_cpus=resources.get("CPU", 1.0)).remote(
+                trainable_bytes, new_config, trial.trial_id, trial.dir,
+                src_path)
+        trial.config = new_config
+        ref = trial.actor.step.remote()
+        ref_to_trial[ref] = trial
+
+    def _snapshot(self, exp_dir: str, trials: List[Trial]) -> None:
+        cfg = self.tune_config
+        state = {
+            "name": self.run_config.name,
+            "storage_path": self.run_config.storage_path,
+            "tune_config": {"metric": cfg.metric, "mode": cfg.mode,
+                            "num_samples": cfg.num_samples},
+            "trials": [{
+                "trial_id": t.trial_id, "config": _json_config(t.config),
+                "status": t.status,
+                "last_result": _json_config(t.last_result),
+                "checkpoint_path": t.checkpoint_path, "dir": t.dir,
+            } for t in trials],
+        }
+        tmp = os.path.join(exp_dir, ".tuner_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, os.path.join(exp_dir, "tuner_state.json"))
+
+
+def _trial_actor_cls():
+    from .trainable import _TrialActor
+
+    return _TrialActor
+
+
+def _json_config(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out
+
+
+def run(trainable, *, config: Optional[Dict] = None, num_samples: int = 1,
+        metric: Optional[str] = None, mode: str = "max",
+        scheduler: Optional[TrialScheduler] = None,
+        stop: Optional[Union[Dict, Callable]] = None,
+        name: Optional[str] = None,
+        storage_path: Optional[str] = None,
+        max_concurrent_trials: Optional[int] = None) -> ResultGrid:
+    """Legacy tune.run surface (reference python/ray/tune/tune.py)."""
+    rc = RunConfig(name=name, storage_path=storage_path)
+    if stop is not None:
+        rc.stop = stop  # type: ignore[attr-defined]
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples,
+                               scheduler=scheduler,
+                               max_concurrent_trials=max_concurrent_trials),
+        run_config=rc)
+    return tuner.fit()
