@@ -1,0 +1,35 @@
+"""ray_tpu.rllib: reinforcement learning on TPU meshes.
+
+Capability surface of the reference's RLlib (rllib/ — SURVEY.md §2.4):
+AlgorithmConfig builder -> Algorithm (a Tune Trainable), EnvRunner actors
+stepping vector envs, and learners updating policies from rollouts. The
+reference's torch-DDP learner path (core/learner/torch/torch_learner.py:
+265,384-395 NCCL allreduce) becomes a single jitted update — GAE/V-trace,
+minibatch SGD and gradient sync compile into one XLA program that runs
+SPMD over a dp mesh axis on TPU.
+
+Algorithms: PPO (sync on-policy, ppo.py) and IMPALA (async off-policy
+with V-trace, impala.py) — the two shapes that cover the reference's
+sync/async execution plans. Native vectorized CartPole/Pendulum remove
+the gymnasium dependency from tests; any gymnasium env id works via the
+adapter.
+"""
+from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from .env import (  # noqa: F401
+    CartPoleVectorEnv,
+    GymnasiumVectorEnv,
+    PendulumVectorEnv,
+    VectorEnv,
+    make_env,
+    register_env,
+)
+from .env_runner import EnvRunner, make_remote_runners  # noqa: F401
+from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "IMPALAConfig", "EnvRunner", "make_remote_runners", "VectorEnv",
+    "CartPoleVectorEnv", "PendulumVectorEnv", "GymnasiumVectorEnv",
+    "register_env", "make_env",
+]

@@ -1,0 +1,240 @@
+"""Algorithm base + AlgorithmConfig builder.
+
+Reference: rllib/algorithms/algorithm.py (Algorithm extends Tune's
+Trainable; step() -> training_step()) and algorithm_config.py (the
+builder: .environment().env_runners().training().build()). The rebuild
+keeps the builder surface and the Trainable integration (so
+tune.Tuner(PPO...) works), while the learner update is a single jitted
+SPMD function instead of a DDP-wrapped torch module
+(torch_learner.py:265's NCCL path -> XLA collectives on the mesh).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from ..tune.trainable import Trainable
+from .env import make_env
+from .env_runner import EnvRunner, make_remote_runners
+
+
+class AlgorithmConfig:
+    """Builder (reference algorithm_config.py)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env: Any = None
+        self.env_config: Dict[str, Any] = {}
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 128
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.seed = 0
+        self.hidden = (64, 64)
+        self.train_extra: Dict[str, Any] = {}
+
+    # builder steps -------------------------------------------------------
+
+    def environment(self, env: Any = None, *,
+                    env_config: Optional[Dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 model: Optional[Dict] = None,
+                 **extra) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if model and "fcnet_hiddens" in model:
+            self.hidden = tuple(model["fcnet_hiddens"])
+        self.train_extra.update(extra)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "env": self.env, "env_config": self.env_config,
+            "num_env_runners": self.num_env_runners,
+            "num_envs_per_env_runner": self.num_envs_per_env_runner,
+            "rollout_fragment_length": self.rollout_fragment_length,
+            "lr": self.lr, "gamma": self.gamma, "seed": self.seed,
+            "hidden": self.hidden,
+        }
+        d.update(self.train_extra)
+        return d
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("no algo_class bound to this config")
+        return self.algo_class(self.to_dict())
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+
+class Algorithm(Trainable):
+    """Trainable whose step() is one training iteration (reference
+    algorithm.py:789 step -> :1489 training_step)."""
+
+    _default_config: Dict[str, Any] = {}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(cls)
+        for k, v in cls._default_config.items():
+            setattr(cfg, k, v) if hasattr(cfg, k) \
+                else cfg.train_extra.__setitem__(k, v)
+        return cfg
+
+    # ------------------------------------------------------------- setup
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg = dict(self._default_config)
+        cfg.update(config)
+        self.cfg = cfg
+        if cfg.get("env") is None:
+            raise ValueError("config['env'] is required")
+        probe = make_env(cfg["env"], 1, cfg.get("env_config"),
+                         seed=cfg.get("seed", 0))
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.act_dim = probe.act_dim
+        self.continuous = probe.num_actions < 0
+
+        n_runners = cfg.get("num_env_runners", 0)
+        if n_runners > 0:
+            self.runners = make_remote_runners(
+                cfg["env"], num_runners=n_runners,
+                num_envs=cfg.get("num_envs_per_env_runner", 1),
+                rollout_fragment_length=cfg.get("rollout_fragment_length",
+                                                128),
+                env_config=cfg.get("env_config"),
+                seed=cfg.get("seed", 0))
+            self.local_runner = None
+        else:
+            self.runners = []
+            self.local_runner = EnvRunner(
+                cfg["env"], num_envs=cfg.get("num_envs_per_env_runner", 1),
+                rollout_fragment_length=cfg.get("rollout_fragment_length",
+                                                128),
+                seed=cfg.get("seed", 0), env_config=cfg.get("env_config"))
+        self._episode_returns: collections.deque = collections.deque(
+            maxlen=100)
+        self._episode_lens: collections.deque = collections.deque(maxlen=100)
+        self._env_steps_lifetime = 0
+        self._build_learner()
+
+    def _build_learner(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        result = self.training_step()
+        result.setdefault("episode_return_mean",
+                          float(np.mean(self._episode_returns))
+                          if self._episode_returns else float("nan"))
+        result.setdefault("episode_len_mean",
+                          float(np.mean(self._episode_lens))
+                          if self._episode_lens else float("nan"))
+        result.setdefault("num_env_steps_sampled_lifetime",
+                          self._env_steps_lifetime)
+        return result
+
+    # ----------------------------------------------------------- sampling
+
+    def _host_params(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def _collect_batches(self) -> List[Dict[str, Any]]:
+        """Synchronous fan-out (reference rollout_ops.py
+        synchronous_parallel_sample)."""
+        if self.local_runner is not None:
+            batches = [self.local_runner.sample(self.params)]
+        else:
+            import ray_tpu
+
+            p = self._host_params()
+            batches = ray_tpu.get(
+                [r.sample.remote(p) for r in self.runners])
+        for b in batches:
+            self._episode_returns.extend(b["episode_returns"])
+            self._episode_lens.extend(b["episode_lens"])
+            self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+        return batches
+
+    @staticmethod
+    def _concat_batches(batches: List[Dict[str, Any]]) -> Dict[str, Any]:
+        keys = ("obs", "actions", "logp", "rewards", "dones")
+        return {k: np.concatenate([b[k] for b in batches], axis=1)
+                for k in keys}
+
+    # --------------------------------------------------------- checkpoint
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "env_steps": self._env_steps_lifetime}
+
+    def load_checkpoint(self, data: Any) -> None:
+        self.params = data["params"]
+        self.opt_state = data["opt_state"]
+        self._env_steps_lifetime = data.get("env_steps", 0)
+
+    def cleanup(self) -> None:
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    # legacy surface ------------------------------------------------------
+
+    def compute_single_action(self, obs: np.ndarray) -> Any:
+        """Greedy action for serving/eval (reference
+        Algorithm.compute_single_action)."""
+        import jax.numpy as jnp
+
+        from . import core
+
+        logits = core.policy_logits(self.params,
+                                    jnp.asarray(obs[None], jnp.float32))
+        if self.continuous:
+            return np.asarray(logits[0])
+        return int(np.argmax(np.asarray(logits[0])))
+
+
+__all__ = ["Algorithm", "AlgorithmConfig"]

@@ -1,0 +1,160 @@
+"""RLModule core: JAX policy/value networks + action distributions + GAE
+and V-trace — the compute kernel layer of the RL stack.
+
+Reference surface: rllib/core/rl_module/ (RLModule forward_* methods),
+rllib/models/ (distributions), rllib/evaluation/postprocessing.py (GAE),
+rllib/algorithms/impala/vtrace_torch.py (V-trace). Reimplemented as pure
+jittable functions — losses/advantages compile into the learner's SPMD
+update instead of running eagerly per batch on the driver.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------------- MLPs
+
+
+def mlp_init(key: jax.Array, sizes: List[int]) -> List[Dict[str, jax.Array]]:
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        scale = np.sqrt(2.0 / sizes[i])
+        params.append({
+            "w": jax.random.normal(sub, (sizes[i], sizes[i + 1])) * scale,
+            "b": jnp.zeros((sizes[i + 1],)),
+        })
+    return params
+
+
+def mlp_apply(params: List[Dict[str, jax.Array]], x: jax.Array,
+              final_scale: float = 1.0) -> jax.Array:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x * final_scale
+
+
+def policy_init(key: jax.Array, obs_dim: int, act_dim: int,
+                hidden: Tuple[int, ...] = (64, 64),
+                continuous: bool = False) -> Dict[str, Any]:
+    """pi + vf torso params (separate networks, reference MLP default).
+    Continuous policies get a state-independent log_std."""
+    k1, k2 = jax.random.split(key)
+    params = {
+        "pi": mlp_init(k1, [obs_dim, *hidden, act_dim]),
+        "vf": mlp_init(k2, [obs_dim, *hidden, 1]),
+    }
+    if continuous:
+        params["log_std"] = jnp.zeros((act_dim,))
+    return params
+
+
+def policy_logits(params: Dict[str, Any], obs: jax.Array) -> jax.Array:
+    return mlp_apply(params["pi"], obs, final_scale=0.01)
+
+
+def value(params: Dict[str, Any], obs: jax.Array) -> jax.Array:
+    return mlp_apply(params["vf"], obs)[..., 0]
+
+
+# ---------------------------------------------------------- distributions
+
+
+def categorical_sample(key: jax.Array, logits: jax.Array) -> jax.Array:
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def categorical_logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None],
+                               axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def gaussian_sample(key: jax.Array, mean: jax.Array,
+                    log_std: jax.Array) -> jax.Array:
+    return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+
+def gaussian_logp(mean: jax.Array, log_std: jax.Array,
+                  actions: jax.Array) -> jax.Array:
+    var = jnp.exp(2 * log_std)
+    return jnp.sum(-0.5 * ((actions - mean) ** 2 / var
+                           + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1)
+
+
+def gaussian_entropy(log_std: jax.Array) -> jax.Array:
+    return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+
+
+# ------------------------------------------------------------------- GAE
+
+
+def compute_gae(rewards: jax.Array, values: jax.Array, dones: jax.Array,
+                gamma: float = 0.99, lam: float = 0.95
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation (reference
+    evaluation/postprocessing.py compute_advantages), as a lax.scan over
+    time. rewards/dones: [T, N]; values: [T+1, N] (bootstrapped).
+    Returns (advantages [T, N], value_targets [T, N])."""
+    not_done = 1.0 - dones.astype(values.dtype)
+
+    def step(carry, t):
+        gae = carry
+        delta = rewards[t] + gamma * values[t + 1] * not_done[t] - values[t]
+        gae = delta + gamma * lam * not_done[t] * gae
+        return gae, gae
+
+    T = rewards.shape[0]
+    _, adv_rev = jax.lax.scan(step, jnp.zeros_like(values[0]),
+                              jnp.arange(T - 1, -1, -1))
+    advantages = adv_rev[::-1]
+    return advantages, advantages + values[:-1]
+
+
+# ---------------------------------------------------------------- V-trace
+
+
+def vtrace(behavior_logp: jax.Array, target_logp: jax.Array,
+           rewards: jax.Array, values: jax.Array, dones: jax.Array,
+           gamma: float = 0.99, clip_rho: float = 1.0,
+           clip_c: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+    """IMPALA V-trace off-policy correction (Espeholt et al. 2018;
+    reference impala/vtrace_torch.py). Shapes as compute_gae; logp [T, N].
+    Returns (pg_advantages [T, N], vs targets [T, N])."""
+    not_done = 1.0 - dones.astype(values.dtype)
+    rhos = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rhos, clip_rho)
+    c_bar = jnp.minimum(rhos, clip_c)
+
+    def step(carry, t):
+        acc = carry
+        delta = rho_bar[t] * (
+            rewards[t] + gamma * values[t + 1] * not_done[t] - values[t])
+        acc = delta + gamma * not_done[t] * c_bar[t] * acc
+        return acc, acc
+
+    T = rewards.shape[0]
+    _, vs_minus_v_rev = jax.lax.scan(step, jnp.zeros_like(values[0]),
+                                     jnp.arange(T - 1, -1, -1))
+    vs_minus_v = vs_minus_v_rev[::-1]
+    vs = vs_minus_v + values[:-1]
+    vs_next = jnp.concatenate([vs[1:], values[-1:]], axis=0)
+    pg_adv = rho_bar * (rewards + gamma * vs_next * not_done - values[:-1])
+    return pg_adv, vs
+
+
+__all__ = ["mlp_init", "mlp_apply", "policy_init", "policy_logits", "value",
+           "categorical_sample", "categorical_logp", "categorical_entropy",
+           "gaussian_sample", "gaussian_logp", "gaussian_entropy",
+           "compute_gae", "vtrace"]

@@ -1,0 +1,201 @@
+"""Environments: a vectorized env API + native numpy CartPole/Pendulum.
+
+Reference surface: rllib/env/ — EnvRunners step gymnasium *vector* envs
+(single_agent_env_runner.py builds `gym.vector.SyncVectorEnv`). Here the
+vector API is the primitive (TPU-first: batched obs ship straight into
+jitted policies), with a gymnasium adapter when the package is present
+and two native numpy envs so the RL stack has zero hard deps.
+
+Auto-reset semantics match gymnasium's VectorEnv: when an episode ends,
+`step` returns the *reset* observation of the next episode and
+terminated=True for that slot.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+_ENV_REGISTRY: Dict[str, Callable[..., "VectorEnv"]] = {}
+
+
+def register_env(name: str, creator: Callable[..., "VectorEnv"]) -> None:
+    """Reference ray/tune/registry.py register_env."""
+    _ENV_REGISTRY[name] = creator
+
+
+class VectorEnv:
+    """num_envs parallel copies; numpy in/out."""
+
+    num_envs: int
+    observation_dim: int
+    num_actions: int  # discrete; -1 => continuous action_dim in act_dim
+    act_dim: int = 1
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """-> (obs [N, obs_dim], rewards [N], terminated|truncated [N])."""
+        raise NotImplementedError
+
+
+class CartPoleVectorEnv(VectorEnv):
+    """CartPole-v1 dynamics (standard cart-pole physics: pole mass 0.1,
+    cart 1.0, force 10, tau 0.02, terminate |x|>2.4 or |theta|>12deg,
+    truncate at 500 steps), vectorized over N envs in numpy."""
+
+    GRAVITY, MASSCART, MASSPOLE = 9.8, 1.0, 0.1
+    LENGTH, FORCE_MAG, TAU = 0.5, 10.0, 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_dim = 4
+        self.num_actions = 2
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, (self.num_envs, 4))
+        self._steps[:] = 0
+        return self._state.astype(np.float32)
+
+    def _reset_slots(self, mask: np.ndarray) -> None:
+        n = int(mask.sum())
+        if n:
+            self._state[mask] = self._rng.uniform(-0.05, 0.05, (n, 4))
+            self._steps[mask] = 0
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) \
+            / total_mass
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0
+                           - self.MASSPOLE * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+
+        terminated = (np.abs(x) > self.X_LIMIT) \
+            | (np.abs(theta) > self.THETA_LIMIT)
+        truncated = self._steps >= self.MAX_STEPS
+        done = terminated | truncated
+        rewards = np.ones(self.num_envs, np.float32)
+        self._reset_slots(done)
+        return self._state.astype(np.float32), rewards, done
+
+
+class PendulumVectorEnv(VectorEnv):
+    """Pendulum-v1 dynamics (g=10, m=1, l=1, dt=0.05, torque in [-2,2],
+    200-step episodes), continuous actions, vectorized in numpy."""
+
+    MAX_SPEED, MAX_TORQUE, DT = 8.0, 2.0, 0.05
+    G, M, L = 10.0, 1.0, 1.0
+    MAX_STEPS = 200
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        self.num_envs = num_envs
+        self.observation_dim = 3
+        self.num_actions = -1
+        self.act_dim = 1
+        self._rng = np.random.default_rng(seed)
+        self._theta = np.zeros(num_envs)
+        self._thetadot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], axis=1).astype(np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta = self._rng.uniform(-np.pi, np.pi, self.num_envs)
+        self._thetadot = self._rng.uniform(-1.0, 1.0, self.num_envs)
+        self._steps[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th = ((self._theta + np.pi) % (2 * np.pi)) - np.pi
+        costs = th ** 2 + 0.1 * self._thetadot ** 2 + 0.001 * u ** 2
+        newthdot = self._thetadot + (
+            3 * self.G / (2 * self.L) * np.sin(th)
+            + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        newthdot = np.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = self._theta + newthdot * self.DT
+        self._thetadot = newthdot
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        if done.any():
+            n = int(done.sum())
+            self._theta[done] = self._rng.uniform(-np.pi, np.pi, n)
+            self._thetadot[done] = self._rng.uniform(-1.0, 1.0, n)
+            self._steps[done] = 0
+        return self._obs(), (-costs).astype(np.float32), done
+
+
+class GymnasiumVectorEnv(VectorEnv):
+    """Adapter over gymnasium.make_vec (reference EnvRunners' gym vector
+    envs)."""
+
+    def __init__(self, env_id: str, num_envs: int = 1, seed: int = 0):
+        import gymnasium as gym
+
+        self._env = gym.make_vec(env_id, num_envs=num_envs)
+        self.num_envs = num_envs
+        self._seed = seed
+        space = self._env.single_observation_space
+        self.observation_dim = int(np.prod(space.shape))
+        act = self._env.single_action_space
+        if hasattr(act, "n"):
+            self.num_actions = int(act.n)
+        else:
+            self.num_actions = -1
+            self.act_dim = int(np.prod(act.shape))
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        obs, _ = self._env.reset(seed=seed if seed is not None
+                                 else self._seed)
+        return obs.reshape(self.num_envs, -1).astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        obs, rew, term, trunc, _ = self._env.step(actions)
+        return (obs.reshape(self.num_envs, -1).astype(np.float32),
+                np.asarray(rew, np.float32),
+                np.asarray(term) | np.asarray(trunc))
+
+
+def make_env(env: Any, num_envs: int, env_config: Optional[Dict] = None,
+             seed: int = 0) -> VectorEnv:
+    env_config = dict(env_config or {})
+    if callable(env) and not isinstance(env, str):
+        return env(num_envs=num_envs, seed=seed, **env_config)
+    if env in _ENV_REGISTRY:
+        return _ENV_REGISTRY[env](num_envs=num_envs, seed=seed, **env_config)
+    if env in ("CartPole-v1", "CartPole-v0"):
+        return CartPoleVectorEnv(num_envs, seed=seed)
+    if env in ("Pendulum-v1", "Pendulum-v0"):
+        return PendulumVectorEnv(num_envs, seed=seed)
+    return GymnasiumVectorEnv(env, num_envs, seed=seed)
+
+
+__all__ = ["VectorEnv", "CartPoleVectorEnv", "PendulumVectorEnv",
+           "GymnasiumVectorEnv", "register_env", "make_env"]

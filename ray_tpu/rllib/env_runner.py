@@ -1,0 +1,130 @@
+"""EnvRunner: collects rollouts from a vector env with a jitted policy.
+
+Reference: rllib/env/single_agent_env_runner.py — an actor stepping
+gymnasium vector envs with RLModule inference. Here inference is a jitted
+CPU policy forward (runners live on host workers; JAX_PLATFORMS=cpu), and
+the same class runs in-process for num_env_runners=0 (reference "local
+EnvRunner" mode) or as a ray_tpu actor for the distributed fleet.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .env import make_env
+
+
+class EnvRunner:
+    def __init__(self, env: Any, *, num_envs: int = 1,
+                 rollout_fragment_length: int = 128, seed: int = 0,
+                 env_config: Optional[Dict] = None):
+        self.env = make_env(env, num_envs, env_config, seed=seed)
+        self.T = rollout_fragment_length
+        self.continuous = self.env.num_actions < 0
+        self._rng_key = None
+        self._seed = seed
+        self._obs = self.env.reset(seed=seed)
+        self._ep_returns = np.zeros(self.env.num_envs, np.float64)
+        self._ep_lens = np.zeros(self.env.num_envs, np.int64)
+        self._completed: List[float] = []
+        self._completed_lens: List[int] = []
+        self._act_fn = None
+
+    # ------------------------------------------------------------- policy
+
+    def _build_act(self):
+        import jax
+
+        from . import core
+
+        continuous = self.continuous
+
+        @jax.jit
+        def act(params, obs, key):
+            if continuous:
+                mean = core.policy_logits(params, obs)
+                a = core.gaussian_sample(key, mean, params["log_std"])
+                logp = core.gaussian_logp(mean, params["log_std"], a)
+            else:
+                logits = core.policy_logits(params, obs)
+                a = core.categorical_sample(key, logits)
+                logp = core.categorical_logp(logits, a)
+            return a, logp
+
+        return act
+
+    def sample(self, params: Any) -> Dict[str, Any]:
+        """One rollout fragment: T steps x num_envs. Returns numpy batch
+        {obs [T+1,N,D], actions, logp, rewards, dones [T,N]} + episode
+        stats of episodes completed during the fragment."""
+        import jax
+
+        if self._act_fn is None:
+            self._act_fn = self._build_act()
+            self._rng_key = jax.random.PRNGKey(self._seed)
+        n, d = self.env.num_envs, self.env.observation_dim
+        obs_buf = np.empty((self.T + 1, n, d), np.float32)
+        act_dtype = np.float32 if self.continuous else np.int32
+        act_shape = (self.T, n, self.env.act_dim) if self.continuous \
+            else (self.T, n)
+        act_buf = np.empty(act_shape, act_dtype)
+        logp_buf = np.empty((self.T, n), np.float32)
+        rew_buf = np.empty((self.T, n), np.float32)
+        done_buf = np.empty((self.T, n), np.bool_)
+
+        self._completed.clear()
+        self._completed_lens.clear()
+        obs = self._obs
+        for t in range(self.T):
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            a, logp = self._act_fn(params, obs, sub)
+            a = np.asarray(a)
+            obs_buf[t] = obs
+            act_buf[t] = a.astype(act_dtype)
+            logp_buf[t] = np.asarray(logp)
+            obs, rew, done = self.env.step(a)
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self._ep_returns += rew
+            self._ep_lens += 1
+            if done.any():
+                for i in np.flatnonzero(done):
+                    self._completed.append(float(self._ep_returns[i]))
+                    self._completed_lens.append(int(self._ep_lens[i]))
+                self._ep_returns[done] = 0.0
+                self._ep_lens[done] = 0
+        obs_buf[self.T] = obs
+        self._obs = obs
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "rewards": rew_buf, "dones": done_buf,
+            "episode_returns": list(self._completed),
+            "episode_lens": list(self._completed_lens),
+        }
+
+    def env_spec(self) -> Dict[str, int]:
+        return {"obs_dim": self.env.observation_dim,
+                "num_actions": self.env.num_actions,
+                "act_dim": self.env.act_dim,
+                "num_envs": self.env.num_envs}
+
+
+def make_remote_runners(env: Any, *, num_runners: int, num_envs: int,
+                        rollout_fragment_length: int,
+                        env_config: Optional[Dict] = None,
+                        seed: int = 0) -> List[Any]:
+    """Spawn EnvRunner actors (reference EnvRunnerGroup /
+    rollout worker set)."""
+    import ray_tpu
+
+    cls = ray_tpu.remote(EnvRunner)
+    return [cls.options(num_cpus=1.0).remote(
+        env, num_envs=num_envs,
+        rollout_fragment_length=rollout_fragment_length,
+        seed=seed + 1000 * (i + 1), env_config=env_config)
+        for i in range(num_runners)]
+
+
+__all__ = ["EnvRunner", "make_remote_runners"]

@@ -1,0 +1,148 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+Reference: rllib/algorithms/impala/impala.py:667 — EnvRunners sample
+continuously into queues; the learner consumes whatever is ready and
+corrects for policy lag with V-trace; weights broadcast on an interval.
+The rebuild keeps that async shape (outstanding sample() refs per runner,
+processed as they complete) with the update jitted end-to-end; this is
+the north-star async-RL workload shape of SURVEY.md §7 ("CPU env-runner
+fleet feeding device learners").
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import core
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.train_extra.update({
+            "entropy_coeff": 0.01, "vf_loss_coeff": 0.5, "grad_clip": 40.0,
+            "clip_rho_threshold": 1.0, "clip_c_threshold": 1.0,
+            "batches_per_step": 8,
+        })
+
+
+def make_impala_update(cfg: Dict[str, Any], continuous: bool, optimizer):
+    gamma = cfg["gamma"]
+    clip_rho = cfg["clip_rho_threshold"]
+    clip_c = cfg["clip_c_threshold"]
+    ent_coeff, vf_coeff = cfg["entropy_coeff"], cfg["vf_loss_coeff"]
+
+    def loss_fn(params, batch):
+        t1, n, d = batch["obs"].shape
+        T = t1 - 1
+        obs_flat = batch["obs"].reshape(-1, d)
+        values = core.value(params, obs_flat).reshape(t1, n)
+        if continuous:
+            mean = core.policy_logits(params, batch["obs"][:-1])
+            logp = core.gaussian_logp(mean, params["log_std"],
+                                      batch["actions"])
+            entropy = core.gaussian_entropy(params["log_std"])
+        else:
+            logits = core.policy_logits(params, batch["obs"][:-1])
+            logp = core.categorical_logp(logits, batch["actions"])
+            entropy = core.categorical_entropy(logits).mean()
+        pg_adv, vs = core.vtrace(batch["logp"], jax.lax.stop_gradient(logp),
+                                 batch["rewards"], values, batch["dones"],
+                                 gamma, clip_rho, clip_c)
+        pg_loss = -(logp * pg_adv).mean()
+        vf_loss = 0.5 * ((values[:-1] - vs) ** 2).mean()
+        total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def update(params, opt_state, batch):
+        (_, aux), grads = grad_fn(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+class IMPALA(Algorithm):
+    _default_config = {
+        "entropy_coeff": 0.01, "vf_loss_coeff": 0.5, "grad_clip": 40.0,
+        "clip_rho_threshold": 1.0, "clip_c_threshold": 1.0,
+        "batches_per_step": 8, "rollout_fragment_length": 64,
+        "num_envs_per_env_runner": 8, "lr": 5e-4,
+    }
+
+    def _build_learner(self) -> None:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(cfg.get("seed", 0))
+        act_out = self.act_dim if self.continuous else self.num_actions
+        self.params = core.policy_init(
+            key, self.obs_dim, act_out, tuple(cfg.get("hidden", (64, 64))),
+            continuous=self.continuous)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.get("grad_clip", 40.0)),
+            optax.adam(cfg.get("lr", 5e-4)))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_impala_update(cfg, self.continuous,
+                                          self.optimizer)
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner
+
+    def training_step(self) -> Dict[str, Any]:
+        n_batches = self.cfg.get("batches_per_step", 8)
+        metrics_acc = []
+
+        if self.local_runner is not None:
+            # degenerate synchronous path (still V-trace corrected)
+            for _ in range(n_batches):
+                b = self.local_runner.sample(self.params)
+                self._account(b)
+                metrics_acc.append(self._learn(b))
+        else:
+            import ray_tpu
+
+            # keep one outstanding sample per runner; behavior params are
+            # whatever was current at launch (V-trace absorbs the lag)
+            for r in self.runners:
+                if r not in self._inflight.values():
+                    ref = r.sample.remote(self._host_params())
+                    self._inflight[ref] = r
+            processed = 0
+            while processed < n_batches:
+                done, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                       num_returns=1, timeout=30.0)
+                if not done:
+                    break
+                ref = done[0]
+                runner = self._inflight.pop(ref)
+                b = ray_tpu.get(ref)
+                self._account(b)
+                metrics_acc.append(self._learn(b))
+                processed += 1
+                # relaunch with fresh weights (broadcast-on-consume)
+                nref = runner.sample.remote(self._host_params())
+                self._inflight[nref] = runner
+        out = {k: float(np.mean([m[k] for m in metrics_acc]))
+               for k in metrics_acc[0]} if metrics_acc else {}
+        return out
+
+    def _account(self, b: Dict[str, Any]) -> None:
+        self._episode_returns.extend(b["episode_returns"])
+        self._episode_lens.extend(b["episode_lens"])
+        self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+
+    def _learn(self, b: Dict[str, Any]) -> Dict[str, float]:
+        batch = {k: jnp.asarray(v) for k, v in b.items()
+                 if k in ("obs", "actions", "logp", "rewards", "dones")}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in aux.items()}
+
+
+__all__ = ["IMPALA", "IMPALAConfig", "make_impala_update"]

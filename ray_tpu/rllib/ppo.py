@@ -1,0 +1,148 @@
+"""PPO: clipped-surrogate policy optimization with a jitted SPMD learner.
+
+Reference: rllib/algorithms/ppo/ (ppo.py:408 training_step =
+synchronous_parallel_sample -> learner_group.update_from_episodes;
+torch loss in ppo_torch_learner.py). The rebuild compiles the ENTIRE
+update — GAE, advantage normalization, epochs x minibatches of
+clipped-surrogate SGD — into one jitted function with donated state: no
+per-minibatch python, no DDP allreduce (gradients sync via XLA psum when
+the batch is sharded over a dp mesh axis).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import core
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self.train_extra.update({
+            "lambda_": 0.95, "clip_param": 0.2, "vf_clip_param": 10.0,
+            "num_sgd_iter": 8, "minibatch_size": 256,
+            "entropy_coeff": 0.0, "vf_loss_coeff": 0.5,
+            "grad_clip": 0.5,
+        })
+
+
+def make_ppo_update(cfg: Dict[str, Any], continuous: bool, optimizer):
+    """Build the jitted update(params, opt_state, key, batch)."""
+    gamma, lam = cfg["gamma"], cfg["lambda_"]
+    clip, vf_clip = cfg["clip_param"], cfg["vf_clip_param"]
+    epochs, mb_size = cfg["num_sgd_iter"], cfg["minibatch_size"]
+    ent_coeff, vf_coeff = cfg["entropy_coeff"], cfg["vf_loss_coeff"]
+
+    def loss_fn(params, mb):
+        if continuous:
+            mean = core.policy_logits(params, mb["obs"])
+            logp = core.gaussian_logp(mean, params["log_std"],
+                                      mb["actions"])
+            entropy = core.gaussian_entropy(params["log_std"])
+        else:
+            logits = core.policy_logits(params, mb["obs"])
+            logp = core.categorical_logp(logits, mb["actions"])
+            entropy = core.categorical_entropy(logits).mean()
+        ratio = jnp.exp(logp - mb["logp"])
+        adv = mb["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg = -jnp.minimum(ratio * adv,
+                          jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+        v = core.value(params, mb["obs"])
+        vf = 0.5 * jnp.minimum((v - mb["targets"]) ** 2,
+                               vf_clip ** 2).mean()
+        total = pg + vf_coeff * vf - ent_coeff * entropy
+        return total, {"policy_loss": pg, "vf_loss": vf,
+                       "entropy": entropy,
+                       "kl": (mb["logp"] - logp).mean()}
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def update(params, opt_state, key, batch):
+        # batch: obs [T+1,N,D], actions [T,N(,A)], logp/rewards/dones [T,N]
+        t1, n, d = batch["obs"].shape
+        T = t1 - 1
+        values = core.value(params, batch["obs"].reshape(-1, d)) \
+            .reshape(t1, n)
+        adv, targets = core.compute_gae(batch["rewards"], values,
+                                        batch["dones"], gamma, lam)
+        m = T * n
+        flat = {
+            "obs": batch["obs"][:-1].reshape(m, d),
+            "actions": batch["actions"].reshape(
+                (m, -1) if continuous else (m,)),
+            "logp": batch["logp"].reshape(m),
+            "adv": adv.reshape(m),
+            "targets": targets.reshape(m),
+        }
+        n_mb = max(1, m // mb_size)
+        usable = n_mb * (m // n_mb)
+
+        def epoch(carry, ekey):
+            params, opt_state = carry
+            perm = jax.random.permutation(ekey, m)[:usable] \
+                .reshape(n_mb, -1)
+
+            def mb_step(carry, idx):
+                params, opt_state = carry
+                mb = jax.tree.map(lambda a: a[idx], flat)
+                (_, aux), grads = grad_fn(params, mb)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), aux
+
+            (params, opt_state), auxes = jax.lax.scan(
+                mb_step, (params, opt_state), perm)
+            return (params, opt_state), auxes
+
+        (params, opt_state), auxes = jax.lax.scan(
+            epoch, (params, opt_state), jax.random.split(key, epochs))
+        metrics = jax.tree.map(lambda a: a.mean(), auxes)
+        metrics["vf_explained_var"] = 1.0 - jnp.var(
+            targets - values[:-1]) / (jnp.var(targets) + 1e-8)
+        return params, opt_state, metrics
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+class PPO(Algorithm):
+    _default_config = {
+        "lambda_": 0.95, "clip_param": 0.2, "vf_clip_param": 10.0,
+        "num_sgd_iter": 8, "minibatch_size": 256, "entropy_coeff": 0.0,
+        "vf_loss_coeff": 0.5, "grad_clip": 0.5,
+        "rollout_fragment_length": 128, "num_envs_per_env_runner": 8,
+    }
+
+    def _build_learner(self) -> None:
+        cfg = self.cfg
+        key = jax.random.PRNGKey(cfg.get("seed", 0))
+        act_out = self.act_dim if self.continuous else self.num_actions
+        self.params = core.policy_init(
+            key, self.obs_dim, act_out, tuple(cfg.get("hidden", (64, 64))),
+            continuous=self.continuous)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.get("grad_clip", 0.5)),
+            optax.adam(cfg.get("lr", 3e-4)))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_ppo_update(cfg, self.continuous, self.optimizer)
+        self._key = jax.random.PRNGKey(cfg.get("seed", 0) + 1)
+
+    def training_step(self) -> Dict[str, Any]:
+        batches = self._collect_batches()
+        batch = self._concat_batches(batches)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, sub, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+
+__all__ = ["PPO", "PPOConfig", "make_ppo_update"]
