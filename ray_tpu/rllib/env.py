@@ -158,8 +158,16 @@ class GymnasiumVectorEnv(VectorEnv):
 
     def __init__(self, env_id: str, num_envs: int = 1, seed: int = 0):
         import gymnasium as gym
+        from gymnasium.vector import AutoresetMode, SyncVectorEnv
 
-        self._env = gym.make_vec(env_id, num_envs=num_envs)
+        # gymnasium >= 1.0 defaults vector envs to NEXT_STEP autoreset (the
+        # done step returns the final obs and the following step is a no-op
+        # reset transition). The runner's rollout/GAE logic expects the
+        # classic semantics — obs returned alongside done=True is already the
+        # next episode's reset obs — so request SAME_STEP explicitly.
+        self._env = SyncVectorEnv(
+            [lambda: gym.make(env_id) for _ in range(num_envs)],
+            autoreset_mode=AutoresetMode.SAME_STEP)
         self.num_envs = num_envs
         self._seed = seed
         space = self._env.single_observation_space

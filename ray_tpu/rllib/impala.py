@@ -54,6 +54,11 @@ def make_impala_update(cfg: Dict[str, Any], continuous: bool, optimizer):
         pg_adv, vs = core.vtrace(batch["logp"], jax.lax.stop_gradient(logp),
                                  batch["rewards"], values, batch["dones"],
                                  gamma, clip_rho, clip_c)
+        # V-trace targets are fixed regression/advantage targets: without the
+        # stop_gradient the critic differentiates through its own target via
+        # `values`, and pg_adv leaks critic gradients into the policy loss.
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        vs = jax.lax.stop_gradient(vs)
         pg_loss = -(logp * pg_adv).mean()
         vf_loss = 0.5 * ((values[:-1] - vs) ** 2).mean()
         total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy

@@ -1,0 +1,240 @@
+"""ray_tpu.serve — model-serving library, analog of the reference's
+python/ray/serve (api.py: serve.run :543, @serve.deployment; _private/
+api.py:208 serve_start; _private/client.py:243 deploy_application).
+
+Architecture (SURVEY.md §3.5): a singleton ServeController actor reconciles
+deployment targets into ReplicaActors and runs an HTTP ProxyActor; handles
+route requests pow-2 over replica queue lengths. TPU-first notes: replicas
+pin jitted model shards, @serve.batch keeps the MXU fed with batched forward
+passes, @serve.multiplexed LRU-loads weight sets into HBM."""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import cloudpickle
+
+from .batching import batch  # noqa: F401
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
+from .context import get_request_context  # noqa: F401
+from .controller import ServeController
+from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+from .http_util import Request  # noqa: F401
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+from .replica import HandleMarker
+
+
+class Application:
+    """A deployment bound to init args — reference serve/_private/
+    deployment_graph_build.py's DeploymentNode, minus the DAG generality Serve
+    dropped upstream too."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self._deployment = deployment
+        self._args = args
+        self._kwargs = kwargs
+
+
+class Deployment:
+    """Created by @serve.deployment — reference python/ray/serve/
+    deployment.py."""
+
+    def __init__(self, func_or_class, name: str,
+                 config: Optional[DeploymentConfig] = None):
+        self._func_or_class = func_or_class
+        self.name = name
+        self.config = config or DeploymentConfig()
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[Union[int, str]] = None,
+                max_ongoing_requests: Optional[int] = None,
+                user_config: Optional[Any] = None,
+                autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
+                health_check_period_s: Optional[float] = None,
+                health_check_timeout_s: Optional[float] = None,
+                graceful_shutdown_timeout_s: Optional[float] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None
+                ) -> "Deployment":
+        import dataclasses
+        cfg = dataclasses.replace(self.config)
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        if num_replicas == "auto":
+            autoscaling_config = autoscaling_config or AutoscalingConfig(
+                min_replicas=1, max_replicas=8)
+            num_replicas = None
+        for field, value in [("num_replicas", num_replicas),
+                             ("max_ongoing_requests", max_ongoing_requests),
+                             ("user_config", user_config),
+                             ("autoscaling_config", autoscaling_config),
+                             ("health_check_period_s", health_check_period_s),
+                             ("health_check_timeout_s", health_check_timeout_s),
+                             ("graceful_shutdown_timeout_s",
+                              graceful_shutdown_timeout_s),
+                             ("ray_actor_options", ray_actor_options)]:
+            if value is not None:
+                setattr(cfg, field, value)
+        return Deployment(self._func_or_class, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"deployment {self.name} cannot be called directly; use "
+            f".bind() + serve.run(), then handle.remote(...)")
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None, **options):
+    """@serve.deployment — reference serve/api.py deployment decorator."""
+
+    def deco(fc):
+        d = Deployment(fc, name or fc.__name__)
+        if options:
+            d = d.options(**options)
+        return d
+
+    if _func_or_class is not None:
+        return deco(_func_or_class)
+    return deco
+
+
+# -- controller lifecycle ---------------------------------------------------
+
+def _get_controller(create: bool = True, http_options:
+                    Optional[HTTPOptions] = None):
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001 — not started yet
+        if not create:
+            raise RuntimeError("Serve is not running on this cluster")
+    http_options = http_options or HTTPOptions()
+    ctrl = ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, max_concurrency=64).remote(
+            http_options.host, http_options.port)
+    return ctrl
+
+
+def start(http_options: Optional[HTTPOptions] = None,
+          **http_kwargs) -> None:
+    """Start the Serve control plane — reference serve/_private/api.py:208."""
+    if http_options is None and http_kwargs:
+        http_options = HTTPOptions(**http_kwargs)
+    _get_controller(create=True, http_options=http_options)
+
+
+def _build_app_config(target: Union[Application, Deployment], name: str,
+                      route_prefix: str) -> Dict[str, Any]:
+    if isinstance(target, Deployment):
+        target = target.bind()
+    seen: Dict[str, Dict[str, Any]] = {}
+
+    def visit(app: Application) -> str:
+        dep = app._deployment
+
+        def swap(obj):
+            if isinstance(obj, Application):
+                return HandleMarker(visit(obj))
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(swap(x) for x in obj)
+            if isinstance(obj, dict):
+                return {k: swap(v) for k, v in obj.items()}
+            return obj
+
+        args = tuple(swap(a) for a in app._args)
+        kwargs = {k: swap(v) for k, v in app._kwargs.items()}
+        if dep.name not in seen:
+            seen[dep.name] = {
+                "name": dep.name,
+                "serialized_callable": cloudpickle.dumps(dep._func_or_class),
+                "init_args": cloudpickle.dumps((args, kwargs)),
+                "config": dep.config,
+            }
+        return dep.name
+
+    ingress = visit(target)
+    return {"name": name, "route_prefix": route_prefix, "ingress": ingress,
+            "deployments": list(seen.values())}
+
+
+def run(target: Union[Application, Deployment], *, name: str = "default",
+        route_prefix: str = "/", blocking_timeout_s: float = 120.0,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application and wait for it to be RUNNING — reference
+    serve/api.py:543."""
+    import ray_tpu
+    ctrl = _get_controller(create=True)
+    cfg = _build_app_config(target, name, route_prefix)
+    ray_tpu.get(ctrl.deploy_application.remote(cfg), timeout=60.0)
+    if _blocking:
+        deadline = time.monotonic() + blocking_timeout_s
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(ctrl.get_serve_status.remote(), timeout=30.0)
+            app = st["applications"].get(name)
+            if app is not None and app["status"] == "RUNNING":
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(
+                f"application '{name}' did not become RUNNING within "
+                f"{blocking_timeout_s}s")
+    return DeploymentHandle(cfg["ingress"], name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu
+    ctrl = _get_controller(create=False)
+    st = ray_tpu.get(ctrl.get_serve_status.remote(), timeout=30.0)
+    app = st["applications"].get(name)
+    if app is None:
+        raise ValueError(f"no application named '{name}'")
+    return DeploymentHandle(app["ingress"], name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+    ctrl = _get_controller(create=False)
+    return ray_tpu.get(ctrl.get_serve_status.remote(), timeout=30.0)
+
+
+def proxy_address() -> Optional[tuple]:
+    import ray_tpu
+    ctrl = _get_controller(create=False)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        addr = ray_tpu.get(ctrl.get_proxy_address.remote(), timeout=30.0)
+        if addr is not None:
+            return tuple(addr)
+        time.sleep(0.1)
+    return None
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+    ctrl = _get_controller(create=False)
+    ray_tpu.get(ctrl.delete_application.remote(name), timeout=60.0)
+
+
+def shutdown() -> None:
+    """Tear down all of Serve — reference serve/api.py serve.shutdown."""
+    import ray_tpu
+    try:
+        ctrl = _get_controller(create=False)
+    except RuntimeError:
+        return
+    try:
+        ray_tpu.get(ctrl.graceful_shutdown.remote(), timeout=30.0)
+    except Exception:  # noqa: BLE001 — force-kill below
+        pass
+    try:
+        ray_tpu.kill(ctrl)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,58 @@
+"""Serve configuration schemas — analog of the reference's
+python/ray/serve/config.py and schema.py (pydantic there; plain dataclasses
+here — no pydantic dependency in the TPU build)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """Queue-length-driven autoscaling — reference
+    python/ray/serve/config.py AutoscalingConfig + autoscaling_policy.py.
+    Target replicas = ceil(total ongoing requests / target_ongoing_requests),
+    clamped to [min_replicas, max_replicas], smoothed by upscale/downscale
+    delays."""
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 60.0
+    metrics_interval_s: float = 0.5
+
+    def validate(self) -> None:
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError(
+                f"invalid autoscaling bounds [{self.min_replicas}, "
+                f"{self.max_replicas}]")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+@dataclass
+class DeploymentConfig:
+    """Per-deployment config — reference serve/config.py DeploymentConfig."""
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_ongoing_requests < 1:
+            raise ValueError("max_ongoing_requests must be >= 1")
+        if self.autoscaling_config is not None:
+            self.autoscaling_config.validate()
+
+
+@dataclass
+class HTTPOptions:
+    """Proxy options — reference python/ray/serve/config.py HTTPOptions."""
+    host: str = "127.0.0.1"
+    port: int = 8000

@@ -1,0 +1,309 @@
+"""ServeController — analog of the reference's python/ray/serve/_private/
+controller.py:85 (ServeController) + deployment_state.py:1225,2447
+(DeploymentState/DeploymentStateManager reconciliation) +
+autoscaling_policy.py (queue-length autoscaling) + long_poll.py (config push
+modeled as a version counter routers poll).
+
+One named actor owns all Serve state; a background thread reconciles target
+vs running replicas, health-checks them, and autoscales."""
+from __future__ import annotations
+
+import math
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import AutoscalingConfig, DeploymentConfig
+from .handle import CONTROLLER_NAME  # noqa: F401 — canonical name lives here
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, name: str, serialized_callable: bytes,
+                 init_args: bytes, config: DeploymentConfig):
+        self.app_name = app_name
+        self.name = name
+        self.serialized_callable = serialized_callable
+        self.init_args = init_args
+        self.config = config
+        self.target_num_replicas = config.num_replicas
+        if config.autoscaling_config is not None:
+            self.target_num_replicas = max(
+                config.autoscaling_config.min_replicas, 1)
+        self.replicas: List[Tuple[str, Any]] = []  # (tag, ActorHandle)
+        self.last_health_check = 0.0
+        self.last_scale_up_ok = time.monotonic()
+        self.last_scale_down_ok = time.monotonic()
+        self.status = "DEPLOYING"
+        # handle_id -> (total inflight from that handle, monotonic ts)
+        self.handle_metrics: Dict[str, Tuple[float, float]] = {}
+
+    def to_status(self) -> Dict[str, Any]:
+        return {"name": self.name, "status": self.status,
+                "target_num_replicas": self.target_num_replicas,
+                "replicas": [tag for tag, _ in self.replicas]}
+
+
+class ServeController:
+    """Reference controller.py:85 — singleton detached actor."""
+
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000):
+        self._apps: Dict[str, Dict[str, Any]] = {}
+        self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
+        self._version = 0
+        self._lock = threading.RLock()
+        self._shutting_down = False
+        self._http_host = http_host
+        self._http_port = http_port
+        self._proxy = None
+        self._proxy_addr: Optional[Tuple[str, int]] = None
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile")
+        self._reconcile_thread.start()
+
+    # -- deploy / delete ----------------------------------------------------
+    def deploy_application(self, app_config: Dict[str, Any]) -> None:
+        """app_config: {name, route_prefix, ingress,
+        deployments: [{name, serialized_callable, init_args, config}]}."""
+        app = app_config["name"]
+        # Tear down any previous version OUTSIDE the lock: replica drain can
+        # take graceful_shutdown_timeout_s per replica and must not block
+        # get_serve_status/poll_update/record_handle_metrics.
+        self.delete_application(app)
+        with self._lock:
+            self._apps[app] = {
+                "route_prefix": app_config.get("route_prefix", "/"),
+                "ingress": app_config["ingress"],
+                "deployments": [d["name"]
+                                for d in app_config["deployments"]],
+            }
+            for d in app_config["deployments"]:
+                cfg = d["config"]
+                cfg.validate()
+                self._deployments[(app, d["name"])] = _DeploymentState(
+                    app, d["name"], d["serialized_callable"], d["init_args"],
+                    cfg)
+            self._version += 1
+
+    def delete_application(self, app: str) -> None:
+        with self._lock:
+            if app not in self._apps:
+                return
+            doomed = [k for k in self._deployments if k[0] == app]
+            states = [self._deployments.pop(k) for k in doomed]
+            del self._apps[app]
+            self._version += 1
+        for st in states:
+            for tag, handle in st.replicas:
+                self._stop_replica(handle, st.config)
+
+    def graceful_shutdown(self) -> None:
+        with self._lock:
+            self._shutting_down = True
+            states = list(self._deployments.values())
+            self._deployments.clear()
+            self._apps.clear()
+            self._version += 1
+        import ray_tpu
+        for st in states:
+            for tag, handle in st.replicas:
+                self._stop_replica(handle, st.config)
+        if self._proxy is not None:
+            try:
+                ray_tpu.get(self._proxy.graceful_shutdown.remote(),
+                            timeout=5.0)
+                ray_tpu.kill(self._proxy)
+            except Exception:  # noqa: BLE001 — proxy may already be gone
+                pass
+
+    # -- introspection (state API / routers / proxy) ------------------------
+    def get_replicas(self, app: str, deployment: str
+                     ) -> Tuple[int, List[Tuple[str, Any]]]:
+        with self._lock:
+            st = self._deployments.get((app, deployment))
+            if st is None:
+                return self._version, []
+            return self._version, list(st.replicas)
+
+    def get_route_table(self) -> Dict[str, Tuple[str, str]]:
+        with self._lock:
+            return {info["route_prefix"]: (app, info["ingress"])
+                    for app, info in self._apps.items()}
+
+    def poll_update(self, known_version: int, timeout_s: float = 10.0) -> int:
+        """Long-poll — reference _private/long_poll.py LongPollHost."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._version != known_version:
+                    return self._version
+            time.sleep(0.05)
+        return known_version
+
+    def get_serve_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "proxy": {"host": self._http_host, "port": self._http_port,
+                          "ready": self._proxy_addr is not None},
+                "applications": {
+                    app: {
+                        "route_prefix": info["route_prefix"],
+                        "ingress": info["ingress"],
+                        "status": self._app_status(app),
+                        "deployments": {
+                            d: self._deployments[(app, d)].to_status()
+                            for d in info["deployments"]},
+                    } for app, info in self._apps.items()},
+            }
+
+    def _app_status(self, app: str) -> str:
+        sts = [self._deployments[(app, d)].status
+               for d in self._apps[app]["deployments"]]
+        if all(s == "RUNNING" for s in sts):
+            return "RUNNING"
+        if any(s == "UNHEALTHY" for s in sts):
+            return "UNHEALTHY"
+        return "DEPLOYING"
+
+    def get_proxy_address(self) -> Optional[Tuple[str, int]]:
+        return self._proxy_addr
+
+    # -- reconciliation -----------------------------------------------------
+    def _reconcile_loop(self):
+        while not self._shutting_down:
+            try:
+                self._ensure_proxy()
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                import traceback
+                traceback.print_exc()
+            time.sleep(0.25)
+
+    def _ensure_proxy(self):
+        if self._proxy is not None:
+            return
+        import ray_tpu
+        from .proxy import ProxyActor
+        self._proxy = ray_tpu.remote(ProxyActor).options(
+            name=PROXY_NAME, max_concurrency=32).remote(
+                self._http_host, self._http_port)
+        self._proxy_addr = tuple(ray_tpu.get(self._proxy.ready.remote()))
+        # The proxy skips ports already in use — report the bound one.
+        self._http_host, self._http_port = self._proxy_addr
+
+    def _reconcile_once(self):
+        import ray_tpu
+        with self._lock:
+            states = list(self._deployments.values())
+        for st in states:
+            self._autoscale(st)
+            with self._lock:
+                live = list(st.replicas)
+                want = st.target_num_replicas
+            # health checks (reference deployment_state.py check_health path)
+            now = time.monotonic()
+            if now - st.last_health_check > st.config.health_check_period_s:
+                st.last_health_check = now
+                healthy = []
+                for tag, handle in live:
+                    try:
+                        ray_tpu.get(handle.check_health.remote(),
+                                    timeout=st.config.health_check_timeout_s)
+                        healthy.append((tag, handle))
+                    except Exception:  # noqa: BLE001 — replica is dead
+                        try:
+                            ray_tpu.kill(handle)
+                        except Exception:  # noqa: BLE001
+                            pass
+                if len(healthy) != len(live):
+                    with self._lock:
+                        st.replicas = healthy
+                        self._version += 1
+                    live = healthy
+            # scale up
+            while len(live) < want:
+                tag = f"{st.app_name}#{st.name}#{uuid.uuid4().hex[:6]}"
+                try:
+                    handle = self._start_replica(st, tag)
+                except Exception:  # noqa: BLE001 — e.g. no resources yet
+                    st.status = "DEPLOYING"
+                    break
+                live.append((tag, handle))
+                with self._lock:
+                    st.replicas = list(live)
+                    self._version += 1
+            # scale down (newest first, like the reference's pending-first)
+            removed = []
+            while len(live) > want:
+                removed.append(live.pop())
+            if removed:
+                with self._lock:
+                    st.replicas = list(live)
+                    self._version += 1
+                for tag, handle in removed:
+                    self._stop_replica(handle, st.config)
+            st.status = "RUNNING" if len(live) >= want else "DEPLOYING"
+
+    def _start_replica(self, st: _DeploymentState, tag: str):
+        import ray_tpu
+        from .replica import ReplicaActor
+        opts = dict(st.config.ray_actor_options or {})
+        opts.setdefault("max_concurrency", st.config.max_ongoing_requests)
+        handle = ray_tpu.remote(ReplicaActor).options(**opts).remote(
+            tag, st.name, st.app_name, st.serialized_callable, st.init_args,
+            st.config.user_config)
+        # Block until constructed so a broken __init__ surfaces here.
+        ray_tpu.get(handle.check_health.remote(), timeout=60.0)
+        return handle
+
+    def _stop_replica(self, handle, config: DeploymentConfig):
+        import ray_tpu
+        try:
+            ray_tpu.get(handle.prepare_for_shutdown.remote(
+                config.graceful_shutdown_timeout_s),
+                timeout=config.graceful_shutdown_timeout_s + 5.0)
+        except Exception:  # noqa: BLE001 — force-kill below either way
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- autoscaling --------------------------------------------------------
+    def record_handle_metrics(self, app: str, deployment: str,
+                              handle_id: str, inflight: float) -> None:
+        """Reference serve/_private/autoscaling_state.py — handles push their
+        queued+ongoing counts; the controller aggregates across handles."""
+        with self._lock:
+            st = self._deployments.get((app, deployment))
+            if st is not None:
+                st.handle_metrics[handle_id] = (inflight, time.monotonic())
+
+    _METRICS_STALE_S = 3.0
+
+    def _autoscale(self, st: _DeploymentState):
+        cfg: Optional[AutoscalingConfig] = st.config.autoscaling_config
+        if cfg is None or not st.replicas:
+            return
+        now = time.monotonic()
+        with self._lock:
+            st.handle_metrics = {
+                h: (v, ts) for h, (v, ts) in st.handle_metrics.items()
+                if now - ts < self._METRICS_STALE_S}
+            total = sum(v for v, _ in st.handle_metrics.values())
+        desired = int(math.ceil(total / cfg.target_ongoing_requests))
+        desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
+        now = time.monotonic()
+        current = st.target_num_replicas
+        if desired <= current:
+            st.last_scale_up_ok = now  # not under pressure
+        if desired >= current:
+            st.last_scale_down_ok = now  # not over-provisioned
+        if desired > current and \
+                now - st.last_scale_up_ok >= cfg.upscale_delay_s:
+            st.target_num_replicas = desired
+        elif desired < current and \
+                now - st.last_scale_down_ok >= cfg.downscale_delay_s:
+            st.target_num_replicas = desired

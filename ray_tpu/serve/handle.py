@@ -1,0 +1,236 @@
+"""DeploymentHandle + router — analog of the reference's
+python/ray/serve/handle.py (DeploymentHandle :711, DeploymentResponse) and
+_private/router.py:297 + replica_scheduler/pow_2_scheduler.py:49.
+
+Replica choice is power-of-two-choices over cached queue lengths: the router
+keeps a per-replica in-flight estimate (incremented on submit, decremented on
+completion) and periodically reconciles against replica-reported queue
+lengths, like the reference's cached RunningReplica queue-length probes."""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class RequestMetadata:
+    def __init__(self, call_method: str = "__call__",
+                 multiplexed_model_id: str = "", is_http: bool = False,
+                 app_name: str = "", route: str = ""):
+        self.call_method = call_method
+        self.multiplexed_model_id = multiplexed_model_id
+        self.is_http = is_http
+        self.app_name = app_name
+        self.route = route
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(call_method=self.call_method,
+                    multiplexed_model_id=self.multiplexed_model_id,
+                    is_http=self.is_http, app_name=self.app_name,
+                    route=self.route)
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() — reference handle.py
+    DeploymentResponse. Pass it to another handle call and it resolves to the
+    underlying ObjectRef (model composition without driver round-trips)."""
+
+    def __init__(self, object_ref, router: "Router", replica_tag: str):
+        self._object_ref = object_ref
+        self._router = router
+        self._replica_tag = replica_tag
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+        try:
+            return ray_tpu.get(self._object_ref, timeout=timeout_s)
+        finally:
+            self._mark_done()
+
+    def _to_object_ref(self):
+        self._mark_done()
+        return self._object_ref
+
+    def _mark_done(self):
+        if not self._done:
+            self._done = True
+            self._router._complete(self._replica_tag)
+
+    def __del__(self):
+        # Fire-and-forget callers drop the response without result();
+        # release the router's in-flight slot so pow-2 routing and the
+        # autoscaler metrics don't leak upward forever.
+        try:
+            self._mark_done()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class Router:
+    """Caches the replica set for one deployment (refreshed from the
+    controller on a version bump) and schedules requests pow-2 style."""
+
+    _REFRESH_S = 1.0
+
+    _METRICS_PUSH_S = 0.5
+
+    def __init__(self, deployment_name: str, app_name: str):
+        self._deployment = deployment_name
+        self._app = app_name
+        self._lock = threading.Lock()
+        self._version = -1
+        self._replicas: List[Tuple[str, Any]] = []  # (tag, ActorHandle)
+        self._inflight: Dict[str, int] = {}
+        self._last_refresh = 0.0
+        self._handle_id = f"router-{id(self):x}"
+        self._metrics_started = False
+
+    def _controller(self):
+        import ray_tpu
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            stale = force or not self._replicas or \
+                now - self._last_refresh > self._REFRESH_S
+        if not stale:
+            return
+        import ray_tpu
+        version, replicas = ray_tpu.get(
+            self._controller().get_replicas.remote(
+                self._app, self._deployment))
+        with self._lock:
+            self._last_refresh = time.monotonic()
+            if version != self._version:
+                self._version = version
+                self._replicas = list(replicas)
+                self._inflight = {tag: self._inflight.get(tag, 0)
+                                  for tag, _ in self._replicas}
+
+    def _pick(self) -> Tuple[str, Any]:
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                if self._replicas:
+                    if len(self._replicas) == 1:
+                        chosen = self._replicas[0]
+                    else:
+                        a, b = random.sample(self._replicas, 2)
+                        chosen = a if self._inflight.get(a[0], 0) <= \
+                            self._inflight.get(b[0], 0) else b
+                    self._inflight[chosen[0]] = \
+                        self._inflight.get(chosen[0], 0) + 1
+                    return chosen
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no running replicas for deployment "
+                    f"{self._app}#{self._deployment} after 30s")
+            time.sleep(0.1)
+
+    def _complete(self, tag: str):
+        with self._lock:
+            if tag in self._inflight and self._inflight[tag] > 0:
+                self._inflight[tag] -= 1
+
+    def _start_metrics_push(self):
+        """Handle-side autoscaling metrics — reference serve/_private/
+        router.py pushes num_queued+ongoing per handle to the controller
+        (autoscaling_state.py); replica-side probes would deadlock behind a
+        saturated replica's own request pool."""
+        with self._lock:
+            if self._metrics_started:
+                return
+            self._metrics_started = True
+
+        def push_loop():
+            while True:
+                time.sleep(self._METRICS_PUSH_S)
+                try:
+                    with self._lock:
+                        total = sum(self._inflight.values())
+                    self._controller().record_handle_metrics.remote(
+                        self._app, self._deployment, self._handle_id, total)
+                except Exception:  # noqa: BLE001 — controller restarting
+                    pass
+
+        threading.Thread(target=push_loop, daemon=True,
+                         name="serve-handle-metrics").start()
+
+    def assign(self, meta: RequestMetadata, args, kwargs,
+               retries: int = 2) -> DeploymentResponse:
+        self._start_metrics_push()
+        last_err: Optional[Exception] = None
+        for _ in range(retries + 1):
+            tag, handle = self._pick()
+            try:
+                ref = handle.handle_request.remote(
+                    meta.to_dict(), list(args), dict(kwargs))
+                return DeploymentResponse(ref, self, tag)
+            except Exception as e:  # noqa: BLE001 — dead replica: drop + retry
+                last_err = e
+                self._complete(tag)
+                self._refresh(force=True)
+        raise last_err  # type: ignore[misc]
+
+
+class DeploymentHandle:
+    """Picklable handle to a deployment — reference serve/handle.py:711.
+    ``handle.method.remote(*args)`` returns a DeploymentResponse."""
+
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 _call_method: str = "__call__",
+                 _multiplexed_model_id: str = ""):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._call_method = _call_method
+        self._multiplexed_model_id = _multiplexed_model_id
+        self._router_obj: Optional[Router] = None
+        self._router_lock = threading.Lock()
+
+    @property
+    def _router(self) -> Router:
+        with self._router_lock:
+            if self._router_obj is None:
+                self._router_obj = Router(self.deployment_name, self.app_name)
+            return self._router_obj
+
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            _call_method=method_name or self._call_method,
+            _multiplexed_model_id=(multiplexed_model_id
+                                   if multiplexed_model_id is not None
+                                   else self._multiplexed_model_id))
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        meta = RequestMetadata(
+            call_method=self._call_method,
+            multiplexed_model_id=self._multiplexed_model_id,
+            app_name=self.app_name)
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        return self._router.assign(meta, args, kwargs)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._call_method,
+                 self._multiplexed_model_id))
+
+    def __repr__(self):
+        return (f"DeploymentHandle(deployment='{self.deployment_name}', "
+                f"app='{self.app_name}')")

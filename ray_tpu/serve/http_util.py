@@ -1,0 +1,52 @@
+"""Minimal HTTP request/response model shared by proxy and replicas —
+analog of the reference's python/ray/serve/_private/http_util.py (which
+adapts Starlette; the TPU build carries a plain picklable Request so it can
+cross the proxy->replica actor boundary without an ASGI dependency)."""
+from __future__ import annotations
+
+import json as _json
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qsl
+
+
+class Request:
+    """What an ingress deployment's __call__ receives for HTTP requests."""
+
+    def __init__(self, method: str, path: str, query_string: str = "",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: bytes = b""):
+        self.method = method
+        self.path = path
+        self.query_string = query_string
+        self.headers = dict(headers or {})
+        self.body = body
+
+    @property
+    def query_params(self) -> Dict[str, str]:
+        return dict(parse_qsl(self.query_string))
+
+    def json(self) -> Any:
+        return _json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode("utf-8", "replace")
+
+    def __repr__(self):
+        return f"Request({self.method} {self.path})"
+
+
+def coerce_response(result: Any) -> Tuple[int, Dict[str, str], bytes]:
+    """Map a user return value to (status, headers, body) the way the
+    reference proxy does for Starlette responses / raw returns."""
+    if isinstance(result, tuple) and len(result) == 2 and \
+            isinstance(result[0], int):
+        status, payload = result
+    else:
+        status, payload = 200, result
+    if isinstance(payload, bytes):
+        return status, {"content-type": "application/octet-stream"}, payload
+    if isinstance(payload, str):
+        return status, {"content-type": "text/plain; charset=utf-8"}, \
+            payload.encode()
+    return status, {"content-type": "application/json"}, \
+        _json.dumps(payload, default=str).encode()

@@ -1,0 +1,152 @@
+"""HTTP proxy actor — analog of the reference's python/ray/serve/_private/
+proxy.py (ProxyActor :1111, HTTPProxy.__call__ :836, proxy_request :423) +
+proxy_router.py (longest-prefix route matching).
+
+The reference embeds uvicorn; here an aiohttp server runs inside the actor on
+its own thread/event loop. Replica calls are sync actor calls dispatched to a
+thread pool so the event loop stays free."""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+from .handle import CONTROLLER_NAME, DeploymentHandle, RequestMetadata
+from .http_util import Request, coerce_response
+
+MULTIPLEX_HEADER = "serve_multiplexed_model_id"
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._handles: Dict[Tuple[str, str], DeploymentHandle] = {}
+        self._route_version = -1
+        self._ready = threading.Event()
+        self._bound_port: Optional[int] = None
+        self._shutdown = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix="proxy-call")
+        threading.Thread(target=self._serve_thread, daemon=True,
+                         name="serve-proxy-http").start()
+        threading.Thread(target=self._route_poll_loop, daemon=True,
+                         name="serve-proxy-routes").start()
+
+    # -- control ------------------------------------------------------------
+    def ready(self) -> Tuple[str, int]:
+        if not self._ready.wait(timeout=30.0):
+            raise RuntimeError("proxy HTTP server failed to start")
+        return (self._host, self._bound_port)
+
+    def graceful_shutdown(self) -> bool:
+        self._shutdown.set()
+        return True
+
+    def _controller(self):
+        import ray_tpu
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _route_poll_loop(self):
+        import ray_tpu
+        while not self._shutdown.is_set():
+            try:
+                ctrl = self._controller()
+                version = ray_tpu.get(ctrl.poll_update.remote(
+                    self._route_version, 5.0), timeout=15.0)
+                if version != self._route_version:
+                    self._route_version = version
+                    self._routes = ray_tpu.get(
+                        ctrl.get_route_table.remote(), timeout=10.0)
+            except Exception:  # noqa: BLE001 — controller restarting
+                self._shutdown.wait(1.0)
+
+    # -- data plane ---------------------------------------------------------
+    def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
+        """Longest-prefix match — reference proxy_router.py."""
+        best = None
+        for prefix, (app, ingress) in self._routes.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or prefix == "/":
+                if best is None or len(norm) > len(best[0].rstrip("/")):
+                    best = (prefix, app, ingress)
+        return best
+
+    def _handle_for(self, app: str, deployment: str) -> DeploymentHandle:
+        key = (app, deployment)
+        if key not in self._handles:
+            self._handles[key] = DeploymentHandle(deployment, app)
+        return self._handles[key]
+
+    def _call_replica(self, app: str, ingress: str, req: Request,
+                      route: str):
+        handle = self._handle_for(app, ingress)
+        meta = RequestMetadata(
+            call_method="__call__", is_http=True, app_name=app, route=route,
+            multiplexed_model_id=req.headers.get(MULTIPLEX_HEADER, ""))
+        resp = handle._router.assign(meta, (req,), {})
+        return resp.result(timeout_s=60.0)
+
+    def _serve_thread(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def dispatch(request: "web.Request") -> "web.Response":
+            path = request.path
+            if path == "/-/healthz":
+                return web.Response(text="success")
+            if path == "/-/routes":
+                return web.json_response(
+                    {p: f"{a}#{d}" for p, (a, d) in self._routes.items()})
+            match = self._match_route(path)
+            if match is None:
+                return web.Response(
+                    status=404,
+                    text=f"no application matches path '{path}'; routes: "
+                         f"{json.dumps(sorted(self._routes))}")
+            prefix, app, ingress = match
+            body = await request.read()
+            req = Request(method=request.method, path=path,
+                          query_string=request.query_string,
+                          headers=dict(request.headers), body=body)
+            req.headers.setdefault("x-request-id", uuid.uuid4().hex)
+            try:
+                result = await loop.run_in_executor(
+                    self._pool,
+                    self._call_replica, app, ingress, req, prefix)
+            except Exception as e:  # noqa: BLE001 — surface as 500
+                return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            status, headers, payload = coerce_response(result)
+            return web.Response(status=status, headers=headers, body=payload)
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", dispatch)
+
+        async def run():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            port = self._port
+            site = None
+            for attempt in range(20):  # skip ports already in use
+                try:
+                    site = web.TCPSite(runner, self._host, port)
+                    await site.start()
+                    break
+                except OSError:
+                    port += 1
+                    site = None
+            if site is None:
+                raise RuntimeError("could not bind proxy port")
+            self._bound_port = port
+            self._ready.set()
+            while not self._shutdown.is_set():
+                await asyncio.sleep(0.2)
+            await runner.cleanup()
+
+        loop.run_until_complete(run())

@@ -1,0 +1,140 @@
+"""Replica actor — analog of the reference's python/ray/serve/_private/
+replica.py (ReplicaActor :231, handle_request :390, UserCallableWrapper).
+
+One replica = one actor with max_concurrency = max_ongoing_requests; the
+queue-length it reports (num ongoing requests) drives both the pow-2 router
+and the controller's autoscaler, mirroring the reference's
+ReplicaMetricsManager."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from .context import RequestContext, set_request_context
+from .http_util import Request  # noqa: F401 — re-export for user callables
+
+
+class HandleMarker:
+    """Placeholder for a bound sub-deployment inside serialized init args;
+    swapped for a live DeploymentHandle in the replica (reference: Serve
+    replaces DeploymentNode args with handles at build time)."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+
+def _resolve_markers(obj: Any, app_name: str) -> Any:
+    from .handle import DeploymentHandle
+    if isinstance(obj, HandleMarker):
+        return DeploymentHandle(obj.deployment_name, app_name)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_resolve_markers(x, app_name) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _resolve_markers(v, app_name) for k, v in obj.items()}
+    return obj
+
+
+class ReplicaActor:
+    """Hosts the user callable (class instance or plain function)."""
+
+    def __init__(self, replica_tag: str, deployment_name: str, app_name: str,
+                 serialized_callable: bytes, init_args: bytes,
+                 user_config: Optional[Any] = None):
+        self.replica_tag = replica_tag
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._num_requests = 0
+        self._start_time = time.time()
+
+        target = cloudpickle.loads(serialized_callable)
+        args, kwargs = cloudpickle.loads(init_args)
+        args = _resolve_markers(args, app_name)
+        kwargs = _resolve_markers(kwargs, app_name)
+        if isinstance(target, type):
+            self._callable = target(*args, **kwargs)
+            self._is_function = False
+        else:
+            self._callable = target
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- data plane ---------------------------------------------------------
+    def handle_request(self, meta: Dict[str, Any], args: List[Any],
+                       kwargs: Dict[str, Any]) -> Any:
+        with self._lock:
+            self._inflight += 1
+            self._num_requests += 1
+        # Resolve composed DeploymentResponse refs (they arrive nested inside
+        # the args list, below the depth the worker auto-resolves).
+        import ray_tpu
+        from ray_tpu import ObjectRef
+        args = [ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                for a in args]
+        kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        token = set_request_context(RequestContext(
+            route=meta.get("route", ""),
+            app_name=meta.get("app_name", self.app_name),
+            multiplexed_model_id=meta.get("multiplexed_model_id", "")))
+        try:
+            if self._is_function:
+                return self._callable(*args, **kwargs)
+            method_name = meta.get("call_method") or "__call__"
+            method = getattr(self._callable, method_name, None)
+            if method is None:
+                raise AttributeError(
+                    f"deployment {self.deployment_name} has no method "
+                    f"'{method_name}'")
+            return method(*args, **kwargs)
+        finally:
+            from .context import _request_context
+            _request_context.reset(token)
+            with self._lock:
+                self._inflight -= 1
+
+    # -- control plane ------------------------------------------------------
+    def get_queue_len(self) -> int:
+        return self._inflight
+
+    def get_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"replica_tag": self.replica_tag,
+                    "inflight": self._inflight,
+                    "num_requests": self._num_requests,
+                    "uptime_s": time.time() - self._start_time}
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if callable(fn):
+            fn()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+
+    def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+        """Drain in-flight requests — reference replica.py
+        perform_graceful_shutdown."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.05)
+        # Optional user shutdown hook; __del__ is left to GC so
+        # non-idempotent destructors don't run twice.
+        fn = getattr(self._callable, "shutdown", None)
+        if callable(fn):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                pass
+        return True

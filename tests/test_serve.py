@@ -1,0 +1,247 @@
+"""Serve tests — modeled on the reference's python/ray/serve/tests/
+(test_deploy.py, test_batching.py, test_multiplex.py, test_autoscaling_policy.py)."""
+from __future__ import annotations
+
+import time
+
+import pytest
+import requests
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _url(path="/"):
+    host, port = serve.proxy_address()
+    return f"http://{host}:{port}{path}"
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def echo(request):
+        return {"echo": request.json()}
+
+    serve.run(echo.bind(), name="fn_app", route_prefix="/fn")
+    r = requests.post(_url("/fn"), json=[1, 2, 3])
+    assert r.status_code == 200 and r.json() == {"echo": [1, 2, 3]}
+    serve.delete("fn_app")
+
+
+def test_class_deployment_and_handle(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def add(self, x):
+            return x + self.offset
+
+        def __call__(self, request):
+            return self.add(request.json()["x"])
+
+    serve.run(Adder.bind(10), name="adder", route_prefix="/adder")
+    h = serve.get_app_handle("adder")
+    assert h.add.remote(5).result() == 15
+    r = requests.post(_url("/adder"), json={"x": 1})
+    assert r.json() == 11
+    st = serve.status()["applications"]["adder"]
+    assert st["status"] == "RUNNING"
+    assert len(st["deployments"]["Adder"]["replicas"]) == 2
+    serve.delete("adder")
+
+
+def test_composition(serve_cluster):
+    @serve.deployment
+    class Preprocess:
+        def run(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            return self.pre.run.remote(x).result() + 1
+
+    h = serve.run(Model.bind(Preprocess.bind()), name="comp",
+                  route_prefix="/comp")
+    assert h.remote(4).result() == 9
+    serve.delete("comp")
+
+
+def test_response_passing(serve_cluster):
+    """DeploymentResponse passed to another handle resolves without a
+    driver round-trip (reference: model composition in handle.py)."""
+    @serve.deployment
+    class Stage:
+        def __call__(self, x):
+            return x + 1
+
+    serve.run(Stage.bind(), name="stage", route_prefix="/stage")
+    h = serve.get_app_handle("stage")
+    resp = h.remote(h.remote(0))
+    assert resp.result() == 2
+    serve.delete("stage")
+
+
+def test_batching(serve_cluster):
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        def __call__(self, xs):
+            assert isinstance(xs, list)
+            self.last_batch = len(xs)
+            return [x * 10 for x in xs]
+
+        def probe(self):
+            return getattr(self, "last_batch", 0)
+
+    serve.run(Batched.bind(), name="batched", route_prefix="/batched")
+    h = serve.get_app_handle("batched")
+    resps = [h.remote(i) for i in range(8)]
+    assert [r.result() for r in resps] == [i * 10 for i in range(8)]
+    assert h.probe.remote().result() >= 2  # at least one real batch formed
+    serve.delete("batched")
+
+
+def test_multiplex(serve_cluster):
+    @serve.deployment
+    class Multi:
+        def __init__(self):
+            self.loads = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads += 1
+            return {"id": model_id}
+
+        def __call__(self, _x):
+            mid = serve.get_multiplexed_model_id()
+            return (self.get_model(mid)["id"], self.loads)
+
+    serve.run(Multi.bind(), name="mx", route_prefix="/mx")
+    h = serve.get_app_handle("mx")
+    assert h.options(multiplexed_model_id="a").remote(0).result() == ("a", 1)
+    assert h.options(multiplexed_model_id="a").remote(0).result() == ("a", 1)
+    assert h.options(multiplexed_model_id="b").remote(0).result() == ("b", 2)
+    r = requests.get(_url("/mx"),
+                     headers={"serve_multiplexed_model_id": "c"})
+    assert r.json()[0] == "c"
+    serve.delete("mx")
+
+
+def test_user_config_reconfigure(serve_cluster):
+    @serve.deployment(user_config={"threshold": 5})
+    class Configured:
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, _x):
+            return self.threshold
+
+    serve.run(Configured.bind(), name="cfg", route_prefix="/cfg")
+    h = serve.get_app_handle("cfg")
+    assert h.remote(0).result() == 5
+    serve.delete("cfg")
+
+
+def test_replica_recovery(serve_cluster):
+    """Controller health checks replace a killed replica — reference
+    deployment_state.py replica recovery."""
+    @serve.deployment(health_check_period_s=0.3)
+    class Fragile:
+        def pid(self):
+            import os
+            return os.getpid()
+
+    serve.run(Fragile.bind(), name="fragile", route_prefix="/fragile")
+    h = serve.get_app_handle("fragile")
+    pid1 = h.pid.remote().result()
+
+    # Kill the replica out from under the controller.
+    import ray_tpu as rt
+    ctrl = rt.get_actor("SERVE_CONTROLLER")
+    _, replicas = rt.get(ctrl.get_replicas.remote("fragile", "Fragile"))
+    rt.kill(replicas[0][1])
+
+    deadline = time.monotonic() + 30.0
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = h.pid.remote().result(timeout_s=5.0)
+            if pid2 != pid1:
+                break
+        except Exception:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+    serve.delete("fragile")
+
+
+def test_autoscaling_scale_up(serve_cluster):
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            upscale_delay_s=0.3, downscale_delay_s=60.0),
+        max_ongoing_requests=4)
+    class Slow:
+        def __call__(self, _x):
+            time.sleep(1.0)
+            return "done"
+
+    serve.run(Slow.bind(), name="auto", route_prefix="/auto")
+    h = serve.get_app_handle("auto")
+    resps = [h.remote(i) for i in range(12)]
+    deadline = time.monotonic() + 30.0
+    scaled = False
+    while time.monotonic() < deadline and not scaled:
+        st = serve.status()["applications"]["auto"]
+        scaled = st["deployments"]["Slow"]["target_num_replicas"] > 1
+        time.sleep(0.2)
+    for r in resps:
+        r.result(timeout_s=60.0)
+    assert scaled, "autoscaler never scaled up under sustained load"
+    serve.delete("auto")
+
+
+def test_batch_state_is_per_instance():
+    """Two instances of a @serve.batch-decorated class must not share one
+    batch queue (items would run against the wrong self)."""
+    class M:
+        def __init__(self, scale):
+            self.scale = scale
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+        def __call__(self, xs):
+            return [x * self.scale for x in xs]
+
+    a, b = M(10), M(100)
+    assert a(1) == 10 and b(1) == 100
+
+
+def test_multiplex_cache_is_per_instance():
+    class M:
+        def __init__(self, tag):
+            self.tag = tag
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return (self.tag, model_id)
+
+    a, b = M("a"), M("b")
+    assert a.get_model("m") == ("a", "m")
+    assert b.get_model("m") == ("b", "m")
+
+
+def test_404_and_healthz(serve_cluster):
+    assert requests.get(_url("/-/healthz")).text == "success"
+    assert requests.get(_url("/definitely-not-a-route-xyz")).status_code == 404
