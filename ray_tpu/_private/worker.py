@@ -644,6 +644,14 @@ class ActorRuntime:
                 from ray_tpu.util import collective as _collective
 
                 fn = _collective.init_collective_group
+            elif method == "__ray_tpu_compiled_loop__":
+                # universal hook pinning a compiled-DAG loop on this actor
+                # (reference compiled_dag_node.py do_exec_compiled_task :43)
+                import functools as _functools
+
+                from ray_tpu.dag.compiled_dag import run_actor_loop
+
+                fn = _functools.partial(run_actor_loop, self.instance)
             else:
                 fn = getattr(self.instance, method)
             args = tuple(self.worker._materialize(a) for a in args)

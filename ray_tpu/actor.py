@@ -26,6 +26,12 @@ class ActorMethod:
         m._override_num_returns = num_returns
         return m
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node — reference python/ray/dag/class_node.py
+        ClassMethodNode via actor.py bind()."""
+        from .dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def _num_returns(self) -> int:
         return getattr(self, "_override_num_returns", 1)
 

@@ -1,0 +1,11 @@
+"""ray_tpu.dag — lazy DAG + compiled execution, analog of the reference's
+python/ray/dag/ and python/ray/experimental/channel.py (see SURVEY.md §2.3).
+"""
+from .channel import Channel, ChannelClosedError  # noqa: F401
+from .compiled_dag import CompiledDAG, CompiledDAGFuture  # noqa: F401
+from .dag_node import (ClassMethodNode, DAGNode, FunctionNode,  # noqa: F401
+                       InputAttributeNode, InputNode, MultiOutputNode)
+
+__all__ = ["DAGNode", "InputNode", "InputAttributeNode", "FunctionNode",
+           "ClassMethodNode", "MultiOutputNode", "CompiledDAG",
+           "CompiledDAGFuture", "Channel", "ChannelClosedError"]

@@ -1,0 +1,188 @@
+"""Lazy DAG API — analog of the reference's python/ray/dag/
+(dag_node.py DAGNode, input_node.py InputNode/InputAttributeNode,
+output_node.py MultiOutputNode, function_node.py, class_node.py).
+
+``fn.bind(...)`` / ``actor.method.bind(...)`` build the graph lazily;
+``.execute(input)`` runs it through the normal task/actor path;
+``.experimental_compile()`` (compiled_dag.py) pins actor loops over
+shared-memory channels."""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    def __init__(self, args: tuple = (), kwargs: Optional[dict] = None):
+        self._bound_args = args
+        self._bound_kwargs = dict(kwargs or {})
+        self._id = next(_node_counter)
+
+    # -- traversal ----------------------------------------------------------
+    def _upstream(self) -> List["DAGNode"]:
+        found: List[DAGNode] = []
+
+        def scan(obj):
+            if isinstance(obj, DAGNode):
+                found.append(obj)
+            elif isinstance(obj, (list, tuple)):
+                for x in obj:
+                    scan(x)
+            elif isinstance(obj, dict):
+                for x in obj.values():
+                    scan(x)
+
+        for a in self._bound_args:
+            scan(a)
+        for v in self._bound_kwargs.values():
+            scan(v)
+        return found
+
+    def _resolve_args(self, resolved: Dict[int, Any]):
+        def swap(obj):
+            if isinstance(obj, DAGNode):
+                return resolved[obj._id]
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(swap(x) for x in obj)
+            if isinstance(obj, dict):
+                return {k: swap(v) for k, v in obj.items()}
+            return obj
+
+        return (tuple(swap(a) for a in self._bound_args),
+                {k: swap(v) for k, v in self._bound_kwargs.items()})
+
+    def _topo_order(self) -> List["DAGNode"]:
+        order: List[DAGNode] = []
+        seen: set = set()
+
+        def visit(n: DAGNode):
+            if n._id in seen:
+                return
+            seen.add(n._id)
+            for up in n._upstream():
+                visit(up)
+            order.append(n)
+
+        visit(self)
+        return order
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, *input_args, **input_kwargs):
+        """Run the DAG once via normal task/actor submission — reference
+        dag_node.py execute(). Returns ObjectRef(s) for the output node."""
+        resolved: Dict[int, Any] = {}
+        for node in self._topo_order():
+            resolved[node._id] = node._execute_impl(resolved, input_args,
+                                                    input_kwargs)
+        return resolved[self._id]
+
+    def _execute_impl(self, resolved, input_args, input_kwargs):
+        raise NotImplementedError
+
+    def experimental_compile(self, buffer_size_bytes: int = 16 * 1024 * 1024):
+        from .compiled_dag import CompiledDAG
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)
+
+
+class InputNode(DAGNode):
+    """The DAG's runtime input — reference input_node.py. Usable as a
+    context manager: ``with InputNode() as inp: ...``."""
+
+    def __init__(self):
+        super().__init__()
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def __getattr__(self, key: str) -> "InputAttributeNode":
+        if key.startswith("_"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key)
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key)
+
+    def _execute_impl(self, resolved, input_args, input_kwargs):
+        if input_args and input_kwargs:
+            raise TypeError(
+                "DAG input must be all-positional or all-keyword")
+        if input_kwargs:
+            return dict(input_kwargs)
+        if len(input_args) == 1:
+            return input_args[0]
+        return tuple(input_args)
+
+
+class InputAttributeNode(DAGNode):
+    """inp.key / inp[i] — reference input_node.py InputAttributeNode."""
+
+    def __init__(self, parent: InputNode, key: Any):
+        super().__init__()
+        self._parent = parent
+        self._key = key
+
+    def _upstream(self):
+        return [self._parent]
+
+    def _execute_impl(self, resolved, input_args, input_kwargs):
+        if isinstance(self._key, str) and input_kwargs and \
+                self._key in input_kwargs:
+            return input_kwargs[self._key]
+        base = resolved.get(self._parent._id)
+        if base is None:
+            base = input_args[0] if len(input_args) == 1 else tuple(input_args)
+        if isinstance(self._key, str) and isinstance(base, dict):
+            return base[self._key]
+        if isinstance(self._key, str):
+            return getattr(base, self._key)
+        return base[self._key]
+
+    @staticmethod
+    def extract(value, key):
+        if isinstance(key, str) and isinstance(value, dict):
+            return value[key]
+        if isinstance(key, str):
+            return getattr(value, key)
+        return value[key]
+
+
+class FunctionNode(DAGNode):
+    """fn.bind(...) on a @remote function — reference function_node.py."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, resolved, input_args, input_kwargs):
+        args, kwargs = self._resolve_args(resolved)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """actor.method.bind(...) — reference class_node.py ClassMethodNode
+    (bound to a *live* actor handle, as in the compiled-DAG examples)."""
+
+    def __init__(self, actor_handle, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor = actor_handle
+        self._method_name = method_name
+
+    def _execute_impl(self, resolved, input_args, input_kwargs):
+        args, kwargs = self._resolve_args(resolved)
+        return getattr(self._actor, self._method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves into one output — reference output_node.py."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(args=tuple(outputs))
+        self._outputs = list(outputs)
+
+    def _execute_impl(self, resolved, input_args, input_kwargs):
+        return [resolved[n._id] for n in self._outputs]

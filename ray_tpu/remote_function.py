@@ -47,6 +47,12 @@ class RemoteFunction:
             max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
             placement_group_id=pg_id)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node — reference python/ray/dag/function_node.py
+        via remote_function.py bind()."""
+        from .dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     @property
     def underlying_function(self):
         return self._fn

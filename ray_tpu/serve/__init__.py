@@ -219,13 +219,17 @@ def proxy_address() -> Optional[tuple]:
 
 def delete(name: str) -> None:
     import ray_tpu
+    from .handle import _drop_routers
     ctrl = _get_controller(create=False)
     ray_tpu.get(ctrl.delete_application.remote(name), timeout=60.0)
+    _drop_routers(name)
 
 
 def shutdown() -> None:
     """Tear down all of Serve — reference serve/api.py serve.shutdown."""
     import ray_tpu
+    from .handle import _drop_routers
+    _drop_routers()
     try:
         ctrl = _get_controller(create=False)
     except RuntimeError:

@@ -285,8 +285,9 @@ class ServeController:
 
     def _autoscale(self, st: _DeploymentState):
         cfg: Optional[AutoscalingConfig] = st.config.autoscaling_config
-        if cfg is None or not st.replicas:
-            return
+        if cfg is None:
+            return  # NOTE: runs even with zero replicas, else a
+        # min_replicas=0 deployment that scaled to zero could never wake up.
         now = time.monotonic()
         with self._lock:
             st.handle_metrics = {

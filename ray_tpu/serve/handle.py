@@ -88,6 +88,7 @@ class Router:
         self._last_refresh = 0.0
         self._handle_id = f"router-{id(self):x}"
         self._metrics_started = False
+        self._stopped = False
 
     def _controller(self):
         import ray_tpu
@@ -149,7 +150,7 @@ class Router:
             self._metrics_started = True
 
         def push_loop():
-            while True:
+            while not self._stopped:
                 time.sleep(self._METRICS_PUSH_S)
                 try:
                     with self._lock:
@@ -179,6 +180,33 @@ class Router:
         raise last_err  # type: ignore[misc]
 
 
+# One Router per (app, deployment) per process — shared across all handles
+# (including the throwaway ones __getattr__/options() mint), so pow-2
+# in-flight state is coherent and only one metrics thread exists per target.
+_ROUTERS: Dict[Tuple[str, str], Router] = {}
+_ROUTERS_LOCK = threading.Lock()
+
+
+def _shared_router(deployment_name: str, app_name: str) -> Router:
+    key = (app_name, deployment_name)
+    with _ROUTERS_LOCK:
+        r = _ROUTERS.get(key)
+        if r is None:
+            r = Router(deployment_name, app_name)
+            _ROUTERS[key] = r
+        return r
+
+
+def _drop_routers(app_name: Optional[str] = None) -> None:
+    """Forget cached routers (on serve.shutdown/delete) so a later
+    redeploy doesn't serve stale replica sets."""
+    with _ROUTERS_LOCK:
+        for key in [k for k in _ROUTERS
+                    if app_name is None or k[0] == app_name]:
+            _ROUTERS[key]._stopped = True  # ends its metrics thread
+            del _ROUTERS[key]
+
+
 class DeploymentHandle:
     """Picklable handle to a deployment — reference serve/handle.py:711.
     ``handle.method.remote(*args)`` returns a DeploymentResponse."""
@@ -190,15 +218,10 @@ class DeploymentHandle:
         self.app_name = app_name
         self._call_method = _call_method
         self._multiplexed_model_id = _multiplexed_model_id
-        self._router_obj: Optional[Router] = None
-        self._router_lock = threading.Lock()
 
     @property
     def _router(self) -> Router:
-        with self._router_lock:
-            if self._router_obj is None:
-                self._router_obj = Router(self.deployment_name, self.app_name)
-            return self._router_obj
+        return _shared_router(self.deployment_name, self.app_name)
 
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None

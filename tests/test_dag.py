@@ -1,0 +1,282 @@
+"""DAG + compiled DAG tests — modeled on the reference's
+python/ray/dag/tests/ (test_function_dag.py, test_accelerated_dag.py)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import (ChannelClosedError, Channel, InputNode,
+                         MultiOutputNode)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, bias=0):
+        self.bias = bias
+        self.calls = 0
+
+    def inc(self, x):
+        self.calls += 1
+        return x + 1 + self.bias
+
+    def double(self, x):
+        self.calls += 1
+        return x * 2
+
+    def add(self, a, b):
+        self.calls += 1
+        return a + b
+
+    def get_calls(self):
+        return self.calls
+
+    def fail(self, x):
+        raise ValueError(f"boom on {x}")
+
+
+# -- channel unit tests ------------------------------------------------------
+
+def test_channel_roundtrip():
+    ch = Channel(1024)
+    ch.write(b"hello")
+    seq, data = ch.read(0)
+    assert (seq, data) == (1, b"hello")
+    ch.write(b"world")
+    seq, data = ch.read(1)
+    assert (seq, data) == (2, b"world")
+    ch.destroy()
+
+
+def test_channel_backpressure():
+    ch = Channel(1024)
+    ch.write(b"a")
+    with pytest.raises(TimeoutError):
+        ch.write(b"b", timeout=0.2)  # unread slot blocks the writer
+    ch.read(0)
+    ch.write(b"b", timeout=0.2)
+    ch.destroy()
+
+
+def test_channel_close_unblocks():
+    ch = Channel(1024)
+    with pytest.raises(ChannelClosedError):
+        ch.close()
+        ch.read(0, timeout=1.0)
+    ch.destroy()
+
+
+def test_channel_capacity_error():
+    ch = Channel(16)
+    with pytest.raises(ValueError):
+        ch.write(b"x" * 64)
+    ch.destroy()
+
+
+# -- uncompiled DAG ----------------------------------------------------------
+
+def test_dag_execute_chain(cluster):
+    a = Worker.remote()
+    b = Worker.remote()
+    with InputNode() as inp:
+        d = b.double.bind(a.inc.bind(inp))
+    assert ray_tpu.get(d.execute(3)) == 8
+    assert ray_tpu.get(d.execute(10)) == 22
+
+
+def test_dag_execute_fanout_multi_output(cluster):
+    a = Worker.remote()
+    b = Worker.remote(bias=100)
+    with InputNode() as inp:
+        d = MultiOutputNode([a.inc.bind(inp), b.inc.bind(inp)])
+    r1, r2 = d.execute(1)
+    assert ray_tpu.get(r1) == 2
+    assert ray_tpu.get(r2) == 102
+
+
+def test_dag_function_nodes(cluster):
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    @ray_tpu.remote
+    def plus(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        d = plus.bind(square.bind(inp), inp)
+    assert ray_tpu.get(d.execute(4)) == 20
+
+
+def test_dag_input_attribute(cluster):
+    a = Worker.remote()
+    with InputNode() as inp:
+        d = a.add.bind(inp["x"], inp["y"])
+    assert ray_tpu.get(d.execute({"x": 2, "y": 5})) == 7
+
+
+# -- compiled DAG ------------------------------------------------------------
+
+def test_compiled_chain(cluster):
+    a = Worker.remote()
+    b = Worker.remote()
+    with InputNode() as inp:
+        d = b.double.bind(a.inc.bind(inp))
+    cd = d.experimental_compile()
+    try:
+        for i in range(10):
+            assert cd.execute(i).get() == (i + 1) * 2
+    finally:
+        cd.teardown()
+
+
+def test_compiled_same_actor_chain(cluster):
+    a = Worker.remote()
+    with InputNode() as inp:
+        d = a.double.bind(a.inc.bind(inp))
+    cd = d.experimental_compile()
+    try:
+        assert cd.execute(5).get() == 12
+    finally:
+        cd.teardown()
+
+
+def test_compiled_fanout_fanin(cluster):
+    a, b, c = Worker.remote(), Worker.remote(bias=10), Worker.remote()
+    with InputNode() as inp:
+        d = c.add.bind(a.inc.bind(inp), b.inc.bind(inp))
+    cd = d.experimental_compile()
+    try:
+        # (x+1) + (x+11)
+        assert cd.execute(0).get() == 12
+        assert cd.execute(5).get() == 22
+    finally:
+        cd.teardown()
+
+
+def test_compiled_multi_output(cluster):
+    a, b = Worker.remote(), Worker.remote(bias=5)
+    with InputNode() as inp:
+        d = MultiOutputNode([a.inc.bind(inp), b.inc.bind(inp)])
+    cd = d.experimental_compile()
+    try:
+        assert cd.execute(1).get() == [2, 7]
+    finally:
+        cd.teardown()
+
+
+def test_compiled_numpy_payload(cluster):
+    a = Worker.remote()
+    with InputNode() as inp:
+        d = a.double.bind(inp)
+    cd = d.experimental_compile(buffer_size_bytes=8 * 1024 * 1024)
+    try:
+        x = np.arange(100_000, dtype=np.float32)
+        np.testing.assert_allclose(cd.execute(x).get(), x * 2)
+    finally:
+        cd.teardown()
+
+
+def test_compiled_actor_revisit(cluster):
+    """A->B->A shape (the pipeline fwd/bwd pattern): actor A's loop must not
+    block on the B->A edge before producing what B is waiting for."""
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        d = a.double.bind(b.inc.bind(a.inc.bind(inp)))
+    cd = d.experimental_compile()
+    try:
+        # ((x+1)+1)*2
+        assert cd.execute(3).get(timeout=10.0) == 10
+        assert cd.execute(0).get(timeout=10.0) == 4
+    finally:
+        cd.teardown()
+
+
+def test_compiled_duplicate_arg(cluster):
+    """The same upstream node consumed twice by one op must not double-write
+    its edge channel."""
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        up = a.inc.bind(inp)
+        d = b.add.bind(up, up)
+    cd = d.experimental_compile()
+    try:
+        assert cd.execute(1).get(timeout=10.0) == 4
+        assert cd.execute(2).get(timeout=10.0) == 6
+        assert cd.execute(3).get(timeout=10.0) == 8
+    finally:
+        cd.teardown()
+
+
+def test_dag_kwargs_input(cluster):
+    a = Worker.remote()
+    with InputNode() as inp:
+        d = a.add.bind(inp.x, inp.y)
+    assert ray_tpu.get(d.execute(x=3, y=4)) == 7
+    cd = d.experimental_compile()
+    try:
+        assert cd.execute(x=1, y=2).get(timeout=10.0) == 3
+        with pytest.raises(TypeError, match="all-positional or all-keyword"):
+            cd.execute(1, y=2)
+    finally:
+        cd.teardown()
+
+
+def test_compiled_error_propagation(cluster):
+    a, b = Worker.remote(), Worker.remote()
+    with InputNode() as inp:
+        d = b.double.bind(a.fail.bind(inp))
+    cd = d.experimental_compile()
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            cd.execute(1).get()
+        # The DAG survives a failed invocation.
+        with pytest.raises(RuntimeError, match="boom"):
+            cd.execute(2).get()
+    finally:
+        cd.teardown()
+
+
+def test_compiled_actor_usable_after_teardown(cluster):
+    a = Worker.remote()
+    with InputNode() as inp:
+        d = a.inc.bind(inp)
+    cd = d.experimental_compile()
+    assert cd.execute(1).get() == 2
+    cd.teardown()
+    # After teardown the pinned loop exits and normal calls flow again.
+    assert ray_tpu.get(a.get_calls.remote()) >= 1
+
+
+def test_compiled_throughput_beats_task_path(cluster):
+    """The compiled path must be much faster than per-call actor RPC —
+    the reference's whole reason for compiled graphs."""
+    a = Worker.remote()
+    with InputNode() as inp:
+        d = a.inc.bind(inp)
+
+    n = 200
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(a.inc.remote(i))
+    rpc_s = time.perf_counter() - t0
+
+    cd = d.experimental_compile()
+    try:
+        cd.execute(0).get()  # warm
+        t0 = time.perf_counter()
+        for i in range(n):
+            cd.execute(i).get()
+        compiled_s = time.perf_counter() - t0
+    finally:
+        cd.teardown()
+    assert compiled_s < rpc_s, (compiled_s, rpc_s)
