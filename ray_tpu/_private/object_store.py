@@ -91,6 +91,7 @@ class _Entry:
     shm_name: Optional[str] = None
     layout: Optional[List[Tuple[int, int]]] = None  # (offset, size) per buffer
     shm: Optional[shared_memory.SharedMemory] = None
+    arena_offset: Optional[int] = None  # owner-side: block to free on delete
     nbytes: int = 0
     error: Optional[BaseException] = None
     ready: bool = False
@@ -105,10 +106,28 @@ class LocalObjectStore:
     def __init__(self):
         self._entries: Dict[str, _Entry] = {}
         self._cv = threading.Condition()
-        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._attached: Dict[str, Any] = {}  # SharedMemory or attached Arena
         self._bytes = 0
         # objects for which only a placeholder exists (awaiting task result)
         self._deserialized_cache: Dict[str, Any] = {}
+        # Native C++ slab arena (shm_store.cc): one mapping for ALL of this
+        # process's large objects — peers attach once and read at offsets
+        # instead of one shm_open+mmap per object. None → per-object
+        # SharedMemory fallback.
+        self._arena = None
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") == "1":
+            try:
+                from ray_tpu._native import Arena
+
+                self._arena = Arena.create(
+                    f"rtpu_a_{os.getpid()}_{ObjectID().hex()[:8]}",
+                    int(os.environ.get("RAY_TPU_ARENA_SIZE", STORE_CAP)))
+            except Exception:  # noqa: BLE001 — build/env issue: fall back
+                self._arena = None
+        # Freed arena blocks rest here ~2s before reuse so a peer mid-copy
+        # of an exported object never reads recycled bytes (the reference
+        # uses plasma pins; deferred reuse is the ownership-model analog).
+        self._arena_quarantine: List[Tuple[float, int]] = []
 
     # ---------- write paths ----------
 
@@ -124,10 +143,20 @@ class LocalObjectStore:
                 off = (size + _ALIGN - 1) // _ALIGN * _ALIGN
                 layout.append((off, b.nbytes))
                 size = off + b.nbytes
-            shm = shared_memory.SharedMemory(create=True, size=max(size, 1))
+            base = self._arena.alloc(max(size, 1)) if self._arena else 0
+            if base:
+                mem = self._arena.view(base, size)
+                e.arena_offset = base
+                e.shm_name = f"arena:{self._arena.name}"
+                e.layout = [(base + off, n) for off, n in layout]
+            else:  # no native store, or arena full: per-object segment
+                shm = shared_memory.SharedMemory(create=True,
+                                                 size=max(size, 1))
+                mem = shm.buf
+                e.shm, e.shm_name, e.layout = shm, shm.name, layout
             for (off, n), b in zip(layout, buffers):
-                shm.buf[off:off + n] = b.cast("B")[:] if b.format != "B" else b[:]
-            e.shm, e.shm_name, e.layout = shm, shm.name, layout
+                mem[off:off + n] = \
+                    b.cast("B")[:] if b.format != "B" else b[:]
         else:
             e.buffers = [memoryview(bytes(b)) for b in buffers]
         e.ready = True
@@ -168,11 +197,12 @@ class LocalObjectStore:
     def invalidate(self, object_id: str) -> None:
         """Drop a (possibly pending) entry so waiters see it as missing."""
         with self._cv:
+            pinned = self._externally_referenced(object_id)
             e = self._entries.pop(object_id, None)
             self._deserialized_cache.pop(object_id, None)
             if e is not None:
                 self._bytes -= e.nbytes
-                self._free_entry(e)
+                self._free_entry(e, leak_arena_block=pinned)
             self._cv.notify_all()
 
     # ---------- read paths ----------
@@ -206,8 +236,22 @@ class LocalObjectStore:
             if e.error is not None:
                 raise e.error
         if e.shm_name is not None:
-            shm = e.shm or self._attach(e.shm_name)
-            bufs = [memoryview(shm.buf)[off:off + n] for off, n in e.layout]
+            is_arena = e.shm_name.startswith("arena:")
+            if is_arena and e.arena_offset is None:
+                # Remote arena object: the OWNER may free+reuse this block
+                # after the cluster-wide ref drops, so copy out of the
+                # mapping instead of keeping zero-copy views (the reference
+                # solves this with plasma pins; copy-on-read is our
+                # ownership-model equivalent). Owner-side reads (arena_offset
+                # set) stay zero-copy — the owner controls the free.
+                shm = self._attach(e.shm_name)
+                bufs = [memoryview(bytes(shm.buf[off:off + n]))
+                        for off, n in e.layout]
+            else:
+                shm = e.shm or (self._arena if e.arena_offset is not None
+                                else self._attach(e.shm_name))
+                bufs = [memoryview(shm.buf)[off:off + n]
+                        for off, n in e.layout]
         else:
             bufs = e.buffers or []
         value = serialization.deserialize(e.meta, bufs)
@@ -246,14 +290,54 @@ class LocalObjectStore:
 
     def delete(self, object_id: str) -> None:
         with self._cv:
+            pinned = self._externally_referenced(object_id)
             e = self._entries.pop(object_id, None)
             self._deserialized_cache.pop(object_id, None)
         if e is not None:
             with self._cv:
                 self._bytes -= e.nbytes
-            self._free_entry(e)
+            self._free_entry(e, leak_arena_block=pinned)
 
-    def _free_entry(self, e: _Entry) -> None:
+    _QUARANTINE_S = 2.0
+
+    def _drain_quarantine(self, everything: bool = False) -> None:
+        now = time.monotonic()
+        with self._cv:
+            if everything:
+                ready = [o for _, o in self._arena_quarantine]
+                self._arena_quarantine = []
+            else:
+                ready = [o for t, o in self._arena_quarantine if t <= now]
+                self._arena_quarantine = [
+                    (t, o) for t, o in self._arena_quarantine if t > now]
+        if self._arena is not None:
+            for off in ready:
+                self._arena.free(off)
+
+    def _externally_referenced(self, object_id: str) -> bool:
+        """True if the owner-side deserialized value for this object is still
+        held OUTSIDE the store (zero-copy arrays point into the arena, so
+        freeing their block would be a silent use-after-free; the reference
+        prevents this with plasma pins)."""
+        import sys
+        v = self._deserialized_cache.get(object_id)
+        if v is None:
+            return False
+        # refs when unreferenced elsewhere: cache dict + local v + arg
+        return sys.getrefcount(v) > 3
+
+    def _free_entry(self, e: _Entry, leak_arena_block: bool = False) -> None:
+        if e.arena_offset is not None and self._arena is not None:
+            if leak_arena_block:
+                # A live user array is backed by this block: never reuse it.
+                e.arena_offset = None
+            else:
+                with self._cv:
+                    self._arena_quarantine.append(
+                        (time.monotonic() + self._QUARANTINE_S,
+                         e.arena_offset))
+                e.arena_offset = None
+                self._drain_quarantine()
         if e.shm is not None:
             try:
                 e.shm.close()
@@ -263,17 +347,25 @@ class LocalObjectStore:
             except OSError:
                 pass
 
-    def _attach(self, name: str) -> shared_memory.SharedMemory:
+    def _attach(self, name: str):
         with self._cv:
             shm = self._attached.get(name)
             if shm is not None:
                 return shm
-        shm = shared_memory.SharedMemory(name=name)
+        if name.startswith("arena:"):
+            from ray_tpu._native import Arena
+
+            shm = Arena.attach(name[len("arena:"):])
+            if shm is None:
+                raise KeyError(f"arena {name} is gone")
+        else:
+            shm = shared_memory.SharedMemory(name=name)
         with self._cv:
             self._attached[name] = shm
         return shm
 
     def _maybe_evict(self) -> None:
+        self._drain_quarantine()
         with self._cv:
             if self._bytes <= STORE_CAP:
                 return
@@ -284,10 +376,11 @@ class LocalObjectStore:
             for oid, e in entries:
                 if self._bytes <= STORE_CAP * 0.8:
                     break
+                pinned = self._externally_referenced(oid)
                 self._entries.pop(oid, None)
                 self._deserialized_cache.pop(oid, None)
                 self._bytes -= e.nbytes
-                self._free_entry(e)
+                self._free_entry(e, leak_arena_block=pinned)
 
     def stats(self) -> Dict[str, int]:
         with self._cv:
@@ -307,3 +400,8 @@ class LocalObjectStore:
                 shm.close()
             except OSError:
                 pass
+        if self._arena is not None:
+            # unlink the name only — munmap here would SIGSEGV any zero-copy
+            # array the user still holds; the mapping dies with the process.
+            self._arena.unlink_only()
+            self._arena = None
