@@ -50,6 +50,18 @@ def init(address: Optional[str] = None, *,
             return {"address": _worker_mod.global_worker.conductor_address}
         raise RuntimeError("ray_tpu.init() already called; "
                            "use ignore_reinit_error=True to ignore")
+    if address == "auto":
+        # Reference semantics of ray.init("auto") / RAY_ADDRESS.
+        address = os.environ.get("RAY_TPU_ADDRESS")
+        if not address:
+            raise RuntimeError(
+                "no RAY_TPU_ADDRESS in the environment; pass "
+                "address='host:port' or start a head with "
+                "`python -m ray_tpu start --head`")
+    elif address is None:
+        # Job drivers spawned by the head's JobManager find their cluster
+        # here (reference: RAY_ADDRESS).
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     if session_dir is None:
         session_dir = os.path.join(
             tempfile.gettempdir(), "ray_tpu",

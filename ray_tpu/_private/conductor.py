@@ -23,6 +23,7 @@ Responsibilities:
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -547,6 +548,134 @@ class ConductorHandler:
         with self._lock:
             return self._task_events[-limit:]
 
+    # ----------------------------------------------------------- metrics
+    # Reference: src/ray/stats/metric_exporter.cc -> metrics agent ->
+    # Prometheus; here workers push their registry snapshots and the
+    # conductor is the aggregation point the exporter reads.
+
+    def report_metrics(self, worker_id: str,
+                       snapshot: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            if not hasattr(self, "_metrics"):
+                self._metrics: Dict[str, List[Dict[str, Any]]] = {}
+            self._metrics[worker_id] = snapshot
+
+    def get_metrics(self) -> Dict[str, List[Dict[str, Any]]]:
+        with self._lock:
+            return dict(getattr(self, "_metrics", {}))
+
+    # ------------------------------------------------------------------ jobs
+    # Reference: GcsJobManager (src/ray/gcs/gcs_server/gcs_job_manager) +
+    # dashboard/modules/job JobManager — entrypoint drivers run as head-node
+    # subprocesses with RAY_TPU_ADDRESS injected, logs captured per job.
+
+    def submit_job(self, entrypoint: str,
+                   env: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None,
+                   working_dir: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        import uuid as _uuid
+
+        job_id = submission_id or f"job_{_uuid.uuid4().hex[:12]}"
+        with self._lock:
+            if job_id in getattr(self, "_jobs", {}):
+                raise ValueError(
+                    f"job submission id {job_id!r} already exists "
+                    "(reference JobManager rejects duplicates)")
+        logs = os.path.join(self._session_dir, "logs")
+        os.makedirs(logs, exist_ok=True)
+        log_path = os.path.join(logs, f"{job_id}.log")
+        host, port = self.address
+        penv = dict(os.environ)
+        penv.update(env or {})
+        penv["RAY_TPU_ADDRESS"] = f"{host}:{port}"
+        penv["RAY_TPU_JOB_ID"] = job_id
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, env=penv,
+                cwd=working_dir or os.getcwd(),
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        finally:
+            log_f.close()
+        with self._lock:
+            if not hasattr(self, "_jobs"):
+                self._jobs: Dict[str, Dict[str, Any]] = {}
+            self._jobs[job_id] = {
+                "job_id": job_id, "entrypoint": entrypoint,
+                "start_time": time.time(), "end_time": None,
+                "log_path": log_path, "proc": proc, "stopped": False,
+                "metadata": dict(metadata or {})}
+        return job_id
+
+    def _job_status_locked(self, rec: Dict[str, Any]) -> str:
+        proc = rec["proc"]
+        code = proc.poll()
+        if code is None:
+            return "RUNNING"
+        if rec["end_time"] is None:
+            rec["end_time"] = time.time()
+        if rec["stopped"]:
+            return "STOPPED"
+        return "SUCCEEDED" if code == 0 else "FAILED"
+
+    def get_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = getattr(self, "_jobs", {}).get(job_id)
+            if rec is None:
+                return None
+            return {k: v for k, v in dict(
+                rec, status=self._job_status_locked(rec)).items()
+                if k != "proc"}
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{k: v for k, v in dict(
+                r, status=self._job_status_locked(r)).items() if k != "proc"}
+                for r in getattr(self, "_jobs", {}).values()]
+
+    def stop_job(self, job_id: str) -> bool:
+        with self._lock:
+            rec = getattr(self, "_jobs", {}).get(job_id)
+            if rec is None or rec["proc"].poll() is not None:
+                return False
+            rec["stopped"] = True
+            proc = rec["proc"]
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            proc.terminate()
+        return True
+
+    def get_job_logs(self, job_id: str, tail_bytes: int = 1 << 20) -> str:
+        with self._lock:
+            rec = getattr(self, "_jobs", {}).get(job_id)
+        if rec is None:
+            raise KeyError(job_id)
+        try:
+            with open(rec["log_path"], "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode("utf-8", "replace")
+        except FileNotFoundError:
+            return ""
+
+    def shutdown_cluster(self) -> bool:
+        """Remote stop for `ray_tpu stop` — tears the head down shortly
+        after replying."""
+
+        def later():
+            time.sleep(0.2)
+            try:
+                self.stop()
+            finally:
+                os._exit(0)
+
+        threading.Thread(target=later, daemon=True).start()
+        return True
+
     # ------------------------------------------------------------------ misc
 
     def ping(self) -> str:
@@ -617,7 +746,14 @@ class ConductorHandler:
         with self._cv:
             self._stopped = True
             workers = list(self._workers.values())
+            jobs = list(getattr(self, "_jobs", {}).values())
             self._cv.notify_all()
+        for rec in jobs:
+            if rec["proc"].poll() is None:
+                try:
+                    os.killpg(rec["proc"].pid, signal.SIGTERM)
+                except (OSError, ProcessLookupError):
+                    pass
         for w in workers:
             if w.proc is not None and w.proc.poll() is None:
                 try:

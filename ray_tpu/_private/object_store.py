@@ -197,12 +197,11 @@ class LocalObjectStore:
     def invalidate(self, object_id: str) -> None:
         """Drop a (possibly pending) entry so waiters see it as missing."""
         with self._cv:
-            pinned = self._externally_referenced(object_id)
             e = self._entries.pop(object_id, None)
             self._deserialized_cache.pop(object_id, None)
             if e is not None:
                 self._bytes -= e.nbytes
-                self._free_entry(e, leak_arena_block=pinned)
+                self._free_entry(e)
             self._cv.notify_all()
 
     # ---------- read paths ----------
@@ -236,20 +235,19 @@ class LocalObjectStore:
             if e.error is not None:
                 raise e.error
         if e.shm_name is not None:
-            is_arena = e.shm_name.startswith("arena:")
-            if is_arena and e.arena_offset is None:
-                # Remote arena object: the OWNER may free+reuse this block
-                # after the cluster-wide ref drops, so copy out of the
-                # mapping instead of keeping zero-copy views (the reference
-                # solves this with plasma pins; copy-on-read is our
-                # ownership-model equivalent). Owner-side reads (arena_offset
-                # set) stay zero-copy — the owner controls the free.
-                shm = self._attach(e.shm_name)
+            if e.shm_name.startswith("arena:"):
+                # Arena blocks are RECYCLED after free (unlike per-object
+                # segments, whose pages survive unlink), so any deserialize
+                # that could outlive the entry copies out of the mapping —
+                # the ownership-model stand-in for plasma pins. In practice
+                # this path is cold: owner reads of own puts are served by
+                # _deserialized_cache above.
+                shm = (self._arena if e.arena_offset is not None
+                       else self._attach(e.shm_name))
                 bufs = [memoryview(bytes(shm.buf[off:off + n]))
                         for off, n in e.layout]
             else:
-                shm = e.shm or (self._arena if e.arena_offset is not None
-                                else self._attach(e.shm_name))
+                shm = e.shm or self._attach(e.shm_name)
                 bufs = [memoryview(shm.buf)[off:off + n]
                         for off, n in e.layout]
         else:
@@ -290,13 +288,12 @@ class LocalObjectStore:
 
     def delete(self, object_id: str) -> None:
         with self._cv:
-            pinned = self._externally_referenced(object_id)
             e = self._entries.pop(object_id, None)
             self._deserialized_cache.pop(object_id, None)
         if e is not None:
             with self._cv:
                 self._bytes -= e.nbytes
-            self._free_entry(e, leak_arena_block=pinned)
+            self._free_entry(e)
 
     _QUARANTINE_S = 2.0
 
@@ -314,30 +311,14 @@ class LocalObjectStore:
             for off in ready:
                 self._arena.free(off)
 
-    def _externally_referenced(self, object_id: str) -> bool:
-        """True if the owner-side deserialized value for this object is still
-        held OUTSIDE the store (zero-copy arrays point into the arena, so
-        freeing their block would be a silent use-after-free; the reference
-        prevents this with plasma pins)."""
-        import sys
-        v = self._deserialized_cache.get(object_id)
-        if v is None:
-            return False
-        # refs when unreferenced elsewhere: cache dict + local v + arg
-        return sys.getrefcount(v) > 3
-
-    def _free_entry(self, e: _Entry, leak_arena_block: bool = False) -> None:
+    def _free_entry(self, e: _Entry) -> None:
         if e.arena_offset is not None and self._arena is not None:
-            if leak_arena_block:
-                # A live user array is backed by this block: never reuse it.
-                e.arena_offset = None
-            else:
-                with self._cv:
-                    self._arena_quarantine.append(
-                        (time.monotonic() + self._QUARANTINE_S,
-                         e.arena_offset))
-                e.arena_offset = None
-                self._drain_quarantine()
+            with self._cv:
+                self._arena_quarantine.append(
+                    (time.monotonic() + self._QUARANTINE_S,
+                     e.arena_offset))
+            e.arena_offset = None
+            self._drain_quarantine()
         if e.shm is not None:
             try:
                 e.shm.close()
@@ -376,11 +357,10 @@ class LocalObjectStore:
             for oid, e in entries:
                 if self._bytes <= STORE_CAP * 0.8:
                     break
-                pinned = self._externally_referenced(oid)
                 self._entries.pop(oid, None)
                 self._deserialized_cache.pop(oid, None)
                 self._bytes -= e.nbytes
-                self._free_entry(e, leak_arena_block=pinned)
+                self._free_entry(e)
 
     def stats(self) -> Dict[str, int]:
         with self._cv:

@@ -309,6 +309,10 @@ class Worker:
                 self.store.put_error(oid, err)
             with self._state_lock:
                 self._pending_ids.difference_update(spec.return_ids)
+            # infrastructure failures (worker crash, lease failure) must
+            # show up in `summary`/`timeline` as FAILED too
+            now = time.time()
+            self._record_event(spec, now, None, "FAILED")
 
     def _submit_once(self, spec: TaskSpec) -> None:
         for dep in _top_level_refs(spec.args, spec.kwargs):
@@ -329,7 +333,9 @@ class Worker:
             except ConnectionLost:
                 pass
         self._record_results(spec.return_ids, reply)
-        self._record_event(spec, t0, tuple(address))
+        status = "FAILED" if any(entry[1] == "error" for entry in reply) \
+            else "FINISHED"
+        self._record_event(spec, t0, tuple(address), status)
 
     def _wire_spec(self, spec: TaskSpec) -> dict:
         return {"task_id": spec.task_id, "name": spec.name,
@@ -364,10 +370,12 @@ class Worker:
         self.clients.get(tuple(owner)).call("resolve_object_location", ref.id,
                                             timeout=None)
 
-    def _record_event(self, spec: TaskSpec, t0: float, address) -> None:
+    def _record_event(self, spec: TaskSpec, t0: float, address,
+                      status: str = "FINISHED") -> None:
         ev = {"task_id": spec.task_id, "name": spec.name, "start": t0,
-              "end": time.time(), "worker": tuple(address),
-              "job_id": self.job_id}
+              "end": time.time(),
+              "worker": tuple(address) if address else None,
+              "job_id": self.job_id, "status": status}
         with self._task_events_lock:
             self._task_events.append(ev)
             batch = None
@@ -570,6 +578,15 @@ class Worker:
         if self._shutdown:
             return
         self._shutdown = True
+        # flush the tail of the task-event batch so `ray_tpu summary`/
+        # `timeline` see short-lived drivers (e.g. submitted jobs)
+        with self._task_events_lock:
+            batch, self._task_events = self._task_events, []
+        if batch:
+            try:
+                self.conductor.notify("report_task_events", batch)
+            except Exception:  # noqa: BLE001 — head may already be gone
+                pass
         self._submit_pool.shutdown(wait=False, cancel_futures=True)
         self.server.stop()
         self.clients.close_all()
@@ -698,6 +715,15 @@ class WorkerHandler:
 
     def ping(self) -> str:
         return "pong"
+
+    def store_stats(self) -> dict:
+        """Object-store introspection for the state API (reference
+        `ray memory` / StateHead object aggregation)."""
+        s = self.w.store.stats()
+        s["worker_id"] = self.w.worker_id
+        s["actor_id"] = getattr(self.w._actor_runtime, "actor_id", None) \
+            if self.w._actor_runtime else None
+        return s
 
     def push_task(self, wire: dict) -> list:
         return self.w.execute_task(wire)

@@ -1,0 +1,224 @@
+"""CLI — analog of the reference's python/ray/scripts/scripts.py
+(`ray start` :568, `stop` :1044, `submit` :1578, plus status/memory/
+timeline/logs) and util/state/state_cli.py (`ray list ...`).
+
+Run as ``python -m ray_tpu <command>``."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Optional
+
+_ADDR_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu",
+                          "head_address.txt")
+
+
+def _resolve_address(explicit: Optional[str]) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(_ADDR_FILE) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        raise SystemExit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "start a head on this machine with "
+            "`python -m ray_tpu start --head`")
+
+
+def _connect(args) -> None:
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(getattr(args, "address", None)))
+
+
+def cmd_start(args) -> None:
+    """Foreground head process — reference `ray start --head` (scripts.py:568
+    starts GCS+raylet; here one conductor process is the whole head)."""
+    if not args.head:
+        raise SystemExit("only --head is supported; worker processes are "
+                         "spawned on demand by the conductor")
+    from ray_tpu._private.conductor import Conductor
+
+    resources = {"CPU": float(args.num_cpus)}
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    session_dir = os.path.join(
+        tempfile.gettempdir(), "ray_tpu",
+        f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+    os.makedirs(session_dir, exist_ok=True)
+    c = Conductor(resources, session_dir, host=args.host,
+                  port=args.port).start()
+    host, port = c.address
+    os.makedirs(os.path.dirname(_ADDR_FILE), exist_ok=True)
+    with open(_ADDR_FILE, "w") as f:
+        f.write(f"{host}:{port}")
+    print(f"ray_tpu head started at {host}:{port}\n"
+          f"  session dir: {session_dir}\n"
+          f"  connect with ray_tpu.init(address=\"{host}:{port}\") "
+          f"or RAY_TPU_ADDRESS={host}:{port}", flush=True)
+    # The head lives in this process either way (use `&`/systemd to
+    # background it); --block is accepted for reference-CLI compatibility.
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        c.stop()
+
+
+def cmd_stop(args) -> None:
+    from ray_tpu._private.rpc import RpcClient
+
+    addr = _resolve_address(args.address)
+    host, _, port = addr.rpartition(":")
+    try:
+        RpcClient((host, int(port))).call("shutdown_cluster", timeout=10.0)
+        print(f"head at {addr} stopped")
+    except Exception as e:  # noqa: BLE001
+        # keep the address file: the head may still be alive and reachable
+        raise SystemExit(f"could not reach head at {addr}: {e}")
+    try:
+        os.unlink(_ADDR_FILE)
+    except OSError:
+        pass
+
+
+def cmd_status(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.cluster_summary(), indent=2, default=str))
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    fns = {"nodes": state.list_nodes, "workers": state.list_workers,
+           "actors": state.list_actors, "tasks": state.list_tasks,
+           "objects": state.list_objects,
+           "placement-groups": state.list_placement_groups}
+    print(json.dumps(fns[args.kind](), indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=2, default=str))
+
+
+def cmd_memory(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.list_objects(), indent=2, default=str))
+
+
+def cmd_timeline(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    n = len(state.timeline(args.output))
+    print(f"wrote {n} events to {args.output} "
+          f"(load in chrome://tracing or Perfetto)")
+
+
+def cmd_metrics(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    sys.stdout.write(state.prometheus_metrics())
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args.address))
+    if args.job_cmd == "submit":
+        import shlex
+
+        tokens = args.entrypoint
+        if tokens and tokens[0] == "--":  # REMAINDER keeps the separator
+            tokens = tokens[1:]
+        job_id = client.submit_job(
+            entrypoint=" ".join(shlex.quote(t) for t in tokens))
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id, timeout=args.timeout)
+            sys.stdout.write(client.get_job_logs(job_id))
+            print(f"job {job_id}: {status}")
+            if status != "SUCCEEDED":
+                raise SystemExit(1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.job_id) else "not running")
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=float,
+                    default=float(os.cpu_count() or 1))
+    sp.add_argument("--resources", help='extra resources as JSON, e.g. '
+                    '\'{"TPU": 4}\'')
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    for name, fn in [("stop", cmd_stop), ("status", cmd_status),
+                     ("summary", cmd_summary), ("memory", cmd_memory),
+                     ("metrics", cmd_metrics)]:
+        sp = sub.add_parser(name)
+        sp.add_argument("--address")
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("kind", choices=["nodes", "workers", "actors", "tasks",
+                                     "objects", "placement-groups"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("timeline", help="export chrome trace")
+    sp.add_argument("--output", default="ray_tpu_timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("job", help="job submission")
+    sp.add_argument("--address")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for jc in ["status", "logs", "stop"]:
+        j = jsub.add_parser(jc)
+        j.add_argument("job_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""CLI + job submission tests — modeled on the reference's
+python/ray/tests/test_cli.py and dashboard/modules/job/tests."""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def head():
+    """A standalone head via `python -m ray_tpu start --head`."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("RAY_TPU_ADDRESS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    address = None
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        m = re.search(r"started at ([\d.]+:\d+)", line or "")
+        if m:
+            address = m.group(1)
+            break
+    assert address, "head did not start"
+    yield address
+    subprocess.run([sys.executable, "-m", "ray_tpu", "stop",
+                    "--address", address], env=env, timeout=30)
+    proc.wait(timeout=10)
+
+
+def _cli(*args, address=None, check=True, timeout=120):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cmd = [sys.executable, "-m", "ray_tpu", *args]
+    if address:
+        env["RAY_TPU_ADDRESS"] = address
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    if check:
+        assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_connect_to_standalone_head(head):
+    import ray_tpu
+
+    ray_tpu.init(address=head)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21)) == 42
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cli_status_and_list(head):
+    out = json.loads(_cli("status", address=head))
+    assert out["resources_total"]["CPU"] == 4.0
+    nodes = json.loads(_cli("list", "nodes", address=head))
+    assert len(nodes) >= 1
+
+
+def test_job_submit_and_logs(head):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+
+    client = JobSubmissionClient(head)
+    script = ("import ray_tpu; ray_tpu.init(address='auto'); "
+              "print('job-result:', ray_tpu.get(ray_tpu.remote("
+              "lambda: 6 * 7).remote()))")
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{script}\"",
+        runtime_env={"env_vars": {"PYTHONPATH": REPO}})
+    status = client.wait_until_finished(job_id, timeout=120.0)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job-result: 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_status(head):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(head)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(job_id, timeout=60.0) == "FAILED"
+
+
+def test_job_stop(head):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(head)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.monotonic() + 30.0
+    while client.get_job_status(job_id) != "RUNNING" and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, timeout=30.0) == "STOPPED"
+
+
+def test_cli_job_roundtrip(head):
+    job_id = _cli("job", "--address", head, "submit",
+                  sys.executable, "-c", "print('cli-job-ok')").strip()
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        status = _cli("job", "--address", head, "status", job_id).strip()
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.2)
+    assert status == "SUCCEEDED"
+    assert "cli-job-ok" in _cli("job", "--address", head, "logs", job_id)
