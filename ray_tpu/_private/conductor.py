@@ -47,6 +47,9 @@ class WorkerRecord:
     state: str = "STARTING"  # STARTING | IDLE | BUSY | ACTOR | DEAD
     proc: Optional[subprocess.Popen] = None
     resources: Dict[str, float] = field(default_factory=dict)  # held while leased
+    # node whose resources the current lease took (an autoscaled accounting
+    # node may differ from the spawn node on this single-host runtime)
+    lease_node_id: Optional[str] = None
 
 
 @dataclass
@@ -104,6 +107,8 @@ class ConductorHandler:
         self._clients = ClientPool()
         self._stopped = False
         self._waiting_leases = 0
+        # resource shapes of leases currently blocked (autoscaler signal)
+        self._pending_demand: List[Tuple[float, Dict[str, float]]] = []
         self.address: Optional[Tuple[str, int]] = None  # set by Conductor
 
         head = NodeRecord(node_id=NodeID().hex(), total=dict(resources),
@@ -124,6 +129,20 @@ class ConductorHandler:
                                               available=dict(resources),
                                               address=tuple(address))
             self._cv.notify_all()
+
+    def deregister_node(self, node_id: str) -> bool:
+        """Remove a (non-head, idle) node — autoscaler scale-down path."""
+        with self._cv:
+            if node_id == self._head_node_id:
+                return False
+            n = self._nodes.get(node_id)
+            if n is None:
+                return False
+            if any(n.available.get(k, 0.0) < v for k, v in n.total.items()):
+                return False  # leases still hold its resources
+            del self._nodes[node_id]
+            self._cv.notify_all()
+            return True
 
     def cluster_resources(self) -> Dict[str, float]:
         with self._lock:
@@ -230,30 +249,58 @@ class ConductorHandler:
             # resources come out of the PG's pre-reserved bundle pool
             resources = {f"_pg_{placement_group_id}_{k}": v
                          for k, v in resources.items()}
+        demand_token = (time.time(), dict(resources))
         with self._cv:
             self._waiting_leases += 1
+            self._pending_demand.append(demand_token)
             try:
                 return self._lease_locked(resources, deadline)
             finally:
                 self._waiting_leases -= 1
+                self._pending_demand.remove(demand_token)
+
+    def get_pending_demand(self) -> List[Dict[str, Any]]:
+        """Resource shapes of leases currently waiting, with wait age —
+        the autoscaler's scale-up signal (reference LoadMetrics /
+        gcs_autoscaler_state_manager.cc)."""
+        now = time.time()
+        with self._lock:
+            return [{"resources": dict(res), "age_s": now - t0}
+                    for t0, res in self._pending_demand]
+
+    def _lease_release_node(self, w: WorkerRecord) -> NodeRecord:
+        """The node to credit a worker's held resources back to."""
+        return self._nodes.get(w.lease_node_id or w.node_id) \
+            or self._nodes[w.node_id]
 
     def _lease_locked(self, resources, deadline):
             while True:
                 if self._stopped:
                     raise RuntimeError("conductor stopped")
-                node = self._nodes[self._head_node_id]
-                if self._acquire_resources(node, resources):
+                # head first, then any registered (e.g. autoscaled) node —
+                # workers run on this host either way; remote nodes are
+                # resource-accounting entries (single-host runtime).
+                head = self._nodes[self._head_node_id]
+                nodes = [head] + [n for nid, n in self._nodes.items()
+                                  if nid != self._head_node_id and n.alive]
+                acquired = None
+                for node in nodes:
+                    if self._acquire_resources(node, resources):
+                        acquired = node
+                        break
+                if acquired is not None:
                     w = self._take_idle_or_spawn(deadline)
                     if w is not None:
                         w.state = "BUSY"
                         w.resources = resources
+                        w.lease_node_id = acquired.node_id
                         return w.worker_id, w.address
-                    self._release_resources(node, resources)
+                    self._release_resources(acquired, resources)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
                         f"no worker available for {resources} within timeout; "
-                        f"available={node.available}")
+                        f"available={head.available}")
                 self._cv.wait(min(remaining, 0.1))
 
     def _take_idle_or_spawn(self, deadline: float) -> Optional[WorkerRecord]:
@@ -279,8 +326,7 @@ class ConductorHandler:
             w = self._workers.get(worker_id)
             if w is None or w.state == "DEAD":
                 return
-            node = self._nodes[w.node_id]
-            self._release_resources(node, w.resources)
+            self._release_resources(self._lease_release_node(w), w.resources)
             w.resources = {}
             if w.state == "BUSY":
                 w.state = "IDLE"
@@ -432,7 +478,7 @@ class ConductorHandler:
                 if w is not None and w.state == "ACTOR":
                     w.state = "DEAD"
                     # monitor skips DEAD workers, so release the lease here
-                    self._release_resources(self._nodes[w.node_id],
+                    self._release_resources(self._lease_release_node(w),
                                             w.resources)
                     w.resources = {}
             self._cv.notify_all()
@@ -707,8 +753,8 @@ class ConductorHandler:
                             alive = False
                     if not alive:
                         w.state = "DEAD"
-                        node = self._nodes[w.node_id]
-                        self._release_resources(node, w.resources)
+                        self._release_resources(self._lease_release_node(w),
+                                                w.resources)
                         w.resources = {}
                         dead.append(w)
                         if w.address:

@@ -1,0 +1,243 @@
+"""ray_tpu.workflow — durable DAG execution, analog of the reference's
+python/ray/workflow/ (api.py workflow.run/resume, workflow_executor.py,
+workflow_state.py step state machine, workflow_storage.py idempotent
+storage).
+
+A workflow is a ray_tpu.dag graph run with per-step checkpointing: each
+step's result is persisted before dependents run, so `resume()` after a
+crash (or cluster restart) re-executes only unfinished steps. Steps execute
+as normal tasks/actor calls; independent steps run concurrently."""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
+                                  InputAttributeNode, InputNode,
+                                  MultiOutputNode)
+
+from . import storage as _storage
+from .storage import WorkflowStorage, delete_workflow, list_workflow_ids
+
+__all__ = ["run", "run_async", "resume", "resume_async", "get_status",
+           "get_output", "list_all", "cancel", "delete", "WorkflowStatus"]
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+
+
+_cancel_flags: Dict[str, threading.Event] = {}
+_cancel_lock = threading.Lock()
+
+
+def _step_key(node: DAGNode, index: int) -> str:
+    """Stable step identity across resumes: topo position + symbolic name
+    (reference workflow_state_from_storage.py keys steps by name)."""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "__name__", "fn")
+    elif isinstance(node, ClassMethodNode):
+        name = node._method_name
+    else:
+        name = type(node).__name__
+    return f"{index:04d}_{name}"
+
+
+def _execute_workflow(workflow_id: str, store: WorkflowStorage) -> Any:
+    """Run (or finish) the stored DAG, checkpointing each step."""
+    import ray_tpu
+
+    dag, run_args, run_kwargs = store.load_dag()
+    with _cancel_lock:
+        cancel = _cancel_flags.setdefault(workflow_id, threading.Event())
+
+    topo = dag._topo_order()
+    keys = {n._id: _step_key(n, i) for i, n in enumerate(topo)}
+    resolved: Dict[int, Any] = {}
+    pending: List[tuple] = []  # (node_id, key, ref) awaiting checkpoint
+    try:
+        # Submit eagerly: uncheckpointed steps get ObjectRefs that chain
+        # through downstream submissions, so independent steps execute
+        # concurrently; checkpointing trails in topo order below. A crash
+        # between completion and checkpoint just re-runs that step on
+        # resume (steps must be idempotent — same contract as the
+        # reference's workflow_executor).
+        for node in topo:
+            if cancel.is_set():
+                store.update_meta(status=WorkflowStatus.CANCELED,
+                                  finished=time.time())
+                raise RuntimeError(f"workflow {workflow_id} canceled")
+            key = keys[node._id]
+            if isinstance(node, (InputNode, InputAttributeNode,
+                                 MultiOutputNode)):
+                # structural nodes are recomputed, never checkpointed
+                resolved[node._id] = node._execute_impl(
+                    resolved, run_args, run_kwargs)
+                continue
+            if store.has_step(key):  # idempotent resume: skip finished work
+                resolved[node._id] = store.load_step(key)
+                continue
+            ref = node._execute_impl(resolved, run_args, run_kwargs)
+            resolved[node._id] = ref
+            pending.append((node._id, key, ref))
+        for node_id, key, ref in pending:
+            if cancel.is_set():
+                store.update_meta(status=WorkflowStatus.CANCELED,
+                                  finished=time.time())
+                raise RuntimeError(f"workflow {workflow_id} canceled")
+            value = ray_tpu.get(ref)
+            store.save_step(key, value)
+            resolved[node_id] = value
+        output = resolved[dag._id]
+        if isinstance(output, list):  # MultiOutputNode members
+            output = [resolved[n._id] for n in dag._outputs] \
+                if isinstance(dag, MultiOutputNode) else output
+        store.save_output(output)
+        store.update_meta(status=WorkflowStatus.SUCCESSFUL,
+                          finished=time.time())
+        return output
+    except Exception:
+        if (store.load_meta() or {}).get("status") != WorkflowStatus.CANCELED:
+            store.update_meta(status=WorkflowStatus.FAILED,
+                              finished=time.time(),
+                              error=traceback.format_exc())
+        raise
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        **kwargs) -> Any:
+    """Execute a DAG durably and return its output — reference
+    workflow/api.py run()."""
+    import uuid
+
+    if not isinstance(dag, DAGNode):
+        raise TypeError("workflow.run takes a DAG built with .bind()")
+    import hashlib
+
+    import cloudpickle
+
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    store = WorkflowStorage(workflow_id)
+    meta = store.load_meta()
+    if meta is not None and meta.get("status") == WorkflowStatus.RUNNING:
+        raise RuntimeError(f"workflow {workflow_id} is already running")
+    if meta is not None and meta.get("status") == WorkflowStatus.SUCCESSFUL:
+        return store.load_output()
+    dag_bytes = cloudpickle.dumps((dag, args, kwargs))
+    fingerprint = hashlib.sha256(dag_bytes).hexdigest()
+    if meta is not None and meta.get("fingerprint") != fingerprint:
+        # re-run under the same id with a DIFFERENT dag/args: step keys may
+        # collide, so stale checkpoints would be silently mixed in — clear
+        # them (conservative: any pickle difference clears)
+        for key in store.list_steps():
+            try:
+                import os as _os
+
+                _os.unlink(store._step_path(key))
+            except OSError:
+                pass
+    store.save_dag(dag, args, kwargs)
+    store.update_meta(status=WorkflowStatus.RUNNING, started=time.time(),
+                      fingerprint=fingerprint)
+    with _cancel_lock:
+        _cancel_flags[workflow_id] = threading.Event()
+    return _execute_workflow(workflow_id, store)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              **kwargs) -> "Future[Any]":
+    fut: "Future[Any]" = Future()
+
+    def body():
+        try:
+            fut.set_result(run(dag, *args, workflow_id=workflow_id,
+                               **kwargs))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=body, daemon=True,
+                     name=f"workflow-{workflow_id}").start()
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run only the unfinished steps of a stored workflow — reference
+    workflow/api.py resume() + workflow_state_from_storage.py."""
+    store = WorkflowStorage(workflow_id)
+    meta = store.load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    if meta.get("status") == WorkflowStatus.SUCCESSFUL:
+        return store.load_output()
+    store.update_meta(status=WorkflowStatus.RUNNING, resumed=time.time())
+    with _cancel_lock:
+        _cancel_flags[workflow_id] = threading.Event()
+    return _execute_workflow(workflow_id, store)
+
+
+def resume_async(workflow_id: str) -> "Future[Any]":
+    fut: "Future[Any]" = Future()
+
+    def body():
+        try:
+            fut.set_result(resume(workflow_id))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=body, daemon=True).start()
+    return fut
+
+
+def get_status(workflow_id: str) -> str:
+    meta = WorkflowStorage(workflow_id).load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    return meta.get("status", WorkflowStatus.RUNNING)
+
+
+def get_output(workflow_id: str) -> Any:
+    store = WorkflowStorage(workflow_id)
+    if not store.has_output():
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status={get_status(workflow_id)})")
+    return store.load_output()
+
+
+def get_error(workflow_id: str) -> Optional[str]:
+    meta = WorkflowStorage(workflow_id).load_meta() or {}
+    return meta.get("error")
+
+
+def list_all(status_filter: Optional[str] = None
+             ) -> List[Dict[str, Any]]:
+    out = []
+    for wid in list_workflow_ids():
+        meta = WorkflowStorage(wid).load_meta() or {"workflow_id": wid}
+        if status_filter is None or meta.get("status") == status_filter:
+            out.append(meta)
+    return out
+
+
+def cancel(workflow_id: str) -> None:
+    """Best-effort: running executors observe the flag between steps —
+    reference workflow.cancel. No-op on already-terminal workflows."""
+    store = WorkflowStorage(workflow_id)
+    status = (store.load_meta() or {}).get("status")
+    if status in (WorkflowStatus.SUCCESSFUL, WorkflowStatus.FAILED,
+                  WorkflowStatus.CANCELED):
+        return
+    with _cancel_lock:
+        flag = _cancel_flags.get(workflow_id)
+    if flag is not None:
+        flag.set()
+    store.update_meta(status=WorkflowStatus.CANCELED, finished=time.time())
+
+
+def delete(workflow_id: str) -> bool:
+    return delete_workflow(workflow_id)

@@ -1,0 +1,124 @@
+"""Workflow storage — analog of the reference's
+python/ray/workflow/workflow_storage.py: a filesystem layout holding the
+serialized DAG, per-step checkpoints, and workflow metadata, addressed by
+workflow_id and durable across cluster restarts."""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+
+def storage_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE",
+        os.path.join(os.path.expanduser("~"), ".ray_tpu", "workflows"))
+
+
+def _validate_id(workflow_id: str) -> str:
+    """Reject ids that could escape the storage root ('..', separators)."""
+    if not workflow_id or workflow_id in (".", "..") or \
+            "/" in workflow_id or "\\" in workflow_id or \
+            os.sep in workflow_id:
+        raise ValueError(f"bad workflow id {workflow_id!r}")
+    return workflow_id
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str):
+        _validate_id(workflow_id)
+        self.workflow_id = workflow_id
+        self.root = os.path.join(storage_root(), workflow_id)
+        os.makedirs(os.path.join(self.root, "steps"), exist_ok=True)
+
+    # -- atomic file helpers -------------------------------------------------
+    def _write(self, path: str, data: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+
+    # -- metadata ------------------------------------------------------------
+    def save_meta(self, meta: Dict[str, Any]) -> None:
+        self._write(os.path.join(self.root, "meta.json"),
+                    json.dumps(meta).encode())
+
+    def load_meta(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.root, "meta.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def update_meta(self, **kv: Any) -> Dict[str, Any]:
+        meta = self.load_meta() or {"workflow_id": self.workflow_id,
+                                    "created": time.time()}
+        meta.update(kv)
+        self.save_meta(meta)
+        return meta
+
+    # -- DAG -----------------------------------------------------------------
+    def save_dag(self, dag: Any, run_args: tuple, run_kwargs: dict) -> None:
+        self._write(os.path.join(self.root, "dag.pkl"),
+                    cloudpickle.dumps((dag, run_args, run_kwargs)))
+
+    def load_dag(self):
+        with open(os.path.join(self.root, "dag.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    # -- steps ---------------------------------------------------------------
+    def _step_path(self, step_key: str) -> str:
+        return os.path.join(self.root, "steps", f"{step_key}.pkl")
+
+    def has_step(self, step_key: str) -> bool:
+        return os.path.exists(self._step_path(step_key))
+
+    def save_step(self, step_key: str, result: Any) -> None:
+        self._write(self._step_path(step_key), cloudpickle.dumps(result))
+
+    def load_step(self, step_key: str) -> Any:
+        with open(self._step_path(step_key), "rb") as f:
+            return cloudpickle.load(f)
+
+    def list_steps(self) -> List[str]:
+        return [f[:-4] for f in os.listdir(os.path.join(self.root, "steps"))
+                if f.endswith(".pkl")]
+
+    # -- output --------------------------------------------------------------
+    def save_output(self, value: Any) -> None:
+        self._write(os.path.join(self.root, "output.pkl"),
+                    cloudpickle.dumps(value))
+
+    def load_output(self) -> Any:
+        with open(os.path.join(self.root, "output.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def has_output(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "output.pkl"))
+
+
+def list_workflow_ids() -> List[str]:
+    root = storage_root()
+    try:
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+    except FileNotFoundError:
+        return []
+
+
+def delete_workflow(workflow_id: str) -> bool:
+    import shutil
+
+    _validate_id(workflow_id)
+    path = os.path.join(storage_root(), workflow_id)
+    if not os.path.isdir(path):
+        return False
+    shutil.rmtree(path)
+    return True

@@ -1,0 +1,147 @@
+"""Workflow tests — modeled on the reference's
+python/ray/workflow/tests/ (test_basic_workflows.py, test_recovery.py)."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    os.environ["RAY_TPU_WORKFLOW_STORAGE"] = str(
+        tmp_path_factory.mktemp("wf_storage"))
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_WORKFLOW_STORAGE", None)
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+def test_basic_run(cluster):
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 1)
+    assert workflow.run(dag, 5, workflow_id="wf_basic") == 11
+    assert workflow.get_status("wf_basic") == "SUCCESSFUL"
+    assert workflow.get_output("wf_basic") == 11
+
+
+def test_rerun_returns_cached(cluster):
+    calls_file = None  # results come from storage, steps don't re-run
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    assert workflow.run(dag, 4, workflow_id="wf_cache") == 8
+    # second run with SAME id returns stored output without re-executing
+    assert workflow.run(dag, 999, workflow_id="wf_cache") == 8
+    assert calls_file is None
+
+
+def test_multi_output(cluster):
+    with InputNode() as inp:
+        dag = MultiOutputNode([double.bind(inp), add.bind(inp, 10)])
+    assert workflow.run(dag, 3, workflow_id="wf_multi") == [6, 13]
+
+
+def test_failure_and_resume(cluster, tmp_path):
+    marker = tmp_path / "fail_once"
+    marker.write_text("1")
+
+    @ray_tpu.remote
+    def flaky(x, marker_path):
+        if os.path.exists(marker_path):
+            raise RuntimeError("transient failure")
+        return x + 100
+
+    with InputNode() as inp:
+        dag = add.bind(flaky.bind(double.bind(inp), str(marker)), 1)
+
+    with pytest.raises(Exception):
+        workflow.run(dag, 2, workflow_id="wf_resume")
+    assert workflow.get_status("wf_resume") == "FAILED"
+    assert "transient failure" in (workflow.get_error("wf_resume") or "")
+
+    marker.unlink()  # heal the fault, then resume: only flaky+add re-run
+    assert workflow.resume("wf_resume") == 105  # (2*2)+100+1
+    assert workflow.get_status("wf_resume") == "SUCCESSFUL"
+
+
+def test_resume_skips_completed_steps(cluster, tmp_path):
+    counter = tmp_path / "count"
+    counter.write_text("0")
+
+    @ray_tpu.remote
+    def counted(x, path):
+        n = int(open(path).read()) + 1
+        open(path, "w").write(str(n))
+        return x + n
+
+    @ray_tpu.remote
+    def boom(x):
+        raise ValueError("always fails")
+
+    with InputNode() as inp:
+        dag = boom.bind(counted.bind(inp, str(counter)))
+    with pytest.raises(Exception):
+        workflow.run(dag, 0, workflow_id="wf_skip")
+    assert counter.read_text() == "1"
+    with pytest.raises(Exception):
+        workflow.resume("wf_skip")
+    # `counted` was checkpointed, so resume must NOT re-run it
+    assert counter.read_text() == "1"
+
+
+def test_list_and_delete(cluster):
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    workflow.run(dag, 1, workflow_id="wf_list_a")
+    workflow.run(dag, 2, workflow_id="wf_list_b")
+    ids = {m["workflow_id"] for m in workflow.list_all()}
+    assert {"wf_list_a", "wf_list_b"} <= ids
+    ok = {m["workflow_id"]
+          for m in workflow.list_all(status_filter="SUCCESSFUL")}
+    assert "wf_list_a" in ok
+    assert workflow.delete("wf_list_a")
+    assert "wf_list_a" not in {m["workflow_id"]
+                               for m in workflow.list_all()}
+
+
+def test_actor_method_steps(cluster):
+    @ray_tpu.remote
+    class Accum:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    a = Accum.remote()
+    with InputNode() as inp:
+        dag = double.bind(a.add.bind(inp))
+    assert workflow.run(dag, 5, workflow_id="wf_actor") == 10
+
+
+def test_run_async(cluster):
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 7)
+    fut = workflow.run_async(dag, 10, workflow_id="wf_async")
+    assert fut.result(timeout=60) == 27
+
+
+def test_kwargs_input(cluster):
+    with InputNode() as inp:
+        dag = add.bind(inp.x, inp.y)
+    assert workflow.run(dag, x=2, y=3, workflow_id="wf_kw") == 5
