@@ -1,0 +1,165 @@
+"""Runtime environments — analog of the reference's
+python/ray/_private/runtime_env/ (working_dir/py_modules packaging.py: zip
+to GCS KV, URI-cached per node; env_vars; plugins) + the runtime-env agent
+flow (agent/runtime_env_agent.py:161).
+
+Scope for the TPU build: env_vars, working_dir, py_modules, and config
+validation. Directories are zipped, content-addressed, staged through the
+conductor KV (the GCS-KV analog), and extracted once per worker into a
+hash-keyed cache. pip/conda/container are rejected with a clear error —
+this runtime never installs packages at task time (TPU images are baked;
+the reference's conda path is its slowest, least reproducible feature)."""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Any, Dict, Optional
+
+_KV_NS = "runtime_env"
+_MAX_PACKAGE_BYTES = 256 * 1024 * 1024
+_UNSUPPORTED = ("pip", "conda", "container", "uv", "image_uri")
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    if not runtime_env:
+        return {}
+    env = dict(runtime_env)
+    for key in _UNSUPPORTED:
+        if key in env:
+            raise ValueError(
+                f"runtime_env[{key!r}] is not supported: ray_tpu never "
+                "installs packages at task time (bake them into the image); "
+                "supported keys: env_vars, working_dir, py_modules")
+    ev = env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in ev.items()):
+        raise ValueError("runtime_env['env_vars'] must be Dict[str, str]")
+    return env
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for f in files:
+                if f.endswith(".pyc"):
+                    continue
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, base))
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES}); exclude large data files")
+    return data
+
+
+def package_dir(conductor, path: str) -> str:
+    """Zip + upload a directory to the conductor KV; returns a
+    content-addressed URI (reference packaging.py upload_package_if_needed).
+    """
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory {path!r} does not exist")
+    data = _zip_dir(path)
+    digest = hashlib.sha256(data).hexdigest()[:24]
+    uri = f"kv://{digest}.zip"
+    key = uri.encode()
+    if conductor.call("kv_get", key, _KV_NS, timeout=30.0) is None:
+        conductor.call("kv_put", key, data, True, _KV_NS, timeout=60.0)
+    return uri
+
+
+def prepare(conductor, runtime_env: Dict[str, Any]) -> Dict[str, Any]:
+    """Driver-side: replace local dirs with uploaded URIs. Idempotent."""
+    env = validate(runtime_env)
+    if not env:
+        return {}
+    out = dict(env)
+    wd = env.get("working_dir")
+    if wd and not wd.startswith("kv://"):
+        out["working_dir"] = package_dir(conductor, wd)
+    mods = []
+    for m in env.get("py_modules") or []:
+        mods.append(m if m.startswith("kv://")
+                    else package_dir(conductor, m))
+    if mods:
+        out["py_modules"] = mods
+    return out
+
+
+def _cache_root() -> str:
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "runtime_env")
+
+
+def ensure_local(conductor, uri: str) -> str:
+    """Worker-side: fetch + extract a kv:// package once; returns its
+    directory (reference uri_cache.py — content-addressed, shared across
+    tasks on the worker)."""
+    digest = uri[len("kv://"):-len(".zip")]
+    dest = os.path.join(_cache_root(), digest)
+    if os.path.isdir(dest):
+        return dest
+    data = conductor.call("kv_get", uri.encode(), _KV_NS, timeout=60.0)
+    if data is None:
+        raise RuntimeError(f"runtime_env package {uri} not found in KV")
+    tmp = dest + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        z.extractall(tmp)
+    try:
+        os.replace(tmp, dest)
+    except OSError:  # another worker won the race
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+@contextlib.contextmanager
+def applied(conductor, runtime_env: Optional[Dict[str, Any]],
+            permanent: bool = False):
+    """Apply a (prepared) runtime_env around execution. For tasks the
+    previous env/cwd/sys.path are restored afterwards (shared worker);
+    actors pass permanent=True (dedicated process, reference behavior)."""
+    env = runtime_env or {}
+    if not env:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd = os.getcwd()
+    saved_path = list(sys.path)
+    try:
+        for k, v in (env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        wd = env.get("working_dir")
+        if wd:
+            local = ensure_local(conductor, wd)
+            os.chdir(local)
+            if local not in sys.path:
+                sys.path.insert(0, local)
+        for uri in env.get("py_modules") or []:
+            local = ensure_local(conductor, uri)
+            if local not in sys.path:
+                sys.path.insert(0, local)
+        yield
+    finally:
+        if not permanent:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+            sys.path[:] = saved_path

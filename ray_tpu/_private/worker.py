@@ -56,6 +56,7 @@ class TaskSpec:
     max_retries: int = DEFAULT_MAX_RETRIES
     owner: Optional[Tuple[str, int]] = None
     placement_group_id: Optional[str] = None
+    runtime_env: Optional[Dict[str, Any]] = None  # prepared (URIs staged)
 
 
 def _top_level_refs(args: tuple, kwargs: dict) -> List[ObjectRef]:
@@ -268,7 +269,12 @@ class Worker:
                     name: str = "", num_returns: int = 1,
                     resources: Optional[Dict[str, float]] = None,
                     max_retries: int = DEFAULT_MAX_RETRIES,
-                    placement_group_id: Optional[str] = None):
+                    placement_group_id: Optional[str] = None,
+                    runtime_env: Optional[Dict[str, Any]] = None):
+        if runtime_env:
+            from . import runtime_env as renv
+
+            runtime_env = renv.prepare(self.conductor, runtime_env)
         return_ids = [ObjectID().hex() for _ in range(num_returns)]
         spec = TaskSpec(
             task_id=TaskID().hex(),
@@ -279,7 +285,8 @@ class Worker:
             resources=dict(resources or {}),
             max_retries=max_retries,
             owner=self.address,
-            placement_group_id=placement_group_id)
+            placement_group_id=placement_group_id,
+            runtime_env=runtime_env)
         refs = [ObjectRef(oid, locator=None, owner=self.address)
                 for oid in return_ids]
         with self._state_lock:
@@ -341,7 +348,7 @@ class Worker:
         return {"task_id": spec.task_id, "name": spec.name,
                 "fn_bytes": spec.fn_bytes, "args": spec.args,
                 "kwargs": spec.kwargs, "return_ids": spec.return_ids,
-                "owner": spec.owner}
+                "owner": spec.owner, "runtime_env": spec.runtime_env}
 
     def _record_results(self, return_ids: List[str], reply: list) -> None:
         for oid, kind, payload in reply:
@@ -399,7 +406,10 @@ class Worker:
             args = tuple(self._materialize(a) for a in wire["args"])
             kwargs = {k: self._materialize(v)
                       for k, v in wire["kwargs"].items()}
-            result = fn(*args, **kwargs)
+            from . import runtime_env as renv
+
+            with renv.applied(self.conductor, wire.get("runtime_env")):
+                result = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             err = exc.TaskError(e, traceback.format_exc(), name)
             return [(oid, "error", err) for oid in wire["return_ids"]]
@@ -435,6 +445,12 @@ class Worker:
     # --------------------------------------------------------------- actors
 
     def create_actor(self, cls, args, kwargs, options: Dict[str, Any]) -> dict:
+        if options.get("runtime_env"):
+            from . import runtime_env as renv
+
+            options = dict(options)
+            options["runtime_env"] = renv.prepare(self.conductor,
+                                                  options["runtime_env"])
         spec_bytes = serialization.dumps((cls, args, kwargs, dict(options)))
         resources = dict(options.get("resources") or {})
         num_cpus = options.get("num_cpus")
@@ -609,6 +625,13 @@ class ActorRuntime:
         self.actor_id = actor_id
         self.options = options
         self.max_concurrency = int(options.get("max_concurrency") or 1)
+        if options.get("runtime_env"):
+            # dedicated process: applied permanently (reference behavior)
+            from . import runtime_env as renv
+
+            ctx = renv.applied(worker.conductor, options["runtime_env"],
+                               permanent=True)
+            ctx.__enter__()
         self.instance = cls(
             *[worker._materialize(a) for a in args],
             **{k: worker._materialize(v) for k, v in kwargs.items()})

@@ -12,3 +12,17 @@ from .gpt2 import (  # noqa: F401
     gpt2_loss,
     gpt2_partition_specs,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_partition_specs,
+)
+from .moe_transformer import (  # noqa: F401
+    MoEConfig,
+    moe_forward,
+    moe_init,
+    moe_loss,
+    moe_partition_specs,
+)

@@ -1,0 +1,182 @@
+"""Llama-family decoder in pure functional JAX — RMSNorm, RoPE, SwiGLU,
+grouped-query attention — with a megatron-style PartitionSpec tree.
+
+The reference tree carries no model code (its Train/RLlib wrap torch
+models, SURVEY.md §2.4); this is native framework capability following the
+same idioms as models/gpt2.py: pytree params + jit-able forward, bf16
+params/activations with fp32 norm stats, flash attention (Pallas on TPU),
+static shapes, one spec tree serving dp/fsdp/tp by changing only the mesh.
+
+GQA + tp note: num_kv_heads must divide by the tp degree in use (as in
+every tp Llama deployment); kv heads are repeated to query heads right
+before attention, which XLA lowers to a broadcast (no HBM copy)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import flash_attention
+from ..ops.layers import rms_norm
+from ..ops.rope import apply_rope, rope_table
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 2048
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 4
+    d_model: int = 768
+    d_ff: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    vocab_pad_multiple: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def padded_vocab(self) -> int:
+        m = self.vocab_pad_multiple
+        return (self.vocab_size + m - 1) // m * m
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":  # tests / dry runs
+        return LlamaConfig(vocab_size=512, max_seq_len=128, num_layers=2,
+                           num_heads=4, num_kv_heads=2, d_model=128,
+                           d_ff=256)
+
+    @staticmethod
+    def small() -> "LlamaConfig":  # ~125M-class
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=32000, max_seq_len=4096,
+                           num_layers=32, num_heads=32, num_kv_heads=32,
+                           d_model=4096, d_ff=11008)
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, max_seq_len=8192,
+                           num_layers=32, num_heads=32, num_kv_heads=8,
+                           d_model=4096, d_ff=14336, rope_theta=500000.0)
+
+
+def llama_init(config: LlamaConfig, key: jax.Array) -> Params:
+    c = config
+    if c.num_heads % c.num_kv_heads:
+        raise ValueError("num_heads must be a multiple of num_kv_heads")
+    k_iter = iter(jax.random.split(key, 2 + 7 * c.num_layers))
+
+    def norm(k, *shape, scale=0.02):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * scale).astype(c.dtype)
+
+    kv_dim = c.num_kv_heads * c.head_dim
+    params: Params = {
+        "tok_emb": norm(next(k_iter), c.padded_vocab, c.d_model),
+        "norm_f": {"scale": jnp.ones(c.d_model, c.dtype)},
+        "lm_head": norm(next(k_iter), c.d_model, c.padded_vocab),
+        "blocks": [],
+    }
+    for _ in range(c.num_layers):
+        params["blocks"].append({
+            "attn_norm": {"scale": jnp.ones(c.d_model, c.dtype)},
+            "attn": {
+                "wq": norm(next(k_iter), c.d_model, c.d_model),
+                "wk": norm(next(k_iter), c.d_model, kv_dim),
+                "wv": norm(next(k_iter), c.d_model, kv_dim),
+                "wo": norm(next(k_iter), c.d_model, c.d_model),
+            },
+            "ffn_norm": {"scale": jnp.ones(c.d_model, c.dtype)},
+            "mlp": {
+                "w_gate": norm(next(k_iter), c.d_model, c.d_ff),
+                "w_up": norm(next(k_iter), c.d_model, c.d_ff),
+                "w_down": norm(next(k_iter), c.d_ff, c.d_model),
+            },
+        })
+    return params
+
+
+def _mm(x: jax.Array, w: jax.Array) -> jax.Array:
+    return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def llama_block(x: jax.Array, p: Params, cos: jax.Array, sin: jax.Array,
+                config: LlamaConfig) -> jax.Array:
+    c = config
+    b, t, _ = x.shape
+    h = rms_norm(x, p["attn_norm"]["scale"])
+    q = _mm(h, p["attn"]["wq"]).reshape(b, t, c.num_heads, c.head_dim)
+    k = _mm(h, p["attn"]["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    v = _mm(h, p["attn"]["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if c.num_kv_heads != c.num_heads:  # GQA: broadcast kv to query heads
+        rep = c.num_heads // c.num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    a = flash_attention(q, k, v, True).reshape(b, t, c.d_model)
+    x = x + _mm(a, p["attn"]["wo"])
+
+    h = rms_norm(x, p["ffn_norm"]["scale"])
+    gate = jax.nn.silu(_mm(h, p["mlp"]["w_gate"]).astype(jnp.float32))
+    up = _mm(h, p["mlp"]["w_up"]).astype(jnp.float32)
+    return x + _mm((gate * up).astype(x.dtype), p["mlp"]["w_down"])
+
+
+def llama_forward(params: Params, tokens: jax.Array,
+                  config: LlamaConfig) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, padded_vocab] fp32."""
+    c = config
+    cos, sin = rope_table(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_emb"][tokens]
+    for p in params["blocks"]:
+        x = llama_block(x, p, cos, sin, c)
+    x = rms_norm(x, params["norm_f"]["scale"])
+    return jnp.dot(x, params["lm_head"],
+                   preferred_element_type=jnp.float32)
+
+
+def llama_loss(params: Params, tokens: jax.Array, targets: jax.Array,
+               config: LlamaConfig, remat: bool = False) -> jax.Array:
+    fwd = llama_forward
+    if remat:
+        fwd = jax.checkpoint(llama_forward, static_argnums=(2,))
+    logits = fwd(params, tokens, config)
+    if config.padded_vocab != config.vocab_size:
+        neg = jnp.full((config.padded_vocab - config.vocab_size,), -1e30,
+                       dtype=logits.dtype)
+        logits = logits.at[..., config.vocab_size:].set(neg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def llama_partition_specs(config: LlamaConfig) -> Params:
+    """Megatron layout: q/k/v and gate/up column-parallel on tp, wo/down
+    row-parallel, embeddings 2D-sharded. Collapses to replicated when the
+    mesh has tp=fsdp=1."""
+    block = {
+        "attn_norm": {"scale": P()},
+        "attn": {"wq": P("fsdp", "tp"), "wk": P("fsdp", "tp"),
+                 "wv": P("fsdp", "tp"), "wo": P("tp", "fsdp")},
+        "ffn_norm": {"scale": P()},
+        "mlp": {"w_gate": P("fsdp", "tp"), "w_up": P("fsdp", "tp"),
+                "w_down": P("tp", "fsdp")},
+    }
+    return {
+        "tok_emb": P("tp", "fsdp"),
+        "norm_f": {"scale": P()},
+        "lm_head": P("fsdp", "tp"),
+        "blocks": [block for _ in range(config.num_layers)],
+    }

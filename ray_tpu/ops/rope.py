@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE) — split-half (GPT-NeoX) convention.
+
+TPU notes: cos/sin tables are precomputed fp32 and broadcast (tiny HBM
+cost); the rotation is pure elementwise work that XLA fuses into the
+surrounding QK projections, so no Pallas kernel is warranted here."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_table(head_dim: int, max_seq_len: int,
+               theta: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+    """(cos, sin), each [max_seq_len, head_dim // 2], fp32."""
+    if head_dim % 2:
+        raise ValueError("RoPE needs an even head_dim")
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [T, hd/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """Rotate q or k. x: [B, T, H, hd]; cos/sin: [>=T, hd/2];
+    positions: optional [B, T] int32 (defaults to arange — use for
+    decode-time offsets)."""
+    t = x.shape[1]
+    if positions is None:
+        c = cos[:t][None, :, None, :]  # [1, T, 1, hd/2]
+        s = sin[:t][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]  # [B, T, 1, hd/2]
+        s = sin[positions][:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)

@@ -45,7 +45,8 @@ class RemoteFunction:
             num_returns=int(o.get("num_returns", 1)),
             resources=resources,
             max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
-            placement_group_id=pg_id)
+            placement_group_id=pg_id,
+            runtime_env=o.get("runtime_env"))
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node — reference python/ray/dag/function_node.py

@@ -1,0 +1,136 @@
+"""Runtime env + multiprocessing/joblib shim tests — modeled on the
+reference's python/ray/tests/test_runtime_env*.py and
+test_multiprocessing.py / test_joblib.py."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- runtime env
+
+def test_env_vars_applied_and_restored(cluster):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("RTPU_TEST_VAR")
+
+    assert ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"RTPU_TEST_VAR": "42"}}).remote()) == "42"
+    # shared worker must NOT keep the var for the next plain task
+    assert ray_tpu.get(read_env.remote()) is None
+
+
+def test_working_dir_staged(cluster, tmp_path):
+    (tmp_path / "data.txt").write_text("staged!")
+    (tmp_path / "helper_mod_rtpu.py").write_text("VALUE = 123\n")
+
+    @ray_tpu.remote
+    def read_from_wd():
+        import helper_mod_rtpu  # importable: working_dir on sys.path
+
+        return open("data.txt").read(), helper_mod_rtpu.VALUE
+
+    out = ray_tpu.get(read_from_wd.options(
+        runtime_env={"working_dir": str(tmp_path)}).remote())
+    assert out == ("staged!", 123)
+
+
+def test_py_modules(cluster, tmp_path):
+    pkg = tmp_path / "my_rtpu_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("def answer():\n    return 7\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import my_rtpu_pkg
+
+        return my_rtpu_pkg.answer()
+
+    assert ray_tpu.get(use_module.options(
+        runtime_env={"py_modules": [str(tmp_path)]}).remote()) == 7
+
+
+def test_actor_runtime_env_permanent(cluster):
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self):
+            return os.environ.get("RTPU_ACTOR_VAR")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"RTPU_ACTOR_VAR": "actor"}}).remote()
+    assert ray_tpu.get(a.read.remote()) == "actor"
+    assert ray_tpu.get(a.read.remote()) == "actor"  # sticks for lifetime
+
+
+def test_unsupported_keys_rejected(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="not supported"):
+        f.options(runtime_env={"pip": ["requests"]}).remote()
+
+
+# -------------------------------------------------------------------- shims
+
+def _square(x):
+    return x * x
+
+
+def test_pool_map(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as p:
+        assert p.map(_square, range(20)) == [x * x for x in range(20)]
+
+
+def test_pool_apply_and_async(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool() as p:
+        assert p.apply(_square, (6,)) == 36
+        r = p.apply_async(_square, (7,))
+        assert r.get(timeout=60) == 49 and r.successful()
+
+
+def test_pool_imap_orders(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool() as p:
+        assert list(p.imap(_square, range(10), chunksize=3)) == \
+            [x * x for x in range(10)]
+        assert sorted(p.imap_unordered(_square, range(10), chunksize=3)) \
+            == sorted(x * x for x in range(10))
+
+
+def test_pool_starmap_and_errors(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool() as p:
+        assert p.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        with pytest.raises(Exception):
+            p.map(lambda x: 1 / x, [1, 0, 2])
+    with pytest.raises(ValueError):
+        p.apply(_square, (1,))  # closed
+
+
+def test_joblib_backend(cluster):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(_square)(i)
+                                for i in range(16))
+    assert out == [i * i for i in range(16)]
