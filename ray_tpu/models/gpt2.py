@@ -130,34 +130,86 @@ def _block(x: jax.Array, p: Params, config: GPT2Config) -> jax.Array:
     return x + h + p["mlp"]["proj_b"]
 
 
-def gpt2_forward(params: Params, tokens: jax.Array,
-                 config: GPT2Config) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, padded_vocab] (fp32)."""
+def _constrain(x: jax.Array, spec: Optional[P]) -> jax.Array:
+    if spec is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def gpt2_hidden(params: Params, tokens: jax.Array, config: GPT2Config,
+                remat: bool = False,
+                act_spec: Optional[P] = None) -> jax.Array:
+    """tokens [B, T] int32 -> final hidden states [B, T, d_model].
+
+    remat=True checkpoints each transformer block (per-layer remat — the
+    backward recomputes one layer at a time, peak activation memory is one
+    layer's worth). act_spec, if given, pins the residual-stream sharding
+    after every block so XLA never falls back to involuntary full
+    rematerialization when tp/fsdp axes are active (requires an enclosing
+    mesh context, e.g. TrainStep's)."""
     c = config
     t = tokens.shape[1]
     x = params["wte"][tokens] + params["wpe"][:t]
+    x = _constrain(x, act_spec)
+    block_fn = _block
+    if remat:
+        block_fn = jax.checkpoint(_block, static_argnums=(2,))
     for p in params["blocks"]:
-        x = _block(x, p, c)
-    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        x = _constrain(block_fn(x, p, c), act_spec)
+    return layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+
+
+def gpt2_forward(params: Params, tokens: jax.Array,
+                 config: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, padded_vocab] (fp32)."""
+    x = gpt2_hidden(params, tokens, config)
     # tied LM head
     return jnp.dot(x, params["wte"].T, preferred_element_type=jnp.float32)
 
 
-def gpt2_loss(params: Params, tokens: jax.Array, targets: jax.Array,
-              config: GPT2Config,
-              remat: bool = False) -> jax.Array:
-    """Mean next-token cross-entropy. Padded-vocab logits are masked."""
-    fwd = gpt2_forward
-    if remat:
-        fwd = jax.checkpoint(gpt2_forward, static_argnums=(2,))
-    logits = fwd(params, tokens, config)
-    if config.padded_vocab != config.vocab_size:
-        neg = jnp.full((config.padded_vocab - config.vocab_size,), -1e30,
-                       dtype=logits.dtype)
-        logits = logits.at[..., config.vocab_size:].set(neg)
+def _ce_sum(x: jax.Array, targets: jax.Array, wte: jax.Array,
+            vocab_size: int) -> jax.Array:
+    """Sum of next-token cross-entropy. x [..., d], targets [...]."""
+    logits = jnp.dot(x, wte.T, preferred_element_type=jnp.float32)
+    if wte.shape[0] != vocab_size:  # mask the vocab padding
+        col = jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, logits.ndim - 1)
+        logits = jnp.where(col < vocab_size, logits, -1e30)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.sum(ll)
+
+
+def gpt2_loss(params: Params, tokens: jax.Array, targets: jax.Array,
+              config: GPT2Config, remat: bool = False,
+              loss_chunk_rows: int = 2048,
+              act_spec: Optional[P] = None) -> jax.Array:
+    """Mean next-token cross-entropy, computed in sequence chunks so the
+    [B, T, padded_vocab] fp32 logits never materialize whole (at GPT-2
+    vocab one full-batch logits tensor is gigabytes; chunking caps it near
+    loss_chunk_rows * padded_vocab, recomputed per chunk in the backward).
+    Chunking splits the sequence axis, so dp/fsdp batch sharding is
+    untouched and each chunk stays a full-width MXU matmul.
+    """
+    c = config
+    x = gpt2_hidden(params, tokens, config, remat=remat, act_spec=act_spec)
+    b, t = targets.shape
+    n_chunks = min(t, max(1, (b * t) // loss_chunk_rows))
+    while t % n_chunks != 0:
+        n_chunks -= 1
+
+    def chunk_fn(args):
+        xi, ti = args
+        return _ce_sum(xi, ti, params["wte"], c.vocab_size)
+
+    if n_chunks == 1:
+        total = chunk_fn((x, targets))
+    else:
+        xc = x.reshape(b, n_chunks, t // n_chunks,
+                       c.d_model).swapaxes(0, 1)
+        tc = targets.reshape(b, n_chunks, t // n_chunks).swapaxes(0, 1)
+        total = jnp.sum(jax.lax.map(jax.checkpoint(chunk_fn), (xc, tc)))
+    return total / (b * t)
 
 
 def gpt2_partition_specs(config: GPT2Config) -> Params:

@@ -1,20 +1,28 @@
-"""Flash attention for TPU: Pallas kernel (MXU-tiled, online softmax).
+"""Flash attention for TPU: Pallas kernels (MXU-tiled, online softmax),
+forward AND backward.
 
 New capability relative to the reference (which has no kernels of its own —
 SURVEY.md §5.7); the design follows the standard blockwise-softmax flash
 attention recipe mapped onto TPU constraints from the Pallas guide:
 128-aligned q/kv blocks feeding the 128x128 MXU, fp32 accumulators, causal
-masking via broadcasted_iota, and a `@pl.when` skip of fully-masked KV
-blocks so causal attention does ~half the FLOPs.
+masking via broadcasted_iota, and fully-masked-block skipping so causal
+attention does ~half the FLOPs.
+
+The backward pass is two Pallas kernels (the FlashAttention-2 recipe):
+- dq kernel: grid over q blocks, inner loop over kv blocks;
+- dkv kernel: grid over kv blocks, inner loop over q blocks;
+both recompute P = exp(S - L) from the forward's saved logsumexp L (stored
+lane-broadcast as [B*H, T, 128] f32, the same layout jax's own TPU kernel
+uses) and the precomputed row term D = rowsum(dO * O).
 
 `flash_attention` dispatches: Pallas kernel on TPU backends (or
-`interpret=True` when forced), jnp reference otherwise. The backward pass
-is a checkpointed recompute (custom_vjp over the reference math), the right
-memory/FLOPs trade on HBM-bound TPUs.
+`interpret=True` when RAY_TPU_PALLAS_INTERPRET=1, which is how CPU CI
+tests the hardware code path), jnp reference otherwise.
 """
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -26,9 +34,15 @@ try:  # pltpu only imports on TPU-capable jaxlib builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_LANES = 8  # LSE/D are broadcast over a small minor dim (sublane tile);
+#             keeping it at 8 rather than the 128-lane width cuts the HBM
+#             traffic of the side outputs 16x
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634  # kernels work in log2 domain: exp2 is the
+_LN2 = 0.6931471805599453    # cheap VPU transcendental; scale*log2(e) is
+#                              folded into q so softmax needs only exp2.
 
 
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -47,15 +61,23 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                  causal: bool, block_q: int, block_k: int, kv_len: int,
-                  q_offset: int):
+# --------------------------------------------------------------- forward
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      sm_scale: float, causal: bool, block_q: int,
+                      block_k: int, kv_len: int, q_offset: int):
     """One (batch*head, q_block) program; loops KV blocks with online
-    softmax. Refs: q [block_q, D], k/v [kv_len, D], o [block_q, D].
+    softmax. Refs: q [block_q, D], k/v [kv_len, D], o [block_q, D],
+    lse [block_q, LANES] (logsumexp broadcast over lanes).
     q_offset = kv_len - q_len aligns queries to the END of the kv sequence
     (decode-style), matching mha_reference's tril(k=tk-tq)."""
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale
+    # log2-domain: fold sm_scale*log2(e) into q; softmax uses exp2 only.
+    # Matmul operands stay in the input dtype (bf16 on the fast path —
+    # f32 MXU passes are ~6x slower); accumulation is always f32.
+    cd = q_ref.dtype
+    q = (q_ref[...].astype(jnp.float32) * (sm_scale * _LOG2E)).astype(cd)
     d = q.shape[-1]
 
     m0 = jnp.full((block_q, 1), _NEG_INF, dtype=jnp.float32)
@@ -63,20 +85,26 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
     acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
 
     num_kv_blocks = pl.cdiv(kv_len, block_k)
+    num_full_blocks = num_kv_blocks
     if causal:
         # KV blocks strictly after this q block's diagonal are fully masked.
         num_kv_blocks = jnp.minimum(
             num_kv_blocks,
             (q_offset + qi * block_q + block_q + block_k - 1) // block_k)
+        # Blocks entirely below the diagonal need no mask compute at all;
+        # two loops (full, then diagonal-straddling) keep the hot loop free
+        # of iota/select VPU work.
+        num_full_blocks = jnp.maximum(
+            0, (q_offset + qi * block_q + 1 - block_k) // block_k + 1)
 
-    def body(ki, carry):
+    def body(ki, carry, apply_mask):
         m_prev, l_prev, acc = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [block_q, block_k]
-        if causal:
+        if apply_mask:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -84,22 +112,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(cd), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
-    m, l, acc = jax.lax.fori_loop(0, num_kv_blocks, body, (m0, l0, acc0))
+    carry = jax.lax.fori_loop(
+        0, num_full_blocks, functools.partial(body, apply_mask=False),
+        (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(
+        num_full_blocks, num_kv_blocks,
+        functools.partial(body, apply_mask=True), carry)
     # Fully-masked rows (l == 0) only occur with kv_len < block alignment.
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+    # natural-log LSE for the API: ln(sum exp(s_nat - 0)) recovered from
+    # the log2-domain running (m, l).
+    lse = (m + jnp.log2(l_safe)) * _LN2  # [block_q, 1]
+    lse_ref[...] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
-                      block_q: int, block_k: int,
-                      interpret: bool) -> jax.Array:
+                      block_q: int, block_k: int, interpret: bool):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)
@@ -111,9 +148,9 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
 
     grid = (b * h, pl.cdiv(tq, block_q))
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, kv_len=tk, q_offset=tk - tq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -121,18 +158,239 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
             pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda g, i: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, _LANES), jnp.float32),
+        ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * tq * tk * d,
             bytes_accessed=(qf.size + kf.size + vf.size) * qf.dtype.itemsize,
             transcendentals=b * h * tq * tk),
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3), lse
+
+
+# -------------------------------------------------------------- backward
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcor_ref,
+                         dq_ref, *, sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, kv_len: int,
+                         q_offset: int):
+    """dQ for one q block: loop over kv blocks.
+    Refs: q/do/dq [block_q, D], k/v [kv_len, D], lse/dcor [block_q, LANES]
+    (dcor = rowsum(dO * O), the softmax correction term)."""
+    qi = pl.program_id(1)
+    cd = q_ref.dtype
+    q = (q_ref[...].astype(jnp.float32) * (sm_scale * _LOG2E)).astype(cd)
+    do = do_ref[...]
+    lse2 = lse_ref[:, :1] * _LOG2E   # [block_q, 1], log2 domain
+    dcor = dcor_ref[:, :1]
+    d = q.shape[-1]
+
+    num_kv_blocks = pl.cdiv(kv_len, block_k)
+    num_full_blocks = num_kv_blocks
+    if causal:
+        num_kv_blocks = jnp.minimum(
+            num_kv_blocks,
+            (q_offset + qi * block_q + block_q + block_k - 1) // block_k)
+        num_full_blocks = jnp.maximum(
+            0, (q_offset + qi * block_q + 1 - block_k) // block_k + 1)
+
+    def body(ki, dq_acc, apply_mask):
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if apply_mask:
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp2(s - lse2)                    # [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [block_q, block_k]
+        ds = (p * (dp - dcor)).astype(cd)
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, num_full_blocks, functools.partial(body, apply_mask=False),
+        jnp.zeros((block_q, d), jnp.float32))
+    dq = jax.lax.fori_loop(
+        num_full_blocks, num_kv_blocks,
+        functools.partial(body, apply_mask=True), dq)
+    dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcor_ref,
+                          dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                          block_q: int, block_k: int, q_len: int,
+                          q_offset: int):
+    """dK/dV for one kv block: loop over q blocks.
+    Refs: k/v/dk/dv [block_k, D], q/do [q_len, D], lse/dcor [q_len, LANES].
+    """
+    ki = pl.program_id(1)
+    cd = k_ref.dtype
+    k_scaled = (k_ref[...].astype(jnp.float32)
+                * (sm_scale * _LOG2E)).astype(cd)
+    v_blk = v_ref[...]
+    d = k_scaled.shape[-1]
+
+    num_q_blocks = pl.cdiv(q_len, block_q)
+    start_q = 0
+    first_full_q = 0
+    if causal:
+        # q blocks strictly before this kv block's diagonal see nothing;
+        # blocks at/after first_full_q are entirely below the diagonal and
+        # skip mask compute.
+        start_q = jnp.maximum(
+            0, (ki * block_k - q_offset) // block_q)
+        first_full_q = jnp.minimum(
+            num_q_blocks,
+            (ki * block_k + block_k - 1 - q_offset + block_q - 1)
+            // block_q)
+
+    def body(qi, carry, apply_mask):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
+        do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
+        lse2 = lse_ref[pl.ds(qi * block_q, block_q), :1] * _LOG2E
+        dcor = dcor_ref[pl.ds(qi * block_q, block_q), :1]
+        # s^T: [block_k, block_q] = (K*scale*log2e) Q^T, log2 domain
+        st = jax.lax.dot_general(
+            k_scaled, q_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if apply_mask:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
+        pt = jnp.exp2(st - lse2.T)                # [block_k, block_q]
+        # dv += P^T dO
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pt.astype(cd), do_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp^T = V dO^T : [block_k, block_q]
+        dpt = jax.lax.dot_general(
+            v_blk, do_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dst = (pt * (dpt - dcor.T)).astype(cd)
+        # dk += dS^T (Q*scale)  (the sm_scale factor rides on k_scaled's
+        # partner: dK = scale * dS^T Q, and q_blk here is unscaled)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            dst, q_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    carry = jax.lax.fori_loop(
+        start_q, first_full_q, functools.partial(body, apply_mask=True),
+        (jnp.zeros((k_scaled.shape[0], d), jnp.float32),
+         jnp.zeros((k_scaled.shape[0], d), jnp.float32)))
+    dk, dv = jax.lax.fori_loop(
+        first_full_q, num_q_blocks,
+        functools.partial(body, apply_mask=False), carry)
+    dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
+                      block_q: int, block_k: int, interpret: bool):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    of = o.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    dof = do.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    # softmax correction term D = rowsum(dO * O), lane-broadcast like lse
+    dcor = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1,
+                keepdims=True),
+        (b * h, tq, _LANES))
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=tk, q_offset=tk - tq)
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, pl.cdiv(tq, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda g, i: (g, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * tq * tk * d,
+            bytes_accessed=(qf.size + kf.size + vf.size + dof.size)
+            * qf.dtype.itemsize,
+            transcendentals=b * h * tq * tk),
+    )(qf, kf, vf, dof, lse, dcor)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, q_len=tq, q_offset=tk - tq)
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, pl.cdiv(tk, block_k)),
+        in_specs=[
+            pl.BlockSpec((None, tq, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, tq, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tq, _LANES), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tq, _LANES), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=6 * b * h * tq * tk * d,
+            bytes_accessed=(qf.size + kf.size + vf.size + dof.size)
+            * qf.dtype.itemsize,
+            transcendentals=b * h * tq * tk),
+    )(qf, kf, vf, dof, lse, dcor)
+
+    dq = dqf.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    dk = dkf.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    dv = dvf.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- dispatch
+
+
+def _interpret_forced() -> bool:
+    return os.environ.get("RAY_TPU_PALLAS_INTERPRET", "0") == "1"
 
 
 def _use_pallas() -> bool:
+    if _interpret_forced():
+        return True
     if pltpu is None:
         return False
     try:
@@ -141,31 +399,45 @@ def _use_pallas() -> bool:
         return False
 
 
+def _shapes_ok(q, k, block_q: int, block_k: int) -> bool:
+    # Sequence lengths must divide the *effective* block size (after
+    # clamping to the sequence length); otherwise the in-kernel pl.ds
+    # reads would silently clamp out-of-bounds starts and corrupt the
+    # causal indexing.
+    tq, tk = q.shape[1], k.shape[1]
+    return (tq % min(block_q, tq) == 0 and tk % min(block_k, tk) == 0
+            and tq % 128 == 0 and tk % 128 == 0
+            and (q.shape[-1] % 128 == 0 or q.shape[-1] == 64))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """Fused attention. q,k,v: [batch, time, heads, head_dim] (kv time may
-    differ). Pallas on TPU; XLA reference elsewhere. Gradients recompute
-    attention blockwise (no O(T^2) residuals)."""
+    differ). Pallas on TPU (fwd and bwd kernels); XLA reference elsewhere.
+    """
     return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
-    if _use_pallas() and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 \
-            and (q.shape[-1] % 128 == 0 or q.shape[-1] == 64):
-        out = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                                interpret=False)
-    else:
-        out = mha_reference(q, k, v, causal, scale)
-    return out, (q, k, v)
+    if _use_pallas() and _shapes_ok(q, k, block_q, block_k):
+        out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                     block_k, interpret=_interpret_forced())
+        return out, (q, k, v, out, lse)
+    out = mha_reference(q, k, v, causal, scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
+    if lse is not None:
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,
+                                 block_q, block_k,
+                                 interpret=_interpret_forced())
 
     def ref(q_, k_, v_):
         return mha_reference(q_, k_, v_, causal, scale)

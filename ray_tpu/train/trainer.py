@@ -109,9 +109,16 @@ class TrainStep:
             self._jitted = jax.jit(self._step, donate_argnums=(0,),
                                    in_shardings=(None, batch_sh),
                                    )
-        batch = jax.device_put(
-            batch, jax.tree.map(
-                lambda _: NamedSharding(self.mesh, self.data_spec), batch))
+        sharding = NamedSharding(self.mesh, self.data_spec)
+
+        def put(x):
+            # already resident with the right sharding -> zero-copy no-op;
+            # avoids a host->HBM round trip on the hot step path.
+            if getattr(x, "sharding", None) == sharding:
+                return x
+            return jax.device_put(x, sharding)
+
+        batch = jax.tree.map(put, batch)
         with self.mesh:
             return self._jitted(state, batch)
 

@@ -1,0 +1,172 @@
+"""Pallas kernel correctness: the hardware code path proven on CPU CI.
+
+The test suite forces JAX_PLATFORMS=cpu (conftest.py), where
+flash_attention normally dispatches to the jnp reference — so these tests
+force the Pallas kernels through interpret mode (RAY_TPU_PALLAS_INTERPRET)
+and check fwd AND grads against mha_reference: causal and not, odd
+kv/q lengths (cross attention), bf16 and fp32, multiple block sizes.
+
+Analog of the reference's kernel-less math tests; the reference has no
+kernels of its own (SURVEY.md §5.7), so the model here is its numerical
+test style (e.g. rllib/utils tests): explicit allclose vs a reference
+implementation.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+
+
+@pytest.fixture(autouse=True)
+def _force_interpret(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+
+
+def _rand_qkv(key, b, tq, tk, h, d, dtype):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, tq, h, d), dtype=jnp.float32)
+    k = jax.random.normal(kk, (b, tk, h, d), dtype=jnp.float32)
+    v = jax.random.normal(kv, (b, tk, h, d), dtype=jnp.float32)
+    return q.astype(dtype), k.astype(dtype), v.astype(dtype)
+
+
+def _tol(dtype):
+    return dict(atol=2e-2, rtol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(atol=2e-5, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_flash_fwd_matches_reference(causal, dtype):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 256, 256, 2, 64, dtype)
+    out = flash_attention(q, k, v, causal)
+    ref = mha_reference(q, k, v, causal)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        **_tol(dtype))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads_match_reference(causal):
+    dtype = jnp.float32
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 2, 256, 256, 2, 64, dtype)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=1e-4, rtol=1e-3,
+            err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_flash_grads_bf16():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 128, 128, 2, 64,
+                        jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, True).astype(jnp.float32))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gr, np.float32),
+            atol=5e-2, rtol=5e-2, err_msg=f"d{name} mismatch")
+
+
+def test_flash_cross_attention_decode_alignment():
+    """kv longer than q (decode-style): queries align to the END of kv."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 128, 384, 2, 64,
+                        jnp.float32)
+    out = flash_attention(q, k, v, True)
+    ref = mha_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+    g = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        mha_reference(a, b, c, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for gf, grr in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(grr),
+                                   atol=1e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("block", [(64, 64), (128, 64), (64, 128)])
+def test_flash_block_sizes(block):
+    bq, bk = block
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 256, 256, 2, 64,
+                        jnp.float32)
+    out = flash_attention(q, k, v, True, None, bq, bk)
+    ref = mha_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+    g = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, True, None, bq, bk) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        mha_reference(a, b, c, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for gf, grr in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(grr),
+                                   atol=1e-4, rtol=1e-3)
+
+
+def test_flash_non_block_multiple_length():
+    """T=640 is a multiple of 128 but not of the 512 default block: must
+    not hit the pallas path with clamped (corrupt) pl.ds reads."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, 640, 640, 2, 64,
+                        jnp.float32)
+    out = flash_attention(q, k, v, True)
+    ref = mha_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_flash_odd_length_falls_back_to_reference():
+    """Non-128-multiple sequence lengths use the XLA path and still work."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 100, 100, 2, 64,
+                        jnp.float32)
+    out = flash_attention(q, k, v, True)
+    ref = mha_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_gpt2_loss_chunked_matches_unchunked():
+    from ray_tpu.models.gpt2 import (GPT2Config, gpt2_init, gpt2_loss)
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(cfg, jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                             cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0,
+                             cfg.vocab_size)
+    l1 = gpt2_loss(params, tok, tgt, cfg, loss_chunk_rows=1 << 30)
+    l2 = gpt2_loss(params, tok, tgt, cfg, loss_chunk_rows=32)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               atol=1e-5, rtol=1e-5)
+    # grads agree too, chunked + remat
+    g1 = jax.grad(lambda p: gpt2_loss(p, tok, tgt, cfg,
+                                      loss_chunk_rows=1 << 30))(params)
+    g2 = jax.grad(lambda p: gpt2_loss(p, tok, tgt, cfg, remat=True,
+                                      loss_chunk_rows=32))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=3e-2, rtol=3e-2),
+        g1, g2)
