@@ -124,7 +124,9 @@ def _block(x: jax.Array, p: Params, config: GPT2Config) -> jax.Array:
     h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
     h = jnp.dot(h, p["mlp"]["fc"],
                 preferred_element_type=jnp.float32).astype(c.dtype)
-    h = jax.nn.gelu(h + p["mlp"]["fc_b"])
+    # tanh-approximate gelu: GPT-2's historical activation, and cheaper
+    # on the VPU than the erf form
+    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
     h = jnp.dot(h, p["mlp"]["proj"],
                 preferred_element_type=jnp.float32).astype(c.dtype)
     return x + h + p["mlp"]["proj_b"]
@@ -194,6 +196,15 @@ def gpt2_loss(params: Params, tokens: jax.Array, targets: jax.Array,
     c = config
     x = gpt2_hidden(params, tokens, config, remat=remat, act_spec=act_spec)
     b, t = targets.shape
+
+    from ..ops.fused_ce import fused_ce_supported, linear_cross_entropy
+    if fused_ce_supported(b * t, c.d_model, c.padded_vocab):
+        # fused kernel: logits never materialize (ops/fused_ce.py)
+        losses = linear_cross_entropy(
+            x.reshape(b * t, c.d_model), params["wte"],
+            targets.reshape(b * t), c.vocab_size)
+        return jnp.sum(losses) / (b * t)
+
     n_chunks = min(t, max(1, (b * t) // loss_chunk_rows))
     while t % n_chunks != 0:
         n_chunks -= 1

@@ -170,3 +170,84 @@ def test_gpt2_loss_chunked_matches_unchunked():
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             atol=3e-2, rtol=3e-2),
         g1, g2)
+
+
+# ---------------------------------------------------------- fused CE
+
+
+def test_fused_ce_fwd_matches_reference():
+    from ray_tpu.ops.fused_ce import linear_cross_entropy, _ce_reference
+
+    key = jax.random.PRNGKey(0)
+    n, d, v, vocab = 256, 128, 640, 600  # _pick_block_v(640) -> 320
+    x = jax.random.normal(key, (n, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (v, d), jnp.float32) * 0.1
+    t = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, vocab)
+    loss = linear_cross_entropy(x, w, t, vocab)
+    ref, _ = _ce_reference(x, w, t, vocab)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_fused_ce_grads_match_reference():
+    from ray_tpu.ops.fused_ce import linear_cross_entropy, _ce_reference
+
+    key = jax.random.PRNGKey(3)
+    n, d, v, vocab = 128, 128, 384, 380
+    x = jax.random.normal(key, (n, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(4), (v, d), jnp.float32) * 0.1
+    t = jax.random.randint(jax.random.PRNGKey(5), (n,), 0, vocab)
+
+    def loss_fused(x, w):
+        return jnp.mean(linear_cross_entropy(x, w, t, vocab))
+
+    def loss_ref(x, w):
+        return jnp.mean(_ce_reference(x, w, t, vocab)[0])
+
+    gx, gw = jax.grad(loss_fused, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               atol=1e-5, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               atol=1e-5, rtol=1e-3)
+
+
+def test_fused_ce_bf16():
+    from ray_tpu.ops.fused_ce import linear_cross_entropy, _ce_reference
+
+    n, d, v, vocab = 128, 128, 384, 384
+    x = (jax.random.normal(jax.random.PRNGKey(6), (n, d), jnp.float32)
+         ).astype(jnp.bfloat16)
+    w = (jax.random.normal(jax.random.PRNGKey(7), (v, d), jnp.float32)
+         * 0.1).astype(jnp.bfloat16)
+    t = jax.random.randint(jax.random.PRNGKey(8), (n,), 0, vocab)
+    loss = linear_cross_entropy(x, w, t, vocab)
+    ref, _ = _ce_reference(x, w, t, vocab)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               atol=5e-2, rtol=5e-2)
+    gx, gw = jax.grad(lambda a, b: jnp.mean(
+        linear_cross_entropy(a, b, t, vocab)), argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(lambda a, b: jnp.mean(
+        _ce_reference(a, b, t, vocab)[0]), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32),
+                               atol=5e-2, rtol=5e-2)
+    np.testing.assert_allclose(np.asarray(gw, np.float32),
+                               np.asarray(rw, np.float32),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_fused_ce_padded_rows_masked_when_block_divides_vocab():
+    """vocab_size a multiple of the chosen block must still mask padding
+    rows (regression: mask was gated on vocab_size % block_v != 0)."""
+    from ray_tpu.ops.fused_ce import linear_cross_entropy, _ce_reference
+
+    n, d, v, vocab = 128, 128, 768, 384  # _pick_block_v(768)=384 divides
+    x = jax.random.normal(jax.random.PRNGKey(9), (n, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(10), (v, d),
+                          jnp.float32) * 0.1
+    t = jax.random.randint(jax.random.PRNGKey(11), (n,), 0, vocab)
+    loss = linear_cross_entropy(x, w, t, vocab)
+    ref, _ = _ce_reference(x, w, t, vocab)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
