@@ -85,6 +85,11 @@ class NodeRecord:
     available: Dict[str, float]
     address: Optional[Tuple[str, int]] = None  # node agent RPC (None = inline)
     alive: bool = True
+    last_heartbeat: float = 0.0  # agent nodes only (address is not None)
+
+    @property
+    def has_agent(self) -> bool:
+        return self.address is not None
 
 
 class ConductorHandler:
@@ -127,8 +132,35 @@ class ConductorHandler:
             self._nodes[node_id] = NodeRecord(node_id=node_id,
                                               total=dict(resources),
                                               available=dict(resources),
-                                              address=tuple(address))
+                                              address=tuple(address),
+                                              last_heartbeat=time.monotonic())
             self._cv.notify_all()
+
+    def node_heartbeat(self, node_id: str,
+                       dead_worker_ids: Optional[List[str]] = None) -> bool:
+        """Agent liveness + push-reported worker deaths (the conductor
+        cannot poll pids on remote hosts)."""
+        dead_recs: List[WorkerRecord] = []
+        with self._cv:
+            n = self._nodes.get(node_id)
+            if n is None:
+                return False  # unknown (e.g. after conductor restart)
+            n.last_heartbeat = time.monotonic()
+            n.alive = True
+            for wid in dead_worker_ids or []:
+                w = self._workers.get(wid)
+                if w is not None and w.state != "DEAD":
+                    w.state = "DEAD"
+                    self._release_resources(self._lease_release_node(w),
+                                            w.resources)
+                    w.resources = {}
+                    dead_recs.append(w)
+                    if w.address:
+                        self._clients.invalidate(w.address)
+            self._cv.notify_all()
+        for w in dead_recs:
+            self._on_worker_death(w)
+        return True
 
     def deregister_node(self, node_id: str) -> bool:
         """Remove a (non-head, idle) node — autoscaler scale-down path."""
@@ -172,53 +204,52 @@ class ConductorHandler:
     # ---------------------------------------------------------------- workers
 
     def register_worker(self, worker_id: str, address: Tuple[str, int],
-                        pid: int) -> None:
+                        pid: int, node_id: Optional[str] = None) -> None:
         with self._cv:
             w = self._workers.get(worker_id)
             if w is None:
-                w = WorkerRecord(worker_id=worker_id, node_id=self._head_node_id)
+                w = WorkerRecord(worker_id=worker_id,
+                                 node_id=node_id or self._head_node_id)
                 self._workers[worker_id] = w
+            if node_id:
+                w.node_id = node_id
             w.address = tuple(address)
             w.pid = pid
             if w.state == "STARTING":
                 w.state = "IDLE"
             self._cv.notify_all()
 
-    def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None) -> WorkerRecord:
-        """Start a worker subprocess (reference: WorkerPool starting
-        default_worker.py, worker_pool.h:343)."""
-        worker_id = WorkerID().hex()
-        host, port = self.address
-        env = dict(os.environ)
-        env.update(self._worker_env)
-        if env_extra:
-            env.update(env_extra)
-        env["RAY_TPU_WORKER_ID"] = worker_id
-        env["RAY_TPU_CONDUCTOR"] = f"{host}:{port}"
-        env["RAY_TPU_SESSION_DIR"] = self._session_dir
-        logs = os.path.join(self._session_dir, "logs")
-        os.makedirs(logs, exist_ok=True)
-        out = open(os.path.join(logs, f"worker-{worker_id[:12]}.log"), "ab")
-        # -S skips `site` (whose sitecustomize registers the TPU PJRT plugin
-        # and imports all of jax — ~2s of cold-start the worker doesn't need;
-        # workers are host-side, the driver owns the chips). Site packages are
-        # re-exposed via PYTHONPATH. Set RAY_TPU_WORKER_FULL_SITE=1 in
-        # worker_env for workers that must see the TPU runtime.
-        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main"]
-        if env.get("RAY_TPU_WORKER_FULL_SITE") != "1":
-            import site
+    def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
+                      node: Optional[NodeRecord] = None) -> WorkerRecord:
+        """Start a worker (reference: WorkerPool PopWorker spawn path,
+        worker_pool.h:343). Head/accounting nodes spawn locally; agent
+        nodes get an RPC to their NodeAgent (the raylet-equivalent)."""
+        from .worker_spawn import spawn_worker_process
 
-            paths = list(site.getsitepackages())
-            repo_root = os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))
-            paths.append(repo_root)
-            if env.get("PYTHONPATH"):
-                paths.append(env["PYTHONPATH"])
-            env["PYTHONPATH"] = os.pathsep.join(paths)
-            cmd.insert(1, "-S")
-        proc = subprocess.Popen(
-            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True)
+        worker_id = WorkerID().hex()
+        if node is not None and node.has_agent:
+            w = WorkerRecord(worker_id=worker_id, node_id=node.node_id)
+            self._workers[worker_id] = w
+            agent_addr, env = node.address, dict(env_extra or {})
+
+            def ask_agent():
+                try:
+                    self._clients.get(agent_addr).call(
+                        "spawn_worker", worker_id, env or None,
+                        timeout=30.0)
+                except Exception:
+                    with self._cv:
+                        w.state = "DEAD"
+                        self._cv.notify_all()
+
+            # RPC outside the conductor lock; the lease loop cv-waits for
+            # the worker to register back.
+            threading.Thread(target=ask_agent, daemon=True).start()
+            return w
+        proc = spawn_worker_process(
+            worker_id, self.address, self._session_dir,
+            worker_env=self._worker_env, env_extra=env_extra,
+            node_id=self._head_node_id)
         w = WorkerRecord(worker_id=worker_id, node_id=self._head_node_id,
                          proc=proc)
         self._workers[worker_id] = w
@@ -289,7 +320,7 @@ class ConductorHandler:
                         acquired = node
                         break
                 if acquired is not None:
-                    w = self._take_idle_or_spawn(deadline)
+                    w = self._take_idle_or_spawn(deadline, acquired)
                     if w is not None:
                         w.state = "BUSY"
                         w.resources = resources
@@ -303,21 +334,38 @@ class ConductorHandler:
                         f"available={head.available}")
                 self._cv.wait(min(remaining, 0.1))
 
-    def _take_idle_or_spawn(self, deadline: float) -> Optional[WorkerRecord]:
-        """Must hold lock. Returns a registered IDLE worker or None."""
-        for w in self._workers.values():
-            if w.state == "IDLE":
-                return w
+    def _spawn_node_id(self, node: NodeRecord) -> str:
+        """The node whose worker pool serves a lease on `node`: agent
+        nodes run their own workers; accounting nodes (autoscaler fakes,
+        address=None) are served by the head's pool."""
+        return node.node_id if node.has_agent else self._head_node_id
+
+    def _take_idle_or_spawn(self, deadline: float,
+                            node: NodeRecord) -> Optional[WorkerRecord]:
+        """Must hold lock. Returns a registered IDLE worker on `node`'s
+        serving pool, or None."""
+        pool_node = self._spawn_node_id(node)
+
+        def idle():
+            for w in self._workers.values():
+                if w.state == "IDLE" and w.node_id == pool_node:
+                    return w
+            return None
+
+        w = idle()
+        if w is not None:
+            return w
         n_starting = sum(1 for w in self._workers.values()
-                         if w.state == "STARTING")
+                         if w.state == "STARTING"
+                         and w.node_id == pool_node)
         # spawn enough for every lease currently waiting (parallel cold-start)
         want = max(1, self._waiting_leases)
         for _ in range(max(0, want - n_starting)):
-            self._spawn_worker()
+            self._spawn_worker(node=node)
         while time.monotonic() < deadline and not self._stopped:
-            for w in self._workers.values():
-                if w.state == "IDLE":
-                    return w
+            w = idle()
+            if w is not None:
+                return w
             self._cv.wait(0.05)
         return None
 
@@ -734,18 +782,26 @@ class ConductorHandler:
     # --------------------------------------------------------------- monitor
 
     def _monitor_loop(self) -> None:
-        """Reap dead worker processes; restart actors (reference
-        gcs_health_check_manager.cc + gcs_actor_manager worker-death path)."""
+        """Reap dead worker processes; restart actors; detect dead agent
+        nodes by heartbeat age (reference gcs_health_check_manager.cc +
+        gcs_actor_manager worker-death path)."""
+        node_timeout = float(os.environ.get("RAY_TPU_NODE_TIMEOUT", "10"))
         while not self._stopped:
             time.sleep(0.2)
             dead: List[WorkerRecord] = []
             with self._cv:
+                agent_nodes = {nid for nid, n in self._nodes.items()
+                               if n.has_agent}
                 for w in self._workers.values():
                     if w.state == "DEAD":
                         continue
                     alive = True
                     if w.proc is not None:
                         alive = w.proc.poll() is None
+                    elif w.node_id in agent_nodes:
+                        # remote pid: liveness arrives via the agent's
+                        # heartbeat (node_heartbeat dead_worker_ids)
+                        alive = self._nodes[w.node_id].alive
                     elif w.pid is not None:
                         try:
                             os.kill(w.pid, 0)
@@ -759,6 +815,12 @@ class ConductorHandler:
                         dead.append(w)
                         if w.address:
                             self._clients.invalidate(w.address)
+                # heartbeat-expired agent nodes: mark dead, free resources
+                now = time.monotonic()
+                for n in self._nodes.values():
+                    if (n.has_agent and n.alive
+                            and now - n.last_heartbeat > node_timeout):
+                        n.alive = False
                 self._cv.notify_all()
             for w in dead:
                 self._on_worker_death(w)
@@ -793,7 +855,14 @@ class ConductorHandler:
             self._stopped = True
             workers = list(self._workers.values())
             jobs = list(getattr(self, "_jobs", {}).values())
+            agents = [n.address for n in self._nodes.values()
+                      if n.has_agent and n.alive]
             self._cv.notify_all()
+        for addr in agents:
+            try:
+                self._clients.get(addr).call("stop_node", timeout=5.0)
+            except Exception:
+                pass
         for rec in jobs:
             if rec["proc"].poll() is None:
                 try:

@@ -1,0 +1,179 @@
+"""Per-host node agent: the raylet-equivalent daemon for worker hosts.
+
+A NodeAgent joins an existing cluster (`python -m ray_tpu start
+--address head:port`), registers its host's resources with the conductor,
+and owns that host's worker processes: the conductor's scheduler asks the
+agent to spawn workers when a lease lands on this node, and the agent's
+heartbeat reports worker deaths (the conductor cannot poll remote pids).
+
+Reference: src/ray/raylet/node_manager.h:125 (per-node daemon owning the
+WorkerPool), src/ray/gcs/gcs_server/gcs_health_check_manager.cc (the
+health channel this replaces with push heartbeats).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import NodeID, WorkerID
+from .rpc import RpcClient, RpcServer
+from .worker_spawn import spawn_worker_process
+
+HEARTBEAT_PERIOD_S = float(os.environ.get("RAY_TPU_NODE_HEARTBEAT", "1.0"))
+
+
+class NodeAgentHandler:
+    """RPC handler — conductor-facing surface of one worker host."""
+
+    def __init__(self, node_id: str, conductor_address: Tuple[str, int],
+                 session_dir: str,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.node_id = node_id
+        self.conductor_address = tuple(conductor_address)
+        self.session_dir = session_dir
+        self.worker_env = dict(worker_env or {})
+        self._procs: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def spawn_worker(self, worker_id: str,
+                     env_extra: Optional[Dict[str, str]] = None) -> bool:
+        proc = spawn_worker_process(
+            worker_id, self.conductor_address, self.session_dir,
+            worker_env=self.worker_env, env_extra=env_extra,
+            node_id=self.node_id)
+        with self._lock:
+            self._procs[worker_id] = proc
+        return True
+
+    def reap_dead(self) -> List[str]:
+        """Worker ids whose processes exited since the last call."""
+        dead = []
+        with self._lock:
+            for wid, proc in list(self._procs.items()):
+                if proc.poll() is not None:
+                    dead.append(wid)
+                    del self._procs[wid]
+        return dead
+
+    def ping(self) -> str:
+        return "pong"
+
+    def stop_node(self) -> bool:
+        self._shutdown_workers()
+        return True
+
+    def _shutdown_workers(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 3.0
+        for p in procs:
+            try:
+                p.wait(max(0.0, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+
+class NodeAgent:
+    """Runs a NodeAgentHandler on an RpcServer, registers with the
+    conductor, and heartbeats (carrying dead-worker reports)."""
+
+    def __init__(self, conductor_address: Tuple[str, int],
+                 resources: Dict[str, float],
+                 session_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 node_id: Optional[str] = None):
+        self.node_id = node_id or NodeID().hex()
+        self.resources = dict(resources)
+        self.conductor_address = tuple(conductor_address)
+        self._conductor = RpcClient(self.conductor_address)
+        if session_dir is None:
+            info = self._conductor.call("session_info", timeout=10.0)
+            session_dir = info["session_dir"]
+        self.session_dir = session_dir
+        self.handler = NodeAgentHandler(self.node_id,
+                                        self.conductor_address,
+                                        session_dir, worker_env=worker_env)
+        self.server = RpcServer(self.handler, host=host, port=port,
+                                max_workers=8)
+        self._stopped = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="node-agent-heartbeat",
+            daemon=True)
+
+    def start(self) -> "NodeAgent":
+        self.server.start()
+        self._conductor.call("register_node", self.node_id, self.resources,
+                             self.server.address, timeout=10.0)
+        self._hb_thread.start()
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stopped.wait(HEARTBEAT_PERIOD_S):
+            dead = self.handler.reap_dead()
+            try:
+                self._conductor.call("node_heartbeat", self.node_id, dead,
+                                     timeout=5.0)
+            except Exception:
+                # conductor gone -> cluster gone; shut this host down
+                self.stop()
+                os._exit(0)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.handler._shutdown_workers()
+        try:
+            self._conductor.call("deregister_node", self.node_id,
+                                 timeout=2.0)
+        except Exception:
+            pass
+        self.server.stop()
+        self._conductor.close()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="join a ray_tpu cluster as a worker host")
+    ap.add_argument("--address", required=True, help="head host:port")
+    ap.add_argument("--num-cpus", type=float,
+                    default=float(os.cpu_count() or 1))
+    ap.add_argument("--resources", default=None,
+                    help='extra resources as JSON, e.g. \'{"TPU": 4}\'')
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    host, port = args.address.rsplit(":", 1)
+    resources = {"CPU": args.num_cpus}
+    if args.resources:
+        import json
+
+        resources.update(json.loads(args.resources))
+    agent = NodeAgent((host, int(port)), resources).start()
+    print(f"node agent {agent.node_id[:12]} on {agent.address} "
+          f"joined {args.address}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        agent.stop()
+
+
+if __name__ == "__main__":
+    main()

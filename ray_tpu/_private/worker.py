@@ -235,12 +235,21 @@ class Worker:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Readiness is monotonic: cache known-ready ids so each ref is
+        # probed (possibly via an owner RPC) at most until first ready,
+        # and back the poll period off exponentially — a wait() over many
+        # remote refs must not hammer owners with 5ms-period RPC bursts.
+        ready_ids: set = set()
+        sleep = 0.001
         while True:
-            ready_ids = {r.id for r in refs if self._ref_ready(r)}
+            for r in refs:
+                if r.id not in ready_ids and self._ref_ready(r):
+                    ready_ids.add(r.id)
             if len(ready_ids) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
                 break
-            time.sleep(0.005)
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.05)
         ready = [r for r in refs if r.id in ready_ids]
         extra = ready[num_returns:]
         ready = ready[:num_returns]
@@ -818,9 +827,6 @@ class WorkerHandler:
     def free_objects(self, object_ids: List[str]) -> None:
         for oid in object_ids:
             self.w.store.delete(oid)
-
-    def store_stats(self) -> Dict[str, int]:
-        return self.w.store.stats()
 
     def on_published(self, channel: str, message: Any) -> None:
         pass

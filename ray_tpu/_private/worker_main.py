@@ -32,7 +32,7 @@ def main() -> None:
                session_dir=session_dir, worker_id=worker_id)
     worker_mod.global_worker = w
     w.conductor.call("register_worker", worker_id, w.address, os.getpid(),
-                     timeout=30.0)
+                     os.environ.get("RAY_TPU_NODE_ID"), timeout=30.0)
 
     def _term(signum, frame):
         os._exit(0)

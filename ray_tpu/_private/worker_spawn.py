@@ -1,0 +1,51 @@
+"""Worker process spawning, shared by the conductor's head-local pool and
+per-host node agents (reference: raylet WorkerPool starting
+default_worker.py, src/ray/raylet/worker_pool.h:343)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional, Tuple
+
+
+def spawn_worker_process(worker_id: str,
+                         conductor_address: Tuple[str, int],
+                         session_dir: str,
+                         worker_env: Optional[Dict[str, str]] = None,
+                         env_extra: Optional[Dict[str, str]] = None,
+                         node_id: Optional[str] = None) -> subprocess.Popen:
+    """Start one ray_tpu worker subprocess wired to the conductor."""
+    host, port = conductor_address
+    env = dict(os.environ)
+    env.update(worker_env or {})
+    if env_extra:
+        env.update(env_extra)
+    env["RAY_TPU_WORKER_ID"] = worker_id
+    env["RAY_TPU_CONDUCTOR"] = f"{host}:{port}"
+    env["RAY_TPU_SESSION_DIR"] = session_dir
+    if node_id:
+        env["RAY_TPU_NODE_ID"] = node_id
+    logs = os.path.join(session_dir, "logs")
+    os.makedirs(logs, exist_ok=True)
+    out = open(os.path.join(logs, f"worker-{worker_id[:12]}.log"), "ab")
+    # -S skips `site` (whose sitecustomize registers the TPU PJRT plugin
+    # and imports all of jax — ~2s of cold-start the worker doesn't need;
+    # workers are host-side, the driver owns the chips). Site packages are
+    # re-exposed via PYTHONPATH. Set RAY_TPU_WORKER_FULL_SITE=1 in
+    # worker_env for workers that must see the TPU runtime.
+    cmd = [sys.executable, "-m", "ray_tpu._private.worker_main"]
+    if env.get("RAY_TPU_WORKER_FULL_SITE") != "1":
+        import site
+
+        paths = list(site.getsitepackages())
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        paths.append(repo_root)
+        if env.get("PYTHONPATH"):
+            paths.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(paths)
+        cmd.insert(1, "-S")
+    return subprocess.Popen(
+        cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
+        start_new_session=True)

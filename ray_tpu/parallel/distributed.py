@@ -1,0 +1,124 @@
+"""Multi-host mesh rendezvous: conductor-KV-driven jax.distributed init.
+
+The reference bootstraps its data plane with a NCCL rendezvous
+(MASTER_ADDR + torch dist.init_process_group — train/torch/config.py:64-117);
+the TPU-native equivalent is `jax.distributed.initialize(coordinator,
+num_processes, process_id)`, after which every process sees the GLOBAL
+device set and a single jitted SPMD program spans hosts with XLA
+collectives over ICI/DCN (SURVEY.md §5.8, §7 step 4).
+
+Rank 0 picks a free port on its host, publishes `host:port` under a
+group key in the conductor KV; other ranks poll the key. This is the
+same pattern as the reference's `NCCLUniqueIDStore` named actor
+(util/collective/collective_group/nccl_collective_group.py:28-50), minus
+the actor: the KV is already the cluster's rendezvous plane.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Callable, Optional, Tuple
+
+_NAMESPACE = "_jax_distributed"
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _local_ip(peer_host: str = "8.8.8.8") -> str:
+    """Best-effort address other hosts can reach us on."""
+    env = os.environ.get("RAY_TPU_NODE_IP")
+    if env:
+        return env
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((peer_host, 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def rendezvous_coordinator(kv_put: Callable, kv_get: Callable,
+                           group_key: str, rank: int,
+                           timeout: float = 120.0,
+                           host: Optional[str] = None) -> str:
+    """Agree on a coordinator address for a worker gang. Rank 0 claims
+    it; everyone returns `host:port`."""
+    key = f"{group_key}/coordinator".encode()
+    if rank == 0:
+        host = host or _local_ip()
+        addr = f"{host}:{_free_port('0.0.0.0')}"
+        kv_put(key, addr.encode(), namespace=_NAMESPACE)
+        return addr
+    deadline = time.monotonic() + timeout
+    sleep = 0.01
+    while time.monotonic() < deadline:
+        got = kv_get(key, namespace=_NAMESPACE)
+        if got:
+            return got.decode()
+        time.sleep(sleep)
+        sleep = min(sleep * 2, 0.5)
+    raise TimeoutError(f"no coordinator published for {group_key} "
+                       f"within {timeout}s")
+
+
+def initialize_jax_distributed(group_key: str, rank: int, world: int,
+                               kv_put: Optional[Callable] = None,
+                               kv_get: Optional[Callable] = None,
+                               timeout: float = 120.0) -> None:
+    """Run the coordinator rendezvous and `jax.distributed.initialize`.
+
+    Must be called before any other jax API touches the backend. With
+    world == 1 this is a no-op (single-process SPMD needs no service).
+    kv_put/kv_get default to the connected cluster's conductor KV.
+    """
+    if world <= 1:
+        return
+    if kv_put is None or kv_get is None:
+        from .._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        if w is None:
+            raise RuntimeError(
+                "initialize_jax_distributed needs a connected ray_tpu "
+                "worker (or explicit kv_put/kv_get)")
+        kv_put = lambda k, v, namespace: w.conductor.call(  # noqa: E731
+            "kv_put", k, v, True, namespace, timeout=10.0)
+        kv_get = lambda k, namespace: w.conductor.call(  # noqa: E731
+            "kv_get", k, namespace, timeout=10.0)
+
+    coordinator = rendezvous_coordinator(kv_put, kv_get, group_key, rank,
+                                         timeout)
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world, process_id=rank)
+
+
+def setup_jax_distributed(timeout: float = 120.0) -> Tuple[int, int]:
+    """Inside a JaxTrainer(mode="workers") train_fn: rendezvous this
+    worker gang into one jax.distributed job and return (rank, world).
+
+    After this returns, `jax.devices()` is the GLOBAL device set across
+    all gang workers; build a Mesh over it (parallel.make_mesh) and jit
+    normally — the reference's prepare_model/DDP step
+    (train_loop_utils.py:158) has no equivalent here because XLA owns
+    gradient reduction.
+    """
+    from ..train.session import get_context
+
+    ctx = get_context()
+    group_key = getattr(ctx, "jax_dist_key", None) or \
+        f"group/{ctx.experiment_name}"
+    initialize_jax_distributed(group_key, ctx.rank, ctx.world_size,
+                               timeout=timeout)
+    return ctx.rank, ctx.world_size

@@ -40,11 +40,21 @@ def _connect(args) -> None:
 
 
 def cmd_start(args) -> None:
-    """Foreground head process — reference `ray start --head` (scripts.py:568
-    starts GCS+raylet; here one conductor process is the whole head)."""
+    """Foreground head or worker-host process — reference `ray start`
+    (scripts.py:568: --head starts GCS+raylet; --address joins an
+    existing cluster as a worker node via the per-host NodeAgent)."""
     if not args.head:
-        raise SystemExit("only --head is supported; worker processes are "
-                         "spawned on demand by the conductor")
+        if not getattr(args, "address", None):
+            raise SystemExit("pass --head to start a cluster or "
+                             "--address host:port to join one")
+        from ray_tpu._private.node_agent import main as agent_main
+
+        argv = ["--address", args.address, "--num-cpus",
+                str(args.num_cpus)]
+        if args.resources:
+            argv += ["--resources", args.resources]
+        agent_main(argv)
+        return
     from ray_tpu._private.conductor import Conductor
 
     resources = {"CPU": float(args.num_cpus)}
@@ -174,8 +184,10 @@ def main(argv=None) -> None:
         prog="ray_tpu", description="ray_tpu cluster CLI")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sp = sub.add_parser("start", help="start a head node")
+    sp = sub.add_parser("start",
+                        help="start a head node or join as worker host")
     sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="head host:port to join (worker host)")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--num-cpus", type=float,

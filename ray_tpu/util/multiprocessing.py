@@ -19,9 +19,11 @@ class AsyncResult:
         self._value: Any = None
         self._error: Optional[BaseException] = None
         self._done = threading.Event()
-        threading.Thread(target=self._wait_bg, daemon=True).start()
+        # callbacks must be assigned before the waiter thread starts:
+        # fast-resolving refs otherwise race _wait_bg reading them
         self._callback = callback
         self._error_callback = error_callback
+        threading.Thread(target=self._wait_bg, daemon=True).start()
 
     def _wait_bg(self):
         import ray_tpu
