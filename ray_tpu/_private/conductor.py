@@ -38,6 +38,18 @@ from .rpc import ClientPool, RpcServer
 WORKER_START_TIMEOUT_S = float(os.environ.get("RAY_TPU_WORKER_START_TIMEOUT", 60))
 
 
+def _chips_needed(resources: Dict[str, float]) -> int:
+    """Whole-chip count a lease pins to the worker via TPU_VISIBLE_CHIPS
+    (reference accelerators/tpu.py:30). Fractional TPU shares only
+    resource-count — chip binding is per-process (libtpu is single-client
+    per chip), so there is nothing meaningful to pin below one chip."""
+    for k, v in resources.items():
+        if k == "TPU" or k.endswith("_TPU"):
+            if v >= 1 and float(v).is_integer():
+                return int(v)
+    return 0
+
+
 @dataclass
 class WorkerRecord:
     worker_id: str
@@ -50,6 +62,10 @@ class WorkerRecord:
     # node whose resources the current lease took (an autoscaled accounting
     # node may differ from the spawn node on this single-host runtime)
     lease_node_id: Optional[str] = None
+    # TPU chips this process was bound to at spawn (TPU_VISIBLE_CHIPS);
+    # chips stay bound for the process lifetime — its TPU runtime owns the
+    # devices — and return to the node pool only on death
+    chip_ids: Optional[Tuple[int, ...]] = None
 
 
 @dataclass
@@ -86,6 +102,9 @@ class NodeRecord:
     address: Optional[Tuple[str, int]] = None  # node agent RPC (None = inline)
     alive: bool = True
     last_heartbeat: float = 0.0  # agent nodes only (address is not None)
+    # physical TPU chip ids not bound to any live worker process
+    # (reference: accelerators/tpu.py:30 TPU_VISIBLE_CHIPS partitioning)
+    free_chips: List[int] = field(default_factory=list)
 
     @property
     def has_agent(self) -> bool:
@@ -117,7 +136,8 @@ class ConductorHandler:
         self.address: Optional[Tuple[str, int]] = None  # set by Conductor
 
         head = NodeRecord(node_id=NodeID().hex(), total=dict(resources),
-                          available=dict(resources))
+                          available=dict(resources),
+                          free_chips=list(range(int(resources.get("TPU", 0)))))
         self._nodes[head.node_id] = head
         self._head_node_id = head.node_id
 
@@ -127,13 +147,17 @@ class ConductorHandler:
     # ------------------------------------------------------------------ nodes
 
     def register_node(self, node_id: str, resources: Dict[str, float],
-                      address: Tuple[str, int]) -> None:
+                      address: Optional[Tuple[str, int]] = None) -> None:
+        """address is the node's agent RPC endpoint; None registers an
+        accounting-only node served by the head's worker pool (autoscaler
+        test double, reference FakeMultiNodeProvider)."""
         with self._cv:
-            self._nodes[node_id] = NodeRecord(node_id=node_id,
-                                              total=dict(resources),
-                                              available=dict(resources),
-                                              address=tuple(address),
-                                              last_heartbeat=time.monotonic())
+            self._nodes[node_id] = NodeRecord(
+                node_id=node_id, total=dict(resources),
+                available=dict(resources),
+                address=tuple(address) if address else None,
+                last_heartbeat=time.monotonic(),
+                free_chips=list(range(int(resources.get("TPU", 0)))))
             self._cv.notify_all()
 
     def node_heartbeat(self, node_id: str,
@@ -154,6 +178,7 @@ class ConductorHandler:
                     self._release_resources(self._lease_release_node(w),
                                             w.resources)
                     w.resources = {}
+                    self._free_worker_chips(w)
                     dead_recs.append(w)
                     if w.address:
                         self._clients.invalidate(w.address)
@@ -162,19 +187,46 @@ class ConductorHandler:
             self._on_worker_death(w)
         return True
 
-    def deregister_node(self, node_id: str) -> bool:
-        """Remove a (non-head, idle) node — autoscaler scale-down path."""
+    def deregister_node(self, node_id: str, force: bool = False) -> bool:
+        """Remove a non-head node. Without force (autoscaler scale-down)
+        only an idle node may leave; with force (NodeAgent.stop — the host
+        is going away regardless) its workers are declared dead, their
+        leases freed, and their actors sent through the restart path."""
+        dead: List[WorkerRecord] = []
         with self._cv:
             if node_id == self._head_node_id:
                 return False
             n = self._nodes.get(node_id)
             if n is None:
                 return False
-            if any(n.available.get(k, 0.0) < v for k, v in n.total.items()):
+            if not force and any(n.available.get(k, 0.0) < v
+                                 for k, v in n.total.items()):
                 return False  # leases still hold its resources
+            for w in self._workers.values():
+                if w.node_id == node_id and w.state != "DEAD":
+                    w.state = "DEAD"
+                    self._release_resources(self._lease_release_node(w),
+                                            w.resources)
+                    w.resources = {}
+                    self._free_worker_chips(w)
+                    dead.append(w)
+                    if w.address:
+                        self._clients.invalidate(w.address)
             del self._nodes[node_id]
             self._cv.notify_all()
-            return True
+        for w in dead:
+            self._on_worker_death(w)
+        return True
+
+    def _free_worker_chips(self, w: WorkerRecord) -> None:
+        """Return a dead worker's bound chips to its node's pool. Must
+        hold the lock."""
+        if not w.chip_ids:
+            return
+        n = self._nodes.get(w.node_id)
+        if n is not None:
+            n.free_chips.extend(w.chip_ids)
+        w.chip_ids = None
 
     def cluster_resources(self) -> Dict[str, float]:
         with self._lock:
@@ -240,6 +292,7 @@ class ConductorHandler:
                 except Exception:
                     with self._cv:
                         w.state = "DEAD"
+                        self._free_worker_chips(w)
                         self._cv.notify_all()
 
             # RPC outside the conductor lock; the lease loop cv-waits for
@@ -263,7 +316,10 @@ class ConductorHandler:
             node.available[k] = node.available.get(k, 0.0) - v
         return True
 
-    def _release_resources(self, node: NodeRecord, req: Dict[str, float]) -> None:
+    def _release_resources(self, node: Optional[NodeRecord],
+                           req: Dict[str, float]) -> None:
+        if node is None:
+            return
         for k, v in req.items():
             node.available[k] = node.available.get(k, 0.0) + v
 
@@ -299,10 +355,12 @@ class ConductorHandler:
             return [{"resources": dict(res), "age_s": now - t0}
                     for t0, res in self._pending_demand]
 
-    def _lease_release_node(self, w: WorkerRecord) -> NodeRecord:
-        """The node to credit a worker's held resources back to."""
+    def _lease_release_node(self, w: WorkerRecord) -> Optional[NodeRecord]:
+        """The node to credit a worker's held resources back to, or None
+        when the node was deregistered mid-lease (its resources died with
+        it — crediting another node would inflate the pool)."""
         return self._nodes.get(w.lease_node_id or w.node_id) \
-            or self._nodes[w.node_id]
+            or self._nodes.get(w.node_id)
 
     def _lease_locked(self, resources, deadline):
             while True:
@@ -320,7 +378,8 @@ class ConductorHandler:
                         acquired = node
                         break
                 if acquired is not None:
-                    w = self._take_idle_or_spawn(deadline, acquired)
+                    w = self._take_idle_or_spawn(deadline, acquired,
+                                                 _chips_needed(resources))
                     if w is not None:
                         w.state = "BUSY"
                         w.resources = resources
@@ -340,24 +399,78 @@ class ConductorHandler:
         address=None) are served by the head's pool."""
         return node.node_id if node.has_agent else self._head_node_id
 
-    def _take_idle_or_spawn(self, deadline: float,
-                            node: NodeRecord) -> Optional[WorkerRecord]:
+    def _take_idle_or_spawn(self, deadline: float, node: NodeRecord,
+                            n_chips: int = 0) -> Optional[WorkerRecord]:
         """Must hold lock. Returns a registered IDLE worker on `node`'s
-        serving pool, or None."""
-        pool_node = self._spawn_node_id(node)
+        serving pool, or None.
+
+        n_chips > 0 requests a TPU-bound worker: its process was spawned
+        with TPU_VISIBLE_CHIPS naming exactly that many chips (reference
+        accelerators/tpu.py:147,161 set_current_process_visible_accelerator_ids).
+        Chip workers are only reused for leases of the same chip count;
+        idle chip workers with the wrong count are torn down to reclaim
+        their chips when the pool runs dry."""
+        pool_id = self._spawn_node_id(node)
+        pool = self._nodes[pool_id]
 
         def idle():
             for w in self._workers.values():
-                if w.state == "IDLE" and w.node_id == pool_node:
+                if w.state == "IDLE" and w.node_id == pool_id and \
+                        len(w.chip_ids or ()) == n_chips:
                     return w
             return None
+
+        def try_spawn_chip_worker() -> bool:
+            if len(pool.free_chips) < n_chips:
+                # reclaim chips bound to idle workers of other counts
+                for w in list(self._workers.values()):
+                    if w.state == "IDLE" and w.node_id == pool_id and \
+                            w.chip_ids and len(w.chip_ids) != n_chips:
+                        w.state = "DEAD"
+                        self._free_worker_chips(w)
+                        if w.proc is not None and w.proc.poll() is None:
+                            try:
+                                w.proc.terminate()
+                            except OSError:
+                                pass
+                        elif w.address:  # agent-node worker: remote pid
+                            addr = w.address
+                            threading.Thread(
+                                target=lambda a=addr: self._clients.get(a)
+                                .call("shutdown_worker", timeout=5.0),
+                                daemon=True).start()
+                        if len(pool.free_chips) >= n_chips:
+                            break
+            if len(pool.free_chips) < n_chips:
+                return False
+            chips = tuple(sorted(pool.free_chips)[:n_chips])
+            for c in chips:
+                pool.free_chips.remove(c)
+            w = self._spawn_worker(node=node, env_extra={
+                "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips),
+                "RAY_TPU_WORKER_FULL_SITE": "1",
+                # undo the host-side workers' cpu pin: this worker owns chips
+                "JAX_PLATFORMS": "",
+            })
+            w.chip_ids = chips
+            return True
 
         w = idle()
         if w is not None:
             return w
+        if n_chips > 0:
+            spawned = try_spawn_chip_worker()
+            while time.monotonic() < deadline and not self._stopped:
+                w = idle()
+                if w is not None:
+                    return w
+                if not spawned:
+                    spawned = try_spawn_chip_worker()
+                self._cv.wait(0.05)
+            return None
         n_starting = sum(1 for w in self._workers.values()
                          if w.state == "STARTING"
-                         and w.node_id == pool_node)
+                         and w.node_id == pool_id and not w.chip_ids)
         # spawn enough for every lease currently waiting (parallel cold-start)
         want = max(1, self._waiting_leases)
         for _ in range(max(0, want - n_starting)):
@@ -529,6 +642,7 @@ class ConductorHandler:
                     self._release_resources(self._lease_release_node(w),
                                             w.resources)
                     w.resources = {}
+                    self._free_worker_chips(w)
             self._cv.notify_all()
         self.publish("actor_state", {"actor_id": actor_id, "state": "DEAD"})
 
@@ -812,6 +926,7 @@ class ConductorHandler:
                         self._release_resources(self._lease_release_node(w),
                                                 w.resources)
                         w.resources = {}
+                        self._free_worker_chips(w)
                         dead.append(w)
                         if w.address:
                             self._clients.invalidate(w.address)

@@ -139,7 +139,9 @@ class NodeAgent:
         self._stopped.set()
         self.handler._shutdown_workers()
         try:
-            self._conductor.call("deregister_node", self.node_id,
+            # force: this host is leaving whether or not leases are live;
+            # the conductor frees them and restarts actors elsewhere
+            self._conductor.call("deregister_node", self.node_id, True,
                                  timeout=2.0)
         except Exception:
             pass

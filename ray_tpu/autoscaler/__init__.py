@@ -70,8 +70,10 @@ class FakeNodeProvider(NodeProvider):
     def create_node(self, node_type: str,
                     resources: Dict[str, float]) -> str:
         node_id = f"fake_{node_type}_{uuid.uuid4().hex[:8]}"
+        # address=None -> accounting node: leases placed here are served by
+        # the head's worker pool (no NodeAgent to RPC).
         self._conductor.call("register_node", node_id, dict(resources),
-                             ("127.0.0.1", 0), timeout=10.0)
+                             None, timeout=10.0)
         with self._lock:
             self._nodes[node_id] = {"node_id": node_id,
                                     "node_type": node_type,

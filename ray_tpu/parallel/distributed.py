@@ -33,10 +33,15 @@ def _free_port(host: str) -> int:
 
 
 def _local_ip(peer_host: str = "8.8.8.8") -> str:
-    """Best-effort address other hosts can reach us on."""
+    """Best-effort address other hosts can reach us on: the source IP of
+    the route to `peer_host`. Pass the conductor's host — gang members
+    must reach each other on the network they reach the head on (a
+    public-internet probe can return an unroutable interface)."""
     env = os.environ.get("RAY_TPU_NODE_IP")
     if env:
         return env
+    if peer_host in ("127.0.0.1", "localhost", "::1"):
+        return "127.0.0.1"
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
         s.connect((peer_host, 80))
@@ -74,7 +79,8 @@ def rendezvous_coordinator(kv_put: Callable, kv_get: Callable,
 def initialize_jax_distributed(group_key: str, rank: int, world: int,
                                kv_put: Optional[Callable] = None,
                                kv_get: Optional[Callable] = None,
-                               timeout: float = 120.0) -> None:
+                               timeout: float = 120.0,
+                               host: Optional[str] = None) -> None:
     """Run the coordinator rendezvous and `jax.distributed.initialize`.
 
     Must be called before any other jax API touches the backend. With
@@ -95,9 +101,12 @@ def initialize_jax_distributed(group_key: str, rank: int, world: int,
             "kv_put", k, v, True, namespace, timeout=10.0)
         kv_get = lambda k, namespace: w.conductor.call(  # noqa: E731
             "kv_get", k, namespace, timeout=10.0)
+        if host is None:
+            # advertise on the interface that reaches the conductor
+            host = _local_ip(w.conductor_address[0])
 
     coordinator = rendezvous_coordinator(kv_put, kv_get, group_key, rank,
-                                         timeout)
+                                         timeout, host=host)
     import jax
 
     jax.distributed.initialize(coordinator_address=coordinator,

@@ -1,0 +1,85 @@
+"""Chip-subset scheduling: leases with whole-number {"TPU": k} pin the
+worker process to k specific chips via TPU_VISIBLE_CHIPS, so Serve
+replicas and parallel jobs can partition a host's chips (reference
+python/ray/_private/accelerators/tpu.py:30,147,161)."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def tpu_head():
+    info = ray_tpu.init(num_cpus=4, resources={"TPU": 8})
+    yield info
+    ray_tpu.shutdown()
+
+
+def _chipset(s):
+    return frozenset(int(c) for c in s.split(","))
+
+
+def test_actors_get_disjoint_chip_subsets(tpu_head):
+    @ray_tpu.remote(num_cpus=1, resources={"TPU": 4})
+    class ChipActor:
+        def chips(self):
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    a, b = ChipActor.remote(), ChipActor.remote()
+    ca, cb = ray_tpu.get([a.chips.remote(), b.chips.remote()], timeout=120.0)
+    sa, sb = _chipset(ca), _chipset(cb)
+    assert len(sa) == 4 and len(sb) == 4
+    assert not (sa & sb), f"overlapping chip subsets {sa} vs {sb}"
+    assert (sa | sb) <= set(range(8))
+
+
+def test_task_sees_pinned_chips(tpu_head):
+    @ray_tpu.remote(resources={"TPU": 2})
+    def chips():
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    got = ray_tpu.get(chips.remote(), timeout=120.0)
+    assert len(_chipset(got)) == 2
+
+
+def test_chips_released_on_actor_exit(tpu_head):
+    """All 8 chips to one actor; after it exits, a second 8-chip actor
+    must be schedulable (chips returned to the pool on death)."""
+    @ray_tpu.remote(num_cpus=1, resources={"TPU": 8})
+    class Hog:
+        def chips(self):
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        def leave(self):
+            ray_tpu.exit_actor()
+
+    h = Hog.remote()
+    assert len(_chipset(ray_tpu.get(h.chips.remote(), timeout=120.0))) == 8
+    h.leave.remote()
+    h2 = Hog.remote()
+    assert len(_chipset(ray_tpu.get(h2.chips.remote(), timeout=120.0))) == 8
+
+
+def test_chip_worker_reuse_same_count(tpu_head):
+    """Back-to-back 2-chip tasks reuse one bound process (binding is per
+    process lifetime; same count -> same worker)."""
+    @ray_tpu.remote(resources={"TPU": 2})
+    def pid_chips():
+        return os.getpid(), os.environ.get("TPU_VISIBLE_CHIPS")
+
+    p1, c1 = ray_tpu.get(pid_chips.remote(), timeout=120.0)
+    p2, c2 = ray_tpu.get(pid_chips.remote(), timeout=120.0)
+    assert p1 == p2 and c1 == c2
+
+
+def test_fractional_tpu_counts_without_pinning(tpu_head):
+    """Sub-chip shares resource-count (libtpu is single-client per chip:
+    nothing to pin) and run on ordinary host workers."""
+    @ray_tpu.remote(resources={"TPU": 0.5})
+    def frac():
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    assert ray_tpu.get(frac.remote(), timeout=60.0) is None
