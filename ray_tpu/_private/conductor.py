@@ -66,6 +66,10 @@ class WorkerRecord:
     # chips stay bound for the process lifetime — its TPU runtime owns the
     # devices — and return to the node pool only on death
     chip_ids: Optional[Tuple[int, ...]] = None
+    # set on records rebuilt from a persistence snapshot: liveness is
+    # unknown until the worker re-registers (fills pid) or a grace period
+    # expires (presumed dead with the old conductor)
+    restored_at: Optional[float] = None
 
 
 @dataclass
@@ -140,6 +144,16 @@ class ConductorHandler:
                           free_chips=list(range(int(resources.get("TPU", 0)))))
         self._nodes[head.node_id] = head
         self._head_node_id = head.node_id
+
+        # Durable control-plane tables (reference: GCS Redis-persisted
+        # tables, gcs_server.h:103-110 / gcs_table_storage.cc). A snapshot
+        # in the session dir lets a restarted conductor recover KV, named
+        # actors, placement groups, and job metadata; live workers/agents
+        # re-register themselves on their next periodic announce.
+        self._persist_path = os.path.join(session_dir, "conductor_state.pkl")
+        self._dirty = False
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._restore_state()
 
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="conductor-monitor", daemon=True)
@@ -267,6 +281,7 @@ class ConductorHandler:
                 w.node_id = node_id
             w.address = tuple(address)
             w.pid = pid
+            w.restored_at = None  # liveness confirmed
             if w.state == "STARTING":
                 w.state = "IDLE"
             self._cv.notify_all()
@@ -531,6 +546,7 @@ class ConductorHandler:
                               resources=dict(resources or {}),
                               placement_group_id=placement_group_id)
             self._actors[actor_id] = rec
+            self._dirty = True
             if name is not None:
                 self._named_actors[(namespace, name)] = actor_id
         self._place_actor(actor_id)
@@ -568,6 +584,7 @@ class ConductorHandler:
             rec.worker_id = worker_id
             rec.address = address
             rec.state = "ALIVE"
+            self._dirty = True
             self._cv.notify_all()
         self.publish("actor_state", {"actor_id": actor_id, "state": "ALIVE"})
 
@@ -634,6 +651,7 @@ class ConductorHandler:
             rec.state = "DEAD"
             rec.death_cause = cause
             rec.restarts_remaining = 0
+            self._dirty = True
             if rec.worker_id:
                 w = self._workers.get(rec.worker_id)
                 if w is not None and w.state == "ACTOR":
@@ -655,6 +673,7 @@ class ConductorHandler:
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            self._dirty = True
             return True
 
     def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
@@ -663,6 +682,7 @@ class ConductorHandler:
 
     def kv_del(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
+            self._dirty = True
             return self._kv.get(namespace, {}).pop(key, None) is not None
 
     def kv_keys(self, prefix: bytes = b"", namespace: str = "default") -> List[bytes]:
@@ -714,6 +734,7 @@ class ConductorHandler:
             self._pgs[pg_id] = PlacementGroupRecord(pg_id=pg_id,
                                                     bundles=bundles,
                                                     strategy=strategy, name=name)
+            self._dirty = True
             self._cv.notify_all()
         return pg_id
 
@@ -736,6 +757,7 @@ class ConductorHandler:
                     node.total.pop(pk, None)
                     node.available.pop(pk, None)
             self._release_resources(node, total_req)
+            self._dirty = True
             self._cv.notify_all()
 
     def list_placement_groups(self) -> List[Dict[str, Any]]:
@@ -808,22 +830,24 @@ class ConductorHandler:
         finally:
             log_f.close()
         with self._lock:
-            if not hasattr(self, "_jobs"):
-                self._jobs: Dict[str, Dict[str, Any]] = {}
             self._jobs[job_id] = {
                 "job_id": job_id, "entrypoint": entrypoint,
                 "start_time": time.time(), "end_time": None,
                 "log_path": log_path, "proc": proc, "stopped": False,
                 "metadata": dict(metadata or {})}
+            self._dirty = True
         return job_id
 
     def _job_status_locked(self, rec: Dict[str, Any]) -> str:
         proc = rec["proc"]
+        if proc is None:  # restored after a conductor restart
+            return rec.get("status", "FAILED")
         code = proc.poll()
         if code is None:
             return "RUNNING"
         if rec["end_time"] is None:
             rec["end_time"] = time.time()
+            self._dirty = True  # terminal status reached; persist it
         if rec["stopped"]:
             return "STOPPED"
         return "SUCCEEDED" if code == 0 else "FAILED"
@@ -846,7 +870,8 @@ class ConductorHandler:
     def stop_job(self, job_id: str) -> bool:
         with self._lock:
             rec = getattr(self, "_jobs", {}).get(job_id)
-            if rec is None or rec["proc"].poll() is not None:
+            if rec is None or rec["proc"] is None \
+                    or rec["proc"].poll() is not None:
                 return False
             rec["stopped"] = True
             proc = rec["proc"]
@@ -893,6 +918,92 @@ class ConductorHandler:
         return {"session_dir": self._session_dir,
                 "head_node_id": self._head_node_id}
 
+    # ----------------------------------------------------------- persistence
+
+    def _flush_state(self) -> None:
+        """Write the durable tables to disk (atomic rename). Called by the
+        monitor when dirty and on stop — mutations only mark dirty, so the
+        hot path never pays the disk write."""
+        import pickle
+
+        with self._lock:
+            if not self._dirty:
+                return
+            self._dirty = False
+            jobs = {}
+            for jid, r in self._jobs.items():
+                meta = {k: v for k, v in r.items() if k != "proc"}
+                meta["status"] = self._job_status_locked(r)
+                jobs[jid] = meta
+            blob = pickle.dumps({
+                "kv": {ns: dict(d) for ns, d in self._kv.items()},
+                "named_actors": dict(self._named_actors),
+                "actors": list(self._actors.values()),
+                "pgs": list(self._pgs.values()),
+                "jobs": jobs,
+            })
+        tmp = self._persist_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._persist_path)
+        except OSError:
+            with self._lock:
+                self._dirty = True  # retry next monitor tick
+
+    def _restore_state(self) -> None:
+        """Load a prior snapshot from this session dir (conductor restart).
+        Actor records come back with their worker addresses, so handles
+        keep working against surviving worker processes; those workers'
+        records are reconstructed provisionally and confirmed (pid filled
+        in) by their periodic re-registration."""
+        import pickle
+
+        if not os.path.exists(self._persist_path):
+            return
+        try:
+            with open(self._persist_path, "rb") as f:
+                state = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return
+        head = self._nodes[self._head_node_id]
+        self._kv = {ns: dict(d) for ns, d in state.get("kv", {}).items()}
+        self._named_actors = dict(state.get("named_actors", {}))
+        now = time.monotonic()
+        for rec in state.get("actors", []):
+            self._actors[rec.actor_id] = rec
+            if rec.state in ("ALIVE", "RESTARTING") and rec.worker_id:
+                w = WorkerRecord(worker_id=rec.worker_id,
+                                 node_id=self._head_node_id,
+                                 address=rec.address, state="ACTOR",
+                                 resources=dict(rec.resources),
+                                 lease_node_id=self._head_node_id,
+                                 restored_at=now)
+                self._workers[w.worker_id] = w
+                self._acquire_resources(head, rec.resources)
+        for pg in state.get("pgs", []):
+            if pg.state != "CREATED":
+                continue
+            total_req: Dict[str, float] = {}
+            for b in pg.bundles:
+                for k, v in b.items():
+                    total_req[k] = total_req.get(k, 0) + v
+            self._acquire_resources(head, total_req)
+            for b in pg.bundles:
+                for k, v in b.items():
+                    pk = f"_pg_{pg.pg_id}_{k}"
+                    head.total[pk] = head.total.get(pk, 0) + v
+                    head.available[pk] = head.available.get(pk, 0) + v
+            self._pgs[pg.pg_id] = pg
+        for jid, meta in state.get("jobs", {}).items():
+            meta = dict(meta, proc=None)
+            if meta.get("status") == "RUNNING":
+                # the job driver was orphaned by the crash; we can no
+                # longer supervise it
+                meta["status"] = "FAILED"
+                meta["end_time"] = meta.get("end_time") or time.time()
+            self._jobs[jid] = meta
+
     # --------------------------------------------------------------- monitor
 
     def _monitor_loop(self) -> None:
@@ -900,8 +1011,10 @@ class ConductorHandler:
         nodes by heartbeat age (reference gcs_health_check_manager.cc +
         gcs_actor_manager worker-death path)."""
         node_timeout = float(os.environ.get("RAY_TPU_NODE_TIMEOUT", "10"))
+        restore_grace = float(os.environ.get("RAY_TPU_RESTORE_GRACE", "20"))
         while not self._stopped:
             time.sleep(0.2)
+            self._flush_state()
             dead: List[WorkerRecord] = []
             with self._cv:
                 agent_nodes = {nid for nid, n in self._nodes.items()
@@ -910,7 +1023,12 @@ class ConductorHandler:
                     if w.state == "DEAD":
                         continue
                     alive = True
-                    if w.proc is not None:
+                    if w.restored_at is not None:
+                        # snapshot-restored record: presumed alive until
+                        # the re-register window passes with no announce
+                        alive = (time.monotonic() - w.restored_at
+                                 <= restore_grace)
+                    elif w.proc is not None:
                         alive = w.proc.poll() is None
                     elif w.node_id in agent_nodes:
                         # remote pid: liveness arrives via the agent's
@@ -954,6 +1072,7 @@ class ConductorHandler:
                     else:
                         rec.state = "DEAD"
                         rec.death_cause = "worker process died"
+            self._dirty = True
             self._cv.notify_all()
         for actor_id in restart:
             self.publish("actor_state",
@@ -979,7 +1098,7 @@ class ConductorHandler:
             except Exception:
                 pass
         for rec in jobs:
-            if rec["proc"].poll() is None:
+            if rec["proc"] is not None and rec["proc"].poll() is None:
                 try:
                     os.killpg(rec["proc"].pid, signal.SIGTERM)
                 except (OSError, ProcessLookupError):
@@ -1001,6 +1120,7 @@ class ConductorHandler:
                     except OSError:
                         pass
         self._clients.close_all()
+        self._flush_state()
 
 
 class Conductor:

@@ -18,7 +18,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .ids import NodeID, WorkerID
-from .rpc import RpcClient, RpcServer
+from .rpc import ReconnectingClient, RpcServer
 from .worker_spawn import spawn_worker_process
 
 HEARTBEAT_PERIOD_S = float(os.environ.get("RAY_TPU_NODE_HEARTBEAT", "1.0"))
@@ -98,7 +98,7 @@ class NodeAgent:
         self.node_id = node_id or NodeID().hex()
         self.resources = dict(resources)
         self.conductor_address = tuple(conductor_address)
-        self._conductor = RpcClient(self.conductor_address)
+        self._conductor = ReconnectingClient(self.conductor_address)
         if session_dir is None:
             info = self._conductor.call("session_info", timeout=10.0)
             session_dir = info["session_dir"]
@@ -125,15 +125,25 @@ class NodeAgent:
         return self.server.address
 
     def _heartbeat_loop(self) -> None:
+        grace = float(os.environ.get("RAY_TPU_NODE_ORPHAN_GRACE", "30"))
+        last_ok = time.monotonic()
         while not self._stopped.wait(HEARTBEAT_PERIOD_S):
             dead = self.handler.reap_dead()
             try:
-                self._conductor.call("node_heartbeat", self.node_id, dead,
-                                     timeout=5.0)
+                known = self._conductor.call("node_heartbeat", self.node_id,
+                                             dead, timeout=5.0)
+                if not known:
+                    # conductor restarted and lost us: re-register
+                    self._conductor.call("register_node", self.node_id,
+                                         self.resources, self.server.address,
+                                         timeout=5.0)
+                last_ok = time.monotonic()
             except Exception:
-                # conductor gone -> cluster gone; shut this host down
-                self.stop()
-                os._exit(0)
+                # tolerate a brief outage (conductor restart); a sustained
+                # one means the cluster is gone -> shut this host down
+                if time.monotonic() - last_ok > grace:
+                    self.stop()
+                    os._exit(0)
 
     def stop(self) -> None:
         self._stopped.set()

@@ -22,6 +22,8 @@ layout with a slab allocator; object_store transparently uses it when built.
 from __future__ import annotations
 
 import os
+import struct
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -97,17 +99,36 @@ class _Entry:
     ready: bool = False
     last_access: float = field(default_factory=time.monotonic)
     pinned: int = 0
+    # on-disk copy written by eviction-spill; data is restored (or range-
+    # read) from here on next access (reference local_object_manager.h:53)
+    spill_path: Optional[str] = None
+
+    @property
+    def in_memory(self) -> bool:
+        return (self.buffers is not None or self.shm_name is not None
+                or self.error is not None)
 
 
 class LocalObjectStore:
     """Per-process store: holds objects this process created, caches fetched
     ones, and provides blocking get with readiness signaling."""
 
-    def __init__(self):
+    def __init__(self, cap: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
         self._entries: Dict[str, _Entry] = {}
         self._cv = threading.Condition()
         self._attached: Dict[str, Any] = {}  # SharedMemory or attached Arena
         self._bytes = 0
+        self._cap = int(cap if cap is not None else os.environ.get(
+            "RAY_TPU_OBJECT_STORE_CAP", STORE_CAP))
+        # Eviction SPILLS owned objects here instead of dropping them, so
+        # put() beyond the memory cap stays correct (reference
+        # local_object_manager.h:53 spill + restore)
+        self._spill_dir = spill_dir or os.path.join(
+            os.environ.get("RAY_TPU_SPILL_DIR",
+                           os.path.join(tempfile.gettempdir(),
+                                        "ray_tpu_spill")),
+            str(os.getpid()))
         # objects for which only a placeholder exists (awaiting task result)
         self._deserialized_cache: Dict[str, Any] = {}
         # Native C++ slab arena (shm_store.cc): one mapping for ALL of this
@@ -169,8 +190,12 @@ class LocalObjectStore:
         return e.nbytes
 
     def put_serialized(self, object_id: str, meta: bytes,
-                       buffers: List[memoryview]) -> None:
-        e = _Entry(meta=meta, buffers=[memoryview(bytes(b)) for b in buffers],
+                       buffers: List[memoryview], copy: bool = True) -> None:
+        """copy=False adopts the buffers as-is (chunked-fetch assembly
+        already owns a private bytearray — don't double the peak)."""
+        e = _Entry(meta=meta,
+                   buffers=[memoryview(bytes(b)) for b in buffers] if copy
+                   else [memoryview(b) for b in buffers],
                    nbytes=len(meta) + sum(b.nbytes for b in buffers), ready=True)
         with self._cv:
             self._entries[object_id] = e
@@ -234,27 +259,34 @@ class LocalObjectStore:
             e.last_access = time.monotonic()
             if e.error is not None:
                 raise e.error
-        if e.shm_name is not None:
-            if e.shm_name.startswith("arena:"):
-                # Arena blocks are RECYCLED after free (unlike per-object
-                # segments, whose pages survive unlink), so any deserialize
-                # that could outlive the entry copies out of the mapping —
-                # the ownership-model stand-in for plasma pins. In practice
-                # this path is cold: owner reads of own puts are served by
-                # _deserialized_cache above.
-                shm = (self._arena if e.arena_offset is not None
-                       else self._attach(e.shm_name))
-                bufs = [memoryview(bytes(shm.buf[off:off + n]))
-                        for off, n in e.layout]
+            self._ensure_resident_locked(e)
+            e.pinned += 1  # a concurrent eviction must not spill mid-read
+        try:
+            if e.shm_name is not None:
+                if e.shm_name.startswith("arena:"):
+                    # Arena blocks are RECYCLED after free (unlike per-object
+                    # segments, whose pages survive unlink), so any deserialize
+                    # that could outlive the entry copies out of the mapping —
+                    # the ownership-model stand-in for plasma pins. In practice
+                    # this path is cold: owner reads of own puts are served by
+                    # _deserialized_cache above.
+                    shm = (self._arena if e.arena_offset is not None
+                           else self._attach(e.shm_name))
+                    bufs = [memoryview(bytes(shm.buf[off:off + n]))
+                            for off, n in e.layout]
+                else:
+                    shm = e.shm or self._attach(e.shm_name)
+                    bufs = [memoryview(shm.buf)[off:off + n]
+                            for off, n in e.layout]
             else:
-                shm = e.shm or self._attach(e.shm_name)
-                bufs = [memoryview(shm.buf)[off:off + n]
-                        for off, n in e.layout]
-        else:
-            bufs = e.buffers or []
-        value = serialization.deserialize(e.meta, bufs)
+                bufs = e.buffers or []
+            value = serialization.deserialize(e.meta, bufs)
+        finally:
+            with self._cv:
+                e.pinned -= 1
         with self._cv:
             self._deserialized_cache[object_id] = value
+        self._maybe_evict()  # a restore may have pushed us over the cap
         return value
 
     def export(self, object_id: str) -> Tuple[bytes, Optional[str],
@@ -268,9 +300,10 @@ class LocalObjectStore:
             if e.error is not None:
                 raise e.error
             e.last_access = time.monotonic()
-        if e.shm_name is not None:
-            return e.meta, e.shm_name, e.layout, None
-        return e.meta, None, None, [bytes(b) for b in (e.buffers or [])]
+            self._ensure_resident_locked(e)
+            if e.shm_name is not None:
+                return e.meta, e.shm_name, e.layout, None
+            return e.meta, None, None, [bytes(b) for b in (e.buffers or [])]
 
     # ---------- lifetime ----------
 
@@ -327,6 +360,12 @@ class LocalObjectStore:
                 pass
             except OSError:
                 pass
+        if e.spill_path is not None:
+            try:
+                os.unlink(e.spill_path)
+            except OSError:
+                pass
+            e.spill_path = None
 
     def _attach(self, name: str):
         with self._cv:
@@ -345,18 +384,163 @@ class LocalObjectStore:
             self._attached[name] = shm
         return shm
 
+    # ---------- spill / restore (reference local_object_manager.h:53) ----
+
+    _SPILL_HDR = struct.Struct(">I")     # len(meta)
+    _SPILL_CNT = struct.Struct(">I")     # n buffers
+    _SPILL_SZ = struct.Struct(">Q")      # per-buffer size
+
+    def _gather_buffers_locked(self, e: _Entry) -> Optional[List[memoryview]]:
+        """Current in-memory payload views, or None if not resident."""
+        if e.buffers is not None:
+            return e.buffers
+        if e.shm_name is not None and e.layout is not None:
+            if e.shm_name.startswith("arena:"):
+                shm = (self._arena if e.arena_offset is not None
+                       else self._attached.get(e.shm_name))
+                if shm is None:
+                    return None
+            else:
+                shm = e.shm or self._attached.get(e.shm_name)
+                if shm is None:
+                    return None
+            return [memoryview(shm.buf)[off:off + n] for off, n in e.layout]
+        return None
+
+    def _spill_entry_locked(self, oid: str, e: _Entry) -> bool:
+        """Write payload to disk, then drop the memory copy. Must hold
+        lock (eviction is the cold path; the write is tolerable here)."""
+        bufs = self._gather_buffers_locked(e)
+        if bufs is None:
+            return False
+        if e.spill_path is None or not os.path.exists(e.spill_path):
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, oid)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(self._SPILL_HDR.pack(len(e.meta or b"")))
+                    f.write(e.meta or b"")
+                    f.write(self._SPILL_CNT.pack(len(bufs)))
+                    for b in bufs:
+                        f.write(self._SPILL_SZ.pack(b.nbytes))
+                    for b in bufs:
+                        f.write(b.cast("B") if b.format != "B" else b)
+                os.replace(tmp, path)
+            except OSError:
+                return False
+            e.spill_path = path
+        # free the memory copy (entry stays, ready, restorable)
+        self._deserialized_cache.pop(oid, None)
+        self._bytes -= e.nbytes
+        if e.arena_offset is not None and self._arena is not None:
+            self._arena_quarantine.append(
+                (time.monotonic() + self._QUARANTINE_S, e.arena_offset))
+            e.arena_offset = None
+        if e.shm is not None:
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except OSError:
+                pass
+            e.shm = None
+        e.buffers = None
+        e.shm_name = None
+        e.layout = None
+        return True
+
+    def _read_spill_file(self, path: str):
+        with open(path, "rb") as f:
+            (meta_len,) = self._SPILL_HDR.unpack(f.read(self._SPILL_HDR.size))
+            meta = f.read(meta_len)
+            (n,) = self._SPILL_CNT.unpack(f.read(self._SPILL_CNT.size))
+            sizes = [self._SPILL_SZ.unpack(f.read(self._SPILL_SZ.size))[0]
+                     for _ in range(n)]
+            bufs = [memoryview(f.read(sz)) for sz in sizes]
+        return meta, bufs
+
+    def _restore_locked(self, e: _Entry) -> None:
+        """Load a spilled entry back into heap buffers. The spill file is
+        kept: a later re-evict of an unmodified object is then free."""
+        meta, bufs = self._read_spill_file(e.spill_path)
+        e.meta = meta
+        e.buffers = bufs
+        e.layout = None
+        self._bytes += e.nbytes
+
+    def _ensure_resident_locked(self, e: _Entry) -> None:
+        if not e.in_memory and e.spill_path is not None:
+            self._restore_locked(e)
+
+    # ---------- chunked streaming (reference pull_manager.cc 64MB) -------
+
+    def stream_info(self, object_id: str):
+        """(meta, total_payload_bytes, buffer_sizes) without forcing a
+        spilled object back into memory — the remote-fetch header."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.ready:
+                raise KeyError(object_id)
+            if e.error is not None:
+                raise e.error
+            e.last_access = time.monotonic()
+            if e.in_memory:
+                bufs = self._gather_buffers_locked(e)
+                if bufs is None:
+                    raise KeyError(object_id)
+                return e.meta, sum(b.nbytes for b in bufs), \
+                    [b.nbytes for b in bufs]
+            meta, bufs = self._read_spill_file(e.spill_path)
+            return meta, sum(b.nbytes for b in bufs), \
+                [b.nbytes for b in bufs]
+
+    def read_range(self, object_id: str, start: int, size: int) -> bytes:
+        """Bytes [start, start+size) of the object's payload stream (all
+        buffers concatenated). Serves from memory or the spill file."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.ready:
+                raise KeyError(object_id)
+            if e.error is not None:
+                raise e.error
+            e.last_access = time.monotonic()
+            bufs = self._gather_buffers_locked(e) if e.in_memory else None
+            if bufs is None and e.spill_path is not None:
+                _, bufs = self._read_spill_file(e.spill_path)
+            if bufs is None:
+                raise KeyError(object_id)
+            out = bytearray()
+            pos = 0
+            for b in bufs:
+                if size <= 0:
+                    break
+                b = b.cast("B") if b.format != "B" else b
+                if pos + b.nbytes > start:
+                    lo = max(0, start - pos)
+                    take = min(b.nbytes - lo, size)
+                    out += b[lo:lo + take]
+                    size -= take
+                    start += take
+                pos += b.nbytes
+            return bytes(out)
+
     def _maybe_evict(self) -> None:
         self._drain_quarantine()
         with self._cv:
-            if self._bytes <= STORE_CAP:
+            if self._bytes <= self._cap:
                 return
             entries = sorted(
                 ((oid, e) for oid, e in self._entries.items()
-                 if e.ready and e.pinned == 0 and e.error is None),
+                 if e.ready and e.pinned == 0 and e.error is None
+                 and e.in_memory),
                 key=lambda kv: kv[1].last_access)
             for oid, e in entries:
-                if self._bytes <= STORE_CAP * 0.8:
+                if self._bytes <= self._cap * 0.8:
                     break
+                if self._spill_entry_locked(oid, e):
+                    continue
+                # not ours to spill (zero-copy reference into another
+                # process's memory): drop — it is refetchable
                 self._entries.pop(oid, None)
                 self._deserialized_cache.pop(oid, None)
                 self._bytes -= e.nbytes
@@ -364,7 +548,11 @@ class LocalObjectStore:
 
     def stats(self) -> Dict[str, int]:
         with self._cv:
-            return {"num_objects": len(self._entries), "bytes": self._bytes}
+            spilled = [e for e in self._entries.values()
+                       if not e.in_memory and e.spill_path is not None]
+            return {"num_objects": len(self._entries), "bytes": self._bytes,
+                    "spilled_objects": len(spilled),
+                    "spilled_bytes": sum(e.nbytes for e in spilled)}
 
     def shutdown(self) -> None:
         with self._cv:

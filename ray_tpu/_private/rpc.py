@@ -78,7 +78,21 @@ class RpcServer:
         self._handler = handler
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        if port == 0:
+            self._sock.bind((host, port))
+        else:
+            # explicit port = a daemon restarting at a known address; the
+            # previous incarnation's sockets may linger in FIN_WAIT for a
+            # moment after its stop() — retry briefly instead of failing
+            deadline = time.monotonic() + 5.0
+            while True:
+                try:
+                    self._sock.bind((host, port))
+                    break
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.1)
         self._sock.listen(128)
         self.address: Tuple[str, int] = self._sock.getsockname()
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
@@ -118,6 +132,9 @@ class RpcServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # lets a successor rebind this port while old accepted
+            # sockets drain through FIN_WAIT (conductor restart)
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             with self._conns_lock:
                 self._conns.add(conn)
             threading.Thread(target=self._conn_loop, args=(conn,),
@@ -345,3 +362,71 @@ class ClientPool:
             for c in self._clients.values():
                 c.close()
             self._clients.clear()
+
+
+class ReconnectingClient:
+    """RpcClient facade that re-dials a lost connection on the NEXT call —
+    lets drivers, workers, and node agents ride out a conductor restart
+    (reference: the GCS client's reconnect-with-backoff,
+    src/ray/gcs/gcs_client/gcs_client.cc).
+
+    A call already in flight when the connection drops still raises
+    ConnectionLost — re-sending it here could double-execute a
+    non-idempotent method (e.g. lease_worker); recovery is the caller's
+    retry, made cheap because the re-dial happens underneath."""
+
+    def __init__(self, address: Tuple[str, int], connect_timeout: float = 10.0,
+                 connect_retries: int = 0, retry_interval: float = 0.3):
+        self.address = tuple(address)
+        self._connect_timeout = connect_timeout
+        self._retry_interval = retry_interval
+        self._lock = threading.Lock()
+        self._client = RpcClient(address, connect_timeout=connect_timeout,
+                                 connect_retries=connect_retries,
+                                 retry_interval=retry_interval)
+        self._shutdown = False
+
+    @property
+    def _closed(self) -> bool:
+        """Closed for good (close() was called). A dropped connection is
+        not 'closed' — the next call re-dials."""
+        return self._shutdown
+
+    def _live(self) -> RpcClient:
+        with self._lock:
+            if self._shutdown:
+                raise ConnectionLost(f"client to {self.address} shut down")
+            if not self._client._closed:
+                return self._client
+        # dial outside the lock; a brief outage gets a couple of retries
+        nc = RpcClient(self.address, connect_timeout=self._connect_timeout,
+                       connect_retries=2,
+                       retry_interval=self._retry_interval)
+        with self._lock:
+            if self._shutdown or not self._client._closed:
+                nc.close()
+                if self._shutdown:
+                    raise ConnectionLost(
+                        f"client to {self.address} shut down")
+                return self._client
+            self._client = nc
+            return nc
+
+    def call(self, method: str, *args, timeout: Optional[float] = None,
+             **kwargs) -> Any:
+        return self._live().call(method, *args, timeout=timeout, **kwargs)
+
+    def notify(self, method: str, *args, **kwargs) -> None:
+        self._live().notify(method, *args, **kwargs)
+
+    def start_call(self, method: str, *args, **kwargs):
+        return self._live().start_call(method, *args, **kwargs)
+
+    def finish_call(self, p, method: str = "",
+                    timeout: Optional[float] = None) -> Any:
+        return self._client.finish_call(p, method, timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._client.close()

@@ -37,11 +37,35 @@ from .. import exceptions as exc
 from . import serialization
 from .ids import JobID, ObjectID, TaskID
 from .object_store import SHM_THRESHOLD, LocalObjectStore, ObjectRef
-from .rpc import ClientPool, ConnectionLost, RemoteError, RpcClient, RpcServer
+from .rpc import (ClientPool, ConnectionLost, ReconnectingClient,
+                  RemoteError, RpcServer)
 
 global_worker: Optional["Worker"] = None
 
 DEFAULT_MAX_RETRIES = 3
+
+# Remote fetches above this ride chunked fetch_object_range pulls instead
+# of one RPC frame (reference pull_manager.cc: 64MB chunks)
+FETCH_CHUNK = int(os.environ.get("RAY_TPU_FETCH_CHUNK", 64 * 1024 * 1024))
+
+
+def _compute_machine_id() -> str:
+    """Identity of this HOST (not process): shm handoff is only valid
+    between processes that share it. RAY_TPU_FORCE_REMOTE_FETCH makes
+    every process claim a distinct machine (tests exercise the cross-host
+    chunked path on one box)."""
+    if os.environ.get("RAY_TPU_FORCE_REMOTE_FETCH"):
+        return f"forced-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    import socket as _socket
+
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f"{_socket.gethostname()}/{f.read().strip()}"
+    except OSError:
+        return _socket.gethostname()
+
+
+_MACHINE_ID = _compute_machine_id()
 
 
 @dataclass
@@ -74,9 +98,12 @@ class Worker:
         self.worker_id = worker_id or uuid.uuid4().hex
         self.job_id = JobID().hex()
         self.session_dir = session_dir
-        self.store = LocalObjectStore()
+        self.store = LocalObjectStore(
+            spill_dir=os.path.join(session_dir, "spill", self.worker_id[:12]))
         self.clients = ClientPool()
-        self.conductor = RpcClient(conductor_address, connect_retries=30)
+        # reconnecting: survives a conductor restart (persistence story)
+        self.conductor = ReconnectingClient(conductor_address,
+                                            connect_retries=30)
         self.conductor_address = tuple(conductor_address)
         self.handler = WorkerHandler(self)
         self.server = RpcServer(self.handler, max_workers=32).start()
@@ -165,9 +192,9 @@ class Worker:
         addr = self._locator_of(ref.id) or ref.locator
         if addr is not None and tuple(addr) != self.address:
             try:
-                kind, payload = self.clients.get(tuple(addr)).call(
-                    "fetch_object", ref.id, timeout=60.0)
-                self._store_fetched(ref.id, kind, payload)
+                reply = self.clients.get(tuple(addr)).call(
+                    "fetch_object", ref.id, _MACHINE_ID, timeout=60.0)
+                self._consume_fetch_reply(ref.id, reply, tuple(addr))
                 return
             except (ConnectionLost, RemoteError) as e:
                 if isinstance(e, RemoteError) and not isinstance(
@@ -179,11 +206,43 @@ class Worker:
             raise exc.ObjectLostError(ref.id, "no live holder and no owner")
         rem = None if deadline is None else max(0.1, deadline - time.monotonic())
         kind, payload = self.clients.get(tuple(owner)).call(
-            "resolve_object", ref.id, timeout=rem)
+            "resolve_object", ref.id, _MACHINE_ID, timeout=rem)
         if kind == "locator":
-            kind, payload = self.clients.get(tuple(payload)).call(
-                "fetch_object", ref.id, timeout=60.0)
-        self._store_fetched(ref.id, kind, payload)
+            addr = tuple(payload)
+            reply = self.clients.get(addr).call(
+                "fetch_object", ref.id, _MACHINE_ID, timeout=60.0)
+            self._consume_fetch_reply(ref.id, reply, addr)
+        else:
+            self._consume_fetch_reply(ref.id, (kind, payload), tuple(owner))
+
+    def _consume_fetch_reply(self, object_id: str, reply,
+                             src_addr: Tuple[str, int]) -> None:
+        """Handle a fetch_object/resolve_object reply; 'stream' replies
+        are pulled down in bounded chunks (reference pull_manager.cc — a
+        multi-GB object must never ride one RPC frame)."""
+        kind, payload = reply
+        if kind != "stream":
+            self._store_fetched(object_id, kind, payload)
+            return
+        meta, total, sizes = payload
+        data = bytearray(total)
+        client = self.clients.get(src_addr)
+        pos = 0
+        while pos < total:
+            n = min(FETCH_CHUNK, total - pos)
+            chunk = client.call("fetch_object_range", object_id, pos, n,
+                                timeout=60.0)
+            data[pos:pos + len(chunk)] = chunk
+            if not chunk:
+                raise exc.ObjectLostError(object_id,
+                                          "holder returned empty chunk")
+            pos += len(chunk)
+        views, off = [], 0
+        mv = memoryview(data)
+        for s in sizes:
+            views.append(mv[off:off + s])
+            off += s
+        self.store.put_serialized(object_id, meta, views, copy=False)
 
     def _store_fetched(self, object_id: str, kind: str, payload) -> None:
         if kind == "inline":
@@ -782,22 +841,44 @@ class WorkerHandler:
         rt.submit(method, args, kwargs, return_ids, seqno, caller_id,
                   lambda reply: reply_cb(True, reply))
 
-    def fetch_object(self, object_id: str):
+    def fetch_object(self, object_id: str, machine_id: Optional[str] = None):
+        """Serve a fetch. Same-host peers (or legacy callers passing no
+        machine id) get the shm zero-copy reference; cross-host peers get
+        the payload inline, or a 'stream' header directing them to pull
+        fetch_object_range chunks (reference object_manager chunked
+        push/pull, pull_manager.cc)."""
+        same_host = machine_id is None or machine_id == _MACHINE_ID
         try:
-            meta, shm_name, layout, inline = self.w.store.export(object_id)
+            if same_host:
+                meta, shm_name, layout, inline = self.w.store.export(object_id)
+                if shm_name is not None:
+                    return ("shm", (meta, shm_name, layout))
+                return ("inline", (meta, inline))
+            meta, total, sizes = self.w.store.stream_info(object_id)
+            if total > FETCH_CHUNK:
+                return ("stream", (meta, total, sizes))
+            data = self.w.store.read_range(object_id, 0, total)
+            bufs, off = [], 0
+            for s in sizes:
+                bufs.append(data[off:off + s])
+                off += s
+            return ("inline", (meta, bufs))
         except exc.RayTpuError as e:
             return ("error", e)
-        if shm_name is not None:
-            return ("shm", (meta, shm_name, layout))
-        return ("inline", (meta, inline))
 
-    def resolve_object(self, object_id: str):
+    def fetch_object_range(self, object_id: str, start: int,
+                           size: int) -> bytes:
+        return self.w.store.read_range(object_id, start,
+                                       min(size, FETCH_CHUNK))
+
+    def resolve_object(self, object_id: str,
+                       machine_id: Optional[str] = None):
         """Owner-side: block until ready, then return the value or its
         location (reference: ownership-based object directory)."""
         w = self.w
         while True:
             if w.store.contains(object_id):
-                return self.fetch_object(object_id)
+                return self.fetch_object(object_id, machine_id)
             loc = w._locator_of(object_id)
             if loc is not None:
                 return ("locator", loc)

@@ -39,15 +39,23 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, _term)
 
-    # Park the main thread; all work arrives via the RPC server. Exit if the
-    # conductor connection dies (our cluster is gone).
+    # Park the main thread; all work arrives via the RPC server. Re-register
+    # periodically — idempotent, and it re-announces this worker to a
+    # restarted conductor (persistence story; the reconnecting client
+    # re-dials underneath). Exit only after a sustained outage: the
+    # cluster is then really gone.
+    grace = float(os.environ.get("RAY_TPU_WORKER_ORPHAN_GRACE", "30"))
+    last_ok = time.monotonic()
     while True:
-        time.sleep(1.0)
+        time.sleep(5.0)
         try:
-            if w.conductor._closed:
-                os._exit(0)
+            w.conductor.call("register_worker", worker_id, w.address,
+                             os.getpid(), os.environ.get("RAY_TPU_NODE_ID"),
+                             timeout=5.0)
+            last_ok = time.monotonic()
         except Exception:
-            os._exit(0)
+            if time.monotonic() - last_ok > grace:
+                os._exit(0)
 
 
 if __name__ == "__main__":
