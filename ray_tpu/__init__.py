@@ -41,10 +41,19 @@ def init(address: Optional[str] = None, *,
          namespace: str = "default",
          session_dir: Optional[str] = None,
          worker_env: Optional[Dict[str, str]] = None,
-         ignore_reinit_error: bool = False) -> Dict[str, Any]:
+         ignore_reinit_error: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Start a local cluster (conductor in-process) or connect to an existing
-    one via ``address="host:port"``."""
+    one via ``address="host:port"``.
+
+    ``_system_config`` overrides flags from the central table
+    (``ray_tpu._private.config``) — reference semantics of ray.init's
+    _system_config over ray_config_def.h."""
     global _conductor
+    if _system_config:
+        from ._private.config import config as _cfg
+
+        _cfg.apply(_system_config)
     if is_initialized():
         if ignore_reinit_error:
             return {"address": _worker_mod.global_worker.conductor_address}
@@ -63,9 +72,14 @@ def init(address: Optional[str] = None, *,
         # here (reference: RAY_ADDRESS).
         address = os.environ.get("RAY_TPU_ADDRESS") or None
     if session_dir is None:
+        # must be unique per cluster: a reused dir would make the new
+        # conductor restore the PREVIOUS cluster's persistence snapshot
+        import uuid as _uuid
+
         session_dir = os.path.join(
             tempfile.gettempdir(), "ray_tpu",
-            f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+            f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}"
+            f"_{_uuid.uuid4().hex[:8]}")
     os.makedirs(session_dir, exist_ok=True)
 
     if address is None:

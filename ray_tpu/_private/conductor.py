@@ -35,7 +35,10 @@ from . import serialization
 from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from .rpc import ClientPool, RpcServer
 
-WORKER_START_TIMEOUT_S = float(os.environ.get("RAY_TPU_WORKER_START_TIMEOUT", 60))
+def _worker_start_timeout() -> float:
+    from .config import config
+
+    return config.worker_start_timeout
 
 
 def _chips_needed(resources: Dict[str, float]) -> int:
@@ -344,7 +347,7 @@ class ConductorHandler:
         """Grant an idle worker (spawning if below capacity), holding
         `resources` against the node until return_worker."""
         deadline = time.monotonic() + (timeout if timeout is not None
-                                       else WORKER_START_TIMEOUT_S)
+                                       else _worker_start_timeout())
         resources = dict(resources or {})
         resources.setdefault("CPU", 1.0)
         if placement_group_id is not None:
@@ -569,7 +572,7 @@ class ConductorHandler:
         client = self._clients.get(address)
         try:
             client.call("become_actor", actor_id, spec,
-                        timeout=WORKER_START_TIMEOUT_S)
+                        timeout=_worker_start_timeout())
         except Exception as e:  # creation failed on the worker
             self.return_worker(worker_id)
             with self._cv:
@@ -970,17 +973,8 @@ class ConductorHandler:
         self._kv = {ns: dict(d) for ns, d in state.get("kv", {}).items()}
         self._named_actors = dict(state.get("named_actors", {}))
         now = time.monotonic()
-        for rec in state.get("actors", []):
-            self._actors[rec.actor_id] = rec
-            if rec.state in ("ALIVE", "RESTARTING") and rec.worker_id:
-                w = WorkerRecord(worker_id=rec.worker_id,
-                                 node_id=self._head_node_id,
-                                 address=rec.address, state="ACTOR",
-                                 resources=dict(rec.resources),
-                                 lease_node_id=self._head_node_id,
-                                 restored_at=now)
-                self._workers[w.worker_id] = w
-                self._acquire_resources(head, rec.resources)
+        # PGs first: live actors scheduled inside one hold the PG's
+        # synthetic `_pg_<id>_<k>` keys, which must exist to re-charge
         for pg in state.get("pgs", []):
             if pg.state != "CREATED":
                 continue
@@ -995,6 +989,24 @@ class ConductorHandler:
                     head.total[pk] = head.total.get(pk, 0) + v
                     head.available[pk] = head.available.get(pk, 0) + v
             self._pgs[pg.pg_id] = pg
+        for rec in state.get("actors", []):
+            self._actors[rec.actor_id] = rec
+            if rec.state in ("ALIVE", "RESTARTING") and rec.worker_id:
+                # mirror lease_worker: a PG-scheduled actor's lease holds
+                # the bundle's prefixed keys, NOT head general capacity
+                if rec.placement_group_id:
+                    held = {f"_pg_{rec.placement_group_id}_{k}": v
+                            for k, v in rec.resources.items()}
+                else:
+                    held = dict(rec.resources)
+                w = WorkerRecord(worker_id=rec.worker_id,
+                                 node_id=self._head_node_id,
+                                 address=rec.address, state="ACTOR",
+                                 resources=held,
+                                 lease_node_id=self._head_node_id,
+                                 restored_at=now)
+                self._workers[w.worker_id] = w
+                self._acquire_resources(head, held)
         for jid, meta in state.get("jobs", {}).items():
             meta = dict(meta, proc=None)
             if meta.get("status") == "RUNNING":
@@ -1010,8 +1022,10 @@ class ConductorHandler:
         """Reap dead worker processes; restart actors; detect dead agent
         nodes by heartbeat age (reference gcs_health_check_manager.cc +
         gcs_actor_manager worker-death path)."""
-        node_timeout = float(os.environ.get("RAY_TPU_NODE_TIMEOUT", "10"))
-        restore_grace = float(os.environ.get("RAY_TPU_RESTORE_GRACE", "20"))
+        from .config import config
+
+        node_timeout = config.node_timeout
+        restore_grace = config.restore_grace
         while not self._stopped:
             time.sleep(0.2)
             self._flush_state()

@@ -1,0 +1,136 @@
+"""Runtime flag table — the one place to see and override every knob.
+
+Analog of the reference's `src/ray/common/ray_config_def.h` (219
+RAY_CONFIG entries materialized into a singleton RayConfig) and the
+`_system_config` dict accepted by ray.init. Here each flag is declared
+once with its type, default, and doc; the value resolves as
+
+    explicit _system_config override  >  RAY_TPU_<NAME> env var  >  default
+
+Overrides are exported back into the environment so worker/agent child
+processes (and the scattered lazy `os.environ` reads across the
+codebase) all see one consistent value.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str            # lower_snake; env var is RAY_TPU_<upper>
+    type: type
+    default: Any
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return _ENV_PREFIX + self.name.upper()
+
+
+_FLAGS: List[Flag] = [
+    # --- control plane -------------------------------------------------
+    Flag("worker_start_timeout", float, 60.0,
+         "seconds a lease waits for a worker process to start"),
+    Flag("node_timeout", float, 10.0,
+         "seconds without a heartbeat before an agent node is dead"),
+    Flag("node_heartbeat", float, 1.0,
+         "node agent heartbeat period (seconds)"),
+    Flag("worker_orphan_grace", float, 30.0,
+         "seconds a worker outlives a dead conductor before exiting"),
+    Flag("node_orphan_grace", float, 30.0,
+         "seconds a node agent outlives a dead conductor before exiting"),
+    Flag("restore_grace", float, 20.0,
+         "seconds a snapshot-restored worker record is presumed alive "
+         "awaiting its re-register"),
+    # --- object plane --------------------------------------------------
+    Flag("object_store_cap", int, 2 * 1024**3,
+         "per-process object store memory cap in bytes; eviction spills "
+         "past it"),
+    Flag("shm_threshold", int, 100 * 1024,
+         "bytes above which host objects go to shared memory"),
+    Flag("arena_size", int, 2 * 1024**3,
+         "native shm arena size in bytes"),
+    Flag("native_store", int, 1,
+         "1 = use the C++ slab arena (shm_store.cc); 0 = per-object "
+         "SharedMemory segments"),
+    Flag("fetch_chunk", int, 64 * 1024 * 1024,
+         "chunk size for cross-host object pulls"),
+    Flag("spill_dir", str, "",
+         "directory for eviction spill files (default: tmp)"),
+    Flag("force_remote_fetch", int, 0,
+         "testing: every process claims a distinct machine id, forcing "
+         "the cross-host chunked fetch path"),
+    # --- accelerators --------------------------------------------------
+    Flag("chips", int, 0,
+         "override detected TPU chip count (0 = autodetect)"),
+    Flag("pallas_interpret", int, 0,
+         "run Pallas kernels in interpret mode (CPU testing)"),
+    # --- misc ----------------------------------------------------------
+    Flag("node_ip", str, "",
+         "address other hosts can reach this one on (else inferred from "
+         "the route to the conductor)"),
+    Flag("workflow_storage", str, "",
+         "workflow checkpoint root (default: ~/.ray_tpu_workflows)"),
+]
+
+_BY_NAME: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+
+def _coerce(flag: Flag, raw: Any) -> Any:
+    if isinstance(raw, str) and flag.type is not str:
+        return flag.type(float(raw)) if flag.type is int else flag.type(raw)
+    return flag.type(raw)
+
+
+class RayTpuConfig:
+    """Resolved view of every flag; `apply` installs overrides."""
+
+    def get(self, name: str) -> Any:
+        flag = _BY_NAME.get(name)
+        if flag is None:
+            raise KeyError(f"unknown config flag {name!r}; known: "
+                           f"{sorted(_BY_NAME)}")
+        raw = os.environ.get(flag.env_var)
+        if raw is None or raw == "":
+            return flag.default
+        return _coerce(flag, raw)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+    def apply(self, overrides: Dict[str, Any]) -> None:
+        """Install `_system_config` overrides: validated against the
+        table and exported to the environment so child processes and
+        lazy readers agree."""
+        for name, value in overrides.items():
+            flag = _BY_NAME.get(name)
+            if flag is None:
+                raise ValueError(
+                    f"unknown _system_config flag {name!r}; known flags: "
+                    f"{sorted(_BY_NAME)}")
+            os.environ[flag.env_var] = str(_coerce(flag, value))
+
+    def describe(self) -> List[Dict[str, Any]]:
+        """All flags with their current value and provenance — the
+        `ray_tpu config` CLI listing."""
+        out = []
+        for f in _FLAGS:
+            raw = os.environ.get(f.env_var)
+            out.append({
+                "name": f.name, "env_var": f.env_var,
+                "type": f.type.__name__, "default": f.default,
+                "value": self.get(f.name),
+                "source": "env" if raw not in (None, "") else "default",
+                "doc": f.doc,
+            })
+        return out
+
+
+config = RayTpuConfig()

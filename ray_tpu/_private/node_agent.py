@@ -21,7 +21,10 @@ from .ids import NodeID, WorkerID
 from .rpc import ReconnectingClient, RpcServer
 from .worker_spawn import spawn_worker_process
 
-HEARTBEAT_PERIOD_S = float(os.environ.get("RAY_TPU_NODE_HEARTBEAT", "1.0"))
+def _heartbeat_period() -> float:
+    from .config import config
+
+    return config.node_heartbeat
 
 
 class NodeAgentHandler:
@@ -125,9 +128,11 @@ class NodeAgent:
         return self.server.address
 
     def _heartbeat_loop(self) -> None:
-        grace = float(os.environ.get("RAY_TPU_NODE_ORPHAN_GRACE", "30"))
+        from .config import config
+
+        grace = config.node_orphan_grace
         last_ok = time.monotonic()
-        while not self._stopped.wait(HEARTBEAT_PERIOD_S):
+        while not self._stopped.wait(_heartbeat_period()):
             dead = self.handler.reap_dead()
             try:
                 known = self._conductor.call("node_heartbeat", self.node_id,

@@ -33,8 +33,14 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import serialization
 from .ids import ObjectID
 
-SHM_THRESHOLD = int(os.environ.get("RAY_TPU_SHM_THRESHOLD", 100 * 1024))
-STORE_CAP = int(os.environ.get("RAY_TPU_OBJECT_STORE_CAP", 2 * 1024**3))
+def shm_threshold() -> int:
+    """Bytes above which host objects go to shared memory — resolved via
+    the flag table at use time (ray_config_def.h analog)."""
+    from .config import config
+
+    return config.shm_threshold
+
+
 _ALIGN = 8
 
 
@@ -119,15 +125,15 @@ class LocalObjectStore:
         self._cv = threading.Condition()
         self._attached: Dict[str, Any] = {}  # SharedMemory or attached Arena
         self._bytes = 0
-        self._cap = int(cap if cap is not None else os.environ.get(
-            "RAY_TPU_OBJECT_STORE_CAP", STORE_CAP))
+        from .config import config
+
+        self._cap = int(cap) if cap is not None else config.object_store_cap
         # Eviction SPILLS owned objects here instead of dropping them, so
         # put() beyond the memory cap stays correct (reference
         # local_object_manager.h:53 spill + restore)
         self._spill_dir = spill_dir or os.path.join(
-            os.environ.get("RAY_TPU_SPILL_DIR",
-                           os.path.join(tempfile.gettempdir(),
-                                        "ray_tpu_spill")),
+            config.spill_dir or os.path.join(tempfile.gettempdir(),
+                                             "ray_tpu_spill"),
             str(os.getpid()))
         # objects for which only a placeholder exists (awaiting task result)
         self._deserialized_cache: Dict[str, Any] = {}
@@ -136,13 +142,13 @@ class LocalObjectStore:
         # instead of one shm_open+mmap per object. None → per-object
         # SharedMemory fallback.
         self._arena = None
-        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") == "1":
+        if config.native_store:
             try:
                 from ray_tpu._native import Arena
 
                 self._arena = Arena.create(
                     f"rtpu_a_{os.getpid()}_{ObjectID().hex()[:8]}",
-                    int(os.environ.get("RAY_TPU_ARENA_SIZE", STORE_CAP)))
+                    config.arena_size)
             except Exception:  # noqa: BLE001 — build/env issue: fall back
                 self._arena = None
         # Freed arena blocks rest here ~2s before reuse so a peer mid-copy
@@ -157,7 +163,7 @@ class LocalObjectStore:
         meta, buffers = serialization.serialize(value)
         total = sum(b.nbytes for b in buffers)
         e = _Entry(meta=meta, nbytes=len(meta) + total)
-        if total >= SHM_THRESHOLD:
+        if total >= shm_threshold():
             size = 0
             layout = []
             for b in buffers:
@@ -409,7 +415,17 @@ class LocalObjectStore:
 
     def _spill_entry_locked(self, oid: str, e: _Entry) -> bool:
         """Write payload to disk, then drop the memory copy. Must hold
-        lock (eviction is the cold path; the write is tolerable here)."""
+        lock (eviction is the cold path; the write is tolerable here).
+
+        Only entries whose bytes WE own are spillable. A zero-copy
+        reference into another process's arena (put_shm_reference) may
+        already point at recycled memory by the time we evict — spilling
+        it would persist garbage as the object's value. Those are dropped
+        and refetched instead."""
+        owned = (e.buffers is not None or e.shm is not None
+                 or e.arena_offset is not None)
+        if not owned:
+            return False
         bufs = self._gather_buffers_locked(e)
         if bufs is None:
             return False
@@ -449,15 +465,30 @@ class LocalObjectStore:
         e.layout = None
         return True
 
+    def _read_spill_header(self, f):
+        """(meta, buffer_sizes, payload_file_offset) — cheap: no payload."""
+        (meta_len,) = self._SPILL_HDR.unpack(f.read(self._SPILL_HDR.size))
+        meta = f.read(meta_len)
+        (n,) = self._SPILL_CNT.unpack(f.read(self._SPILL_CNT.size))
+        sizes = [self._SPILL_SZ.unpack(f.read(self._SPILL_SZ.size))[0]
+                 for _ in range(n)]
+        return meta, sizes, f.tell()
+
     def _read_spill_file(self, path: str):
         with open(path, "rb") as f:
-            (meta_len,) = self._SPILL_HDR.unpack(f.read(self._SPILL_HDR.size))
-            meta = f.read(meta_len)
-            (n,) = self._SPILL_CNT.unpack(f.read(self._SPILL_CNT.size))
-            sizes = [self._SPILL_SZ.unpack(f.read(self._SPILL_SZ.size))[0]
-                     for _ in range(n)]
+            meta, sizes, _ = self._read_spill_header(f)
             bufs = [memoryview(f.read(sz)) for sz in sizes]
         return meta, bufs
+
+    def _read_spill_range(self, path: str, start: int, size: int) -> bytes:
+        """Seek-and-read: a chunked fetch of a spilled multi-GB object
+        must not load (or re-load) the whole file per chunk."""
+        with open(path, "rb") as f:
+            _, sizes, data_off = self._read_spill_header(f)
+            total = sum(sizes)
+            start = min(start, total)
+            f.seek(data_off + start)
+            return f.read(min(size, total - start))
 
     def _restore_locked(self, e: _Entry) -> None:
         """Load a spilled entry back into heap buffers. The spill file is
@@ -490,9 +521,9 @@ class LocalObjectStore:
                     raise KeyError(object_id)
                 return e.meta, sum(b.nbytes for b in bufs), \
                     [b.nbytes for b in bufs]
-            meta, bufs = self._read_spill_file(e.spill_path)
-            return meta, sum(b.nbytes for b in bufs), \
-                [b.nbytes for b in bufs]
+            with open(e.spill_path, "rb") as f:
+                meta, sizes, _ = self._read_spill_header(f)
+            return meta, sum(sizes), sizes
 
     def read_range(self, object_id: str, start: int, size: int) -> bytes:
         """Bytes [start, start+size) of the object's payload stream (all
@@ -506,7 +537,7 @@ class LocalObjectStore:
             e.last_access = time.monotonic()
             bufs = self._gather_buffers_locked(e) if e.in_memory else None
             if bufs is None and e.spill_path is not None:
-                _, bufs = self._read_spill_file(e.spill_path)
+                return self._read_spill_range(e.spill_path, start, size)
             if bufs is None:
                 raise KeyError(object_id)
             out = bytearray()

@@ -132,9 +132,6 @@ class RpcServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            # lets a successor rebind this port while old accepted
-            # sockets drain through FIN_WAIT (conductor restart)
-            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             with self._conns_lock:
                 self._conns.add(conn)
             threading.Thread(target=self._conn_loop, args=(conn,),

@@ -1,28 +1,86 @@
 """Runtime environments — analog of the reference's
 python/ray/_private/runtime_env/ (working_dir/py_modules packaging.py: zip
-to GCS KV, URI-cached per node; env_vars; plugins) + the runtime-env agent
-flow (agent/runtime_env_agent.py:161).
+to GCS KV, URI-cached per node; env_vars; pip plugin pip.py; the plugin
+protocol plugin.py) + the runtime-env agent flow
+(agent/runtime_env_agent.py:161).
 
-Scope for the TPU build: env_vars, working_dir, py_modules, and config
-validation. Directories are zipped, content-addressed, staged through the
-conductor KV (the GCS-KV analog), and extracted once per worker into a
-hash-keyed cache. pip/conda/container are rejected with a clear error —
-this runtime never installs packages at task time (TPU images are baked;
-the reference's conda path is its slowest, least reproducible feature)."""
+Built-in keys: env_vars, working_dir, py_modules, pip. Directories are
+zipped, content-addressed, staged through the conductor KV (the GCS-KV
+analog), and extracted once per worker into a hash-keyed cache. `pip`
+creates a content-keyed venv (--system-site-packages, --no-index: this
+runtime installs LOCAL wheels/dirs at env-setup time, never from the
+network — TPU images are baked) whose site-packages is prepended for the
+task/actor. conda/container stay rejected; third-party keys can hook in
+via register_plugin (reference plugin.py RuntimeEnvPlugin)."""
 from __future__ import annotations
 
 import contextlib
 import hashlib
 import io
 import os
+import subprocess
 import sys
 import tempfile
 import zipfile
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 _KV_NS = "runtime_env"
 _MAX_PACKAGE_BYTES = 256 * 1024 * 1024
-_UNSUPPORTED = ("pip", "conda", "container", "uv", "image_uri")
+_UNSUPPORTED = ("conda", "container", "uv", "image_uri")
+_BUILTIN = ("env_vars", "working_dir", "py_modules", "pip", "config")
+
+
+class RuntimeEnvPlugin:
+    """Extension point for custom runtime_env keys (reference
+    python/ray/_private/runtime_env/plugin.py). Subclass, set `name`,
+    and register_plugin() an instance; `validate` runs driver-side at
+    submission, `apply` runs worker-side around execution and may mutate
+    os.environ / sys.path (restored for non-permanent task envs by the
+    surrounding context manager)."""
+
+    name: str = ""
+
+    def validate(self, value: Any) -> Any:
+        return value
+
+    def prepare(self, conductor, value: Any) -> Any:
+        """Driver-side staging (e.g. upload); returns the wire value."""
+        return value
+
+    def apply(self, conductor, value: Any) -> None:
+        """Worker-side activation before task/actor code runs."""
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+_ENV_PLUGINS_LOADED: Optional[str] = None
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name or plugin.name in _BUILTIN:
+        raise ValueError(f"invalid plugin name {plugin.name!r}")
+    _PLUGINS[plugin.name] = plugin
+
+
+def _plugins() -> Dict[str, RuntimeEnvPlugin]:
+    """register_plugin()'d instances + classes named in
+    RAY_TPU_RUNTIME_ENV_PLUGINS ("module:Class,module:Class") — the env
+    var is how plugins reach WORKER processes, which never ran the
+    driver's register_plugin call (reference RAY_RUNTIME_ENV_PLUGINS,
+    runtime_env/plugin.py:40)."""
+    global _ENV_PLUGINS_LOADED
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    if spec and spec != _ENV_PLUGINS_LOADED:
+        import importlib
+
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            mod_name, _, cls_name = item.partition(":")
+            plugin = getattr(importlib.import_module(mod_name), cls_name)()
+            _PLUGINS.setdefault(plugin.name, plugin)
+        _ENV_PLUGINS_LOADED = spec
+    return _PLUGINS
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -33,12 +91,28 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         if key in env:
             raise ValueError(
                 f"runtime_env[{key!r}] is not supported: ray_tpu never "
-                "installs packages at task time (bake them into the image); "
-                "supported keys: env_vars, working_dir, py_modules")
+                "builds images/envs from the network at task time (bake "
+                "them into the image); supported keys: env_vars, "
+                "working_dir, py_modules, pip (local wheels/dirs)")
+    for key in env:
+        if key not in _BUILTIN and key not in _plugins():
+            raise ValueError(
+                f"unknown runtime_env key {key!r}; built-ins: {_BUILTIN}, "
+                f"registered plugins: {sorted(_PLUGINS)}")
     ev = env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
         raise ValueError("runtime_env['env_vars'] must be Dict[str, str]")
+    pip = env.get("pip")
+    if pip is not None and not (
+            isinstance(pip, list)
+            and all(isinstance(s, str) for s in pip)):
+        raise ValueError("runtime_env['pip'] must be List[str] of local "
+                         "wheel/sdist/directory paths or requirement "
+                         "specifiers resolvable offline")
+    for key, plugin in _plugins().items():
+        if key in env:
+            env[key] = plugin.validate(env[key])
     return env
 
 
@@ -77,8 +151,39 @@ def package_dir(conductor, path: str) -> str:
     return uri
 
 
+def package_file(conductor, path: str) -> str:
+    """Upload one artifact (wheel/sdist) to the KV, content-addressed."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(f"runtime_env artifact {path} too large")
+    digest = hashlib.sha256(data).hexdigest()[:24]
+    uri = f"kv://{digest}.bin"
+    key = uri.encode()
+    if conductor.call("kv_get", key, _KV_NS, timeout=30.0) is None:
+        conductor.call("kv_put", key, data, True, _KV_NS, timeout=60.0)
+    return uri
+
+
+def _prepare_pip(conductor, specs: List[str]) -> Dict[str, Any]:
+    """Stage local artifacts so remote workers can install them offline
+    (reference pip.py + packaging.py upload flow)."""
+    staged = []
+    for s in specs:
+        if os.path.isfile(s):  # wheel/sdist: filename carries pip's tags
+            staged.append({"kind": "file", "uri": package_file(conductor, s),
+                           "filename": os.path.basename(s)})
+        elif os.path.isdir(s):
+            staged.append({"kind": "dir", "uri": package_dir(conductor, s)})
+        else:  # bare requirement: must resolve offline on the worker
+            staged.append({"kind": "req", "spec": s})
+    key = hashlib.sha256(repr(staged).encode()).hexdigest()[:24]
+    return {"key": key, "specs": staged}
+
+
 def prepare(conductor, runtime_env: Dict[str, Any]) -> Dict[str, Any]:
-    """Driver-side: replace local dirs with uploaded URIs. Idempotent."""
+    """Driver-side: replace local dirs/artifacts with uploaded URIs.
+    Idempotent."""
     env = validate(runtime_env)
     if not env:
         return {}
@@ -92,6 +197,12 @@ def prepare(conductor, runtime_env: Dict[str, Any]) -> Dict[str, Any]:
                     else package_dir(conductor, m))
     if mods:
         out["py_modules"] = mods
+    pip = env.get("pip")
+    if pip and not (isinstance(pip, dict) and "key" in pip):
+        out["pip"] = _prepare_pip(conductor, pip)
+    for key, plugin in _plugins().items():
+        if key in env:
+            out[key] = plugin.prepare(conductor, env[key])
     return out
 
 
@@ -123,6 +234,56 @@ def ensure_local(conductor, uri: str) -> str:
     return dest
 
 
+def ensure_pip_env(conductor, prepared: Dict[str, Any]) -> str:
+    """Worker-side: materialize the staged pip env once; returns its
+    site-packages dir. A content-keyed venv (--system-site-packages so
+    the baked jax stack stays visible; --no-index so nothing touches the
+    network) mirrors the reference's per-env virtualenv (pip.py:282) —
+    shared by every task/actor with the same spec on this machine."""
+    key = prepared["key"]
+    venv_dir = os.path.join(_cache_root(), "venvs", key)
+    ok_marker = os.path.join(venv_dir, ".ray_tpu_ok")
+    lib = os.path.join(venv_dir, "lib",
+                       f"python{sys.version_info.major}."
+                       f"{sys.version_info.minor}", "site-packages")
+    if os.path.exists(ok_marker):
+        return lib
+    # localize staged artifacts
+    art_dir = os.path.join(_cache_root(), "artifacts", key)
+    os.makedirs(art_dir, exist_ok=True)
+    targets: List[str] = []
+    for s in prepared["specs"]:
+        if s["kind"] == "file":
+            dest = os.path.join(art_dir, s["filename"])
+            if not os.path.exists(dest):
+                data = conductor.call("kv_get", s["uri"].encode(), _KV_NS,
+                                      timeout=60.0)
+                if data is None:
+                    raise RuntimeError(f"pip artifact {s['uri']} lost")
+                with open(dest + ".tmp", "wb") as f:
+                    f.write(data)
+                os.replace(dest + ".tmp", dest)
+            targets.append(dest)
+        elif s["kind"] == "dir":
+            targets.append(ensure_local(conductor, s["uri"]))
+        else:
+            targets.append(s["spec"])
+    subprocess.run([sys.executable, "-m", "venv", "--system-site-packages",
+                    venv_dir], check=True, capture_output=True)
+    pip = os.path.join(venv_dir, "bin", "pip")
+    r = subprocess.run(
+        [pip, "install", "--quiet", "--no-index",
+         "--no-build-isolation", *targets],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"pip runtime_env failed (offline install of {targets}): "
+            f"{r.stdout}\n{r.stderr}")
+    with open(ok_marker, "w") as f:
+        f.write("ok")
+    return lib
+
+
 @contextlib.contextmanager
 def applied(conductor, runtime_env: Optional[Dict[str, Any]],
             permanent: bool = False):
@@ -150,6 +311,14 @@ def applied(conductor, runtime_env: Optional[Dict[str, Any]],
             local = ensure_local(conductor, uri)
             if local not in sys.path:
                 sys.path.insert(0, local)
+        pip = env.get("pip")
+        if pip:
+            sp = ensure_pip_env(conductor, pip)
+            if sp not in sys.path:
+                sys.path.insert(0, sp)
+        for key, plugin in _plugins().items():
+            if key in env:
+                plugin.apply(conductor, env[key])
         yield
     finally:
         if not permanent:

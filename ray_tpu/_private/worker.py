@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import exceptions as exc
 from . import serialization
 from .ids import JobID, ObjectID, TaskID
-from .object_store import SHM_THRESHOLD, LocalObjectStore, ObjectRef
+from .object_store import LocalObjectStore, ObjectRef, shm_threshold
 from .rpc import (ClientPool, ConnectionLost, ReconnectingClient,
                   RemoteError, RpcServer)
 
@@ -44,9 +44,14 @@ global_worker: Optional["Worker"] = None
 
 DEFAULT_MAX_RETRIES = 3
 
-# Remote fetches above this ride chunked fetch_object_range pulls instead
-# of one RPC frame (reference pull_manager.cc: 64MB chunks)
-FETCH_CHUNK = int(os.environ.get("RAY_TPU_FETCH_CHUNK", 64 * 1024 * 1024))
+
+def _fetch_chunk() -> int:
+    """Chunk size for cross-host pulls (reference pull_manager.cc: 64MB).
+    Read through the flag table at use time so _system_config overrides
+    reach this process too, not only spawned children."""
+    from .config import config
+
+    return config.fetch_chunk
 
 
 def _compute_machine_id() -> str:
@@ -124,6 +129,8 @@ class Worker:
         self._shutdown = False
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
+        threading.Thread(target=self._event_flush_loop, daemon=True,
+                         name="task-event-flush").start()
 
     # ------------------------------------------------------------ put / get
 
@@ -229,7 +236,7 @@ class Worker:
         client = self.clients.get(src_addr)
         pos = 0
         while pos < total:
-            n = min(FETCH_CHUNK, total - pos)
+            n = min(_fetch_chunk(), total - pos)
             chunk = client.call("fetch_object_range", object_id, pos, n,
                                 timeout=60.0)
             data[pos:pos + len(chunk)] = chunk
@@ -413,10 +420,14 @@ class Worker:
         self._record_event(spec, t0, tuple(address), status)
 
     def _wire_spec(self, spec: TaskSpec) -> dict:
+        # "machine" tells the executor whether a shm-name result reply is
+        # attachable by us (same host) or must come back as a locator we
+        # fetch through the machine-id-aware chunked path
         return {"task_id": spec.task_id, "name": spec.name,
                 "fn_bytes": spec.fn_bytes, "args": spec.args,
                 "kwargs": spec.kwargs, "return_ids": spec.return_ids,
-                "owner": spec.owner, "runtime_env": spec.runtime_env}
+                "owner": spec.owner, "runtime_env": spec.runtime_env,
+                "machine": _MACHINE_ID}
 
     def _record_results(self, return_ids: List[str], reply: list) -> None:
         for oid, kind, payload in reply:
@@ -453,14 +464,27 @@ class Worker:
               "job_id": self.job_id, "status": status}
         with self._task_events_lock:
             self._task_events.append(ev)
-            batch = None
-            if len(self._task_events) >= 50:
-                batch, self._task_events = self._task_events, []
+            n = len(self._task_events)
+        if n >= 50:
+            self._flush_task_events()
+
+    def _flush_task_events(self) -> None:
+        """Push buffered events to the conductor (size-triggered above,
+        time-triggered by the flusher thread — external consumers like
+        the dashboard must see small workloads too; reference
+        task_event_buffer.cc periodic flush)."""
+        with self._task_events_lock:
+            batch, self._task_events = self._task_events, []
         if batch:
             try:
                 self.conductor.notify("report_task_events", batch)
             except ConnectionLost:
                 pass
+
+    def _event_flush_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(2.0)
+            self._flush_task_events()
 
     # ------------------------------------------------------------ execution
 
@@ -491,22 +515,29 @@ class Worker:
                     ValueError(f"task {name} returned {len(results)} values, "
                                f"expected {len(return_ids)}"), "", name)
                 return [(oid, "error", err) for oid in return_ids]
-        return [self._store_result(oid, value)
+        return [self._store_result(oid, value, wire.get("machine"))
                 for oid, value in zip(return_ids, results)]
 
     def _materialize(self, v: Any) -> Any:
         return self._get_one(v, None) if isinstance(v, ObjectRef) else v
 
-    def _store_result(self, oid: str, value: Any):
+    def _store_result(self, oid: str, value: Any,
+                      requester_machine: Optional[str] = None):
         try:
             nbytes = self.store.put_value(oid, value)
             meta, shm_name, layout, inline = self.store.export(oid)
         except BaseException as e:  # noqa: BLE001 — serialization failure
             return (oid, "error",
                     exc.TaskError(e, traceback.format_exc(), "store_result"))
+        same_host = requester_machine is None \
+            or requester_machine == _MACHINE_ID
         if shm_name is not None:
-            return (oid, "shm", (meta, shm_name, layout))
-        if nbytes <= SHM_THRESHOLD:
+            if same_host:
+                return (oid, "shm", (meta, shm_name, layout))
+            # cross-host: a shm name is meaningless there — hand back a
+            # locator; the caller pulls through the chunked fetch path
+            return (oid, "locator", self.address)
+        if nbytes <= shm_threshold():
             return (oid, "inline", (meta, inline))
         return (oid, "locator", self.address)
 
@@ -581,7 +612,7 @@ class Worker:
                     client = self.clients.get(address)
                     pending = client.start_call(
                         "actor_task", actor_id, method, args, kwargs,
-                        return_ids, seqno, caller_id)
+                        return_ids, seqno, caller_id, _MACHINE_ID)
                 except ConnectionLost:
                     pass
                 finally:
@@ -715,19 +746,21 @@ class ActorRuntime:
                          name="actor-dispatch").start()
 
     def submit(self, method, args, kwargs, return_ids, seqno, caller_id,
-               done_cb) -> None:
+               done_cb, caller_machine=None) -> None:
         if seqno < 0:
             # unordered (post-restart retry): skip the reorder buffer —
             # ordering across a restart boundary is best-effort, matching the
             # reference's at-least-once actor-retry semantics.
-            self._queue.put((method, args, kwargs, return_ids, done_cb))
+            self._queue.put((method, args, kwargs, return_ids, done_cb,
+                             caller_machine))
             return
         with self._cv:
             # A fresh runtime (post-restart) may first see a caller mid-stream;
             # adopt its current seqno as the starting point.
             expected = self._next_seqno.setdefault(caller_id, seqno)
             buf = self._reorder.setdefault(caller_id, {})
-            buf[seqno] = (method, args, kwargs, return_ids, done_cb)
+            buf[seqno] = (method, args, kwargs, return_ids, done_cb,
+                          caller_machine)
             while expected in buf:
                 self._queue.put(buf.pop(expected))
                 expected += 1
@@ -744,7 +777,7 @@ class ActorRuntime:
                 self._exec_pool.submit(self._run_one, item)
 
     def _run_one(self, item) -> None:
-        method, args, kwargs, return_ids, done_cb = item
+        method, args, kwargs, return_ids, done_cb, caller_machine = item
         try:
             if method == "__ray_tpu_col_init__":
                 # universal hook so create_collective_group works on any
@@ -769,7 +802,7 @@ class ActorRuntime:
             if asyncio.iscoroutine(result):
                 result = self._run_coroutine(result)
             results = [result] if len(return_ids) == 1 else list(result)
-            reply = [self.worker._store_result(oid, value)
+            reply = [self.worker._store_result(oid, value, caller_machine)
                      for oid, value in zip(return_ids, results)]
         except SystemExit:
             err = exc.ActorDiedError(self.actor_id, "exit_actor() called")
@@ -831,7 +864,8 @@ class WorkerHandler:
     _async_reply_methods = frozenset({"actor_task"})
 
     def actor_task(self, reply_cb, actor_id: str, method: str, args, kwargs,
-                   return_ids, seqno: int, caller_id: str) -> None:
+                   return_ids, seqno: int, caller_id: str,
+                   caller_machine: Optional[str] = None) -> None:
         rt = self.w._actor_runtime
         if rt is None or rt.actor_id != actor_id:
             e = exc.ActorUnavailableError(actor_id,
@@ -839,7 +873,7 @@ class WorkerHandler:
             reply_cb(False, (e, ""))
             return
         rt.submit(method, args, kwargs, return_ids, seqno, caller_id,
-                  lambda reply: reply_cb(True, reply))
+                  lambda reply: reply_cb(True, reply), caller_machine)
 
     def fetch_object(self, object_id: str, machine_id: Optional[str] = None):
         """Serve a fetch. Same-host peers (or legacy callers passing no
@@ -855,7 +889,7 @@ class WorkerHandler:
                     return ("shm", (meta, shm_name, layout))
                 return ("inline", (meta, inline))
             meta, total, sizes = self.w.store.stream_info(object_id)
-            if total > FETCH_CHUNK:
+            if total > _fetch_chunk():
                 return ("stream", (meta, total, sizes))
             data = self.w.store.read_range(object_id, 0, total)
             bufs, off = [], 0
@@ -869,7 +903,7 @@ class WorkerHandler:
     def fetch_object_range(self, object_id: str, start: int,
                            size: int) -> bytes:
         return self.w.store.read_range(object_id, start,
-                                       min(size, FETCH_CHUNK))
+                                       min(size, _fetch_chunk()))
 
     def resolve_object(self, object_id: str,
                        machine_id: Optional[str] = None):

@@ -44,7 +44,9 @@ def main() -> None:
     # restarted conductor (persistence story; the reconnecting client
     # re-dials underneath). Exit only after a sustained outage: the
     # cluster is then really gone.
-    grace = float(os.environ.get("RAY_TPU_WORKER_ORPHAN_GRACE", "30"))
+    from .config import config
+
+    grace = config.worker_orphan_grace
     last_ok = time.monotonic()
     while True:
         time.sleep(5.0)

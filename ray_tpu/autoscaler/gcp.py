@@ -1,0 +1,161 @@
+"""GCE TPU-VM NodeProvider: provisions real TPU slices behind the
+autoscaler (reference python/ray/autoscaler/_private/gcp/node_provider.py
++ the TPU-pod support in gcp/config.py).
+
+Design: a "node" is one TPU VM (single-host slice like v5litepod-8) or
+pod slice; creation goes through the Cloud TPU REST API
+(tpu.googleapis.com/v2). The booted VM joins the cluster itself via its
+startup script (`python -m ray_tpu start --address <head>`), so the
+provider never registers accounting entries — node identity flows
+VM -> NodeAgent -> conductor.
+
+The HTTP layer is injectable: unit tests run the full lifecycle against
+a canned transport, and zero-egress environments never dial out."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from . import NodeProvider
+
+TPU_API = "https://tpu.googleapis.com/v2"
+
+# acceleratorType -> chips per host-VM (reference accelerators/tpu.py
+# TPU_*_CHIPS tables; v2-v4 hosts expose 4 chips, v5e/v5p vary by slice)
+_CHIPS = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 8, "v5p": 4, "v6e": 8}
+
+
+def accelerator_chips(accelerator_type: str) -> int:
+    """Chips a slice of `accelerator_type` (e.g. "v5litepod-8", "v4-16")
+    exposes as schedulable TPU resources."""
+    gen, _, count = accelerator_type.partition("-")
+    try:
+        return int(count)
+    except ValueError:
+        return _CHIPS.get(gen, 4)
+
+
+def _metadata_token() -> str:
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token",
+        headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())["access_token"]
+
+
+def _default_http(token_fn: Callable[[], str]):
+    def http(method: str, url: str,
+             body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method, headers={
+            "Authorization": f"Bearer {token_fn()}",
+            "Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            payload = r.read()
+            return json.loads(payload) if payload else {}
+    return http
+
+
+class GcpTpuNodeProvider(NodeProvider):
+    """Cloud TPU slices as autoscaler nodes.
+
+    node_config (per node type, passed at construction) supports:
+      accelerator_type   e.g. "v5litepod-8" (required)
+      runtime_version    e.g. "v2-alpha-tpuv5-lite" (required)
+      startup_script     shell run on boot; defaults to joining the head
+      network / subnetwork / service_account / labels  passthrough
+    """
+
+    def __init__(self, project: str, zone: str, cluster_name: str,
+                 head_address: str,
+                 node_configs: Dict[str, Dict[str, Any]],
+                 http: Optional[Callable] = None,
+                 token_fn: Optional[Callable[[], str]] = None):
+        self.project = project
+        self.zone = zone
+        self.cluster_name = cluster_name
+        self.head_address = head_address
+        self.node_configs = dict(node_configs)
+        self._http = http or _default_http(token_fn or _metadata_token)
+
+    # ------------------------------------------------------------ helpers
+
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _node_url(self, node_id: str) -> str:
+        return f"{TPU_API}/{self._parent}/nodes/{node_id}"
+
+    def _startup_script(self, cfg: Dict[str, Any], chips: int) -> str:
+        return cfg.get("startup_script") or (
+            "#! /bin/bash\n"
+            f"python3 -m ray_tpu start --address {self.head_address} "
+            f"--resources '{{\"TPU\": {chips}}}'\n")
+
+    # ----------------------------------------------------- NodeProvider API
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> str:
+        cfg = self.node_configs[node_type]
+        chips = int(resources.get("TPU") or
+                    accelerator_chips(cfg["accelerator_type"]))
+        node_id = f"ray-tpu-{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+        body = {
+            "acceleratorType": cfg["accelerator_type"],
+            "runtimeVersion": cfg["runtime_version"],
+            "networkConfig": {
+                "network": cfg.get("network", "default"),
+                "subnetwork": cfg.get("subnetwork", "default"),
+                "enableExternalIps": bool(cfg.get("external_ips", False)),
+            },
+            "metadata": {
+                "startup-script": self._startup_script(cfg, chips),
+            },
+            "labels": dict(cfg.get("labels") or {},
+                           **{"ray-cluster": self.cluster_name,
+                              "ray-node-type": node_type}),
+        }
+        if cfg.get("service_account"):
+            body["serviceAccount"] = {"email": cfg["service_account"]}
+        self._http("POST",
+                   f"{TPU_API}/{self._parent}/nodes?nodeId={node_id}", body)
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self._http("DELETE", self._node_url(node_id))
+
+    def non_terminated_nodes(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        resp = self._http("GET", f"{TPU_API}/{self._parent}/nodes")
+        for node in resp.get("nodes", []):
+            labels = node.get("labels") or {}
+            if labels.get("ray-cluster") != self.cluster_name:
+                continue
+            if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
+            chips = accelerator_chips(node.get("acceleratorType", ""))
+            out.append({
+                "node_id": node["name"].rsplit("/", 1)[-1],
+                "node_type": labels.get("ray-node-type", "tpu"),
+                "resources": {"TPU": float(chips)},
+                "state": node.get("state"),
+            })
+        return out
+
+    # ------------------------------------------------------------ extras
+
+    def wait_ready(self, node_id: str, timeout: float = 600.0,
+                   poll_s: float = 5.0) -> bool:
+        """Block until a slice reports READY (TPU creation is minutes)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            node = self._http("GET", self._node_url(node_id))
+            if node.get("state") == "READY":
+                return True
+            time.sleep(poll_s)
+        return False

@@ -1,0 +1,221 @@
+"""Web dashboard: cluster state over HTTP + a single-file SPA.
+
+Replaces the reference's `dashboard/` subsystem (aiohttp head + per-node
+agents + React SPA, dashboard/dashboard.py, dashboard/client/) with one
+aiohttp server beside the conductor. There are no per-node dashboard
+agents to aggregate: the conductor is already the single authority for
+nodes/workers/actors/jobs, and per-worker object stats are one RPC away.
+
+Routes:
+  /                      the SPA (ray_tpu/dashboard/index.html)
+  /api/summary           cluster overview (nodes + resources + counts)
+  /api/nodes|workers|actors|placement_groups|jobs
+  /api/objects           per-process object store stats (fan-out)
+  /api/tasks             task-name summary table
+  /api/timeline          chrome-trace JSON of task events
+  /api/metrics           Prometheus exposition (text)
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.rpc import ClientPool, ReconnectingClient
+
+DEFAULT_DASHBOARD_PORT = 8265
+
+
+class _ClusterData:
+    """Blocking conductor/worker queries (called via run_in_executor)."""
+
+    def __init__(self, conductor_address: Tuple[str, int]):
+        self.conductor = ReconnectingClient(conductor_address)
+        self.pool = ClientPool()
+
+    def summary(self) -> Dict[str, Any]:
+        c = self.conductor
+        return {
+            "timestamp": time.time(),
+            "address": list(self.conductor.address),
+            "nodes": c.call("nodes", timeout=5.0),
+            "resources_total": c.call("cluster_resources", timeout=5.0),
+            "resources_available": c.call("available_resources", timeout=5.0),
+            "num_workers": len(c.call("list_workers", timeout=5.0)),
+            "num_actors": len(c.call("list_actors", timeout=5.0)),
+        }
+
+    def simple(self, method: str) -> Any:
+        return self.conductor.call(method, timeout=10.0)
+
+    def objects(self) -> List[Dict[str, Any]]:
+        out = []
+        for rec in self.conductor.call("list_workers", timeout=5.0):
+            addr = rec.get("address")
+            if not addr or rec.get("state") == "DEAD":
+                continue
+            try:
+                out.append(self.pool.get(tuple(addr)).call("store_stats",
+                                                           timeout=3.0))
+            except Exception:  # noqa: BLE001 — worker mid-restart
+                pass
+        return out
+
+    def tasks_summary(self) -> List[Dict[str, Any]]:
+        events = self.conductor.call("get_task_events", 10_000, timeout=10.0)
+        groups: Dict[str, Dict[str, Any]] = defaultdict(
+            lambda: {"count": 0, "failed": 0, "total_s": 0.0})
+        for ev in events:
+            g = groups[ev["name"]]
+            g["count"] += 1
+            g["failed"] += 1 if ev.get("status") == "FAILED" else 0
+            g["total_s"] += max(0.0, ev["end"] - ev["start"])
+        return [dict(name=k, mean_s=v["total_s"] / max(1, v["count"]), **v)
+                for k, v in sorted(groups.items())]
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        events = self.conductor.call("get_task_events", 10_000, timeout=10.0)
+        out = []
+        for ev in events:
+            worker = ev.get("worker")
+            out.append({
+                "name": ev["name"], "cat": "task", "ph": "X",
+                "ts": ev["start"] * 1e6,
+                "dur": max(0.0, ev["end"] - ev["start"]) * 1e6,
+                "pid": ev.get("job_id", "job"),
+                "tid": f"{worker[0]}:{worker[1]}" if worker else "driver",
+                "args": {"task_id": ev["task_id"],
+                         "status": ev.get("status", "FINISHED")}})
+        return out
+
+    def metrics_text(self) -> str:
+        from ray_tpu.util.state import _render_prometheus
+
+        return _render_prometheus(self.conductor.call("get_metrics",
+                                                      timeout=5.0))
+
+
+class DashboardServer:
+    """aiohttp app on its own thread+loop — works beside a blocking
+    conductor (in-process head) or standalone via `ray_tpu dashboard`."""
+
+    def __init__(self, conductor_address: Tuple[str, int],
+                 host: str = "127.0.0.1",
+                 port: int = DEFAULT_DASHBOARD_PORT):
+        self.data = _ClusterData(tuple(conductor_address))
+        self.host, self.port = host, port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="dashboard", daemon=True)
+
+    # ------------------------------------------------------------ handlers
+
+    async def _call(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args)
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "index.html")
+        return web.FileResponse(path)
+
+    def _json_route(self, fn):
+        from aiohttp import web
+
+        async def handler(request):
+            try:
+                return web.json_response(await self._call(fn))
+            except Exception as e:  # noqa: BLE001 — surface, don't 500-html
+                return web.json_response({"error": str(e)}, status=503)
+        return handler
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        text = await self._call(self.data.metrics_text)
+        return web.Response(text=text,
+                            content_type="text/plain", charset="utf-8")
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _make_app(self):
+        from aiohttp import web
+
+        d = self.data
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/summary", self._json_route(d.summary))
+        for name, method in [("nodes", "nodes"),
+                             ("workers", "list_workers"),
+                             ("actors", "list_actors"),
+                             ("placement_groups", "list_placement_groups"),
+                             ("jobs", "list_jobs")]:
+            app.router.add_get(
+                f"/api/{name}",
+                self._json_route(lambda m=method: d.simple(m)))
+        app.router.add_get("/api/objects", self._json_route(d.objects))
+        app.router.add_get("/api/tasks", self._json_route(d.tasks_summary))
+        app.router.add_get("/api/timeline", self._json_route(d.timeline))
+        app.router.add_get("/api/metrics", self._metrics)
+        return app
+
+    def _run(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        runner = web.AppRunner(self._make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        # port 0 -> discover the bound port
+        for s in site._server.sockets:  # noqa: SLF001 — aiohttp API gap
+            self.port = s.getsockname()[1]
+            break
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+    def start(self, timeout: float = 10.0) -> "DashboardServer":
+        self._thread.start()
+        if not self._started.wait(timeout):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5.0)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="ray_tpu web dashboard")
+    ap.add_argument("--address", required=True, help="conductor host:port")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=DEFAULT_DASHBOARD_PORT)
+    args = ap.parse_args(argv)
+    host, port = args.address.rsplit(":", 1)
+    srv = DashboardServer((host, int(port)), host=args.host,
+                          port=args.port).start()
+    print(f"dashboard at {srv.url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()

@@ -67,6 +67,16 @@ def cmd_start(args) -> None:
     c = Conductor(resources, session_dir, host=args.host,
                   port=args.port).start()
     host, port = c.address
+    dash = None
+    if not args.no_dashboard:
+        try:
+            from ray_tpu.dashboard import DashboardServer
+
+            dash = DashboardServer((host, port), host=args.host,
+                                   port=args.dashboard_port).start()
+            print(f"dashboard at {dash.url}", flush=True)
+        except Exception as e:  # noqa: BLE001 — aiohttp/port problems
+            print(f"dashboard not started: {e}", flush=True)
     os.makedirs(os.path.dirname(_ADDR_FILE), exist_ok=True)
     with open(_ADDR_FILE, "w") as f:
         f.write(f"{host}:{port}")
@@ -82,6 +92,8 @@ def cmd_start(args) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if dash is not None:
+            dash.stop()
         c.stop()
 
 
@@ -150,6 +162,26 @@ def cmd_metrics(args) -> None:
     sys.stdout.write(state.prometheus_metrics())
 
 
+def cmd_dashboard(args) -> None:
+    from ray_tpu.dashboard import main as dash_main
+
+    dash_main(["--address", _resolve_address(args.address),
+               "--host", args.host, "--port", str(args.port)])
+
+
+def cmd_config(args) -> None:
+    """Print the flag table (ray_config_def.h analog) with live values."""
+    from ray_tpu._private.config import config
+
+    rows = config.describe()
+    w = max(len(r["env_var"]) for r in rows)
+    for r in rows:
+        mark = "*" if r["source"] == "env" else " "
+        print(f"{mark} {r['env_var']:<{w}}  {r['type']:<5} "
+              f"= {r['value']!r:<14} {r['doc']}")
+    print("\n(* = overridden via environment / _system_config)")
+
+
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -195,7 +227,16 @@ def main(argv=None) -> None:
     sp.add_argument("--resources", help='extra resources as JSON, e.g. '
                     '\'{"TPU": 4}\'')
     sp.add_argument("--block", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.add_argument("--no-dashboard", action="store_true")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("dashboard", help="serve the web dashboard for a "
+                        "running cluster")
+    sp.add_argument("--address", help="conductor host:port")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     for name, fn in [("stop", cmd_stop), ("status", cmd_status),
                      ("summary", cmd_summary), ("memory", cmd_memory),
@@ -203,6 +244,9 @@ def main(argv=None) -> None:
         sp = sub.add_parser(name)
         sp.add_argument("--address")
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("config", help="show the runtime flag table")
+    sp.set_defaults(fn=cmd_config)
 
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument("kind", choices=["nodes", "workers", "actors", "tasks",

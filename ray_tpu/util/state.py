@@ -121,6 +121,12 @@ def prometheus_metrics() -> str:
     are grouped per metric family (HELP/TYPE once, then ALL of the family's
     series contiguously, across workers) as strict parsers require."""
     per_worker = _conductor().conductor.call("get_metrics", timeout=10.0)
+    return _render_prometheus(per_worker)
+
+
+def _render_prometheus(per_worker: Dict[str, Any]) -> str:
+    """Pure renderer over the conductor's per-worker snapshots (shared
+    with the dashboard, which has no global_worker)."""
     # family name -> list of (worker_id, snapshot dict)
     families: Dict[str, List[Any]] = {}
     for worker_id, snapshot in sorted(per_worker.items()):

@@ -1,0 +1,61 @@
+"""Config flag table — analog of the reference's ray_config_def.h /
+RayConfig singleton + ray.init(_system_config=...)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import RayTpuConfig, config
+
+
+def test_defaults_and_env_resolution(monkeypatch):
+    assert config.get("node_timeout") == 10.0
+    monkeypatch.setenv("RAY_TPU_NODE_TIMEOUT", "3.5")
+    assert config.get("node_timeout") == 3.5
+    assert config.node_timeout == 3.5  # attribute sugar
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(KeyError):
+        config.get("not_a_flag")
+    with pytest.raises(ValueError):
+        config.apply({"not_a_flag": 1})
+
+
+def test_apply_exports_env(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_FETCH_CHUNK", raising=False)
+    cfg = RayTpuConfig()
+    cfg.apply({"fetch_chunk": 12345})
+    import os
+
+    assert os.environ["RAY_TPU_FETCH_CHUNK"] == "12345"
+    assert cfg.get("fetch_chunk") == 12345
+    monkeypatch.delenv("RAY_TPU_FETCH_CHUNK", raising=False)
+
+
+def test_describe_lists_all_flags(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CHIPS", "4")
+    rows = {r["name"]: r for r in config.describe()}
+    assert rows["chips"]["value"] == 4
+    assert rows["chips"]["source"] == "env"
+    assert rows["object_store_cap"]["source"] == "default"
+    assert all(r["doc"] for r in rows.values())
+
+
+def test_system_config_reaches_the_runtime(monkeypatch):
+    """An object-store override handed to init() must actually govern the
+    store: a tiny cap forces spilling on a value that fits comfortably in
+    the default 2GB cap."""
+    monkeypatch.delenv("RAY_TPU_OBJECT_STORE_CAP", raising=False)
+    ray_tpu.init(num_cpus=1, _system_config={"object_store_cap": 256 * 1024})
+    try:
+        w = ray_tpu._private.worker.global_worker
+        refs = [ray_tpu.put(np.zeros(64 * 1024, dtype=np.uint8))
+                for _ in range(8)]  # 512KB total > 256KB cap
+        assert w.store.stats()["spilled_objects"] > 0
+        for r in refs:
+            assert ray_tpu.get(r, timeout=30.0).nbytes == 64 * 1024
+    finally:
+        ray_tpu.shutdown()
+        monkeypatch.delenv("RAY_TPU_OBJECT_STORE_CAP", raising=False)

@@ -1,0 +1,87 @@
+"""Dashboard: HTTP state endpoints + SPA serving (reference dashboard/
+head, dashboard/dashboard.py; the React SPA's role is played by one
+self-contained index.html)."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import DashboardServer
+
+
+@pytest.fixture
+def dashboard():
+    ray_tpu.init(num_cpus=2)
+    w = ray_tpu._private.worker.global_worker
+    srv = DashboardServer(w.conductor_address, port=0).start()
+    yield srv
+    srv.stop()
+    ray_tpu.shutdown()
+
+
+def _get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = r.read()
+        return r.status, r.headers.get_content_type(), body
+
+
+def test_spa_and_summary(dashboard):
+    status, ctype, body = _get(dashboard.url + "/")
+    assert status == 200 and ctype == "text/html"
+    assert b"ray_tpu" in body and b"/api/summary" in body
+
+    status, ctype, body = _get(dashboard.url + "/api/summary")
+    assert status == 200 and ctype == "application/json"
+    s = json.loads(body)
+    assert s["resources_total"]["CPU"] == 2.0
+    assert len(s["nodes"]) == 1 and s["nodes"][0]["alive"]
+
+
+def test_entity_endpoints_reflect_cluster(dashboard):
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    assert ray_tpu.get([work.remote(i) for i in range(4)],
+                       timeout=60.0) == [0, 2, 4, 6]
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="dash-actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60.0) == "pong"
+
+    _, _, body = _get(dashboard.url + "/api/actors")
+    actors = json.loads(body)
+    assert any(r["name"] == "dash-actor" and r["state"] == "ALIVE"
+               for r in actors)
+
+    _, _, body = _get(dashboard.url + "/api/workers")
+    assert len(json.loads(body)) >= 1
+
+    # task events reach the conductor in periodic batches — poll
+    deadline = time.monotonic() + 15.0
+    tasks = []
+    while time.monotonic() < deadline:
+        _, _, body = _get(dashboard.url + "/api/tasks")
+        tasks = json.loads(body)
+        if any(t["name"] == "work" and t["count"] == 4 for t in tasks):
+            break
+        time.sleep(0.3)
+    assert any(t["name"] == "work" and t["count"] == 4 for t in tasks), tasks
+
+    _, _, body = _get(dashboard.url + "/api/objects")
+    assert isinstance(json.loads(body), list)
+
+    _, _, body = _get(dashboard.url + "/api/timeline")
+    trace = json.loads(body)
+    assert any(ev["name"] == "work" for ev in trace)
+
+    status, ctype, _ = _get(dashboard.url + "/api/metrics")
+    assert status == 200 and ctype == "text/plain"

@@ -1,0 +1,104 @@
+"""GCE TPU NodeProvider against a canned transport (the reference tests
+its GCP provider the same way — mocked discovery clients,
+python/ray/tests/test_autoscaler_yaml.py + gcp fixtures)."""
+from __future__ import annotations
+
+import pytest
+
+from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider, accelerator_chips
+
+
+class FakeTpuApi:
+    """Minimal Cloud TPU v2 REST double: POST creates, GET lists/gets,
+    DELETE removes."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.calls = []
+
+    def __call__(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        if method == "POST":
+            node_id = url.rsplit("nodeId=", 1)[-1]
+            self.nodes[node_id] = dict(
+                body, name=f"{url.split('?')[0]}/{node_id}",
+                state="CREATING")
+            return {"name": f"operations/op-{node_id}"}
+        if method == "DELETE":
+            self.nodes.pop(url.rsplit("/", 1)[-1], None)
+            return {}
+        if url.endswith("/nodes"):
+            return {"nodes": list(self.nodes.values())}
+        node = self.nodes.get(url.rsplit("/", 1)[-1])
+        return node or {}
+
+
+@pytest.fixture
+def provider():
+    api = FakeTpuApi()
+    p = GcpTpuNodeProvider(
+        project="proj", zone="us-central2-b", cluster_name="c1",
+        head_address="10.0.0.2:6379",
+        node_configs={"v5e_8": {"accelerator_type": "v5litepod-8",
+                                "runtime_version": "v2-alpha-tpuv5-lite"}},
+        http=api)
+    return p, api
+
+
+def test_accelerator_chip_table():
+    assert accelerator_chips("v5litepod-8") == 8
+    assert accelerator_chips("v4-16") == 16
+    assert accelerator_chips("v5litepod") == 8
+    assert accelerator_chips("v3") == 4
+
+
+def test_create_lists_and_terminate(provider):
+    p, api = provider
+    nid = p.create_node("v5e_8", {"TPU": 8})
+    assert nid.startswith("ray-tpu-c1-")
+    nodes = p.non_terminated_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["node_id"] == nid
+    assert nodes[0]["node_type"] == "v5e_8"
+    assert nodes[0]["resources"] == {"TPU": 8.0}
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_create_request_shape(provider):
+    p, api = provider
+    p.create_node("v5e_8", {"TPU": 8})
+    method, url, body = api.calls[0]
+    assert method == "POST"
+    assert "projects/proj/locations/us-central2-b/nodes" in url
+    assert body["acceleratorType"] == "v5litepod-8"
+    assert body["runtimeVersion"] == "v2-alpha-tpuv5-lite"
+    assert body["labels"]["ray-cluster"] == "c1"
+    # the booted VM must join the head on its own
+    script = body["metadata"]["startup-script"]
+    assert "ray_tpu start --address 10.0.0.2:6379" in script
+    assert '"TPU": 8' in script
+
+
+def test_other_clusters_filtered_out(provider):
+    p, api = provider
+    p.create_node("v5e_8", {"TPU": 8})
+    # a foreign node in the same zone
+    api.nodes["other"] = {"name": ".../other", "state": "READY",
+                          "acceleratorType": "v4-8",
+                          "labels": {"ray-cluster": "someone-else"}}
+    assert len(p.non_terminated_nodes()) == 1
+
+
+def test_terminated_states_filtered(provider):
+    p, api = provider
+    nid = p.create_node("v5e_8", {"TPU": 8})
+    api.nodes[nid]["state"] = "DELETING"
+    assert p.non_terminated_nodes() == []
+
+
+def test_wait_ready(provider):
+    p, api = provider
+    nid = p.create_node("v5e_8", {"TPU": 8})
+    api.nodes[nid]["state"] = "READY"
+    assert p.wait_ready(nid, timeout=1.0, poll_s=0.01)

@@ -92,7 +92,6 @@ def forced_remote_cluster(monkeypatch):
     monkeypatch.setenv("RAY_TPU_FETCH_CHUNK", str(256 * 1024))
     import ray_tpu._private.worker as wm
 
-    monkeypatch.setattr(wm, "FETCH_CHUNK", 256 * 1024)
     monkeypatch.setattr(wm, "_MACHINE_ID", wm._compute_machine_id())
     info = ray_tpu.init(num_cpus=2)
     yield info
@@ -107,10 +106,19 @@ def test_cross_host_chunked_fetch(forced_remote_cluster):
         rng = np.random.default_rng(7)
         return rng.integers(0, 255, size=3 * 1024 * 1024, dtype=np.uint8)
 
-    got = ray_tpu.get(big.remote(), timeout=120.0)
+    ref = big.remote()
+    got = ray_tpu.get(ref, timeout=120.0)
     want = np.random.default_rng(7).integers(
         0, 255, size=3 * 1024 * 1024, dtype=np.uint8)
     np.testing.assert_array_equal(got, want)
+    # PROVE the value rode the stream path: a cross-host result must not
+    # arrive as a shm-name handoff (r1 review: the old test silently took
+    # the shm path and never exercised chunking)
+    w = ray_tpu._private.worker.global_worker
+    entry = w.store._entries[ref.id]
+    assert entry.shm_name is None, \
+        "cross-host fetch still used a shm handoff"
+    assert entry.buffers is not None
 
 
 def test_cross_host_small_inline(forced_remote_cluster):

@@ -1,0 +1,133 @@
+"""Tracing: span recording, W3C propagation across task/actor hops, and
+chrome-trace/OTLP export (reference util/tracing/tracing_helper.py)."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def clean_tracing(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_TRACING", raising=False)
+    tracing._enabled = False
+    tracing._finished.clear()
+    yield
+    tracing._enabled = False
+    tracing._finished.clear()
+
+
+def test_disabled_is_free():
+    with tracing.span("noop") as s:
+        assert s is None
+    assert tracing.drain() == []
+
+
+def test_span_nesting_and_drain():
+    tracing.enable()
+    with tracing.span("outer", job="j1") as outer:
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = tracing.drain()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    assert spans[1]["attrs"] == {"job": "j1"}
+    assert all(s["end"] >= s["start"] for s in spans)
+    assert tracing.drain() == []
+
+
+def test_error_status_recorded():
+    tracing.enable()
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("x")
+    (s,) = tracing.drain()
+    assert s["status"] == "ERROR: ValueError"
+
+
+def test_traceparent_roundtrip():
+    tracing.enable()
+    with tracing.span("parent") as p:
+        tp = tracing.current_traceparent()
+        assert tp == p.traceparent()
+    # a "remote" span built from the wire value joins the same trace
+    with tracing.span("remote-child", traceparent=tp) as c:
+        assert c.trace_id == p.trace_id
+        assert c.parent_id == p.span_id
+
+
+def test_exports():
+    tracing.enable()
+    with tracing.span("work", k="v"):
+        time.sleep(0.01)
+    spans = tracing.drain()
+    trace = tracing.to_chrome_trace(spans)
+    assert trace[0]["name"] == "work" and trace[0]["ph"] == "X"
+    assert trace[0]["dur"] > 0
+    otlp = tracing.to_otlp_json(spans)
+    os_spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert os_spans[0]["name"] == "work"
+    assert os_spans[0]["status"]["code"] == 1
+
+
+@pytest.fixture
+def traced_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    tracing._enabled = True
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_spans_cross_task_boundary(traced_cluster):
+    """The driver's span parents the worker-side task span; both land in
+    the conductor's span table."""
+    @ray_tpu.remote
+    def traced_work(x):
+        return x + 1
+
+    with tracing.span("driver-section") as root:
+        assert ray_tpu.get(traced_work.remote(1), timeout=60.0) == 2
+
+    w = ray_tpu._private.worker.global_worker
+    deadline = time.monotonic() + 15.0
+    spans = []
+    while time.monotonic() < deadline:
+        spans = w.conductor.call("get_spans", timeout=10.0)
+        names = {s["name"] for s in spans}
+        if "task:traced_work" in names and "driver-section" in names:
+            break
+        time.sleep(0.3)
+    by_name = {s["name"]: s for s in spans}
+    assert "task:traced_work" in by_name, spans
+    task_span = by_name["task:traced_work"]
+    driver_span = by_name["driver-section"]
+    assert task_span["trace_id"] == driver_span["trace_id"]
+    assert task_span["parent_id"] == driver_span["span_id"]
+
+
+def test_spans_cross_actor_boundary(traced_cluster):
+    @ray_tpu.remote
+    class T:
+        def m(self):
+            return 1
+
+    a = T.remote()
+    with tracing.span("actor-call-site") as root:
+        assert ray_tpu.get(a.m.remote(), timeout=60.0) == 1
+
+    w = ray_tpu._private.worker.global_worker
+    deadline = time.monotonic() + 15.0
+    by_name = {}
+    while time.monotonic() < deadline:
+        by_name = {s["name"]: s
+                   for s in w.conductor.call("get_spans", timeout=10.0)}
+        if "actor:T.m" in by_name:
+            break
+        time.sleep(0.3)
+    assert "actor:T.m" in by_name
+    assert by_name["actor:T.m"]["trace_id"] == \
+        by_name["actor-call-site"]["trace_id"]
