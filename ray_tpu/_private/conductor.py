@@ -133,6 +133,7 @@ class ConductorHandler:
         self._kv: Dict[str, Dict[bytes, bytes]] = {}
         self._subs: Dict[str, List[Tuple[str, int]]] = {}  # channel -> addrs
         self._task_events: List[Dict[str, Any]] = []
+        self._spans: List[Dict[str, Any]] = []  # tracing span table
         self._session_dir = session_dir
         self._worker_env = dict(worker_env or {})
         self._clients = ClientPool()
@@ -169,12 +170,19 @@ class ConductorHandler:
         accounting-only node served by the head's worker pool (autoscaler
         test double, reference FakeMultiNodeProvider)."""
         with self._cv:
+            # chips already announced by surviving workers of this node
+            # (conductor-restart path: a worker's heartbeat may precede
+            # its node agent's re-register) must not return to the pool
+            bound = {c for w in self._workers.values()
+                     if w.node_id == node_id and w.state != "DEAD"
+                     for c in (w.chip_ids or ())}
             self._nodes[node_id] = NodeRecord(
                 node_id=node_id, total=dict(resources),
                 available=dict(resources),
                 address=tuple(address) if address else None,
                 last_heartbeat=time.monotonic(),
-                free_chips=list(range(int(resources.get("TPU", 0)))))
+                free_chips=[c for c in range(int(resources.get("TPU", 0)))
+                            if c not in bound])
             self._cv.notify_all()
 
     def node_heartbeat(self, node_id: str,
@@ -245,6 +253,60 @@ class ConductorHandler:
             n.free_chips.extend(w.chip_ids)
         w.chip_ids = None
 
+    def _reclaim_chips_after_exit(self, w: WorkerRecord) -> None:
+        """Terminate `w` and return its chips to the node pool only once
+        the process is confirmed gone (reaped locally, or its RPC port
+        stopped answering remotely). Immediate _free_worker_chips here
+        would let a successor bind the same TPU_VISIBLE_CHIPS while the
+        old owner's TPU runtime still holds the devices."""
+        def confirmed_gone() -> bool:
+            if w.proc is not None:
+                try:
+                    if w.proc.poll() is None:
+                        w.proc.terminate()
+                        try:
+                            w.proc.wait(timeout=8.0)
+                        except subprocess.TimeoutExpired:
+                            w.proc.kill()
+                            w.proc.wait(timeout=8.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+                return w.proc.poll() is not None
+            if w.address:
+                addr = tuple(w.address)
+                try:
+                    self._clients.get(addr).call("shutdown_worker",
+                                                 timeout=5.0)
+                except Exception:  # noqa: BLE001 — may already be gone
+                    pass
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    try:
+                        self._clients.get(addr).call("ping", timeout=1.0)
+                    except Exception:  # noqa: BLE001 — port closed
+                        return True
+                    time.sleep(0.2)
+                return False
+            return True  # no process handle and no address: nothing runs
+
+        def reap():
+            # Free the chips ONLY once the owner is verifiably gone. A
+            # wedged worker (e.g. stuck in a native call) keeps its chips
+            # parked — leaked capacity beats a libtpu double-bind. Keep
+            # retrying with backoff; most stragglers exit eventually.
+            backoff = 1.0
+            while not self._stopped:
+                if confirmed_gone():
+                    with self._cv:
+                        self._free_worker_chips(w)
+                        self._cv.notify_all()
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+        threading.Thread(target=reap, daemon=True,
+                         name="chip-reaper").start()
+
     def cluster_resources(self) -> Dict[str, float]:
         with self._lock:
             out: Dict[str, float] = {}
@@ -273,9 +335,17 @@ class ConductorHandler:
     # ---------------------------------------------------------------- workers
 
     def register_worker(self, worker_id: str, address: Tuple[str, int],
-                        pid: int, node_id: Optional[str] = None) -> None:
+                        pid: int, node_id: Optional[str] = None,
+                        chip_ids: Optional[Tuple[int, ...]] = None) -> bool:
+        """Returns False to tell the worker to shut itself down (its chip
+        binding conflicts with the conductor's post-restart view)."""
         with self._cv:
             w = self._workers.get(worker_id)
+            if w is not None and w.state == "DEAD":
+                # a worker we already wrote off (e.g. chips reclaimed)
+                # re-announcing after a partition: it must not run — its
+                # chips may already be bound elsewhere
+                return False
             if w is None:
                 w = WorkerRecord(worker_id=worker_id,
                                  node_id=node_id or self._head_node_id)
@@ -285,9 +355,30 @@ class ConductorHandler:
             w.address = tuple(address)
             w.pid = pid
             w.restored_at = None  # liveness confirmed
+            if chip_ids and not w.chip_ids:
+                # A surviving chip worker re-announcing to a restarted
+                # conductor (which reinitialized free_chips to the full
+                # range): its TPU runtime still owns those devices, so
+                # subtract them from the pool. If another live worker was
+                # already bound to any of them meanwhile, the survivor
+                # must die — libtpu is single-client per chip.
+                chips = tuple(int(c) for c in chip_ids)
+                n = self._nodes.get(w.node_id)
+                taken = {c for rec in self._workers.values()
+                         if rec is not w and rec.state != "DEAD"
+                         for c in (rec.chip_ids or ())}
+                if taken & set(chips):
+                    w.state = "DEAD"
+                    self._cv.notify_all()
+                    return False
+                if n is not None:
+                    n.free_chips = [c for c in n.free_chips
+                                    if c not in chips]
+                w.chip_ids = chips
             if w.state == "STARTING":
                 w.state = "IDLE"
             self._cv.notify_all()
+            return True
 
     def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
                       node: Optional[NodeRecord] = None) -> WorkerRecord:
@@ -440,25 +531,24 @@ class ConductorHandler:
 
         def try_spawn_chip_worker() -> bool:
             if len(pool.free_chips) < n_chips:
-                # reclaim chips bound to idle workers of other counts
+                # Reclaim chips bound to idle workers of other counts.
+                # Chips return to the pool only AFTER the old process has
+                # verifiably exited (_reclaim_chips_after_exit): libtpu is
+                # single-client per chip, so a successor spawned while the
+                # old owner is still dying fails TPU init. The lease loop
+                # cv-waits; the reaper's notify retries the spawn.
+                prospective = len(pool.free_chips) + sum(
+                    len(w.chip_ids or ()) for w in self._workers.values()
+                    if w.state == "DEAD" and w.node_id == pool_id
+                    and w.chip_ids)  # reclaims already in flight
                 for w in list(self._workers.values()):
+                    if prospective >= n_chips:
+                        break
                     if w.state == "IDLE" and w.node_id == pool_id and \
                             w.chip_ids and len(w.chip_ids) != n_chips:
                         w.state = "DEAD"
-                        self._free_worker_chips(w)
-                        if w.proc is not None and w.proc.poll() is None:
-                            try:
-                                w.proc.terminate()
-                            except OSError:
-                                pass
-                        elif w.address:  # agent-node worker: remote pid
-                            addr = w.address
-                            threading.Thread(
-                                target=lambda a=addr: self._clients.get(a)
-                                .call("shutdown_worker", timeout=5.0),
-                                daemon=True).start()
-                        if len(pool.free_chips) >= n_chips:
-                            break
+                        prospective += len(w.chip_ids)
+                        self._reclaim_chips_after_exit(w)
             if len(pool.free_chips) < n_chips:
                 return False
             chips = tuple(sorted(pool.free_chips)[:n_chips])
@@ -776,6 +866,18 @@ class ConductorHandler:
             self._task_events.extend(events)
             if len(self._task_events) > 100_000:
                 del self._task_events[:len(self._task_events) - 100_000]
+
+    def report_spans(self, spans: List[Dict[str, Any]]) -> None:
+        """Tracing spans flushed by workers/drivers (reference: GCS task-
+        event store aggregating OTel-style spans; util/tracing.py drain)."""
+        with self._lock:
+            self._spans.extend(spans)
+            if len(self._spans) > 100_000:
+                del self._spans[:len(self._spans) - 100_000]
+
+    def get_spans(self, limit: int = 10_000) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._spans[-limit:]
 
     def get_task_events(self, limit: int = 10_000) -> List[Dict[str, Any]]:
         with self._lock:

@@ -242,6 +242,26 @@ class LocalObjectStore:
             e = self._entries.get(object_id)
             return e is not None and e.ready
 
+    def notify_waiters(self) -> None:
+        """Wake wait_ready()/Worker._wait_result waiters so they re-check
+        out-of-store readiness signals (e.g. a large result recorded as a
+        remote locator — no store entry is ever created for those)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def wait_ready_once(self, object_id: str, timeout: float) -> bool:
+        """One bounded cv wait: True iff an entry for `object_id` is ready.
+        Returns early (False) on any notify_waiters() wake so callers can
+        re-check out-of-store readiness (locators, vanished submitters)
+        without this module knowing about owner-side state."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None and e.ready:
+                return True
+            self._cv.wait(timeout)
+            e = self._entries.get(object_id)
+            return e is not None and e.ready
+
     def wait_ready(self, object_id: str, timeout: Optional[float]) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
@@ -359,12 +379,18 @@ class LocalObjectStore:
             e.arena_offset = None
             self._drain_quarantine()
         if e.shm is not None:
+            # unlink BEFORE close: close() raises BufferError when a
+            # zero-copy deserialized array the user still holds references
+            # the mapping — the name must be released regardless, and the
+            # error must never abort the caller (eviction / delete paths);
+            # the pages live until the last mapping drops.
+            try:
+                e.shm.unlink()
+            except (FileNotFoundError, OSError):
+                pass
             try:
                 e.shm.close()
-                e.shm.unlink()
-            except FileNotFoundError:
-                pass
-            except OSError:
+            except (OSError, BufferError):
                 pass
         if e.spill_path is not None:
             try:
@@ -454,10 +480,15 @@ class LocalObjectStore:
                 (time.monotonic() + self._QUARANTINE_S, e.arena_offset))
             e.arena_offset = None
         if e.shm is not None:
+            # unlink-then-close, tolerating BufferError — see _free_entry;
+            # an exported buffer must never abort a spill under pressure
+            try:
+                e.shm.unlink()
+            except (FileNotFoundError, OSError):
+                pass
             try:
                 e.shm.close()
-                e.shm.unlink()
-            except OSError:
+            except (OSError, BufferError):
                 pass
             e.shm = None
         e.buffers = None

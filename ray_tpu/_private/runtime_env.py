@@ -104,12 +104,18 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
                for k, v in ev.items()):
         raise ValueError("runtime_env['env_vars'] must be Dict[str, str]")
     pip = env.get("pip")
-    if pip is not None and not (
-            isinstance(pip, list)
-            and all(isinstance(s, str) for s in pip)):
-        raise ValueError("runtime_env['pip'] must be List[str] of local "
-                         "wheel/sdist/directory paths or requirement "
-                         "specifiers resolvable offline")
+    if pip is not None:
+        if not (isinstance(pip, list)
+                and all(isinstance(s, str) for s in pip)):
+            raise ValueError("runtime_env['pip'] must be List[str] of local "
+                             "wheel/sdist/directory paths")
+        for s in pip:
+            if not (os.path.isfile(s) or os.path.isdir(s)):
+                raise ValueError(
+                    f"runtime_env['pip'] entry {s!r} is not supported: "
+                    "network installs at task time never happen in "
+                    "ray_tpu (TPU images are baked; zero egress) — pass "
+                    "a local wheel/sdist/directory path instead")
     for key, plugin in _plugins().items():
         if key in env:
             env[key] = plugin.validate(env[key])
@@ -175,8 +181,9 @@ def _prepare_pip(conductor, specs: List[str]) -> Dict[str, Any]:
                            "filename": os.path.basename(s)})
         elif os.path.isdir(s):
             staged.append({"kind": "dir", "uri": package_dir(conductor, s)})
-        else:  # bare requirement: must resolve offline on the worker
-            staged.append({"kind": "req", "spec": s})
+        else:  # validate() rejected bare requirements before this point
+            raise ValueError(f"runtime_env['pip'] entry {s!r} vanished "
+                             "between validation and staging")
     key = hashlib.sha256(repr(staged).encode()).hexdigest()[:24]
     return {"key": key, "specs": staged}
 

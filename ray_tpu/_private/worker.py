@@ -73,6 +73,16 @@ def _compute_machine_id() -> str:
 _MACHINE_ID = _compute_machine_id()
 
 
+def _current_traceparent() -> Optional[str]:
+    """Traceparent of the calling thread's active span, or None when
+    tracing is off (the common case — keep the hot path import-free)."""
+    if os.environ.get("RAY_TPU_TRACING") != "1":
+        return None
+    from ray_tpu.util import tracing
+
+    return tracing.current_traceparent()
+
+
 @dataclass
 class TaskSpec:
     task_id: str
@@ -86,6 +96,10 @@ class TaskSpec:
     owner: Optional[Tuple[str, int]] = None
     placement_group_id: Optional[str] = None
     runtime_env: Optional[Dict[str, Any]] = None  # prepared (URIs staged)
+    # W3C traceparent captured on the SUBMITTING thread (spans are
+    # thread-local; the submit-pool thread that serializes the wire has no
+    # active span) — reference tracing_helper.py propagation-in-TaskSpec
+    traceparent: Optional[str] = None
 
 
 def _top_level_refs(args: tuple, kwargs: dict) -> List[ObjectRef]:
@@ -119,6 +133,11 @@ class Worker:
         self._lineage: Dict[str, TaskSpec] = {}   # object_id -> producing spec
         self._pending_ids: set = set()            # ids awaiting a local result
         self._locators: Dict[str, Tuple[str, int]] = {}  # large-result holders
+        # return_id -> submit-pool Future: the watchdog signal. A future
+        # that is done while its ids are still pending means the submit
+        # thread vanished without recording results — that must surface as
+        # an error, never a silent forever-wait.
+        self._inflight: Dict[str, Future] = {}
         self._state_lock = threading.Lock()
         # per-caller actor-call send ordering: frames must hit the socket in
         # seqno order or the server's reorder buffer can adopt a too-high
@@ -161,12 +180,7 @@ class Worker:
             if self.store.contains(ref.id):
                 return self._load_local(ref)
             if self._is_pending_local(ref.id):
-                rem = None if deadline is None else deadline - time.monotonic()
-                if not self.store.wait_ready(ref.id, rem):
-                    if self.store.contains(ref.id) or \
-                            self._is_pending_local(ref.id):
-                        raise exc.GetTimeoutError(
-                            f"get() timed out waiting for {ref}")
+                self._wait_result(ref.id, deadline)
                 continue
             try:
                 self._fetch(ref, deadline)
@@ -189,6 +203,43 @@ class Worker:
     def _is_pending_local(self, object_id: str) -> bool:
         with self._state_lock:
             return object_id in self._pending_ids
+
+    def _wait_result(self, object_id: str, deadline: Optional[float]) -> None:
+        """Block until the local store holds an entry for `object_id` OR the
+        id is no longer pending (large results are recorded as remote
+        locators, which never create a store entry — waiting on the store cv
+        alone would hang forever; this was a real livelock when a result
+        larger than the store cap came back spilled→locator). Raises
+        GetTimeoutError at `deadline` while still unresolved."""
+        while True:
+            with self._state_lock:
+                pending = object_id in self._pending_ids
+                fut = self._inflight.get(object_id)
+            if not pending:
+                return  # resolved out-of-store (locator) — caller fetches
+            if fut is not None and fut.done():
+                # watchdog: submit thread gone, id still pending — surface
+                # an error rather than wait forever
+                err = None
+                try:
+                    err = fut.exception(timeout=0)
+                except BaseException as e2:  # noqa: BLE001 — incl. Cancelled
+                    err = e2
+                self.store.put_error(object_id, exc.TaskError(
+                    err or SystemError("submit thread exited without "
+                                       "recording results"),
+                    "", "submit-watchdog"))
+                with self._state_lock:
+                    self._pending_ids.discard(object_id)
+                    self._inflight.pop(object_id, None)
+                return
+            rem = None if deadline is None else deadline - time.monotonic()
+            if rem is not None and rem <= 0:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {object_id[:12]}…")
+            if self.store.wait_ready_once(
+                    object_id, 0.2 if rem is None else min(0.2, rem)):
+                return
 
     def _locator_of(self, object_id: str) -> Optional[Tuple[str, int]]:
         with self._state_lock:
@@ -282,8 +333,16 @@ class Worker:
                 self._pending_ids.add(oid)
         for oid in spec.return_ids:
             self.store.invalidate(oid)
-        self._submit_pool.submit(self._submit_and_record, spec)
+        self._register_inflight(
+            spec.return_ids, self._submit_pool.submit(
+                self._submit_and_record, spec))
         return True
+
+    def _register_inflight(self, return_ids: List[str], fut: Future) -> None:
+        with self._state_lock:
+            for oid in return_ids:
+                if oid in self._pending_ids:  # may already have completed
+                    self._inflight[oid] = fut
 
     # -------------------------------------------------------------- wait
 
@@ -361,14 +420,17 @@ class Worker:
             max_retries=max_retries,
             owner=self.address,
             placement_group_id=placement_group_id,
-            runtime_env=runtime_env)
+            runtime_env=runtime_env,
+            traceparent=_current_traceparent())
         refs = [ObjectRef(oid, locator=None, owner=self.address)
                 for oid in return_ids]
         with self._state_lock:
             for oid in return_ids:
                 self._lineage[oid] = spec
                 self._pending_ids.add(oid)
-        self._submit_pool.submit(self._submit_and_record, spec)
+        self._register_inflight(
+            return_ids, self._submit_pool.submit(
+                self._submit_and_record, spec))
         return refs[0] if num_returns == 1 else refs
 
     def _submit_and_record(self, spec: TaskSpec) -> None:
@@ -391,6 +453,8 @@ class Worker:
                 self.store.put_error(oid, err)
             with self._state_lock:
                 self._pending_ids.difference_update(spec.return_ids)
+                for oid in spec.return_ids:
+                    self._inflight.pop(oid, None)
             # infrastructure failures (worker crash, lease failure) must
             # show up in `summary`/`timeline` as FAILED too
             now = time.time()
@@ -427,7 +491,7 @@ class Worker:
                 "fn_bytes": spec.fn_bytes, "args": spec.args,
                 "kwargs": spec.kwargs, "return_ids": spec.return_ids,
                 "owner": spec.owner, "runtime_env": spec.runtime_env,
-                "machine": _MACHINE_ID}
+                "machine": _MACHINE_ID, "traceparent": spec.traceparent}
 
     def _record_results(self, return_ids: List[str], reply: list) -> None:
         for oid, kind, payload in reply:
@@ -440,6 +504,11 @@ class Worker:
                 self._store_fetched(oid, kind, payload)
         with self._state_lock:
             self._pending_ids.difference_update(return_ids)
+            for oid in return_ids:
+                self._inflight.pop(oid, None)
+        # locator-only results create no store entry: wake waiters so
+        # _wait_result re-checks the pending set and moves on to fetch
+        self.store.notify_waiters()
 
     def _wait_dep_ready(self, ref: ObjectRef) -> None:
         """Block until `ref`'s value exists somewhere reachable."""
@@ -480,11 +549,24 @@ class Worker:
                 self.conductor.notify("report_task_events", batch)
             except ConnectionLost:
                 pass
+        if os.environ.get("RAY_TPU_TRACING") == "1":
+            from ray_tpu.util import tracing
+
+            spans = tracing.drain()
+            if spans:
+                try:
+                    self.conductor.notify("report_spans", spans)
+                except ConnectionLost:
+                    pass
 
     def _event_flush_loop(self) -> None:
         while not self._shutdown:
             time.sleep(2.0)
-            self._flush_task_events()
+            # re-check after the sleep: a stale flusher of a torn-down
+            # Worker must not drain the process-global span buffer into
+            # its dead conductor (drops the next cluster's spans)
+            if not self._shutdown:
+                self._flush_task_events()
 
     # ------------------------------------------------------------ execution
 
@@ -501,7 +583,14 @@ class Worker:
             from . import runtime_env as renv
 
             with renv.applied(self.conductor, wire.get("runtime_env")):
-                result = fn(*args, **kwargs)
+                if wire.get("traceparent"):
+                    from ray_tpu.util import tracing
+
+                    with tracing.span(f"task:{name}",
+                                      traceparent=wire["traceparent"]):
+                        result = fn(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             err = exc.TaskError(e, traceback.format_exc(), name)
             return [(oid, "error", err) for oid in wire["return_ids"]]
@@ -581,9 +670,11 @@ class Worker:
                 for oid in return_ids]
         with self._state_lock:
             self._pending_ids.update(return_ids)
-        self._submit_pool.submit(
-            self._actor_call_bg, actor_id, tuple(address), method, args,
-            kwargs, return_ids, seqno, caller_id, max_task_retries)
+        self._register_inflight(
+            return_ids, self._submit_pool.submit(
+                self._actor_call_bg, actor_id, tuple(address), method, args,
+                kwargs, return_ids, seqno, caller_id, max_task_retries,
+                _current_traceparent()))
         return refs[0] if num_returns == 1 else refs
 
     def _await_send_turn(self, caller_id: str, seqno: int) -> None:
@@ -603,7 +694,8 @@ class Worker:
                 self._send_cv.notify_all()
 
     def _actor_call_bg(self, actor_id, address, method, args, kwargs,
-                       return_ids, seqno, caller_id, retries) -> None:
+                       return_ids, seqno, caller_id, retries,
+                       traceparent=None) -> None:
         try:
             while True:
                 pending = client = None
@@ -612,7 +704,8 @@ class Worker:
                     client = self.clients.get(address)
                     pending = client.start_call(
                         "actor_task", actor_id, method, args, kwargs,
-                        return_ids, seqno, caller_id, _MACHINE_ID)
+                        return_ids, seqno, caller_id, _MACHINE_ID,
+                        traceparent)
                 except ConnectionLost:
                     pass
                 finally:
@@ -655,6 +748,8 @@ class Worker:
                 self.store.put_error(oid, err)
             with self._state_lock:
                 self._pending_ids.difference_update(return_ids)
+                for oid in return_ids:
+                    self._inflight.pop(oid, None)
 
     def _wait_actor_restart(self, actor_id: str,
                             timeout: float = 120.0) -> Tuple[str, int]:
@@ -693,15 +788,12 @@ class Worker:
         if self._shutdown:
             return
         self._shutdown = True
-        # flush the tail of the task-event batch so `ray_tpu summary`/
+        # flush the tail of the task-event/span batch so `ray_tpu summary`/
         # `timeline` see short-lived drivers (e.g. submitted jobs)
-        with self._task_events_lock:
-            batch, self._task_events = self._task_events, []
-        if batch:
-            try:
-                self.conductor.notify("report_task_events", batch)
-            except Exception:  # noqa: BLE001 — head may already be gone
-                pass
+        try:
+            self._flush_task_events()
+        except Exception:  # noqa: BLE001 — head may already be gone
+            pass
         self._submit_pool.shutdown(wait=False, cancel_futures=True)
         self.server.stop()
         self.clients.close_all()
@@ -746,13 +838,13 @@ class ActorRuntime:
                          name="actor-dispatch").start()
 
     def submit(self, method, args, kwargs, return_ids, seqno, caller_id,
-               done_cb, caller_machine=None) -> None:
+               done_cb, caller_machine=None, traceparent=None) -> None:
         if seqno < 0:
             # unordered (post-restart retry): skip the reorder buffer —
             # ordering across a restart boundary is best-effort, matching the
             # reference's at-least-once actor-retry semantics.
             self._queue.put((method, args, kwargs, return_ids, done_cb,
-                             caller_machine))
+                             caller_machine, traceparent))
             return
         with self._cv:
             # A fresh runtime (post-restart) may first see a caller mid-stream;
@@ -760,7 +852,7 @@ class ActorRuntime:
             expected = self._next_seqno.setdefault(caller_id, seqno)
             buf = self._reorder.setdefault(caller_id, {})
             buf[seqno] = (method, args, kwargs, return_ids, done_cb,
-                          caller_machine)
+                          caller_machine, traceparent)
             while expected in buf:
                 self._queue.put(buf.pop(expected))
                 expected += 1
@@ -777,7 +869,8 @@ class ActorRuntime:
                 self._exec_pool.submit(self._run_one, item)
 
     def _run_one(self, item) -> None:
-        method, args, kwargs, return_ids, done_cb, caller_machine = item
+        (method, args, kwargs, return_ids, done_cb, caller_machine,
+         traceparent) = item
         try:
             if method == "__ray_tpu_col_init__":
                 # universal hook so create_collective_group works on any
@@ -798,7 +891,15 @@ class ActorRuntime:
             args = tuple(self.worker._materialize(a) for a in args)
             kwargs = {k: self.worker._materialize(v)
                       for k, v in kwargs.items()}
-            result = fn(*args, **kwargs)
+            if traceparent:
+                from ray_tpu.util import tracing
+
+                span_name = (f"actor:{type(self.instance).__name__}"
+                             f".{method}")
+                with tracing.span(span_name, traceparent=traceparent):
+                    result = fn(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
             if asyncio.iscoroutine(result):
                 result = self._run_coroutine(result)
             results = [result] if len(return_ids) == 1 else list(result)
@@ -865,7 +966,8 @@ class WorkerHandler:
 
     def actor_task(self, reply_cb, actor_id: str, method: str, args, kwargs,
                    return_ids, seqno: int, caller_id: str,
-                   caller_machine: Optional[str] = None) -> None:
+                   caller_machine: Optional[str] = None,
+                   traceparent: Optional[str] = None) -> None:
         rt = self.w._actor_runtime
         if rt is None or rt.actor_id != actor_id:
             e = exc.ActorUnavailableError(actor_id,
@@ -873,7 +975,8 @@ class WorkerHandler:
             reply_cb(False, (e, ""))
             return
         rt.submit(method, args, kwargs, return_ids, seqno, caller_id,
-                  lambda reply: reply_cb(True, reply), caller_machine)
+                  lambda reply: reply_cb(True, reply), caller_machine,
+                  traceparent)
 
     def fetch_object(self, object_id: str, machine_id: Optional[str] = None):
         """Serve a fetch. Same-host peers (or legacy callers passing no

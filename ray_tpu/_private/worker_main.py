@@ -9,6 +9,18 @@ import sys
 import time
 
 
+def _bound_chips():
+    """TPU chips this process was bound to at spawn (the conductor set
+    TPU_VISIBLE_CHIPS); announced on every registration so a restarted
+    conductor re-learns live bindings."""
+    spec = os.environ.get("TPU_VISIBLE_CHIPS", "")
+    try:
+        chips = tuple(int(c) for c in spec.split(",") if c.strip() != "")
+    except ValueError:
+        return None
+    return chips or None
+
+
 def main() -> None:
     # Driver sys.path propagation: functions/classes pickled by reference
     # (module-level defs) must be importable here — the analog of the
@@ -31,8 +43,11 @@ def main() -> None:
     w = Worker(mode="worker", conductor_address=(host, int(port)),
                session_dir=session_dir, worker_id=worker_id)
     worker_mod.global_worker = w
+    # announce the chip binding so a restarted conductor (whose free_chips
+    # reinitialized to the full range) re-learns which chips are taken
+    chips = _bound_chips()
     w.conductor.call("register_worker", worker_id, w.address, os.getpid(),
-                     os.environ.get("RAY_TPU_NODE_ID"), timeout=30.0)
+                     os.environ.get("RAY_TPU_NODE_ID"), chips, timeout=30.0)
 
     def _term(signum, frame):
         os._exit(0)
@@ -51,9 +66,13 @@ def main() -> None:
     while True:
         time.sleep(5.0)
         try:
-            w.conductor.call("register_worker", worker_id, w.address,
-                             os.getpid(), os.environ.get("RAY_TPU_NODE_ID"),
-                             timeout=5.0)
+            ok = w.conductor.call(
+                "register_worker", worker_id, w.address, os.getpid(),
+                os.environ.get("RAY_TPU_NODE_ID"), chips, timeout=5.0)
+            if ok is False:
+                # conductor rebound our chips to another worker while we
+                # were partitioned — we must not touch the TPU again
+                os._exit(0)
             last_ok = time.monotonic()
         except Exception:
             if time.monotonic() - last_ok > grace:

@@ -23,19 +23,45 @@ from . import NodeProvider
 
 TPU_API = "https://tpu.googleapis.com/v2"
 
-# acceleratorType -> chips per host-VM (reference accelerators/tpu.py
-# TPU_*_CHIPS tables; v2-v4 hosts expose 4 chips, v5e/v5p vary by slice)
-_CHIPS = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 8, "v5p": 4, "v6e": 8}
+# acceleratorType generation -> chips per host-VM (reference
+# accelerators/tpu.py: 4 chips per host for v2-v4, 8 for v5e/v6e)
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 8, "v5p": 4,
+                   "v6e": 8}
+# generations whose "-N" suffix counts TensorCores (2 per chip), not chips
+# (reference accelerators/tpu.py: 'v{generation}-{cores}'); v5e/v6e have
+# single-core chips so their suffix is the chip count
+_CORE_SUFFIX_GENS = ("v2", "v3", "v4", "v5p")
 
 
 def accelerator_chips(accelerator_type: str) -> int:
-    """Chips a slice of `accelerator_type` (e.g. "v5litepod-8", "v4-16")
-    exposes as schedulable TPU resources."""
+    """TOTAL chips in a slice of `accelerator_type`. For v2/v3/v4 the
+    numeric suffix counts TensorCores (2 per chip: "v4-16" = 8 chips);
+    for v5litepod/v5p/v6e it counts chips ("v5litepod-8" = 8 chips)."""
     gen, _, count = accelerator_type.partition("-")
     try:
-        return int(count)
+        n = int(count)
     except ValueError:
-        return _CHIPS.get(gen, 4)
+        return _CHIPS_PER_HOST.get(gen, 4)
+    if gen in _CORE_SUFFIX_GENS:
+        return max(1, n // 2)
+    return n
+
+
+def chips_per_host(accelerator_type: str) -> int:
+    """Chips each host VM of the slice exposes — what its NodeAgent must
+    advertise (startup scripts run per VM; advertising the whole-slice
+    count on every host multiplies capacity by the host count)."""
+    gen, _, _ = accelerator_type.partition("-")
+    per_host = _CHIPS_PER_HOST.get(gen, 4)
+    total = accelerator_chips(accelerator_type)
+    return min(per_host, total) if total > 0 else per_host
+
+
+def slice_hosts(accelerator_type: str) -> int:
+    """Host VMs in the slice."""
+    total = accelerator_chips(accelerator_type)
+    per_host = chips_per_host(accelerator_type)
+    return max(1, -(-total // per_host))
 
 
 def _metadata_token() -> str:
@@ -102,8 +128,9 @@ class GcpTpuNodeProvider(NodeProvider):
     def create_node(self, node_type: str,
                     resources: Dict[str, float]) -> str:
         cfg = self.node_configs[node_type]
-        chips = int(resources.get("TPU") or
-                    accelerator_chips(cfg["accelerator_type"]))
+        # the startup script runs on EVERY host VM of a multi-host slice:
+        # each must advertise only its own chips
+        chips = int(chips_per_host(cfg["accelerator_type"]))
         node_id = f"ray-tpu-{self.cluster_name}-{uuid.uuid4().hex[:8]}"
         body = {
             "acceleratorType": cfg["accelerator_type"],
@@ -131,21 +158,34 @@ class GcpTpuNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
-        resp = self._http("GET", f"{TPU_API}/{self._parent}/nodes")
-        for node in resp.get("nodes", []):
-            labels = node.get("labels") or {}
-            if labels.get("ray-cluster") != self.cluster_name:
-                continue
-            if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
-                continue
-            chips = accelerator_chips(node.get("acceleratorType", ""))
-            out.append({
-                "node_id": node["name"].rsplit("/", 1)[-1],
-                "node_type": labels.get("ray-node-type", "tpu"),
-                "resources": {"TPU": float(chips)},
-                "state": node.get("state"),
-            })
-        return out
+        url = f"{TPU_API}/{self._parent}/nodes"
+        page_token = None
+        while True:
+            resp = self._http(
+                "GET", url + (f"?pageToken={page_token}" if page_token
+                              else ""))
+            for node in resp.get("nodes", []):
+                labels = node.get("labels") or {}
+                if labels.get("ray-cluster") != self.cluster_name:
+                    continue
+                if node.get("state") in ("DELETING", "TERMINATED",
+                                         "PREEMPTED"):
+                    continue
+                acct = node.get("acceleratorType", "")
+                out.append({
+                    "node_id": node["name"].rsplit("/", 1)[-1],
+                    "node_type": labels.get("ray-node-type", "tpu"),
+                    # whole-slice chips: the autoscaler launches and
+                    # terminates slices, so slice-level capacity is the
+                    # accounting unit here (per-host advertising happens
+                    # in the startup script)
+                    "resources": {"TPU": float(accelerator_chips(acct))},
+                    "hosts": slice_hosts(acct),
+                    "state": node.get("state"),
+                })
+            page_token = resp.get("nextPageToken")
+            if not page_token:
+                return out
 
     # ------------------------------------------------------------ extras
 

@@ -44,6 +44,12 @@ class TrialScheduler:
         checkpoint into trial_id with the given config."""
         return None
 
+    def on_trials_paused(self, trial_ids: List[str]) -> None:
+        """Synch-barrier hook: the controller calls this when every live
+        trial has either PAUSEd or terminated. The scheduler may queue
+        exploit directives; the controller then resumes all paused
+        trials (reference pbt.py synch=True mode)."""
+
 
 class FIFOScheduler(TrialScheduler):
     """Run every trial to completion (reference trial_scheduler.py)."""
@@ -148,13 +154,19 @@ class PopulationBasedTraining(TrialScheduler):
                  hyperparam_mutations: Optional[Dict[str, Any]] = None,
                  quantile_fraction: float = 0.25,
                  resample_probability: float = 0.25,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 synch: bool = False):
         self.time_attr = time_attr
         self.metric, self.mode = metric, mode
         self.interval = perturbation_interval
         self.mutations = dict(hyperparam_mutations or {})
         self.quantile = quantile_fraction
         self.resample_p = resample_probability
+        # synch=True: trials PAUSE at each perturbation boundary and the
+        # exploit decision happens at the barrier over the whole
+        # population — deterministic under trial skew (reference pbt.py
+        # `synch` flag); async mode decides from whatever results exist.
+        self.synch = synch
         self._rng = random.Random(seed)
         self._last_perturb: Dict[str, int] = defaultdict(int)
         self._latest: Dict[str, Dict[str, Any]] = {}
@@ -196,20 +208,31 @@ class PopulationBasedTraining(TrialScheduler):
         if t - self._last_perturb[trial_id] < self.interval:
             return CONTINUE
         self._last_perturb[trial_id] = t
+        if self.synch:
+            return PAUSE  # decision deferred to the on_trials_paused barrier
+        self._decide_exploits([trial_id])
+        return CONTINUE
+
+    def on_trials_paused(self, trial_ids: List[str]) -> None:
+        self._decide_exploits(trial_ids)
+
+    def _decide_exploits(self, candidates: List[str]) -> None:
+        """Queue exploit directives for `candidates` in the bottom
+        quantile of the current population scores."""
         scores = {tid: self._score(r) for tid, r in self._latest.items()
                   if self.metric in r}
         if len(scores) < 2:
-            return CONTINUE
+            return
         ordered = sorted(scores, key=scores.get)
         k = max(1, int(len(ordered) * self.quantile))
         bottom, top = ordered[:k], ordered[-k:]
-        if trial_id in bottom and trial_id not in top:
-            src = self._rng.choice(top)
-            new_cfg = self._mutate(self._configs.get(src, {}))
-            self._pending_exploit[trial_id] = {"source": src,
-                                               "config": new_cfg}
-            self._configs[trial_id] = new_cfg
-        return CONTINUE
+        for trial_id in candidates:
+            if trial_id in bottom and trial_id not in top:
+                src = self._rng.choice(top)
+                new_cfg = self._mutate(self._configs.get(src, {}))
+                self._pending_exploit[trial_id] = {"source": src,
+                                                   "config": new_cfg}
+                self._configs[trial_id] = new_cfg
 
     def exploit_directive(self, trial_id: str) -> Optional[Dict[str, Any]]:
         return self._pending_exploit.pop(trial_id, None)

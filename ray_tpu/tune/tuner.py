@@ -190,6 +190,7 @@ class Tuner:
                 rerun_configs.append(tstate["config"])
 
         ref_to_trial: Dict[Any, Trial] = {}
+        paused: List[Trial] = []  # synch-PBT trials awaiting the barrier
         deadline = (time.monotonic() + cfg.time_budget_s
                     if cfg.time_budget_s else None)
         next_index = len(trials)
@@ -257,6 +258,23 @@ class Tuner:
 
             outstanding = list(ref_to_trial.keys())
             if not outstanding:
+                if paused:
+                    # synch barrier: every live trial is paused at a
+                    # perturbation boundary — let the scheduler decide
+                    # exploits over the whole population, then resume all
+                    scheduler.on_trials_paused([t.trial_id for t in paused])
+                    batch, paused = paused, []
+                    for trial in batch:
+                        directive = scheduler.exploit_directive(
+                            trial.trial_id)
+                        if directive is not None:
+                            self._exploit(trial, trials, directive,
+                                          trainable_bytes, resources,
+                                          ref_to_trial)
+                        else:
+                            nref = trial.actor.step.remote()
+                            ref_to_trial[nref] = trial
+                    continue
                 break
             done, _ = ray_tpu.wait(outstanding, num_returns=1, timeout=1.0)
             if deadline and time.monotonic() > deadline:
@@ -268,6 +286,9 @@ class Tuner:
                     except Exception:
                         pass
                     finalize(trial, TERMINATED)
+                for trial in paused:
+                    finalize(trial, TERMINATED)
+                paused = []
                 break
             if not done:
                 continue
@@ -309,6 +330,8 @@ class Tuner:
                 except Exception:
                     pass
                 finalize(trial, TERMINATED)
+            elif decision == PAUSE:
+                paused.append(trial)  # resumed at the synch barrier
             else:
                 if ckpt_freq and trial.last_result.get(
                         "training_iteration", 0) % ckpt_freq == 0:

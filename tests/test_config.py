@@ -43,11 +43,18 @@ def test_describe_lists_all_flags(monkeypatch):
     assert all(r["doc"] for r in rows.values())
 
 
-def test_system_config_reaches_the_runtime(monkeypatch):
+def test_system_config_reaches_the_runtime():
     """An object-store override handed to init() must actually govern the
     store: a tiny cap forces spilling on a value that fits comfortably in
-    the default 2GB cap."""
-    monkeypatch.delenv("RAY_TPU_OBJECT_STORE_CAP", raising=False)
+    the default 2GB cap.
+
+    Cleanup is a plain os.environ.pop, NOT monkeypatch.delenv: init()
+    exports the override into os.environ (so spawned workers inherit it),
+    and monkeypatch.delenv would record that value as "previous" and
+    RESTORE it at teardown — leaking a 256KB store cap into every
+    subsequent test in the process."""
+    import os
+
     ray_tpu.init(num_cpus=1, _system_config={"object_store_cap": 256 * 1024})
     try:
         w = ray_tpu._private.worker.global_worker
@@ -58,4 +65,4 @@ def test_system_config_reaches_the_runtime(monkeypatch):
             assert ray_tpu.get(r, timeout=30.0).nbytes == 64 * 1024
     finally:
         ray_tpu.shutdown()
-        monkeypatch.delenv("RAY_TPU_OBJECT_STORE_CAP", raising=False)
+        os.environ.pop("RAY_TPU_OBJECT_STORE_CAP", None)

@@ -159,3 +159,24 @@ def test_reinit_error(ray_start_regular):
     with pytest.raises(RuntimeError):
         ray_tpu.init()
     ray_tpu.init(ignore_reinit_error=True)
+
+
+def test_result_larger_than_store_cap():
+    """Regression (round-2 livelock): a task result bigger than the
+    object-store cap is spilled by the executing worker and comes back as
+    a locator — get() must chunk-fetch it from the holder, never hang
+    waiting for a store entry that will never exist."""
+    import os
+
+    ray_tpu.init(num_cpus=1, _system_config={"object_store_cap": 256 * 1024})
+    try:
+        @ray_tpu.remote
+        def big():
+            return np.ones(1024 * 1024, dtype=np.float32)  # 4 MB
+
+        out = ray_tpu.get(big.remote(), timeout=60.0)
+        assert out.nbytes == 4 * 1024 * 1024
+        assert float(out[-1]) == 1.0
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_OBJECT_STORE_CAP", None)

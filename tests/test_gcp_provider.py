@@ -5,7 +5,8 @@ from __future__ import annotations
 
 import pytest
 
-from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider, accelerator_chips
+from ray_tpu.autoscaler.gcp import (GcpTpuNodeProvider, accelerator_chips,
+                                    chips_per_host, slice_hosts)
 
 
 class FakeTpuApi:
@@ -47,9 +48,21 @@ def provider():
 
 def test_accelerator_chip_table():
     assert accelerator_chips("v5litepod-8") == 8
-    assert accelerator_chips("v4-16") == 16
+    # v2/v3/v4 suffixes count TensorCores, 2 per chip
+    # (reference accelerators/tpu.py): v4-16 is an 8-chip / 2-host slice
+    assert accelerator_chips("v4-16") == 8
+    assert accelerator_chips("v2-8") == 4
     assert accelerator_chips("v5litepod") == 8
     assert accelerator_chips("v3") == 4
+
+
+def test_per_host_chips_and_hosts():
+    assert chips_per_host("v4-16") == 4 and slice_hosts("v4-16") == 2
+    assert chips_per_host("v4-8") == 4 and slice_hosts("v4-8") == 1
+    assert chips_per_host("v5litepod-16") == 8
+    assert slice_hosts("v5litepod-16") == 2
+    assert chips_per_host("v5litepod-4") == 4  # sub-host slice
+    assert slice_hosts("v5litepod-4") == 1
 
 
 def test_create_lists_and_terminate(provider):

@@ -125,7 +125,7 @@ def test_spans_cross_actor_boundary(traced_cluster):
     while time.monotonic() < deadline:
         by_name = {s["name"]: s
                    for s in w.conductor.call("get_spans", timeout=10.0)}
-        if "actor:T.m" in by_name:
+        if "actor:T.m" in by_name and "actor-call-site" in by_name:
             break
         time.sleep(0.3)
     assert "actor:T.m" in by_name

@@ -145,9 +145,13 @@ def test_asha_stops_bad_trials(cluster):
 
 
 def test_pbt_exploits_checkpoint(cluster):
+    # synch=True: exploit decisions happen at a population-wide barrier,
+    # deterministic under trial skew (async PBT can miss the exploit
+    # entirely when one trial finishes before the other reports)
     sched = tune.PopulationBasedTraining(
         metric="score", mode="max", perturbation_interval=2,
-        hyperparam_mutations={"lr": tune.uniform(0.4, 0.6)}, seed=0)
+        hyperparam_mutations={"lr": tune.uniform(0.4, 0.6)}, seed=0,
+        synch=True)
     grid = tune.run(_Quad, config={"lr": tune.grid_search([0.01, 0.5])},
                     metric="score", mode="max", scheduler=sched,
                     stop={"training_iteration": 8})
