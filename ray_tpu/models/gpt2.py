@@ -244,7 +244,13 @@ def gpt2_partition_specs(config: GPT2Config) -> Params:
         },
     }
     return {
-        "wte": P("tp", "fsdp"),
+        # vocab sharded over BOTH model axes, d_model replicated: a 2D-
+        # sharded wte ([tp, fsdp]) forces XLA into "involuntary full
+        # rematerialization" reconciling the embedding-gather and LM-head
+        # grad shardings (replicate-then-reshard on every step); single-dim
+        # vocab sharding keeps the memory scaling and compiles clean, and
+        # logits come out vocab-sharded — Megatron-style vocab-parallel CE
+        "wte": P(("tp", "fsdp"), None),
         "wpe": P(None, "fsdp"),
         "ln_f": {"scale": P(), "bias": P()},
         "blocks": [block for _ in range(config.num_layers)],
