@@ -63,6 +63,19 @@ class ObjectRef:
             self.id = id if id is not None else ObjectID().hex()
         self.locator = tuple(locator) if locator else None
         self.owner = tuple(owner) if owner else None
+        # distributed refcounting (reference reference_count.h:61): every
+        # handle instance is counted; the last drop releases/deregisters
+        from . import refcount
+
+        refcount.tracker.track(self.id, self.owner)
+
+    def __del__(self):
+        try:
+            from . import refcount
+
+            refcount.tracker.untrack(self.id)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def hex(self) -> str:
         return self.id
@@ -108,6 +121,10 @@ class _Entry:
     # on-disk copy written by eviction-spill; data is restored (or range-
     # read) from here on next access (reference local_object_manager.h:53)
     spill_path: Optional[str] = None
+    # True for entries whose bytes THIS process authored (put_value):
+    # possibly the only copy in the cluster (the owner's locator may point
+    # here). False for fetched caches, which are refetchable.
+    primary: bool = False
 
     @property
     def in_memory(self) -> bool:
@@ -162,7 +179,7 @@ class LocalObjectStore:
         """Serialize and store; returns total bytes."""
         meta, buffers = serialization.serialize(value)
         total = sum(b.nbytes for b in buffers)
-        e = _Entry(meta=meta, nbytes=len(meta) + total)
+        e = _Entry(meta=meta, nbytes=len(meta) + total, primary=True)
         if total >= shm_threshold():
             size = 0
             layout = []
@@ -353,6 +370,20 @@ class LocalObjectStore:
             with self._cv:
                 self._bytes -= e.nbytes
             self._free_entry(e)
+
+    def delete_cached(self, object_id: str) -> None:
+        """Delete only if the entry is a fetched CACHE copy. A primary
+        entry (bytes authored here — possibly the cluster's only copy,
+        pointed at by the owner's locator) survives; the owner's
+        free_objects is the authoritative release for those."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or e.primary:
+                return
+            self._entries.pop(object_id, None)
+            self._deserialized_cache.pop(object_id, None)
+            self._bytes -= e.nbytes
+        self._free_entry(e)
 
     _QUARANTINE_S = 2.0
 

@@ -155,6 +155,11 @@ class RpcServer:
                 else:
                     self._pool.submit(self._dispatch, conn, send_lock,
                                       req_id, method, args, kwargs)
+                # a reader blocked in the next _recv_frame must not pin
+                # the previous request in its frame locals: task args can
+                # hold large values and ObjectRefs whose refcount release
+                # (and memory) would otherwise wait for the NEXT request
+                del frame, args, kwargs
         except (ConnectionLost, OSError):
             pass
         except RuntimeError:
@@ -262,6 +267,9 @@ class RpcClient:
                 if p is not None:
                     p.ok, p.payload = ok, payload
                     p.event.set()
+                # idle reader must not pin the last reply (may be a large
+                # task result) until the next one arrives
+                del frame, payload, p
         except (ConnectionLost, OSError, EOFError):
             self._closed = True
             with self._pending_lock:

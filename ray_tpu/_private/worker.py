@@ -150,6 +150,9 @@ class Worker:
         self._task_events_lock = threading.Lock()
         threading.Thread(target=self._event_flush_loop, daemon=True,
                          name="task-event-flush").start()
+        from . import refcount
+
+        refcount.tracker.attach(self)
 
     # ------------------------------------------------------------ put / get
 
@@ -331,6 +334,12 @@ class Worker:
             for oid in spec.return_ids:
                 self._locators.pop(oid, None)
                 self._pending_ids.add(oid)
+        # the resubmission's _submit_and_record will decref on completion:
+        # re-pin the args so the pair stays balanced
+        from . import refcount
+
+        refcount.tracker.wire_incref(
+            refcount.collect_refs(spec.args, spec.kwargs))
         for oid in spec.return_ids:
             self.store.invalidate(oid)
         self._register_inflight(
@@ -424,6 +433,9 @@ class Worker:
             traceparent=_current_traceparent())
         refs = [ObjectRef(oid, locator=None, owner=self.address)
                 for oid in return_ids]
+        from . import refcount
+
+        refcount.tracker.wire_incref(refcount.collect_refs(args, kwargs))
         with self._state_lock:
             for oid in return_ids:
                 self._lineage[oid] = spec
@@ -459,6 +471,13 @@ class Worker:
             # show up in `summary`/`timeline` as FAILED too
             now = time.time()
             self._record_event(spec, now, None, "FAILED")
+        finally:
+            # release the in-flight pins taken at submission — success or
+            # failure, the receiver's adoption window has closed
+            from . import refcount
+
+            refcount.tracker.wire_decref(
+                refcount.collect_refs(spec.args, spec.kwargs))
 
     def _submit_once(self, spec: TaskSpec) -> None:
         for dep in _top_level_refs(spec.args, spec.kwargs):
@@ -478,7 +497,7 @@ class Worker:
                 self.conductor.notify("return_worker", worker_id)
             except ConnectionLost:
                 pass
-        self._record_results(spec.return_ids, reply)
+        self._record_results(spec.return_ids, reply, holder=tuple(address))
         status = "FAILED" if any(entry[1] == "error" for entry in reply) \
             else "FINISHED"
         self._record_event(spec, t0, tuple(address), status)
@@ -493,7 +512,8 @@ class Worker:
                 "owner": spec.owner, "runtime_env": spec.runtime_env,
                 "machine": _MACHINE_ID, "traceparent": spec.traceparent}
 
-    def _record_results(self, return_ids: List[str], reply: list) -> None:
+    def _record_results(self, return_ids: List[str], reply: list,
+                        holder: Optional[Tuple[str, int]] = None) -> None:
         for oid, kind, payload in reply:
             if kind == "locator":
                 with self._state_lock:
@@ -502,6 +522,13 @@ class Worker:
                 self.store.put_error(oid, payload)
             else:
                 self._store_fetched(oid, kind, payload)
+                if kind == "shm" and holder is not None:
+                    # same-host large result: our entry is a zero-copy
+                    # REFERENCE into the executor's memory — remember who
+                    # holds the bytes so refcount-zero can free them (and
+                    # so an evicted reference can refetch)
+                    with self._state_lock:
+                        self._locators[oid] = tuple(holder)
         with self._state_lock:
             self._pending_ids.difference_update(return_ids)
             for oid in return_ids:
@@ -509,6 +536,13 @@ class Worker:
         # locator-only results create no store entry: wake waiters so
         # _wait_result re-checks the pending set and moves on to fetch
         self.store.notify_waiters()
+        # results whose every handle died while the task was in flight
+        # are freed right here (refcounting dead-pending path)
+        from . import refcount
+
+        for oid in return_ids:
+            if refcount.tracker.was_freed_pending(oid):
+                refcount.tracker.on_result_recorded(oid)
 
     def _wait_dep_ready(self, ref: ObjectRef) -> None:
         """Block until `ref`'s value exists somewhere reachable."""
@@ -668,6 +702,9 @@ class Worker:
         return_ids = [ObjectID().hex() for _ in range(num_returns)]
         refs = [ObjectRef(oid, locator=tuple(address), owner=self.address)
                 for oid in return_ids]
+        from . import refcount
+
+        refcount.tracker.wire_incref(refcount.collect_refs(args, kwargs))
         with self._state_lock:
             self._pending_ids.update(return_ids)
         self._register_inflight(
@@ -696,6 +733,9 @@ class Worker:
     def _actor_call_bg(self, actor_id, address, method, args, kwargs,
                        return_ids, seqno, caller_id, retries,
                        traceparent=None) -> None:
+        from . import refcount
+
+        arg_refs = refcount.collect_refs(args, kwargs)
         try:
             while True:
                 pending = client = None
@@ -735,7 +775,7 @@ class Worker:
                     seqno = -1  # retried call executes unordered
                     if retries > 0:
                         retries -= 1
-            self._record_results(return_ids, reply)
+            self._record_results(return_ids, reply, holder=tuple(address))
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, RemoteError) and isinstance(e.cause,
                                                          exc.RayTpuError):
@@ -750,6 +790,8 @@ class Worker:
                 self._pending_ids.difference_update(return_ids)
                 for oid in return_ids:
                     self._inflight.pop(oid, None)
+        finally:
+            refcount.tracker.wire_decref(arg_refs)
 
     def _wait_actor_restart(self, actor_id: str,
                             timeout: float = 120.0) -> Tuple[str, int]:
@@ -788,6 +830,9 @@ class Worker:
         if self._shutdown:
             return
         self._shutdown = True
+        from . import refcount
+
+        refcount.tracker.detach()
         # flush the tail of the task-event/span batch so `ray_tpu summary`/
         # `timeline` see short-lived drivers (e.g. submitted jobs)
         try:
@@ -867,6 +912,8 @@ class ActorRuntime:
                 self._run_one(item)
             else:
                 self._exec_pool.submit(self._run_one, item)
+            # don't pin the last call's args while idle in queue.get()
+            item = None
 
     def _run_one(self, item) -> None:
         (method, args, kwargs, return_ids, done_cb, caller_machine,
@@ -1045,6 +1092,13 @@ class WorkerHandler:
     def free_objects(self, object_ids: List[str]) -> None:
         for oid in object_ids:
             self.w.store.delete(oid)
+
+    def refcount_update(self, from_addr, entries) -> None:
+        """Batched borrower incref/adopt/drop messages (reference
+        reference_count.h borrower protocol)."""
+        from . import refcount
+
+        refcount.tracker.apply_remote(from_addr, entries)
 
     def on_published(self, channel: str, message: Any) -> None:
         pass

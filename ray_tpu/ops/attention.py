@@ -34,8 +34,9 @@ try:  # pltpu only imports on TPU-capable jaxlib builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# block shapes tuned on v5e; env overrides for bench sweeps
+DEFAULT_BLOCK_Q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "512"))
+DEFAULT_BLOCK_K = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K", "512"))
 _LANES = 8  # LSE/D are broadcast over a small minor dim (sublane tile);
 #             keeping it at 8 rather than the 128-lane width cuts the HBM
 #             traffic of the side outputs 16x
@@ -389,6 +390,8 @@ def _interpret_forced() -> bool:
 
 
 def _use_pallas() -> bool:
+    if os.environ.get("RAY_TPU_DISABLE_FLASH") == "1":  # ablation/debug escape hatch
+        return False
     if _interpret_forced():
         return True
     if pltpu is None:

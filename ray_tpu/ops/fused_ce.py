@@ -30,7 +30,8 @@ _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
 _LN2 = 0.6931471805599453
 
-DEFAULT_BLOCK_N = 1024  # token rows per program (tuned on v5e)
+# token rows per program (tuned on v5e; env override for bench sweeps)
+DEFAULT_BLOCK_N = int(os.environ.get("RAY_TPU_CE_BLOCK_N", "1024"))
 
 
 def _ce_reference(x: jax.Array, w: jax.Array, targets: jax.Array,
@@ -274,6 +275,8 @@ def _interpret_forced() -> bool:
 
 
 def _use_pallas() -> bool:
+    if os.environ.get("RAY_TPU_DISABLE_FUSED_CE") == "1":  # ablation/debug escape hatch
+        return False
     if _interpret_forced():
         return True
     try:
