@@ -99,6 +99,9 @@ class PlacementGroupRecord:
     strategy: str
     state: str = "CREATED"  # CREATED | REMOVED
     name: Optional[str] = None
+    # node_id per bundle (parallel to `bundles`) — the scheduler's
+    # placement decision (reference bundle_scheduling_policy.h)
+    assignments: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -183,7 +186,31 @@ class ConductorHandler:
                 last_heartbeat=time.monotonic(),
                 free_chips=[c for c in range(int(resources.get("TPU", 0)))
                             if c not in bound])
+            self._reapply_pg_reservations(node_id)
             self._cv.notify_all()
+
+    def _reapply_pg_reservations(self, node_id: str) -> None:
+        """A (re-)registered node's record starts with full availability;
+        re-reserve any live placement-group bundles assigned to it (the
+        conductor-restart path — PGs are persisted, nodes are not). Must
+        hold the lock."""
+        node = self._nodes[node_id]
+        for pg in self._pgs.values():
+            if pg.state != "CREATED":
+                continue
+            mine = [b for b, nid in zip(pg.bundles, pg.assignments or ())
+                    if nid == node_id]
+            if not mine:
+                continue
+            pk0 = f"_pg_{pg.pg_id}_"
+            if any(k.startswith(pk0) for k in node.total):
+                continue  # already applied (plain re-register)
+            for b in mine:
+                self._acquire_resources(node, b)
+                for k, v in b.items():
+                    pk = pk0 + k
+                    node.total[pk] = node.total.get(pk, 0) + v
+                    node.available[pk] = node.available.get(pk, 0) + v
 
     def node_heartbeat(self, node_id: str,
                        dead_worker_ids: Optional[List[str]] = None) -> bool:
@@ -801,32 +828,119 @@ class ConductorHandler:
 
     # ------------------------------------------------------- placement groups
 
+    def _assign_bundles(self, bundles: List[Dict[str, float]],
+                        strategy: str) -> List[str]:
+        """Pick a node per bundle (reference composite/bundle scheduling
+        policies, scheduling/policy/bundle_scheduling_policy.h):
+        PACK = first-fit onto the fewest nodes, SPREAD = round-robin with
+        overflow, STRICT_PACK = one node or fail, STRICT_SPREAD =
+        distinct nodes or fail. Must hold the lock. Raises ValueError
+        when infeasible; mutates nothing."""
+        order = [self._head_node_id] + sorted(
+            nid for nid, n in self._nodes.items()
+            if nid != self._head_node_id and n.alive)
+        avail = {nid: dict(self._nodes[nid].available) for nid in order}
+
+        def fits(nid, b):
+            return all(avail[nid].get(k, 0.0) >= v for k, v in b.items())
+
+        def take(nid, b):
+            for k, v in b.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+
+        if strategy == "STRICT_PACK":
+            for nid in order:
+                trial = dict(avail[nid])
+                ok = True
+                for b in bundles:
+                    if all(trial.get(k, 0.0) >= v for k, v in b.items()):
+                        for k, v in b.items():
+                            trial[k] = trial.get(k, 0.0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [nid] * len(bundles)
+            raise ValueError(
+                "STRICT_PACK infeasible: no single node fits all bundles")
+
+        if strategy == "STRICT_SPREAD":
+            assignment: List[str] = []
+            used: set = set()
+            for b in bundles:
+                placed = next((nid for nid in order
+                               if nid not in used and fits(nid, b)), None)
+                if placed is None:
+                    raise ValueError(
+                        "STRICT_SPREAD infeasible: needs "
+                        f"{len(bundles)} distinct nodes with capacity, "
+                        f"have {len(order)}")
+                take(placed, b)
+                used.add(placed)
+                assignment.append(placed)
+            return assignment
+
+        if strategy == "SPREAD":
+            assignment = []
+            start = 0
+            for b in bundles:
+                rotation = order[start:] + order[:start]
+                placed = next((nid for nid in rotation if fits(nid, b)),
+                              None)
+                if placed is None:
+                    raise ValueError(
+                        f"SPREAD infeasible: no node fits bundle {b}")
+                take(placed, b)
+                assignment.append(placed)
+                start = (order.index(placed) + 1) % len(order)
+            return assignment
+
+        # PACK: first-fit in fixed order keeps bundles on the fewest nodes
+        assignment = []
+        for b in bundles:
+            placed = next((nid for nid in order if fits(nid, b)), None)
+            if placed is None:
+                raise ValueError(f"PACK infeasible: no node fits bundle {b}")
+            take(placed, b)
+            assignment.append(placed)
+        return assignment
+
     def create_placement_group(self, bundles: List[Dict[str, float]],
                                strategy: str = "PACK",
                                name: Optional[str] = None) -> str:
-        """Atomically reserve bundle resources (reference 2PC
-        gcs_placement_group_scheduler.cc — single-authority here, so plain
-        transactional reserve)."""
+        """Assign each bundle to a node per the strategy, then reserve
+        atomically with rollback on partial failure (reference 2PC
+        gcs_placement_group_scheduler.cc — single authority here, so the
+        transaction is a lock-held reserve loop)."""
         pg_id = PlacementGroupID().hex()
         with self._cv:
-            node = self._nodes[self._head_node_id]
-            total_req: Dict[str, float] = {}
-            for b in bundles:
-                for k, v in b.items():
-                    total_req[k] = total_req.get(k, 0) + v
-            if not self._acquire_resources(node, total_req):
+            assignment = self._assign_bundles(bundles, strategy)
+            reserved: List[Tuple[NodeRecord, Dict[str, float]]] = []
+            ok = True
+            for b, nid in zip(bundles, assignment):
+                node = self._nodes[nid]
+                if not self._acquire_resources(node, b):
+                    ok = False
+                    break
+                reserved.append((node, b))
+            if not ok:  # raced with a concurrent reservation: roll back
+                for node, b in reserved:
+                    self._release_resources(node, b)
                 raise ValueError(
-                    f"placement group infeasible: need {total_req}, "
-                    f"available {node.available}")
-            # expose per-PG pool as synthetic node resources
-            for b in bundles:
+                    f"placement group infeasible: bundles {bundles} "
+                    "no longer fit their assigned nodes")
+            # expose per-PG bundle pools as synthetic resources ON THE
+            # ASSIGNED NODES — leases carrying the _pg_ prefix can then
+            # only be satisfied where the bundle actually lives
+            for b, nid in zip(bundles, assignment):
+                node = self._nodes[nid]
                 for k, v in b.items():
                     pk = f"_pg_{pg_id}_{k}"
                     node.total[pk] = node.total.get(pk, 0) + v
                     node.available[pk] = node.available.get(pk, 0) + v
-            self._pgs[pg_id] = PlacementGroupRecord(pg_id=pg_id,
-                                                    bundles=bundles,
-                                                    strategy=strategy, name=name)
+            self._pgs[pg_id] = PlacementGroupRecord(
+                pg_id=pg_id, bundles=bundles, strategy=strategy, name=name,
+                assignments=assignment)
             self._dirty = True
             self._cv.notify_all()
         return pg_id
@@ -841,22 +955,25 @@ class ConductorHandler:
             pg = self._pgs.pop(pg_id, None)
             if pg is None:
                 return
-            node = self._nodes[self._head_node_id]
-            total_req: Dict[str, float] = {}
-            for b in pg.bundles:
-                for k, v in b.items():
-                    total_req[k] = total_req.get(k, 0) + v
+            assignments = pg.assignments or \
+                [self._head_node_id] * len(pg.bundles)
+            for b, nid in zip(pg.bundles, assignments):
+                node = self._nodes.get(nid)
+                if node is None:  # node died: its capacity died with it
+                    continue
+                for k in b:
                     pk = f"_pg_{pg_id}_{k}"
                     node.total.pop(pk, None)
                     node.available.pop(pk, None)
-            self._release_resources(node, total_req)
+                self._release_resources(node, b)
             self._dirty = True
             self._cv.notify_all()
 
     def list_placement_groups(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [{"pg_id": p.pg_id, "bundles": p.bundles,
-                     "strategy": p.strategy, "state": p.state, "name": p.name}
+                     "strategy": p.strategy, "state": p.state,
+                     "name": p.name, "assignments": list(p.assignments)}
                     for p in self._pgs.values()]
 
     # ------------------------------------------------------------ task events
@@ -1046,6 +1163,9 @@ class ConductorHandler:
                 "actors": list(self._actors.values()),
                 "pgs": list(self._pgs.values()),
                 "jobs": jobs,
+                # a restarted conductor mints a fresh head node id: PG
+                # bundle assignments pointing at THIS id must be remapped
+                "head_node_id": self._head_node_id,
             })
         tmp = self._persist_path + ".tmp"
         try:
@@ -1076,16 +1196,24 @@ class ConductorHandler:
         self._named_actors = dict(state.get("named_actors", {}))
         now = time.monotonic()
         # PGs first: live actors scheduled inside one hold the PG's
-        # synthetic `_pg_<id>_<k>` keys, which must exist to re-charge
+        # synthetic `_pg_<id>_<k>` keys, which must exist to re-charge.
+        # Head-assigned bundles re-reserve now; bundles assigned to agent
+        # nodes re-reserve when their node re-registers
+        # (_reapply_pg_reservations from register_node).
+        old_head = state.get("head_node_id")
         for pg in state.get("pgs", []):
             if pg.state != "CREATED":
                 continue
-            total_req: Dict[str, float] = {}
-            for b in pg.bundles:
-                for k, v in b.items():
-                    total_req[k] = total_req.get(k, 0) + v
-            self._acquire_resources(head, total_req)
-            for b in pg.bundles:
+            if not getattr(pg, "assignments", None):
+                pg.assignments = [self._head_node_id] * len(pg.bundles)
+            else:
+                pg.assignments = [
+                    self._head_node_id if nid == old_head else nid
+                    for nid in pg.assignments]
+            for b, nid in zip(pg.bundles, pg.assignments):
+                if nid != self._head_node_id:
+                    continue
+                self._acquire_resources(head, b)
                 for k, v in b.items():
                     pk = f"_pg_{pg.pg_id}_{k}"
                     head.total[pk] = head.total.get(pk, 0) + v
