@@ -185,10 +185,14 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    """Best-effort cancellation: pending tasks get a TaskCancelledError."""
-    w = _require_worker()
-    if w._is_pending_local(ref.id):
-        w.store.put_error(ref.id, exceptions.TaskCancelledError(str(ref)))
+    """Cancel the task or actor call producing `ref`.
+
+    Queued work is dropped; running work is interrupted cooperatively
+    (force=True kills the executing worker — the guaranteed stop).
+    Subsequent get(ref) raises TaskCancelledError. Best-effort like the
+    reference: a non-force cancel cannot interrupt native code until it
+    re-enters the interpreter."""
+    _require_worker().cancel(ref, force=force)
 
 
 def cluster_resources() -> Dict[str, float]:

@@ -138,6 +138,13 @@ class Worker:
         # thread vanished without recording results — that must surface as
         # an error, never a silent forever-wait.
         self._inflight: Dict[str, Future] = {}
+        # cancellation (reference CoreWorker::CancelTask core_worker.cc):
+        # owner-side cancelled ids + where each pending id is executing
+        self._cancelled: set = set()
+        self._executing_at: Dict[str, Tuple[str, int]] = {}
+        # executor-side: return_id -> thread ident running it (for the
+        # cooperative async-exception interrupt)
+        self._exec_threads: Dict[str, int] = {}
         self._state_lock = threading.Lock()
         # per-caller actor-call send ordering: frames must hit the socket in
         # seqno order or the server's reorder buffer can adopt a too-high
@@ -479,24 +486,51 @@ class Worker:
             refcount.tracker.wire_decref(
                 refcount.collect_refs(spec.args, spec.kwargs))
 
+    def _is_cancelled(self, return_ids) -> bool:
+        with self._state_lock:
+            return any(oid in self._cancelled for oid in return_ids)
+
     def _submit_once(self, spec: TaskSpec) -> None:
+        if self._is_cancelled(spec.return_ids):
+            raise exc.TaskCancelledError(spec.name)
         for dep in _top_level_refs(spec.args, spec.kwargs):
             self._wait_dep_ready(dep)
         worker_id, address = self.conductor.call(
             "lease_worker", spec.resources, spec.placement_group_id,
             timeout=None)
+        if self._is_cancelled(spec.return_ids):  # cancelled during lease
+            try:
+                self.conductor.notify("return_worker", worker_id)
+            except ConnectionLost:
+                pass
+            raise exc.TaskCancelledError(spec.name)
+        with self._state_lock:
+            for oid in spec.return_ids:
+                self._executing_at[oid] = tuple(address)
         t0 = time.time()
         try:
             reply = self.clients.get(tuple(address)).call(
                 "push_task", self._wire_spec(spec), timeout=None)
         except ConnectionLost as e:
+            if self._is_cancelled(spec.return_ids):
+                # force-cancel killed the worker mid-task: that is the
+                # requested outcome, not a crash to retry
+                raise exc.TaskCancelledError(spec.name) from e
             raise exc.WorkerCrashedError(
                 f"worker {worker_id[:12]}… died running {spec.name}") from e
         finally:
+            with self._state_lock:
+                for oid in spec.return_ids:
+                    self._executing_at.pop(oid, None)
             try:
                 self.conductor.notify("return_worker", worker_id)
             except ConnectionLost:
                 pass
+        if self._is_cancelled(spec.return_ids):
+            # completed despite cancellation: the caller was already given
+            # TaskCancelledError — do not overwrite it with the value
+            self._record_event(spec, t0, tuple(address), "CANCELLED")
+            return
         self._record_results(spec.return_ids, reply, holder=tuple(address))
         status = "FAILED" if any(entry[1] == "error" for entry in reply) \
             else "FINISHED"
@@ -514,7 +548,11 @@ class Worker:
 
     def _record_results(self, return_ids: List[str], reply: list,
                         holder: Optional[Tuple[str, int]] = None) -> None:
+        with self._state_lock:
+            cancelled = {oid for oid in return_ids if oid in self._cancelled}
         for oid, kind, payload in reply:
+            if oid in cancelled:
+                continue  # caller already holds TaskCancelledError
             if kind == "locator":
                 with self._state_lock:
                     self._locators[oid] = tuple(payload)
@@ -609,6 +647,10 @@ class Worker:
         task_execution_handler _raylet.pyx:2247; returns stored per
         core_worker.cc:3268)."""
         name = wire.get("name", "task")
+        ident = threading.get_ident()
+        with self._state_lock:
+            for oid in wire["return_ids"]:
+                self._exec_threads[oid] = ident
         try:
             fn = serialization.loads(wire["fn_bytes"])
             args = tuple(self._materialize(a) for a in wire["args"])
@@ -625,9 +667,15 @@ class Worker:
                         result = fn(*args, **kwargs)
                 else:
                     result = fn(*args, **kwargs)
+        except exc.TaskCancelledError as e:
+            return [(oid, "error", e) for oid in wire["return_ids"]]
         except BaseException as e:  # noqa: BLE001
             err = exc.TaskError(e, traceback.format_exc(), name)
             return [(oid, "error", err) for oid in wire["return_ids"]]
+        finally:
+            with self._state_lock:
+                for oid in wire["return_ids"]:
+                    self._exec_threads.pop(oid, None)
         return_ids = wire["return_ids"]
         if len(return_ids) == 1:
             results = [result]
@@ -807,6 +855,38 @@ class Worker:
             time.sleep(0.1)
         raise exc.ActorUnavailableError(actor_id, "restart timed out")
 
+    # --------------------------------------------------------- cancellation
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        """Cancel the task producing `ref` (reference CoreWorker::
+        CancelTask, python worker.py:2932 ray.cancel semantics):
+        - not yet pushed: the submit thread aborts before/after lease;
+        - running: the executor gets a cooperative TaskCancelledError
+          injection (force=True kills the worker process instead — the
+          guaranteed stop, surfacing through the worker-death path);
+        - queued actor call: dropped at dispatch, the actor survives.
+        The caller's get() raises TaskCancelledError immediately either
+        way; completion racing the cancel is discarded, not delivered."""
+        oid = ref.id
+        with self._state_lock:
+            still_mine = oid in self._pending_ids
+            self._cancelled.add(oid)
+            where = self._executing_at.get(oid)
+        if not still_mine:
+            return  # already finished (or not ours): nothing to cancel
+        # wake the caller NOW; execution teardown proceeds asynchronously
+        self.store.put_error(oid, exc.TaskCancelledError(
+            f"task for {oid[:12]}… cancelled"
+            + (" (force)" if force else "")))
+        if where is None and ref.locator is not None:
+            where = tuple(ref.locator)  # actor call: executor known upfront
+        if where is not None:
+            try:
+                self.clients.get(tuple(where)).notify(
+                    "cancel_task", [oid], force)
+            except ConnectionLost:
+                pass
+
     # ----------------------------------------------------------- async get
 
     def get_future(self, ref: ObjectRef) -> Future:
@@ -873,6 +953,7 @@ class ActorRuntime:
             **{k: worker._materialize(v) for k, v in kwargs.items()})
         self._next_seqno: Dict[str, int] = {}
         self._reorder: Dict[str, Dict[int, tuple]] = {}
+        self._cancelled: set = set()  # return_ids dropped before dispatch
         self._cv = threading.Condition()
         self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._exec_pool = ThreadPoolExecutor(
@@ -915,9 +996,29 @@ class ActorRuntime:
             # don't pin the last call's args while idle in queue.get()
             item = None
 
+    def cancel(self, object_ids) -> bool:
+        """Mark queued calls cancelled (dropped with TaskCancelledError at
+        dispatch — the actor itself survives; reference: pending actor
+        tasks cancel with TaskCancelledError, running ones are interrupted
+        via the worker's async-exc path)."""
+        with self._cv:
+            self._cancelled.update(object_ids)
+        return True
+
     def _run_one(self, item) -> None:
         (method, args, kwargs, return_ids, done_cb, caller_machine,
          traceparent) = item
+        with self._cv:
+            dropped = any(oid in self._cancelled for oid in return_ids)
+            self._cancelled.difference_update(return_ids)
+        if dropped:
+            err0 = exc.TaskCancelledError(f"{method} cancelled while queued")
+            done_cb([(oid, "error", err0) for oid in return_ids])
+            return
+        ident = threading.get_ident()
+        with self.worker._state_lock:
+            for oid in return_ids:
+                self.worker._exec_threads[oid] = ident
         try:
             if method == "__ray_tpu_col_init__":
                 # universal hook so create_collective_group works on any
@@ -957,9 +1058,15 @@ class ActorRuntime:
             done_cb([(oid, "error", err) for oid in return_ids])
             self._graceful_exit()
             return
+        except exc.TaskCancelledError as e:
+            reply = [(oid, "error", e) for oid in return_ids]
         except BaseException as e:  # noqa: BLE001
             err2 = exc.TaskError(e, traceback.format_exc(), method)
             reply = [(oid, "error", err2) for oid in return_ids]
+        finally:
+            with self.worker._state_lock:
+                for oid in return_ids:
+                    self.worker._exec_threads.pop(oid, None)
         done_cb(reply)
 
     def _run_coroutine(self, coro):
@@ -1099,6 +1206,40 @@ class WorkerHandler:
         from . import refcount
 
         refcount.tracker.apply_remote(from_addr, entries)
+
+    def cancel_task(self, object_ids: List[str], force: bool = False) -> bool:
+        """Cancel execution of the task producing `object_ids` (reference
+        CoreWorker::CancelTask / HandleCancelTask core_worker.cc).
+
+        force=True kills this worker process — the guaranteed stop, routed
+        through the normal worker-death path on the submitter/conductor.
+        Otherwise a TaskCancelledError is raised asynchronously in the
+        executing thread (cooperative: a thread blocked in native code,
+        e.g. time.sleep, sees it only when it re-enters the interpreter —
+        same best-effort contract as the reference's non-force cancel).
+        Also drops matching queued actor calls."""
+        if force:
+            threading.Thread(target=lambda: (time.sleep(0.05), os._exit(1)),
+                             daemon=True).start()
+            return True
+        hit = False
+        rt = self.w._actor_runtime
+        if rt is not None:
+            hit = rt.cancel(object_ids) or hit
+        with self.w._state_lock:
+            idents = {self.w._exec_threads.get(oid) for oid in object_ids}
+        idents.discard(None)
+        import ctypes
+
+        for ident in idents:
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident),
+                ctypes.py_object(exc.TaskCancelledError))
+            if n > 1:  # hit more than one thread state: revoke
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(ident), None)
+            hit = hit or n == 1
+        return hit
 
     def on_published(self, channel: str, message: Any) -> None:
         pass
