@@ -266,6 +266,14 @@ class LocalObjectStore:
         with self._cv:
             self._cv.notify_all()
 
+    def wait_change(self, timeout: float) -> None:
+        """Bounded wait for ANY readiness change (local puts, errors, and
+        remote object_available pushes routed through notify_waiters).
+        A wake between the caller's check and this wait is missed — the
+        bounded timeout makes that a latency blip, never a hang."""
+        with self._cv:
+            self._cv.wait(timeout)
+
     def wait_ready_once(self, object_id: str, timeout: float) -> bool:
         """One bounded cv wait: True iff an entry for `object_id` is ready.
         Returns early (False) on any notify_waiters() wake so callers can

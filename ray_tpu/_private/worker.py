@@ -142,6 +142,16 @@ class Worker:
         # owner-side cancelled ids + where each pending id is executing
         self._cancelled: set = set()
         self._executing_at: Dict[str, Tuple[str, int]] = {}
+        # push-based readiness (reference: ownership-based object directory
+        # callbacks, object_directory.cc subscriptions — waiters subscribe
+        # once and the owner pushes, instead of the waiter polling RPCs)
+        self._object_waiters: Dict[str, set] = {}  # owner: oid -> waiters
+        self._remote_ready: set = set()            # waiter: pushed-ready ids
+        self._subscribed: set = set()              # ids subscribed at owner
+        # conductor pubsub fan-in: channel -> local callbacks
+        self._pub_lock = threading.Lock()
+        self._pub_handlers: Dict[str, list] = {}
+        self._pub_channels: set = set()
         # executor-side: return_id -> thread ident running it (for the
         # cooperative async-exception interrupt)
         self._exec_threads: Dict[str, int] = {}
@@ -241,7 +251,9 @@ class Worker:
                     "", "submit-watchdog"))
                 with self._state_lock:
                     self._pending_ids.discard(object_id)
+                    self._cancelled.discard(object_id)
                     self._inflight.pop(object_id, None)
+                self._notify_object_waiters([object_id])
                 return
             rem = None if deadline is None else deadline - time.monotonic()
             if rem is not None and rem <= 0:
@@ -376,12 +388,11 @@ class Worker:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
         deadline = None if timeout is None else time.monotonic() + timeout
-        # Readiness is monotonic: cache known-ready ids so each ref is
-        # probed (possibly via an owner RPC) at most until first ready,
-        # and back the poll period off exponentially — a wait() over many
-        # remote refs must not hammer owners with 5ms-period RPC bursts.
+        # Push-driven: each remote ref costs at most ONE subscribe_object
+        # RPC; after that the owner pushes object_available and readiness
+        # checks are purely local. The bounded wait_change is a safety net
+        # (owner died before pushing), not a polling period.
         ready_ids: set = set()
-        sleep = 0.001
         while True:
             for r in refs:
                 if r.id not in ready_ids and self._ref_ready(r):
@@ -389,8 +400,9 @@ class Worker:
             if len(ready_ids) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
                 break
-            time.sleep(sleep)
-            sleep = min(sleep * 2, 0.05)
+            rem = None if deadline is None else deadline - time.monotonic()
+            self.store.wait_change(
+                0.25 if rem is None else max(0.0, min(0.25, rem)))
         ready = [r for r in refs if r.id in ready_ids]
         extra = ready[num_returns:]
         ready = ready[:num_returns]
@@ -401,17 +413,49 @@ class Worker:
 
     def _ref_ready(self, ref: ObjectRef) -> bool:
         if self.store.contains(ref.id) or self._locator_of(ref.id) is not None:
+            with self._state_lock:  # value landed locally: drop push state
+                self._remote_ready.discard(ref.id)
+                self._subscribed.discard(ref.id)
             return True
+        with self._state_lock:
+            if ref.id in self._remote_ready:
+                return True
         if self._is_pending_local(ref.id):
             return False
         owner = ref.owner
         if owner is None or tuple(owner) == self.address:
             return False
+        with self._state_lock:
+            if ref.id in self._subscribed:
+                return False  # owner's push will wake the store cv
+            self._subscribed.add(ref.id)
         try:
-            return bool(self.clients.get(tuple(owner)).call(
-                "object_ready", ref.id, timeout=5.0))
-        except (ConnectionLost, RemoteError):
+            ready = bool(self.clients.get(tuple(owner)).call(
+                "subscribe_object", ref.id, self.address, timeout=5.0))
+        except (ConnectionLost, RemoteError, TimeoutError):
+            # TimeoutError too: a GIL-bound owner answering late must not
+            # leave ref.id wedged in _subscribed with no push coming
+            with self._state_lock:
+                self._subscribed.discard(ref.id)
             return False
+        if ready:
+            with self._state_lock:
+                self._remote_ready.add(ref.id)
+        return ready
+
+    def _notify_object_waiters(self, object_ids: Sequence[str]) -> None:
+        """Owner-side: push readiness (value OR error recorded) to every
+        wait() subscriber of these ids, then forget them."""
+        targets: Dict[Tuple[str, int], List[str]] = {}
+        with self._state_lock:
+            for oid in object_ids:
+                for addr in self._object_waiters.pop(oid, ()):
+                    targets.setdefault(addr, []).append(oid)
+        for addr, oids in targets.items():
+            try:
+                self.clients.get(addr).notify("object_available", oids)
+            except Exception:  # noqa: BLE001 — waiter gone: nothing to wake
+                pass
 
     # -------------------------------------------------------- task submission
 
@@ -472,12 +516,18 @@ class Worker:
                 self.store.put_error(oid, err)
             with self._state_lock:
                 self._pending_ids.difference_update(spec.return_ids)
+                self._cancelled.difference_update(spec.return_ids)
                 for oid in spec.return_ids:
                     self._inflight.pop(oid, None)
+            self._notify_object_waiters(spec.return_ids)
             # infrastructure failures (worker crash, lease failure) must
-            # show up in `summary`/`timeline` as FAILED too
+            # show up in `summary`/`timeline` as FAILED too — but a cancel
+            # that aborted the submit thread is CANCELLED, same as one
+            # landing post-push
             now = time.time()
-            self._record_event(spec, now, None, "FAILED")
+            status = "CANCELLED" if isinstance(e, exc.TaskCancelledError) \
+                else "FAILED"
+            self._record_event(spec, now, None, status)
         finally:
             # release the in-flight pins taken at submission — success or
             # failure, the receiver's adoption window has closed
@@ -526,12 +576,14 @@ class Worker:
                 self.conductor.notify("return_worker", worker_id)
             except ConnectionLost:
                 pass
-        if self._is_cancelled(spec.return_ids):
-            # completed despite cancellation: the caller was already given
-            # TaskCancelledError — do not overwrite it with the value
+        # record ALWAYS: cancelled ids are skipped inside (their caller
+        # already holds TaskCancelledError) but sibling return values of a
+        # multi-return task must still be delivered
+        skipped = self._record_results(spec.return_ids, reply,
+                                       holder=tuple(address))
+        if skipped:
             self._record_event(spec, t0, tuple(address), "CANCELLED")
             return
-        self._record_results(spec.return_ids, reply, holder=tuple(address))
         status = "FAILED" if any(entry[1] == "error" for entry in reply) \
             else "FINISHED"
         self._record_event(spec, t0, tuple(address), status)
@@ -547,7 +599,11 @@ class Worker:
                 "machine": _MACHINE_ID, "traceparent": spec.traceparent}
 
     def _record_results(self, return_ids: List[str], reply: list,
-                        holder: Optional[Tuple[str, int]] = None) -> None:
+                        holder: Optional[Tuple[str, int]] = None) -> set:
+        """Record a task/actor-call reply; returns the subset of ids that
+        were cancelled (skipped — their caller already holds
+        TaskCancelledError). Settles ALL ids: pending/inflight/cancelled
+        bookkeeping is cleared whether cancelled or not."""
         with self._state_lock:
             cancelled = {oid for oid in return_ids if oid in self._cancelled}
         for oid, kind, payload in reply:
@@ -569,11 +625,13 @@ class Worker:
                         self._locators[oid] = tuple(holder)
         with self._state_lock:
             self._pending_ids.difference_update(return_ids)
+            self._cancelled.difference_update(return_ids)
             for oid in return_ids:
                 self._inflight.pop(oid, None)
         # locator-only results create no store entry: wake waiters so
         # _wait_result re-checks the pending set and moves on to fetch
         self.store.notify_waiters()
+        self._notify_object_waiters(return_ids)
         # results whose every handle died while the task was in flight
         # are freed right here (refcounting dead-pending path)
         from . import refcount
@@ -581,6 +639,7 @@ class Worker:
         for oid in return_ids:
             if refcount.tracker.was_freed_pending(oid):
                 refcount.tracker.on_result_recorded(oid)
+        return cancelled
 
     def _wait_dep_ready(self, ref: ObjectRef) -> None:
         """Block until `ref`'s value exists somewhere reachable."""
@@ -652,42 +711,63 @@ class Worker:
             for oid in wire["return_ids"]:
                 self._exec_threads[oid] = ident
         try:
-            fn = serialization.loads(wire["fn_bytes"])
-            args = tuple(self._materialize(a) for a in wire["args"])
-            kwargs = {k: self._materialize(v)
-                      for k, v in wire["kwargs"].items()}
-            from . import runtime_env as renv
+            try:
+                fn = serialization.loads(wire["fn_bytes"])
+                args = tuple(self._materialize(a) for a in wire["args"])
+                kwargs = {k: self._materialize(v)
+                          for k, v in wire["kwargs"].items()}
+                from . import runtime_env as renv
 
-            with renv.applied(self.conductor, wire.get("runtime_env")):
-                if wire.get("traceparent"):
-                    from ray_tpu.util import tracing
+                with renv.applied(self.conductor, wire.get("runtime_env")):
+                    if wire.get("traceparent"):
+                        from ray_tpu.util import tracing
 
-                    with tracing.span(f"task:{name}",
-                                      traceparent=wire["traceparent"]):
+                        with tracing.span(f"task:{name}",
+                                          traceparent=wire["traceparent"]):
+                            result = fn(*args, **kwargs)
+                    else:
                         result = fn(*args, **kwargs)
-                else:
-                    result = fn(*args, **kwargs)
+            except exc.TaskCancelledError as e:
+                return [(oid, "error", e) for oid in wire["return_ids"]]
+            except BaseException as e:  # noqa: BLE001
+                err = exc.TaskError(e, traceback.format_exc(), name)
+                return [(oid, "error", err) for oid in wire["return_ids"]]
+            return_ids = wire["return_ids"]
+            if len(return_ids) == 1:
+                results = [result]
+            else:
+                results = list(result)
+                if len(results) != len(return_ids):
+                    err = exc.TaskError(
+                        ValueError(
+                            f"task {name} returned {len(results)} values, "
+                            f"expected {len(return_ids)}"), "", name)
+                    return [(oid, "error", err) for oid in return_ids]
+            return [self._store_result(oid, value, wire.get("machine"))
+                    for oid, value in zip(return_ids, results)]
         except exc.TaskCancelledError as e:
+            # async-exc injection landed AFTER fn returned (teardown /
+            # result-serialization window) — still a cancel, not a crash
             return [(oid, "error", e) for oid in wire["return_ids"]]
-        except BaseException as e:  # noqa: BLE001
-            err = exc.TaskError(e, traceback.format_exc(), name)
-            return [(oid, "error", err) for oid in wire["return_ids"]]
         finally:
-            with self._state_lock:
-                for oid in wire["return_ids"]:
-                    self._exec_threads.pop(oid, None)
-        return_ids = wire["return_ids"]
-        if len(return_ids) == 1:
-            results = [result]
-        else:
-            results = list(result)
-            if len(results) != len(return_ids):
-                err = exc.TaskError(
-                    ValueError(f"task {name} returned {len(results)} values, "
-                               f"expected {len(return_ids)}"), "", name)
-                return [(oid, "error", err) for oid in return_ids]
-        return [self._store_result(oid, value, wire.get("machine"))
-                for oid, value in zip(return_ids, results)]
+            self._pop_exec_threads(wire["return_ids"])
+
+    def _pop_exec_threads(self, return_ids, also=None) -> None:
+        """Executor teardown that a pending async-exc injection must never
+        skip: retry until the pops (and `also`, which must be idempotent)
+        complete without interruption. Injection happens under _state_lock
+        with an _exec_threads membership check, so once the pop lands no
+        further injection can target this thread for these ids."""
+        while True:
+            try:
+                with self._state_lock:
+                    for oid in return_ids:
+                        self._exec_threads.pop(oid, None)
+                if also is not None:
+                    also()
+                break
+            except exc.TaskCancelledError:
+                continue
 
     def _materialize(self, v: Any) -> Any:
         return self._get_one(v, None) if isinstance(v, ObjectRef) else v
@@ -836,24 +916,70 @@ class Worker:
                 self.store.put_error(oid, err)
             with self._state_lock:
                 self._pending_ids.difference_update(return_ids)
+                self._cancelled.difference_update(return_ids)
                 for oid in return_ids:
                     self._inflight.pop(oid, None)
+            self._notify_object_waiters(return_ids)
         finally:
             refcount.tracker.wire_decref(arg_refs)
 
+    # --------------------------------------------------------------- pubsub
+
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Route conductor pubsub `channel` messages to `callback`
+        (reference: GcsSubscriber; here the conductor pushes on_published
+        straight at our RPC server — no long-poll loop)."""
+        with self._pub_lock:
+            self._pub_handlers.setdefault(channel, []).append(callback)
+            need_sub = channel not in self._pub_channels
+            if need_sub:
+                self._pub_channels.add(channel)
+        if need_sub:
+            try:
+                self.conductor.call("subscribe", channel, self.address,
+                                    timeout=10.0)
+            except (ConnectionLost, TimeoutError, RemoteError):
+                # callers all have polling fallbacks; an unreachable/slow
+                # conductor must not turn a subscribe into their failure
+                with self._pub_lock:
+                    self._pub_channels.discard(channel)
+
+    def unsubscribe_channel(self, channel: str, callback) -> None:
+        """Drop a local callback (the conductor-side subscription is
+        per-address and shared; it stays)."""
+        with self._pub_lock:
+            cbs = self._pub_handlers.get(channel)
+            if cbs and callback in cbs:
+                cbs.remove(callback)
+
     def _wait_actor_restart(self, actor_id: str,
                             timeout: float = 120.0) -> Tuple[str, int]:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            info = self.conductor.call("get_actor_info", actor_id,
-                                       timeout=10.0)
-            if info["state"] == "ALIVE":
-                return tuple(info["address"])
-            if info["state"] == "DEAD":
-                raise exc.ActorDiedError(actor_id,
-                                         info.get("death_cause") or "")
-            time.sleep(0.1)
-        raise exc.ActorUnavailableError(actor_id, "restart timed out")
+        """Block until the actor is ALIVE again. Event-driven: rides the
+        conductor's actor_state pubsub channel (reference GCS actor pubsub,
+        gcs_actor_manager.cc state-change publish); the 2s re-query is only
+        a safety net for a conductor restart dropping subscriptions."""
+        event = threading.Event()
+
+        def on_state(msg) -> None:
+            if isinstance(msg, dict) and msg.get("actor_id") == actor_id:
+                event.set()
+
+        self.subscribe_channel("actor_state", on_state)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                event.clear()  # before the query: a publish racing it wakes
+                info = self.conductor.call("get_actor_info", actor_id,
+                                           timeout=10.0)
+                if info["state"] == "ALIVE":
+                    return tuple(info["address"])
+                if info["state"] == "DEAD":
+                    raise exc.ActorDiedError(actor_id,
+                                             info.get("death_cause") or "")
+                event.wait(2.0)
+            raise exc.ActorUnavailableError(actor_id, "restart timed out")
+        finally:
+            self.unsubscribe_channel("actor_state", on_state)
 
     # --------------------------------------------------------- cancellation
 
@@ -864,13 +990,40 @@ class Worker:
         - running: the executor gets a cooperative TaskCancelledError
           injection (force=True kills the worker process instead — the
           guaranteed stop, surfacing through the worker-death path);
-        - queued actor call: dropped at dispatch, the actor survives.
+        - queued actor call: dropped at dispatch, the actor survives
+          (force=True is rejected for actor calls, as in the reference —
+          it would kill the whole actor, not one call);
+        - a ref owned by another process is forwarded to its owner.
         The caller's get() raises TaskCancelledError immediately either
         way; completion racing the cancel is discarded, not delivered."""
-        oid = ref.id
+        if force and ref.locator is not None and ref.owner is not None \
+                and tuple(ref.locator) != tuple(ref.owner):
+            # actor-call refs are minted with locator=executor upfront;
+            # task refs start locator-less, put() refs have locator==owner
+            raise ValueError(
+                "force=True is not supported for actor calls: it would "
+                "kill the actor process, failing every other caller "
+                "(reference ray.cancel ValueError)")
+        owner = tuple(ref.owner) if ref.owner is not None else None
+        if owner is not None and owner != self.address:
+            # borrowed ref: only the owner knows where it is executing
+            # (reference: CancelTask RPC routed to the owning worker)
+            try:
+                self.clients.get(owner).notify(
+                    "cancel_owned_object", ref.id, force,
+                    tuple(ref.locator) if ref.locator else None)
+            except ConnectionLost:
+                pass
+            return
+        self._cancel_owned(ref.id, force,
+                           tuple(ref.locator) if ref.locator else None)
+
+    def _cancel_owned(self, oid: str, force: bool,
+                      locator: Optional[Tuple[str, int]]) -> None:
         with self._state_lock:
             still_mine = oid in self._pending_ids
-            self._cancelled.add(oid)
+            if still_mine:
+                self._cancelled.add(oid)
             where = self._executing_at.get(oid)
         if not still_mine:
             return  # already finished (or not ours): nothing to cancel
@@ -878,8 +1031,9 @@ class Worker:
         self.store.put_error(oid, exc.TaskCancelledError(
             f"task for {oid[:12]}… cancelled"
             + (" (force)" if force else "")))
-        if where is None and ref.locator is not None:
-            where = tuple(ref.locator)  # actor call: executor known upfront
+        self._notify_object_waiters([oid])
+        if where is None and locator is not None:
+            where = tuple(locator)  # actor call: executor known upfront
         if where is not None:
             try:
                 self.clients.get(tuple(where)).notify(
@@ -954,6 +1108,12 @@ class ActorRuntime:
         self._next_seqno: Dict[str, int] = {}
         self._reorder: Dict[str, Dict[int, tuple]] = {}
         self._cancelled: set = set()  # return_ids dropped before dispatch
+        self._known: set = set()      # return_ids queued or executing
+        # replies go out from a thread that is never an injection target
+        # (not in _exec_threads): an async-exc landing mid reply-frame
+        # write would corrupt the connection for every later reply
+        self._reply_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"actor-reply-{actor_id[:8]}")
         self._cv = threading.Condition()
         self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._exec_pool = ThreadPoolExecutor(
@@ -969,10 +1129,13 @@ class ActorRuntime:
             # unordered (post-restart retry): skip the reorder buffer —
             # ordering across a restart boundary is best-effort, matching the
             # reference's at-least-once actor-retry semantics.
+            with self._cv:
+                self._known.update(return_ids)
             self._queue.put((method, args, kwargs, return_ids, done_cb,
                              caller_machine, traceparent))
             return
         with self._cv:
+            self._known.update(return_ids)
             # A fresh runtime (post-restart) may first see a caller mid-stream;
             # adopt its current seqno as the starting point.
             expected = self._next_seqno.setdefault(caller_id, seqno)
@@ -990,20 +1153,31 @@ class ActorRuntime:
             if item is None:
                 return
             if self.max_concurrency == 1:
-                self._run_one(item)
+                self._run_one_safe(item)
             else:
-                self._exec_pool.submit(self._run_one, item)
+                self._exec_pool.submit(self._run_one_safe, item)
             # don't pin the last call's args while idle in queue.get()
             item = None
+
+    def _run_one_safe(self, item) -> None:
+        try:
+            self._run_one(item)
+        except exc.TaskCancelledError:
+            # stray async-exc that fired after _run_one delivered its
+            # reply: absorb it so the dispatch/pool thread survives
+            pass
 
     def cancel(self, object_ids) -> bool:
         """Mark queued calls cancelled (dropped with TaskCancelledError at
         dispatch — the actor itself survives; reference: pending actor
         tasks cancel with TaskCancelledError, running ones are interrupted
-        via the worker's async-exc path)."""
+        via the worker's async-exc path). Only ids still queued/executing
+        here are marked — a cancel racing an already-delivered completion
+        must not leave a permanent mark."""
         with self._cv:
-            self._cancelled.update(object_ids)
-        return True
+            live = [oid for oid in object_ids if oid in self._known]
+            self._cancelled.update(live)
+        return bool(live)
 
     def _run_one(self, item) -> None:
         (method, args, kwargs, return_ids, done_cb, caller_machine,
@@ -1011,14 +1185,51 @@ class ActorRuntime:
         with self._cv:
             dropped = any(oid in self._cancelled for oid in return_ids)
             self._cancelled.difference_update(return_ids)
+            if dropped:
+                self._known.difference_update(return_ids)
         if dropped:
             err0 = exc.TaskCancelledError(f"{method} cancelled while queued")
             done_cb([(oid, "error", err0) for oid in return_ids])
             return
+        delivered = [False]
+
+        def deliver(reply) -> None:
+            # exactly-once and hang-proof: the reply is handed to the
+            # reply pool (whose thread is never an injection target) and
+            # the flag flips only after the handoff succeeded. A stray
+            # TaskCancelledError inside submit() retries; the worst case
+            # is a duplicate enqueue, and the RPC client drops replies
+            # with an already-settled req_id.
+            while not delivered[0]:
+                try:
+                    self._reply_pool.submit(done_cb, reply)
+                    delivered[0] = True
+                except exc.TaskCancelledError:
+                    continue
+
         ident = threading.get_ident()
         with self.worker._state_lock:
             for oid in return_ids:
                 self.worker._exec_threads[oid] = ident
+        try:
+            self._call_and_reply(method, args, kwargs, return_ids, deliver,
+                                 caller_machine, traceparent)
+        except exc.TaskCancelledError as e:
+            # async-exc landed in the teardown window after the method
+            # returned — deliver the cancel (no-op if already delivered)
+            deliver([(oid, "error", e) for oid in return_ids])
+        finally:
+            # marks for calls cancelled while RUNNING (not queued) are
+            # consumed alongside the pops, not leaked
+            def consume_marks() -> None:
+                with self._cv:
+                    self._cancelled.difference_update(return_ids)
+                    self._known.difference_update(return_ids)
+
+            self.worker._pop_exec_threads(return_ids, also=consume_marks)
+
+    def _call_and_reply(self, method, args, kwargs, return_ids, deliver,
+                        caller_machine, traceparent) -> None:
         try:
             if method == "__ray_tpu_col_init__":
                 # universal hook so create_collective_group works on any
@@ -1055,7 +1266,7 @@ class ActorRuntime:
                      for oid, value in zip(return_ids, results)]
         except SystemExit:
             err = exc.ActorDiedError(self.actor_id, "exit_actor() called")
-            done_cb([(oid, "error", err) for oid in return_ids])
+            deliver([(oid, "error", err) for oid in return_ids])
             self._graceful_exit()
             return
         except exc.TaskCancelledError as e:
@@ -1063,11 +1274,7 @@ class ActorRuntime:
         except BaseException as e:  # noqa: BLE001
             err2 = exc.TaskError(e, traceback.format_exc(), method)
             reply = [(oid, "error", err2) for oid in return_ids]
-        finally:
-            with self.worker._state_lock:
-                for oid in return_ids:
-                    self.worker._exec_threads.pop(oid, None)
-        done_cb(reply)
+        deliver(reply)
 
     def _run_coroutine(self, coro):
         if self._loop is None:
@@ -1077,6 +1284,9 @@ class ActorRuntime:
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
 
     def _graceful_exit(self) -> None:
+        # flush the in-flight reply (exit_actor's own ActorDiedError) —
+        # os._exit would otherwise drop it before the frame hits the wire
+        self._reply_pool.shutdown(wait=True)
         try:
             self.worker.conductor.call("report_actor_exit", self.actor_id,
                                        "exit_actor() called", timeout=5.0)
@@ -1187,11 +1397,41 @@ class WorkerHandler:
                 raise exc.ObjectLostError(object_id, "unknown to owner")
             w.store.wait_ready(object_id, 0.2)
 
-    def object_ready(self, object_id: str) -> bool:
+    def subscribe_object(self, object_id: str,
+                         waiter: Tuple[str, int]) -> bool:
+        """Register `waiter` for an object_available push when `object_id`
+        resolves (value OR error); True if it is already ready, in which
+        case no push will follow. Replaces object_ready polling for wait()
+        (reference: WaitForObjectEviction-style owner callbacks)."""
         w = self.w
         if w.store.contains(object_id) or w._locator_of(object_id):
             return True
+        with w._state_lock:
+            w._object_waiters.setdefault(object_id, set()).add(tuple(waiter))
+        # re-check AFTER registering: a result recorded between the first
+        # check and the insert has already popped (or will never see) the
+        # table entry — without this the waiter could miss its only push
+        if w.store.contains(object_id) or w._locator_of(object_id):
+            with w._state_lock:
+                s = w._object_waiters.get(object_id)
+                if s is not None:
+                    s.discard(tuple(waiter))
+                    if not s:
+                        w._object_waiters.pop(object_id, None)
+            return True
         return False
+
+    def object_available(self, object_ids: List[str]) -> None:
+        """Owner's readiness push for ids we subscribed to."""
+        w = self.w
+        with w._state_lock:
+            w._remote_ready.update(object_ids)
+            if len(w._remote_ready) > 1 << 16:
+                # bounded: dropping entries only costs a re-subscribe RPC
+                w._remote_ready.clear()
+                w._subscribed.clear()
+                w._remote_ready.update(object_ids)
+        w.store.notify_waiters()
 
     def release_object(self, object_id: str) -> None:
         self.w.store.delete(object_id)
@@ -1219,6 +1459,14 @@ class WorkerHandler:
         same best-effort contract as the reference's non-force cancel).
         Also drops matching queued actor calls."""
         if force:
+            # only if a target is STILL executing here: the task may have
+            # finished (and this worker been leased to someone else's task)
+            # between the owner reading _executing_at and this arriving —
+            # killing then would take down an innocent task
+            with self.w._state_lock:
+                live = any(oid in self.w._exec_threads for oid in object_ids)
+            if not live:
+                return False
             threading.Thread(target=lambda: (time.sleep(0.05), os._exit(1)),
                              daemon=True).start()
             return True
@@ -1226,23 +1474,44 @@ class WorkerHandler:
         rt = self.w._actor_runtime
         if rt is not None:
             hit = rt.cancel(object_ids) or hit
-        with self.w._state_lock:
-            idents = {self.w._exec_threads.get(oid) for oid in object_ids}
-        idents.discard(None)
         import ctypes
 
-        for ident in idents:
-            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_ulong(ident),
-                ctypes.py_object(exc.TaskCancelledError))
-            if n > 1:  # hit more than one thread state: revoke
-                ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                    ctypes.c_ulong(ident), None)
-            hit = hit or n == 1
+        # Inject while HOLDING _state_lock: the executor pops its
+        # _exec_threads entry under the same lock in its teardown, so a
+        # finished task can never be "hit" after its pop — the injection
+        # lands in the target task's frame or its guarded teardown, never
+        # in the next task reusing the pool thread.
+        with self.w._state_lock:
+            idents = {self.w._exec_threads.get(oid) for oid in object_ids}
+            idents.discard(None)
+            for ident in idents:
+                n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(ident),
+                    ctypes.py_object(exc.TaskCancelledError))
+                if n > 1:  # hit more than one thread state: revoke
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(ident), None)
+                hit = hit or n == 1
         return hit
 
+    def cancel_owned_object(self, object_id: str, force: bool,
+                            locator) -> None:
+        """A borrower's forwarded cancel for an object WE own (reference:
+        CancelTask RPC arriving at the owning core worker)."""
+        self.w._cancel_owned(object_id, bool(force),
+                             tuple(locator) if locator else None)
+
     def on_published(self, channel: str, message: Any) -> None:
-        pass
+        """Conductor pubsub delivery: fan out to local subscribers
+        registered via Worker.subscribe_channel."""
+        w = self.w
+        with w._pub_lock:
+            cbs = list(w._pub_handlers.get(channel, ()))
+        for cb in cbs:
+            try:
+                cb(message)
+            except Exception:  # noqa: BLE001 — one bad callback ≠ all
+                pass
 
     def shutdown_worker(self) -> None:
         threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)),

@@ -129,3 +129,76 @@ def test_cancel_completed_task_is_noop(cluster):
     assert ray_tpu.get(ref, timeout=60.0) == 7
     ray_tpu.cancel(ref)  # no effect
     assert ray_tpu.get(ref, timeout=10.0) == 7
+
+
+def test_cancel_force_on_actor_call_rejected(cluster):
+    """force=True would kill the whole actor (failing every other caller)
+    — rejected with ValueError like the reference's ray.cancel."""
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            time.sleep(30)
+
+    a = A.remote()
+    ref = a.slow.remote()
+    time.sleep(0.3)
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(ref, force=True)
+    ray_tpu.cancel(ref)  # non-force still works
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10.0)
+
+
+def test_cancel_one_of_multi_return_delivers_siblings(cluster):
+    """Cancelling one return ref must not abandon the sibling ids."""
+    @ray_tpu.remote(num_returns=2)
+    def pair():
+        time.sleep(0.8)
+        return "a", "b"
+
+    r1, r2 = pair.remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(r1)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r1, timeout=10.0)
+    # the sibling still resolves: either the computed value (cancel landed
+    # too late to stop execution) or a cancel error — never a hang or a
+    # fabricated watchdog error
+    try:
+        assert ray_tpu.get(r2, timeout=10.0) == "b"
+    except TaskCancelledError:
+        pass
+
+
+def test_cancel_borrowed_ref_forwards_to_owner(cluster):
+    """cancel() of a ref owned by another process reaches the owner and
+    stops the task (reference: CancelTask RPC to the owning worker)."""
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(30)
+        return "done"
+
+    @ray_tpu.remote
+    class Owner:
+        def start(self):
+            self.ref = sleeper.remote()
+            return [self.ref]
+
+        def probe(self):
+            try:
+                return ray_tpu.get(self.ref, timeout=0.1)
+            except Exception as e:  # noqa: BLE001
+                return type(e).__name__
+
+    o = Owner.remote()
+    [ref] = ray_tpu.get(o.start.remote())
+    time.sleep(0.5)  # task is executing on some worker now
+    ray_tpu.cancel(ref)  # we are a borrower: must forward to the owner
+    deadline = time.monotonic() + 10
+    seen = None
+    while time.monotonic() < deadline:
+        seen = ray_tpu.get(o.probe.remote())
+        if seen == "TaskCancelledError":
+            break
+        time.sleep(0.2)
+    assert seen == "TaskCancelledError", seen

@@ -1,0 +1,120 @@
+"""Push-based object readiness: wait() subscribes once per remote ref and
+the owner pushes object_available — no steady-state object_ready polling
+(reference: ownership-based object directory callbacks,
+src/ray/core_worker/object_recovery_manager / object_directory
+subscriptions, replacing the r2 50ms probe loop)."""
+from __future__ import annotations
+
+import collections
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_wait_remote_ref_push_not_poll(ray_start_regular, monkeypatch):
+    from ray_tpu._private import rpc as rpc_mod
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.0)
+        return 42
+
+    @ray_tpu.remote
+    class Owner:
+        def start(self):
+            self.ref = slow.remote()
+            return [self.ref]
+
+    o = Owner.remote()
+    [ref] = ray_tpu.get(o.start.remote())
+
+    calls: collections.Counter = collections.Counter()
+    orig = rpc_mod.RpcClient.call
+
+    def counting(self, method, *a, **kw):
+        calls[method] += 1
+        return orig(self, method, *a, **kw)
+
+    monkeypatch.setattr(rpc_mod.RpcClient, "call", counting)
+
+    ready, not_ready = ray_tpu.wait([ref], timeout=10)
+    assert [r.id for r in ready] == [ref.id] and not not_ready
+    assert ray_tpu.get(ref) == 42
+    # exactly one subscription RPC; zero polling probes over the ~1s wait
+    assert calls["subscribe_object"] == 1
+    assert calls["object_ready"] == 0
+
+
+def test_wait_many_remote_refs_one_rpc_each(ray_start_regular, monkeypatch):
+    from ray_tpu._private import rpc as rpc_mod
+
+    @ray_tpu.remote
+    def slow(i):
+        time.sleep(0.5 + 0.05 * i)
+        return i
+
+    @ray_tpu.remote
+    class Owner:
+        def start(self, n):
+            return [[slow.remote(i)] for i in range(n)]
+
+    o = Owner.remote()
+    refs = [r for (r,) in ray_tpu.get(o.start.remote(8))]
+
+    calls: collections.Counter = collections.Counter()
+    orig = rpc_mod.RpcClient.call
+
+    def counting(self, method, *a, **kw):
+        calls[method] += 1
+        return orig(self, method, *a, **kw)
+
+    monkeypatch.setattr(rpc_mod.RpcClient, "call", counting)
+
+    ready, not_ready = ray_tpu.wait(refs, num_returns=len(refs), timeout=20)
+    assert len(ready) == len(refs) and not not_ready
+    assert sorted(ray_tpu.get(refs)) == list(range(8))
+    assert calls["subscribe_object"] <= len(refs)
+    assert calls["object_ready"] == 0
+
+
+def test_wait_timeout_then_push_completes(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.0)
+        return "done"
+
+    @ray_tpu.remote
+    class Owner:
+        def start(self):
+            return [slow.remote()]
+
+    o = Owner.remote()
+    [ref] = ray_tpu.get(o.start.remote())
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.15)
+    assert not ready and [r.id for r in not_ready] == [ref.id]
+    # second wait reuses the existing subscription and is woken by the push
+    ready, not_ready = ray_tpu.wait([ref], timeout=10)
+    assert ready and not not_ready
+    assert ray_tpu.get(ref) == "done"
+
+
+def test_wait_remote_error_pushes_ready(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        time.sleep(0.3)
+        raise ValueError("bad")
+
+    @ray_tpu.remote
+    class Owner:
+        def start(self):
+            return [boom.remote()]
+
+    o = Owner.remote()
+    [ref] = ray_tpu.get(o.start.remote())
+    # errors count as "ready" for wait(), exactly like the reference
+    ready, not_ready = ray_tpu.wait([ref], timeout=10)
+    assert ready and not not_ready
+    with pytest.raises(Exception):
+        ray_tpu.get(ref)
