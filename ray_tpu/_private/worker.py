@@ -152,6 +152,9 @@ class Worker:
         self._pub_lock = threading.Lock()
         self._pub_handlers: Dict[str, list] = {}
         self._pub_channels: set = set()
+        # local endpoints remote producers push stream_chunk frames at
+        # (reference: streaming generator refs, task_manager ObjectRefStream)
+        self._streams: Dict[str, "queue.Queue"] = {}
         # executor-side: return_id -> thread ident running it (for the
         # cooperative async-exception interrupt)
         self._exec_threads: Dict[str, int] = {}
@@ -390,16 +393,29 @@ class Worker:
         deadline = None if timeout is None else time.monotonic() + timeout
         # Push-driven: each remote ref costs at most ONE subscribe_object
         # RPC; after that the owner pushes object_available and readiness
-        # checks are purely local. The bounded wait_change is a safety net
-        # (owner died before pushing), not a polling period.
+        # checks are purely local. The bounded wait_change handles lost
+        # wakes; the ~5s re-subscribe heals lost PUSHES (owner's notify hit
+        # a transient connection drop after it already forgot the waiter,
+        # or the owner restarted) — without it a single failed push would
+        # wedge this waiter forever.
         ready_ids: set = set()
+        idle_cycles = 0
         while True:
+            progressed = False
             for r in refs:
                 if r.id not in ready_ids and self._ref_ready(r):
                     ready_ids.add(r.id)
+                    progressed = True
             if len(ready_ids) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
                 break
+            idle_cycles = 0 if progressed else idle_cycles + 1
+            if idle_cycles >= 20:  # ~5s of silence: re-probe the owners
+                idle_cycles = 0
+                with self._state_lock:
+                    for r in refs:
+                        if r.id not in ready_ids:
+                            self._subscribed.discard(r.id)
             rem = None if deadline is None else deadline - time.monotonic()
             self.store.wait_change(
                 0.25 if rem is None else max(0.0, min(0.25, rem)))
@@ -1041,6 +1057,25 @@ class Worker:
             except ConnectionLost:
                 pass
 
+    # ------------------------------------------------------------ streaming
+
+    def open_stream(self) -> Tuple[str, "queue.Queue"]:
+        """Create a local stream endpoint. A remote producer pushes
+        (seq, payload) frames at it via the stream_chunk RPC; the consumer
+        drains the returned queue. Used by Serve's streaming responses
+        (reference: streaming ObjectRefGenerator, replica.py:470)."""
+        stream_id = uuid.uuid4().hex
+        q: "queue.Queue" = queue.Queue()
+        with self._state_lock:
+            self._streams[stream_id] = q
+        return stream_id, q
+
+    def close_stream(self, stream_id: str) -> None:
+        """Drop the endpoint; subsequent producer pushes are acked False
+        so the producer can stop generating."""
+        with self._state_lock:
+            self._streams.pop(stream_id, None)
+
     # ----------------------------------------------------------- async get
 
     def get_future(self, ref: ObjectRef) -> Future:
@@ -1276,12 +1311,19 @@ class ActorRuntime:
             reply = [(oid, "error", err2) for oid in return_ids]
         deliver(reply)
 
-    def _run_coroutine(self, coro):
+    def ensure_loop(self) -> asyncio.AbstractEventLoop:
+        """The actor's persistent event loop — ALL of this actor's async
+        work must share it so loop-bound primitives (asyncio.Queue/Lock
+        created in async methods) stay usable across calls."""
         if self._loop is None:
             self._loop = asyncio.new_event_loop()
             threading.Thread(target=self._loop.run_forever, daemon=True,
                              name="actor-asyncio").start()
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+        return self._loop
+
+    def _run_coroutine(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.ensure_loop()
+                                                ).result()
 
     def _graceful_exit(self) -> None:
         # flush the in-flight reply (exit_actor's own ActorDiedError) —
@@ -1439,6 +1481,16 @@ class WorkerHandler:
     def free_objects(self, object_ids: List[str]) -> None:
         for oid in object_ids:
             self.w.store.delete(oid)
+
+    def stream_chunk(self, stream_id: str, seq: int, payload: bytes) -> bool:
+        """Producer push into a local stream endpoint; False tells the
+        producer the consumer is gone (stop generating)."""
+        with self.w._state_lock:
+            q = self.w._streams.get(stream_id)
+        if q is None:
+            return False
+        q.put((seq, payload))
+        return True
 
     def refcount_update(self, from_addr, entries) -> None:
         """Batched borrower incref/adopt/drop messages (reference

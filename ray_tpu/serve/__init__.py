@@ -114,7 +114,7 @@ def _get_controller(create: bool = True, http_options:
     http_options = http_options or HTTPOptions()
     ctrl = ray_tpu.remote(ServeController).options(
         name=CONTROLLER_NAME, max_concurrency=64).remote(
-            http_options.host, http_options.port)
+            http_options.host, http_options.port, http_options.grpc_port)
     return ctrl
 
 
@@ -215,6 +215,54 @@ def proxy_address() -> Optional[tuple]:
             return tuple(addr)
         time.sleep(0.1)
     return None
+
+
+def grpc_address() -> Optional[tuple]:
+    """(host, port) of the gRPC ingress, or None when it is disabled
+    (enable with serve.start(grpc_port=...))."""
+    import ray_tpu
+    ctrl = _get_controller(create=False)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        state, addr = ray_tpu.get(ctrl.get_grpc_address.remote(),
+                                  timeout=30.0)
+        if state == "disabled":
+            return None
+        if addr is not None:
+            return tuple(addr)
+        time.sleep(0.1)
+    return None
+
+
+def grpc_call(address: tuple, *args, application: str = "default",
+              call_method: str = "__call__", streaming: bool = False,
+              timeout_s: float = 60.0, **kwargs):
+    """Client helper for the generic gRPC ingress: returns the result of
+    the app's ingress deployment, or an iterator of chunks when
+    streaming=True (reference: serve gRPC client usage via generated
+    stubs; here messages are cloudpickled so no stub generation step)."""
+    import cloudpickle as cp
+    import grpc
+
+    channel = grpc.insecure_channel(f"{address[0]}:{address[1]}")
+    md = (("application", application), ("call_method", call_method))
+    payload = cp.dumps((args, kwargs))
+    if not streaming:
+        fn = channel.unary_unary("/ray_tpu.serve.Ingress/Call")
+        try:
+            return cp.loads(fn(payload, metadata=md, timeout=timeout_s))
+        finally:
+            channel.close()
+    fn = channel.unary_stream("/ray_tpu.serve.Ingress/CallStreaming")
+
+    def it():
+        try:
+            for msg in fn(payload, metadata=md, timeout=timeout_s):
+                yield cp.loads(msg)
+        finally:
+            channel.close()
+
+    return it()
 
 
 def delete(name: str) -> None:

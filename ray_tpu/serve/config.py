@@ -53,6 +53,9 @@ class DeploymentConfig:
 
 @dataclass
 class HTTPOptions:
-    """Proxy options — reference python/ray/serve/config.py HTTPOptions."""
+    """Proxy options — reference python/ray/serve/config.py HTTPOptions
+    (+ gRPCOptions folded in: grpc_port=None disables the gRPC ingress,
+    matching the reference's opt-in gRPC proxy)."""
     host: str = "127.0.0.1"
     port: int = 8000
+    grpc_port: Optional[int] = None

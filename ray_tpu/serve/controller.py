@@ -49,7 +49,8 @@ class _DeploymentState:
 class ServeController:
     """Reference controller.py:85 — singleton detached actor."""
 
-    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000):
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000,
+                 grpc_port: Optional[int] = None):
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
         self._version = 0
@@ -57,6 +58,8 @@ class ServeController:
         self._shutting_down = False
         self._http_host = http_host
         self._http_port = http_port
+        self._grpc_port = grpc_port
+        self._grpc_addr: Optional[Tuple[str, int]] = None
         self._proxy = None
         self._proxy_addr: Optional[Tuple[str, int]] = None
         self._reconcile_thread = threading.Thread(
@@ -170,6 +173,14 @@ class ServeController:
     def get_proxy_address(self) -> Optional[Tuple[str, int]]:
         return self._proxy_addr
 
+    def get_grpc_address(self):
+        """('disabled', None) when no grpc_port was configured — lets
+        clients return immediately instead of polling out a deadline —
+        else ('ok', addr_or_None_while_binding)."""
+        if self._grpc_port is None:
+            return ("disabled", None)
+        return ("ok", self._grpc_addr)
+
     # -- reconciliation -----------------------------------------------------
     def _reconcile_loop(self):
         while not self._shutting_down:
@@ -188,10 +199,13 @@ class ServeController:
         from .proxy import ProxyActor
         self._proxy = ray_tpu.remote(ProxyActor).options(
             name=PROXY_NAME, max_concurrency=32).remote(
-                self._http_host, self._http_port)
+                self._http_host, self._http_port, self._grpc_port)
         self._proxy_addr = tuple(ray_tpu.get(self._proxy.ready.remote()))
         # The proxy skips ports already in use — report the bound one.
         self._http_host, self._http_port = self._proxy_addr
+        if self._grpc_port is not None:
+            addr = ray_tpu.get(self._proxy.grpc_address.remote())
+            self._grpc_addr = tuple(addr) if addr else None
 
     def _reconcile_once(self):
         import ray_tpu

@@ -70,6 +70,113 @@ class DeploymentResponse:
             pass
 
 
+class StreamingDeploymentResponse:
+    """Iterator over chunks a replica streams back (reference
+    handle.py DeploymentResponseGenerator / replica handle_request_
+    streaming). Chunks arrive as stream_chunk pushes into a local worker
+    stream endpoint; iteration ends when the replica's final reply lands
+    and every pushed chunk is consumed. If the user method returned a
+    plain value instead of a generator, iteration yields nothing and
+    `.value` holds the result (`.kind` tells which case occurred).
+
+    Not picklable — consume it in the process that made the call."""
+
+    _POLL_S = 0.05
+
+    def __init__(self, object_ref, router: "Router", replica_tag: str,
+                 stream_id: str, chunk_queue,
+                 chunk_timeout_s: float = 120.0):
+        self._ref = object_ref
+        self._router = router
+        self._replica_tag = replica_tag
+        self._stream_id = stream_id
+        self._queue = chunk_queue
+        self._chunk_timeout_s = chunk_timeout_s
+        self._consumed = 0
+        self._total: Optional[int] = None  # known once the reply lands
+        self._buffer: Dict[int, bytes] = {}
+        self._finished = False
+        self.kind: Optional[str] = None    # "gen" | "value"
+        self.value: Any = None
+
+    def __iter__(self) -> "StreamingDeploymentResponse":
+        return self
+
+    def __next__(self) -> Any:
+        import queue as _queue
+
+        from ray_tpu._private import serialization
+
+        deadline = time.monotonic() + self._chunk_timeout_s
+        while True:
+            if self._consumed in self._buffer:
+                payload = self._buffer.pop(self._consumed)
+                self._consumed += 1
+                return serialization.loads(payload)
+            try:
+                seq, payload = self._queue.get(timeout=self._POLL_S)
+                self._buffer[seq] = payload
+                continue
+            except _queue.Empty:
+                pass
+            try:
+                self._check_final()
+            except BaseException:
+                self._finish()
+                raise
+            if self.kind == "value" or (
+                    self._total is not None
+                    and self._consumed >= self._total):
+                self._finish()
+                raise StopIteration
+            if time.monotonic() > deadline:
+                self._finish()
+                raise TimeoutError(
+                    f"no stream chunk within {self._chunk_timeout_s}s")
+
+    def _check_final(self) -> None:
+        """Adopt the replica's final reply once it is ready (non-blocking);
+        raises the replica's error if the stream failed mid-generation."""
+        if self.kind is not None:
+            return
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait([self._ref], timeout=0)
+        if not ready:
+            return
+        kind, payload = ray_tpu.get(self._ref)
+        if kind == "value":
+            self.kind, self.value = "value", payload
+        else:
+            self.kind, self._total = "gen", int(payload)
+
+    def first_event(self):
+        """('chunk', item) | ('value', v) | ('end', None) — lets the HTTP
+        proxy decide between a plain and a chunked response."""
+        try:
+            return ("chunk", next(self))
+        except StopIteration:
+            if self.kind == "value":
+                return ("value", self.value)
+            return ("end", None)
+
+    def _finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker is not None:
+            global_worker.close_stream(self._stream_id)
+        self._router._complete(self._replica_tag)
+
+    def __del__(self):
+        try:
+            self._finish()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
 class Router:
     """Caches the replica set for one deployment (refreshed from the
     controller on a version bump) and schedules requests pow-2 style."""
@@ -179,6 +286,30 @@ class Router:
                 self._refresh(force=True)
         raise last_err  # type: ignore[misc]
 
+    def assign_stream(self, meta: RequestMetadata, args, kwargs,
+                      retries: int = 2) -> StreamingDeploymentResponse:
+        """Streaming variant of assign: opens a local stream endpoint the
+        replica pushes chunks at (reference router streaming path)."""
+        from ray_tpu._private.worker import global_worker
+
+        self._start_metrics_push()
+        last_err: Optional[Exception] = None
+        for _ in range(retries + 1):
+            tag, handle = self._pick()
+            stream_id, q = global_worker.open_stream()
+            try:
+                ref = handle.handle_request_streaming.remote(
+                    meta.to_dict(), list(args), dict(kwargs), stream_id,
+                    tuple(global_worker.address))
+                return StreamingDeploymentResponse(ref, self, tag,
+                                                   stream_id, q)
+            except Exception as e:  # noqa: BLE001 — dead replica: retry
+                global_worker.close_stream(stream_id)
+                last_err = e
+                self._complete(tag)
+                self._refresh(force=True)
+        raise last_err  # type: ignore[misc]
+
 
 # One Router per (app, deployment) per process — shared across all handles
 # (including the throwaway ones __getattr__/options() mint), so pow-2
@@ -213,27 +344,29 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str = "default",
                  _call_method: str = "__call__",
-                 _multiplexed_model_id: str = ""):
+                 _multiplexed_model_id: str = "", _stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._call_method = _call_method
         self._multiplexed_model_id = _multiplexed_model_id
+        self._stream = _stream
 
     @property
     def _router(self) -> Router:
         return _shared_router(self.deployment_name, self.app_name)
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             _call_method=method_name or self._call_method,
             _multiplexed_model_id=(multiplexed_model_id
                                    if multiplexed_model_id is not None
-                                   else self._multiplexed_model_id))
+                                   else self._multiplexed_model_id),
+            _stream=self._stream if stream is None else stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         meta = RequestMetadata(
             call_method=self._call_method,
             multiplexed_model_id=self._multiplexed_model_id,
@@ -242,6 +375,8 @@ class DeploymentHandle:
                      else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
+        if self._stream:
+            return self._router.assign_stream(meta, args, kwargs)
         return self._router.assign(meta, args, kwargs)
 
     def __getattr__(self, name: str):
@@ -252,7 +387,7 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._call_method,
-                 self._multiplexed_model_id))
+                 self._multiplexed_model_id, self._stream))
 
     def __repr__(self):
         return (f"DeploymentHandle(deployment='{self.deployment_name}', "

@@ -20,8 +20,27 @@ from .http_util import Request, coerce_response
 MULTIPLEX_HEADER = "serve_multiplexed_model_id"
 
 
+def _encode_chunk(item, sse: bool) -> bytes:
+    """Wire form of one streamed chunk: SSE data-frames when the client
+    asked for an event stream, raw bytes otherwise."""
+    if isinstance(item, bytes):
+        data = item
+    elif isinstance(item, str):
+        data = item.encode()
+    else:
+        data = json.dumps(item, default=str).encode()
+    if sse:
+        # one 'data:' field line per embedded newline, per the SSE spec —
+        # a raw newline inside a data line would be dropped by compliant
+        # event-stream parsers
+        return b"".join(b"data: " + ln + b"\n"
+                        for ln in data.split(b"\n")) + b"\n"
+    return data
+
+
 class ProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 grpc_port: Optional[int] = None):
         self._host = host
         self._port = port
         self._routes: Dict[str, Tuple[str, str]] = {}
@@ -32,10 +51,14 @@ class ProxyActor:
         self._shutdown = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=32,
                                         thread_name_prefix="proxy-call")
+        self._grpc_server = None
+        self._grpc_bound_port: Optional[int] = None
         threading.Thread(target=self._serve_thread, daemon=True,
                          name="serve-proxy-http").start()
         threading.Thread(target=self._route_poll_loop, daemon=True,
                          name="serve-proxy-routes").start()
+        if grpc_port is not None:
+            self._start_grpc(grpc_port)
 
     # -- control ------------------------------------------------------------
     def ready(self) -> Tuple[str, int]:
@@ -43,9 +66,80 @@ class ProxyActor:
             raise RuntimeError("proxy HTTP server failed to start")
         return (self._host, self._bound_port)
 
+    def grpc_address(self) -> Optional[Tuple[str, int]]:
+        if self._grpc_bound_port is None:
+            return None
+        return (self._host, self._grpc_bound_port)
+
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1.0)
         return True
+
+    # -- gRPC ingress -------------------------------------------------------
+    def _start_grpc(self, grpc_port: int) -> None:
+        """Generic-handler gRPC ingress (reference serve gRPC proxy,
+        python/ray/serve/_private/proxy.py gRPCProxy + serve.proto).
+        No generated stubs: the service is registered dynamically with
+        raw-bytes messages — Call (unary) and CallStreaming (server
+        streaming); request bytes are a cloudpickled (args, kwargs) pair,
+        routing metadata keys are 'application' and 'call_method'."""
+        import grpc
+
+        import cloudpickle as cp
+
+        def meta_of(context) -> Tuple[str, str]:
+            md = dict(context.invocation_metadata())
+            return md.get("application", "default"), \
+                md.get("call_method", "__call__")
+
+        def resolve(context):
+            app, method = meta_of(context)
+            ingress = next((d for (a, d) in self._routes.values()
+                            if a == app), None)
+            if ingress is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no application named '{app}'")
+            handle = self._handle_for(app, ingress)
+            meta = RequestMetadata(call_method=method, app_name=app)
+            return handle, meta
+
+        def unary_call(request: bytes, context) -> bytes:
+            handle, meta = resolve(context)
+            args, kwargs = cp.loads(request)
+            try:
+                resp = handle._router.assign(meta, args, kwargs)
+                return cp.dumps(resp.result(timeout_s=60.0))
+            except Exception as e:  # noqa: BLE001 — surface as INTERNAL
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        def stream_call(request: bytes, context):
+            handle, meta = resolve(context)
+            args, kwargs = cp.loads(request)
+            try:
+                sresp = handle._router.assign_stream(meta, args, kwargs)
+                for item in sresp:
+                    yield cp.dumps(item)
+                if sresp.kind == "value":  # plain method: one message
+                    yield cp.dumps(sresp.value)
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+
+        service = grpc.method_handlers_generic_handler(
+            "ray_tpu.serve.Ingress",
+            {"Call": grpc.unary_unary_rpc_method_handler(unary_call),
+             "CallStreaming":
+                 grpc.unary_stream_rpc_method_handler(stream_call)})
+        self._grpc_server = grpc.server(
+            ThreadPoolExecutor(max_workers=16,
+                               thread_name_prefix="proxy-grpc"))
+        self._grpc_server.add_generic_rpc_handlers((service,))
+        self._grpc_bound_port = self._grpc_server.add_insecure_port(
+            f"{self._host}:{grpc_port}")
+        self._grpc_server.start()
 
     def _controller(self):
         import ray_tpu
@@ -84,12 +178,15 @@ class ProxyActor:
 
     def _call_replica(self, app: str, ingress: str, req: Request,
                       route: str):
+        """Every HTTP request rides the streaming path (reference: the
+        proxy always calls handle_request_streaming, replica.py:470) —
+        plain returns come back in the final reply with zero stream
+        traffic, generator returns stream chunk-by-chunk."""
         handle = self._handle_for(app, ingress)
         meta = RequestMetadata(
             call_method="__call__", is_http=True, app_name=app, route=route,
             multiplexed_model_id=req.headers.get(MULTIPLEX_HEADER, ""))
-        resp = handle._router.assign(meta, (req,), {})
-        return resp.result(timeout_s=60.0)
+        return handle._router.assign_stream(meta, (req,), {})
 
     def _serve_thread(self):
         from aiohttp import web
@@ -117,13 +214,36 @@ class ProxyActor:
                           headers=dict(request.headers), body=body)
             req.headers.setdefault("x-request-id", uuid.uuid4().hex)
             try:
-                result = await loop.run_in_executor(
+                sresp = await loop.run_in_executor(
                     self._pool,
                     self._call_replica, app, ingress, req, prefix)
+                first = await loop.run_in_executor(self._pool,
+                                                   sresp.first_event)
             except Exception as e:  # noqa: BLE001 — surface as 500
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
-            status, headers, payload = coerce_response(result)
-            return web.Response(status=status, headers=headers, body=payload)
+            if first[0] == "value":
+                status, headers, payload = coerce_response(first[1])
+                return web.Response(status=status, headers=headers,
+                                    body=payload)
+            # generator result: chunked transfer; SSE framing when the
+            # client asked for text/event-stream
+            sse = "text/event-stream" in request.headers.get("Accept", "")
+            resp = web.StreamResponse(status=200)
+            resp.headers["content-type"] = (
+                "text/event-stream" if sse else "text/plain; charset=utf-8")
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            _done = object()
+            item = first[1] if first[0] == "chunk" else _done
+            try:
+                while item is not _done:
+                    await resp.write(_encode_chunk(item, sse))
+                    item = await loop.run_in_executor(
+                        self._pool, lambda: next(sresp, _done))
+            except Exception:  # noqa: BLE001 — replica died mid-stream:
+                pass           # nothing valid left to write; close the wire
+            await resp.write_eof()
+            return resp
 
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_route("*", "/{tail:.*}", dispatch)

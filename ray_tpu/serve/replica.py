@@ -37,6 +37,58 @@ def _resolve_markers(obj: Any, app_name: str) -> Any:
     return obj
 
 
+def _as_iterator(result: Any):
+    """An iterator over `result` if it is a streamable producer (sync or
+    async generator, or any non-container iterator); None for plain
+    values. Containers (str/bytes/list/dict/...) are values, not streams."""
+    import types
+
+    if isinstance(result, types.AsyncGeneratorType):
+        return _drain_async_gen(result)
+    if isinstance(result, types.GeneratorType):
+        return result
+    if hasattr(result, "__next__"):
+        return result
+    return None
+
+
+def _drain_async_gen(agen):
+    """Sync iterator over an async generator, run on the ACTOR's
+    persistent event loop — the same loop async methods run on, so
+    loop-bound primitives (asyncio.Queue/Lock created during async init)
+    keep working inside streamed generators. Falls back to a private
+    loop only outside an actor runtime (unit tests)."""
+    import asyncio
+
+    from ray_tpu._private.worker import global_worker
+
+    rt = getattr(global_worker, "_actor_runtime", None)
+    if rt is not None:
+        loop = rt.ensure_loop()
+
+        def run(coro):
+            return asyncio.run_coroutine_threadsafe(coro, loop).result()
+
+        owns_loop = False
+    else:
+        loop = asyncio.new_event_loop()
+        run = loop.run_until_complete
+        owns_loop = True
+    try:
+        while True:
+            try:
+                yield run(agen.__anext__())
+            except StopAsyncIteration:
+                break
+    finally:
+        try:
+            run(agen.aclose())
+        except Exception:  # noqa: BLE001 — best-effort close
+            pass
+        if owns_loop:
+            loop.close()
+
+
 class ReplicaActor:
     """Hosts the user callable (class instance or plain function)."""
 
@@ -65,11 +117,10 @@ class ReplicaActor:
             self.reconfigure(user_config)
 
     # -- data plane ---------------------------------------------------------
-    def handle_request(self, meta: Dict[str, Any], args: List[Any],
-                       kwargs: Dict[str, Any]) -> Any:
-        with self._lock:
-            self._inflight += 1
-            self._num_requests += 1
+    def _invoke(self, meta: Dict[str, Any], args: List[Any],
+                kwargs: Dict[str, Any]) -> Any:
+        """Run the user callable under the request context (no in-flight
+        accounting — callers hold it for their full request lifetime)."""
         # Resolve composed DeploymentResponse refs (they arrive nested inside
         # the args list, below the depth the worker auto-resolves).
         import ray_tpu
@@ -95,6 +146,64 @@ class ReplicaActor:
         finally:
             from .context import _request_context
             _request_context.reset(token)
+
+    def handle_request(self, meta: Dict[str, Any], args: List[Any],
+                       kwargs: Dict[str, Any]) -> Any:
+        with self._lock:
+            self._inflight += 1
+            self._num_requests += 1
+        try:
+            return self._invoke(meta, args, kwargs)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    # every _ACK_EVERY-th chunk is a synchronous call instead of a notify:
+    # bounds unacked in-flight data and detects a vanished consumer
+    _ACK_EVERY = 64
+
+    def handle_request_streaming(self, meta: Dict[str, Any], args: List[Any],
+                                 kwargs: Dict[str, Any], stream_id: str,
+                                 caller_addr) -> Any:
+        """Streaming request path (reference replica.py:470
+        handle_request_streaming). A generator/iterator result is pushed
+        chunk-by-chunk straight to the caller's worker RPC server via
+        stream_chunk frames and the final reply is ("gen", n_chunks); a
+        plain result skips the stream entirely and comes back as
+        ("value", result) — so the proxy can route EVERY request through
+        here, like the reference's everything-streams HTTP proxy.
+
+        In-flight accounting covers the whole generation, keeping pow-2
+        routing and autoscaling honest for long streams."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.worker import global_worker
+
+        with self._lock:
+            self._inflight += 1
+            self._num_requests += 1
+        try:
+            result = self._invoke(meta, args, kwargs)
+            it = _as_iterator(result)
+            if it is None:
+                return ("value", result)
+            client = global_worker.clients.get(tuple(caller_addr))
+            seq = 0
+            try:
+                for item in it:
+                    payload = serialization.dumps(item)
+                    if (seq + 1) % self._ACK_EVERY == 0:
+                        if not client.call("stream_chunk", stream_id, seq,
+                                           payload, timeout=60.0):
+                            break  # consumer closed the stream
+                    else:
+                        client.notify("stream_chunk", stream_id, seq, payload)
+                    seq += 1
+            finally:
+                closer = getattr(it, "close", None)
+                if callable(closer):
+                    closer()
+            return ("gen", seq)
+        finally:
             with self._lock:
                 self._inflight -= 1
 
