@@ -18,6 +18,12 @@ from .config import (  # noqa: F401
     ShardingConfig,
 )
 from .checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from .async_checkpoint import (  # noqa: F401
+    AsyncCheckpoint,
+    AsyncCheckpointer,
+    async_save,
+    restore,
+)
 from .session import (  # noqa: F401
     get_checkpoint,
     get_context,

@@ -18,6 +18,7 @@ import os
 import pickle
 import shutil
 import tempfile
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -70,7 +71,10 @@ def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
     host_leaves = [np.asarray(jax.device_get(x)) for x in leaves]
     arrays = {f"leaf_{i}": a for i, a in enumerate(host_leaves)}
     tmp = os.path.join(directory, f".{name}.npz.tmp")
-    np.savez(tmp, **arrays)
+    # pass an open file, not the path: np.savez silently appends ".npz"
+    # to string filenames, which would break the atomic-rename dance
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
     os.replace(tmp, os.path.join(directory, f"{name}.npz"))
     with open(os.path.join(directory, f"{name}.tree.pkl"), "wb") as f:
         pickle.dump(treedef, f, protocol=5)
@@ -105,13 +109,31 @@ class CheckpointManager:
         self.score_order = score_order
         self._tracked: List[_Tracked] = []
         self._index = 0
+        # async checkpoints register from the writer thread (deferred to
+        # commit time) while the trainer may register sync ones — lock
+        self._lock = threading.Lock()
         os.makedirs(root, exist_ok=True)
 
+    def reserve_index(self) -> int:
+        """Claim the next checkpoint slot NOW — an async save registering
+        later (at commit time, on the writer thread) keeps its report-time
+        position in the recency order, so a sync checkpoint reported after
+        it can never be ranked older."""
+        with self._lock:
+            idx = self._index
+            self._index += 1
+            return idx
+
     def register(self, checkpoint: Checkpoint,
-                 metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
-        """Move `checkpoint` under the managed root and apply retention."""
+                 metrics: Optional[Dict[str, Any]] = None,
+                 index: Optional[int] = None) -> Checkpoint:
+        """Move `checkpoint` under the managed root and apply retention.
+        Disk work happens OUTSIDE the lock: a multi-GB copy on the async
+        writer thread must not block report() or best/latest reads."""
         metrics = dict(metrics or {})
-        dst = os.path.join(self.root, f"checkpoint_{self._index:06d}")
+        if index is None:
+            index = self.reserve_index()
+        dst = os.path.join(self.root, f"checkpoint_{index:06d}")
         if checkpoint.path != dst:
             if os.path.exists(dst):
                 shutil.rmtree(dst)
@@ -121,6 +143,8 @@ class CheckpointManager:
             except OSError:
                 shutil.copytree(checkpoint.path, dst)
                 shutil.rmtree(checkpoint.path, ignore_errors=True)
+            # keep the caller's handle valid after the move
+            checkpoint.path = dst
         ckpt = Checkpoint(dst)
         with open(os.path.join(dst, "metrics.json"), "w") as f:
             json.dump(_json_safe(metrics), f)
@@ -129,35 +153,37 @@ class CheckpointManager:
             if self.score_order == "min":
                 score = -score
         else:
-            score = float(self._index)  # fall back to recency
-        self._tracked.append(_Tracked(score, self._index, ckpt, metrics))
-        self._index += 1
-        self._apply_retention()
+            score = float(index)  # fall back to recency
+        doomed: List[_Tracked] = []
+        with self._lock:
+            self._tracked.append(_Tracked(score, index, ckpt, metrics))
+            if self.num_to_keep is not None:
+                while len(self._tracked) > self.num_to_keep:
+                    worst = min(self._tracked)
+                    self._tracked.remove(worst)
+                    doomed.append(worst)
+        for t in doomed:  # deletion outside the lock too
+            shutil.rmtree(t.checkpoint.path, ignore_errors=True)
         return ckpt
-
-    def _apply_retention(self) -> None:
-        if self.num_to_keep is None:
-            return
-        while len(self._tracked) > self.num_to_keep:
-            worst = min(self._tracked)
-            self._tracked.remove(worst)
-            shutil.rmtree(worst.checkpoint.path, ignore_errors=True)
 
     @property
     def best_checkpoint(self) -> Optional[Checkpoint]:
-        if not self._tracked:
-            return None
-        return max(self._tracked).checkpoint
+        with self._lock:
+            if not self._tracked:
+                return None
+            return max(self._tracked).checkpoint
 
     @property
     def latest_checkpoint(self) -> Optional[Checkpoint]:
-        if not self._tracked:
-            return None
-        return max(self._tracked, key=lambda t: t.index).checkpoint
+        with self._lock:
+            if not self._tracked:
+                return None
+            return max(self._tracked, key=lambda t: t.index).checkpoint
 
     def list_checkpoints(self) -> List[Tuple[Checkpoint, Dict[str, Any]]]:
-        return [(t.checkpoint, t.metrics)
-                for t in sorted(self._tracked, key=lambda t: t.index)]
+        with self._lock:
+            return [(t.checkpoint, t.metrics)
+                    for t in sorted(self._tracked, key=lambda t: t.index)]
 
 
 def _json_safe(d: Dict[str, Any]) -> Dict[str, Any]:

@@ -182,6 +182,7 @@ class JaxTrainer:
                   latest: Optional[Checkpoint], storage: str) -> Result:
         history: List[Dict[str, Any]] = []
         last_metrics: Dict[str, Any] = {}
+        pending_ckpts: List[Any] = []
 
         def report_fn(metrics: Dict[str, Any],
                       checkpoint: Optional[Checkpoint]) -> None:
@@ -191,7 +192,19 @@ class JaxTrainer:
             history.append(metrics)
             last_metrics = metrics
             if checkpoint is not None:
-                manager.register(checkpoint, metrics)
+                from .async_checkpoint import AsyncCheckpoint
+
+                if isinstance(checkpoint, AsyncCheckpoint):
+                    # in-flight async save: report() must not block on
+                    # the disk write — reserve the recency slot NOW and
+                    # register at commit time on the writer thread
+                    snap = dict(metrics)
+                    idx = manager.reserve_index()
+                    checkpoint.add_commit_hook(
+                        lambda c: manager.register(c, snap, index=idx))
+                    pending_ckpts.append(checkpoint)
+                else:
+                    manager.register(checkpoint, metrics)
 
         ctx = TrainContext(
             world_size=1, rank=0,
@@ -209,6 +222,13 @@ class JaxTrainer:
             pass
         finally:
             _set_session(None)
+            # drain in-flight async saves before declaring the result —
+            # best/latest must reflect every reported checkpoint
+            for c in pending_ckpts:
+                try:
+                    c.wait()
+                except Exception:  # noqa: BLE001 — failed save ≠ failed fit
+                    pass
         return Result(metrics=last_metrics,
                       checkpoint=manager.best_checkpoint
                       or manager.latest_checkpoint or latest,
@@ -248,8 +268,12 @@ class JaxTrainer:
 
                 fn = serialization.loads(fn_bytes)
                 out: List[Any] = []
+                pending: List[Any] = []
 
                 def report_fn(metrics, checkpoint):
+                    if checkpoint is not None and \
+                            hasattr(checkpoint, "future"):
+                        pending.append(checkpoint)  # async: drain below
                     out.append((metrics,
                                 checkpoint.path if checkpoint else None))
 
@@ -266,6 +290,14 @@ class JaxTrainer:
                     pass
                 finally:
                     _set_session(None)
+                    # in-flight async saves must hit disk before run()
+                    # returns — the driver registers these paths and then
+                    # kills this worker (its writer thread with it)
+                    for c in pending:
+                        try:
+                            c.wait()
+                        except Exception:  # noqa: BLE001 — torn save:
+                            pass           # driver sees a missing commit
                 return out
 
         from .._private import serialization
