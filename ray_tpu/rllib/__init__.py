@@ -8,10 +8,14 @@ reference's torch-DDP learner path (core/learner/torch/torch_learner.py:
 minibatch SGD and gradient sync compile into one XLA program that runs
 SPMD over a dp mesh axis on TPU.
 
-Algorithms: PPO (sync on-policy, ppo.py) and IMPALA (async off-policy
-with V-trace, impala.py) — the two shapes that cover the reference's
-sync/async execution plans. Native vectorized CartPole/Pendulum remove
-the gymnasium dependency from tests; any gymnasium env id works via the
+Algorithms: PPO (sync on-policy, ppo.py), IMPALA (async off-policy with
+V-trace, impala.py), APPO (IMPALA's async loop + clipped surrogate +
+target network, appo.py — the reference's v4-32 north-star variant), and
+DQN (replay buffer + double-Q + target sync, dqn.py) — covering the
+reference's sync/async/off-policy execution plans. Multi-agent:
+MultiAgentEnvRunner collects per-policy batches via policy_mapping_fn
+(multi_agent.py). Native vectorized CartPole/Pendulum remove the
+gymnasium dependency from tests; any gymnasium env id works via the
 adapter.
 """
 from .algorithm import Algorithm, AlgorithmConfig  # noqa: F401
@@ -24,12 +28,25 @@ from .env import (  # noqa: F401
     register_env,
 )
 from .env_runner import EnvRunner, make_remote_runners  # noqa: F401
+from .appo import APPO, APPOConfig  # noqa: F401
+from .dqn import DQN, DQNConfig, QEnvRunner, ReplayBuffer  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentCartPole,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentVectorEnv,
+    make_multi_agent_env,
+    register_multi_agent_env,
+)
 from .ppo import PPO, PPOConfig  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "EnvRunner", "make_remote_runners", "VectorEnv",
-    "CartPoleVectorEnv", "PendulumVectorEnv", "GymnasiumVectorEnv",
-    "register_env", "make_env",
+    "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
+    "QEnvRunner", "ReplayBuffer", "EnvRunner", "make_remote_runners",
+    "VectorEnv", "CartPoleVectorEnv", "PendulumVectorEnv",
+    "GymnasiumVectorEnv", "register_env", "make_env",
+    "MultiAgentVectorEnv", "MultiAgentCartPole", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "make_multi_agent_env", "register_multi_agent_env",
 ]

@@ -103,6 +103,9 @@ class Algorithm(Trainable):
     algorithm.py:789 step -> :1489 training_step)."""
 
     _default_config: Dict[str, Any] = {}
+    # value-based algorithms sample with their own policy (e.g. DQN's
+    # epsilon-greedy Q-net) — override to swap the collection class
+    _runner_cls: Type[EnvRunner] = EnvRunner
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -135,11 +138,11 @@ class Algorithm(Trainable):
                 rollout_fragment_length=cfg.get("rollout_fragment_length",
                                                 128),
                 env_config=cfg.get("env_config"),
-                seed=cfg.get("seed", 0))
+                seed=cfg.get("seed", 0), runner_cls=self._runner_cls)
             self.local_runner = None
         else:
             self.runners = []
-            self.local_runner = EnvRunner(
+            self.local_runner = self._runner_cls(
                 cfg["env"], num_envs=cfg.get("num_envs_per_env_runner", 1),
                 rollout_fragment_length=cfg.get("rollout_fragment_length",
                                                 128),

@@ -1,0 +1,129 @@
+"""APPO: asynchronous PPO — IMPALA's async sampling loop with a clipped
+surrogate objective and a target network.
+
+Reference: rllib/algorithms/appo/ (appo.py builds on IMPALA; the loss in
+appo_torch_learner.py computes V-trace advantages against the TARGET
+network's values, then applies the PPO clip to the importance ratio, and
+the target net refreshes on an update-count interval). This is the
+stated v4-32 north-star variant (SURVEY.md §7), kept in IMPALA's
+async-runner shape with the update jitted end-to-end.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from . import core
+from .algorithm import AlgorithmConfig
+from .impala import IMPALA
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.train_extra.update({
+            "entropy_coeff": 0.01, "vf_loss_coeff": 0.5, "grad_clip": 40.0,
+            "clip_rho_threshold": 1.0, "clip_c_threshold": 1.0,
+            "clip_param": 0.2, "target_update_freq": 8,
+            "batches_per_step": 8,
+        })
+
+
+def make_appo_update(cfg: Dict[str, Any], continuous: bool, optimizer):
+    gamma = cfg["gamma"]
+    clip_rho = cfg["clip_rho_threshold"]
+    clip_c = cfg["clip_c_threshold"]
+    clip = cfg["clip_param"]
+    ent_coeff, vf_coeff = cfg["entropy_coeff"], cfg["vf_loss_coeff"]
+
+    def loss_fn(params, target_params, batch):
+        t1, n, d = batch["obs"].shape
+        obs_flat = batch["obs"].reshape(-1, d)
+        values = core.value(params, obs_flat).reshape(t1, n)
+        # V-trace bootstraps from the TARGET network: advantage targets
+        # stay stable across the many async updates between refreshes
+        # (reference appo_torch_learner.py old-policy value path)
+        target_values = core.value(target_params, obs_flat).reshape(t1, n)
+        if continuous:
+            mean = core.policy_logits(params, batch["obs"][:-1])
+            logp = core.gaussian_logp(mean, params["log_std"],
+                                      batch["actions"])
+            entropy = core.gaussian_entropy(params["log_std"])
+        else:
+            logits = core.policy_logits(params, batch["obs"][:-1])
+            logp = core.categorical_logp(logits, batch["actions"])
+            entropy = core.categorical_entropy(logits).mean()
+        pg_adv, vs = core.vtrace(batch["logp"], jax.lax.stop_gradient(logp),
+                                 batch["rewards"], target_values,
+                                 batch["dones"], gamma, clip_rho, clip_c)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        vs = jax.lax.stop_gradient(vs)
+        # PPO clip on the behavior→current importance ratio (the APPO
+        # twist over IMPALA's plain -logp * adv)
+        ratio = jnp.exp(logp - batch["logp"])
+        surrogate = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv)
+        pg_loss = -surrogate.mean()
+        vf_loss = 0.5 * ((values[:-1] - vs) ** 2).mean()
+        total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_ratio": ratio.mean()}
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def update(params, target_params, opt_state, batch):
+        (_, aux), grads = grad_fn(params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    return jax.jit(update, donate_argnums=(0, 2))
+
+
+class APPO(IMPALA):
+    _default_config = {
+        **IMPALA._default_config,
+        "clip_param": 0.2, "target_update_freq": 8,
+    }
+
+    def _build_learner(self) -> None:
+        # IMPALA's init verbatim (params/optimizer/async bookkeeping);
+        # only the loss and the target net differ
+        super()._build_learner()
+        self._update = make_appo_update(self.cfg, self.continuous,
+                                        self.optimizer)
+        self.target_params = jax.tree.map(jnp.copy, self.params)  # no alias:
+        # params is donated in the update while target_params rides along
+        self._updates_since_target = 0
+
+    def _learn(self, b: Dict[str, Any]) -> Dict[str, float]:
+        batch = {k: jnp.asarray(v) for k, v in b.items()
+                 if k in ("obs", "actions", "logp", "rewards", "dones")}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.target_params, self.opt_state, batch)
+        self._updates_since_target += 1
+        if self._updates_since_target >= self.cfg.get("target_update_freq",
+                                                      8):
+            # COPY, not alias: params is donated to the jitted update, and
+            # donating a buffer that is also passed as target_params would
+            # be donating one of its own inputs
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._updates_since_target = 0
+        return {k: float(v) for k, v in aux.items()}
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
+        data = super().save_checkpoint(checkpoint_dir)
+        data["target_params"] = jax.device_get(self.target_params)
+        return data
+
+    def load_checkpoint(self, data: Any) -> None:
+        super().load_checkpoint(data)
+        self.target_params = data.get("target_params", self.params)
+
+
+__all__ = ["APPO", "APPOConfig", "make_appo_update"]

@@ -1,0 +1,238 @@
+"""DQN: off-policy Q-learning with replay buffer, double-Q targets and a
+periodically synced target network.
+
+Reference: rllib/algorithms/dqn/ (dqn.py training_step = sample →
+store_to_replay → sample_from_replay → learner update → target sync;
+loss in dqn_rainbow_torch_learner.py: double-DQN argmax via the online
+net, Huber TD error) and rllib/utils/replay_buffers/. The rebuild keeps
+the replay-train shape with a flat numpy ring buffer on the host (cheap
+random access; sampling feeds jnp batches into one jitted update) and an
+epsilon-greedy Q EnvRunner instead of the logp-policy runner.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import core
+from .algorithm import Algorithm, AlgorithmConfig
+from .env_runner import EnvRunner
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.train_extra.update({
+            "buffer_capacity": 50_000, "train_batch_size": 64,
+            "updates_per_step": 32, "learning_starts": 1_000,
+            "target_network_update_freq": 500,
+            "epsilon_initial": 1.0, "epsilon_final": 0.05,
+            "epsilon_timesteps": 8_000, "grad_clip": 10.0,
+        })
+
+
+class ReplayBuffer:
+    """Flat uniform ring buffer (reference utils/replay_buffers/
+    replay_buffer.py) — numpy host-side; minibatches become device
+    arrays only at update time."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self._obs = np.empty((capacity, obs_dim), np.float32)
+        self._next_obs = np.empty((capacity, obs_dim), np.float32)
+        self._actions = np.empty(capacity, np.int32)
+        self._rewards = np.empty(capacity, np.float32)
+        self._dones = np.empty(capacity, np.float32)
+        self._size = 0
+        self._pos = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_fragment(self, batch: Dict[str, np.ndarray]) -> None:
+        """Store a [T, N] rollout fragment as T*N transitions. With
+        SAME_STEP auto-reset, obs[t+1] of a done slot is the NEXT
+        episode's reset obs — harmless: the (1-done) mask zeroes the
+        bootstrap exactly there."""
+        t1, n, d = batch["obs"].shape
+        T = t1 - 1
+        obs = batch["obs"][:-1].reshape(T * n, d)
+        next_obs = batch["obs"][1:].reshape(T * n, d)
+        actions = batch["actions"].reshape(T * n)
+        rewards = batch["rewards"].reshape(T * n)
+        dones = batch["dones"].reshape(T * n).astype(np.float32)
+        m = T * n
+        idx = (self._pos + np.arange(m)) % self.capacity
+        self._obs[idx] = obs
+        self._next_obs[idx] = next_obs
+        self._actions[idx] = actions
+        self._rewards[idx] = rewards
+        self._dones[idx] = dones
+        self._pos = int((self._pos + m) % self.capacity)
+        self._size = int(min(self._size + m, self.capacity))
+
+    def sample(self, rng: np.random.Generator, batch_size: int
+               ) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self._size, batch_size)
+        return {"obs": self._obs[idx], "next_obs": self._next_obs[idx],
+                "actions": self._actions[idx],
+                "rewards": self._rewards[idx], "dones": self._dones[idx]}
+
+
+class QEnvRunner(EnvRunner):
+    """EnvRunner whose policy is epsilon-greedy over the Q-network;
+    `params` is {"q": mlp, "epsilon": scalar} (reference
+    EpsilonGreedy exploration, utils/exploration/epsilon_greedy.py)."""
+
+    def _build_act(self):
+        @jax.jit
+        def act(params, obs, key):
+            q = core.mlp_apply(params["q"], obs)
+            greedy = jnp.argmax(q, axis=-1)
+            k1, k2 = jax.random.split(key)
+            rand = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+            explore = jax.random.uniform(k2, greedy.shape) \
+                < params["epsilon"]
+            a = jnp.where(explore, rand, greedy)
+            return a, jnp.zeros(a.shape, jnp.float32)  # logp unused
+
+        return act
+
+
+def make_dqn_update(cfg: Dict[str, Any], optimizer):
+    gamma = cfg["gamma"]
+
+    def loss_fn(params, target_params, batch):
+        q = core.mlp_apply(params["q"], batch["obs"])
+        qa = jnp.take_along_axis(q, batch["actions"][:, None],
+                                 axis=-1)[:, 0]
+        # double DQN: argmax by the ONLINE net, value by the target net
+        next_online = core.mlp_apply(params["q"], batch["next_obs"])
+        next_a = jnp.argmax(next_online, axis=-1)
+        next_target = core.mlp_apply(target_params["q"], batch["next_obs"])
+        next_q = jnp.take_along_axis(next_target, next_a[:, None],
+                                     axis=-1)[:, 0]
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * next_q
+        td = qa - jax.lax.stop_gradient(target)
+        loss = optax.huber_loss(td).mean()
+        return loss, {"td_error_mean": jnp.abs(td).mean(),
+                      "q_mean": qa.mean()}
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def update(params, target_params, opt_state, batch):
+        (loss, aux), grads = grad_fn(params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["loss"] = loss
+        return params, opt_state, aux
+
+    return jax.jit(update, donate_argnums=(0, 2))
+
+
+class DQN(Algorithm):
+    _default_config = {
+        "buffer_capacity": 50_000, "train_batch_size": 64,
+        "updates_per_step": 32, "learning_starts": 1_000,
+        "target_network_update_freq": 500,
+        "epsilon_initial": 1.0, "epsilon_final": 0.05,
+        "epsilon_timesteps": 8_000, "grad_clip": 10.0,
+        "rollout_fragment_length": 32, "num_envs_per_env_runner": 8,
+        "lr": 1e-3,
+    }
+    _runner_cls = QEnvRunner
+
+    def _build_learner(self) -> None:
+        cfg = self.cfg
+        if self.continuous:
+            raise ValueError("DQN requires a discrete action space")
+        key = jax.random.PRNGKey(cfg.get("seed", 0))
+        hidden = tuple(cfg.get("hidden", (64, 64)))
+        self.params = {"q": core.mlp_init(
+            key, [self.obs_dim, *hidden, self.num_actions])}
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.get("grad_clip", 10.0)),
+            optax.adam(cfg.get("lr", 1e-3)))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_dqn_update(cfg, self.optimizer)
+        self.buffer = ReplayBuffer(cfg.get("buffer_capacity", 50_000),
+                                   self.obs_dim)
+        self._np_rng = np.random.default_rng(cfg.get("seed", 0))
+        self._steps_since_sync = 0
+
+    # -- epsilon schedule ----------------------------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self._env_steps_lifetime
+                   / max(1, cfg.get("epsilon_timesteps", 8_000)))
+        e0, e1 = cfg.get("epsilon_initial", 1.0), \
+            cfg.get("epsilon_final", 0.05)
+        return float(e0 + frac * (e1 - e0))
+
+    def _sample_params(self) -> Dict[str, Any]:
+        # epsilon as an ARRAY, not a python float — a float would be a
+        # static jit argument and recompile the act fn every schedule tick
+        return {"q": self.params["q"],
+                "epsilon": jnp.asarray(self._epsilon(), jnp.float32)}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        # -- collect ---------------------------------------------------------
+        if self.local_runner is not None:
+            batches = [self.local_runner.sample(self._sample_params())]
+        else:
+            import ray_tpu
+
+            p = jax.device_get(self._sample_params())
+            batches = ray_tpu.get(
+                [r.sample.remote(p) for r in self.runners])
+        for b in batches:
+            self._episode_returns.extend(b["episode_returns"])
+            self._episode_lens.extend(b["episode_lens"])
+            n_new = int(np.prod(b["rewards"].shape))
+            self._env_steps_lifetime += n_new
+            self._steps_since_sync += n_new
+            self.buffer.add_fragment(b)
+        # -- learn -----------------------------------------------------------
+        metrics: Dict[str, float] = {"epsilon": self._epsilon(),
+                                     "buffer_size": float(len(self.buffer))}
+        if len(self.buffer) < cfg.get("learning_starts", 1_000):
+            return metrics
+        accum = []
+        for _ in range(cfg.get("updates_per_step", 32)):
+            mb = self.buffer.sample(self._np_rng,
+                                    cfg.get("train_batch_size", 64))
+            mb = {k: jnp.asarray(v) for k, v in mb.items()}
+            self.params, self.opt_state, aux = self._update(
+                self.params, self.target_params, self.opt_state, mb)
+            accum.append(aux)
+        if self._steps_since_sync >= cfg.get("target_network_update_freq",
+                                             500):
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+            self._steps_since_sync = 0
+        metrics.update({k: float(np.mean([float(a[k]) for a in accum]))
+                        for k in accum[0]})
+        return metrics
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
+        data = super().save_checkpoint(checkpoint_dir)
+        data["target_params"] = jax.device_get(self.target_params)
+        return data
+
+    def load_checkpoint(self, data: Any) -> None:
+        super().load_checkpoint(data)
+        self.target_params = data.get("target_params", self.params)
+
+    def compute_single_action(self, obs: np.ndarray) -> Any:
+        q = core.mlp_apply(self.params["q"],
+                           jnp.asarray(obs[None], jnp.float32))
+        return int(np.argmax(np.asarray(q[0])))
+
+
+__all__ = ["DQN", "DQNConfig", "QEnvRunner", "ReplayBuffer",
+           "make_dqn_update"]

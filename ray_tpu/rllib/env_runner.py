@@ -16,6 +16,28 @@ import numpy as np
 from .env import make_env
 
 
+def build_act_fn(continuous: bool):
+    """The jitted sampling forward shared by single- and multi-agent
+    runners: (params, obs, key) -> (actions, logp)."""
+    import jax
+
+    from . import core
+
+    @jax.jit
+    def act(params, obs, key):
+        if continuous:
+            mean = core.policy_logits(params, obs)
+            a = core.gaussian_sample(key, mean, params["log_std"])
+            logp = core.gaussian_logp(mean, params["log_std"], a)
+        else:
+            logits = core.policy_logits(params, obs)
+            a = core.categorical_sample(key, logits)
+            logp = core.categorical_logp(logits, a)
+        return a, logp
+
+    return act
+
+
 class EnvRunner:
     def __init__(self, env: Any, *, num_envs: int = 1,
                  rollout_fragment_length: int = 128, seed: int = 0,
@@ -35,25 +57,7 @@ class EnvRunner:
     # ------------------------------------------------------------- policy
 
     def _build_act(self):
-        import jax
-
-        from . import core
-
-        continuous = self.continuous
-
-        @jax.jit
-        def act(params, obs, key):
-            if continuous:
-                mean = core.policy_logits(params, obs)
-                a = core.gaussian_sample(key, mean, params["log_std"])
-                logp = core.gaussian_logp(mean, params["log_std"], a)
-            else:
-                logits = core.policy_logits(params, obs)
-                a = core.categorical_sample(key, logits)
-                logp = core.categorical_logp(logits, a)
-            return a, logp
-
-        return act
+        return build_act_fn(self.continuous)
 
     def sample(self, params: Any) -> Dict[str, Any]:
         """One rollout fragment: T steps x num_envs. Returns numpy batch
@@ -114,12 +118,12 @@ class EnvRunner:
 def make_remote_runners(env: Any, *, num_runners: int, num_envs: int,
                         rollout_fragment_length: int,
                         env_config: Optional[Dict] = None,
-                        seed: int = 0) -> List[Any]:
+                        seed: int = 0, runner_cls: type = None) -> List[Any]:
     """Spawn EnvRunner actors (reference EnvRunnerGroup /
     rollout worker set)."""
     import ray_tpu
 
-    cls = ray_tpu.remote(EnvRunner)
+    cls = ray_tpu.remote(runner_cls or EnvRunner)
     return [cls.options(num_cpus=1.0).remote(
         env, num_envs=num_envs,
         rollout_fragment_length=rollout_fragment_length,
@@ -127,4 +131,4 @@ def make_remote_runners(env: Any, *, num_runners: int, num_envs: int,
         for i in range(num_runners)]
 
 
-__all__ = ["EnvRunner", "make_remote_runners"]
+__all__ = ["EnvRunner", "build_act_fn", "make_remote_runners"]

@@ -134,7 +134,6 @@ class AsyncCheckpointer:
     def __init__(self):
         self._queue: List[Tuple[AsyncCheckpoint, list, Any]] = []
         self._cv = threading.Condition()
-        self._shutdown = False
         self._thread: Optional[threading.Thread] = None
         self._test_write_delay = 0.0  # test knob: per-save artificial I/O
 
@@ -186,6 +185,18 @@ class AsyncCheckpointer:
     def _write_one(self, directory: str, snaps: list, treedef: Any) -> None:
         proc, nproc = jax.process_index(), jax.process_count()
         os.makedirs(directory, exist_ok=True)
+        # Overwriting an existing checkpoint: invalidate OUR commit marker
+        # before touching any shard bytes, and clear our stale files — a
+        # crash mid-write must read as torn, never as the old checkpoint
+        # silently mixed with new shards. (Each process touches only its
+        # own files; restore ignores manifests >= process_count.)
+        try:
+            os.remove(os.path.join(directory, _COMMIT.format(proc=proc)))
+        except FileNotFoundError:
+            pass
+        for stale in glob.glob(os.path.join(directory,
+                                            f"leaf*_p{proc}_s*.npy")):
+            os.remove(stale)
         manifest: Dict[str, Any] = {"process": proc, "process_count": nproc,
                                     "leaves": {}}
         for leaf_idx, meta, shards in snaps:
@@ -225,11 +236,23 @@ def wait_until_finished() -> None:
 
 
 def _load_manifests(directory: str) -> List[Dict[str, Any]]:
-    paths = sorted(glob.glob(os.path.join(directory, "manifest.*.json")))
-    if not paths:
+    head = os.path.join(directory, _MANIFEST.format(proc=0))
+    if not os.path.exists(head):
         raise FileNotFoundError(f"no checkpoint manifests in {directory}")
-    manifests = [json.load(open(p)) for p in paths]
-    nproc = manifests[0]["process_count"]
+    with open(head) as f:
+        first = json.load(f)
+    nproc = int(first["process_count"])
+    # read EXACTLY processes 0..nproc-1: stale manifest.{>=nproc}.json left
+    # by an earlier larger-world save must not leak old shards in
+    manifests = [first]
+    for p in range(1, nproc):
+        path = os.path.join(directory, _MANIFEST.format(proc=p))
+        if not os.path.exists(path):
+            raise ValueError(
+                f"checkpoint {directory} is torn: manifest of process "
+                f"{p}/{nproc} is missing")
+        with open(path) as f:
+            manifests.append(json.load(f))
     for p in range(nproc):
         if not os.path.exists(os.path.join(directory,
                                            _COMMIT.format(proc=p))):

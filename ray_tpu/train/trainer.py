@@ -268,14 +268,9 @@ class JaxTrainer:
 
                 fn = serialization.loads(fn_bytes)
                 out: List[Any] = []
-                pending: List[Any] = []
 
                 def report_fn(metrics, checkpoint):
-                    if checkpoint is not None and \
-                            hasattr(checkpoint, "future"):
-                        pending.append(checkpoint)  # async: drain below
-                    out.append((metrics,
-                                checkpoint.path if checkpoint else None))
+                    out.append((metrics, checkpoint))
 
                 ctx = TrainContext(
                     world_size=self.world, rank=self.rank,
@@ -290,15 +285,24 @@ class JaxTrainer:
                     pass
                 finally:
                     _set_session(None)
-                    # in-flight async saves must hit disk before run()
-                    # returns — the driver registers these paths and then
-                    # kills this worker (its writer thread with it)
-                    for c in pending:
-                        try:
-                            c.wait()
-                        except Exception:  # noqa: BLE001 — torn save:
-                            pass           # driver sees a missing commit
-                return out
+                # In-flight async saves must hit disk before run() returns
+                # (the driver registers these paths and then kills this
+                # worker, its writer thread with it) — and a save that
+                # FAILED must come back as path=None, not as a torn
+                # directory the driver would register as a checkpoint.
+                resolved: List[Any] = []
+                for metrics, ck in out:
+                    path = None
+                    if ck is not None:
+                        ok = True
+                        if hasattr(ck, "future"):
+                            try:
+                                ck.wait()
+                            except Exception:  # noqa: BLE001 — torn
+                                ok = False
+                        path = ck.path if ok else None
+                    resolved.append((metrics, path))
+                return resolved
 
         from .._private import serialization
 

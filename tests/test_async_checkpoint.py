@@ -179,3 +179,36 @@ def test_async_then_sync_registration_order(tmp_path):
     # latest must be the sync step-1 checkpoint (index 1), not the
     # late-committing async step-0 one
     assert result.checkpoint.path.endswith("checkpoint_000001")
+
+
+def test_overwrite_crash_reads_torn_not_mixed(tmp_path):
+    """Re-saving into the same directory invalidates the commit marker
+    FIRST: a crash mid-overwrite must read as torn, never as a silent
+    mix of old and new shards."""
+    mesh = _mesh([("dp", 8)])
+    state = _sharded_state(mesh, {"w": ((16, 4), P("dp", None))})
+    d = str(tmp_path / "ck")
+    ac.async_save(d, state).wait()
+    # simulate a second save that died after clearing the marker
+    ckpter = ac.AsyncCheckpointer()
+    orig = ckpter._write_one
+
+    def dies_after_invalidate(directory, snaps, treedef):
+        import os as _os
+        try:
+            _os.remove(_os.path.join(directory, "commit.0"))
+        except FileNotFoundError:
+            pass
+        raise RuntimeError("simulated crash mid-write")
+
+    ckpter._write_one = dies_after_invalidate
+    ck = ckpter.save(d, state)
+    with pytest.raises(RuntimeError, match="simulated"):
+        ck.wait()
+    with pytest.raises(ValueError, match="torn"):
+        ac.restore(d)
+    # a fresh successful save into the same dir heals it
+    ac.async_save(d, state).wait()
+    loaded = ac.restore(d)
+    np.testing.assert_array_equal(loaded["w"], np.asarray(state["w"]))
+    del orig
