@@ -29,6 +29,7 @@ from .remote_function import RemoteFunction
 __version__ = "0.1.0"
 
 _conductor: Optional[Conductor] = None
+_system_config_prior: Optional[Dict[str, Optional[str]]] = None
 
 
 def is_initialized() -> bool:
@@ -49,11 +50,11 @@ def init(address: Optional[str] = None, *,
     ``_system_config`` overrides flags from the central table
     (``ray_tpu._private.config``) — reference semantics of ray.init's
     _system_config over ray_config_def.h."""
-    global _conductor
+    global _conductor, _system_config_prior
     if _system_config:
         from ._private.config import config as _cfg
 
-        _cfg.apply(_system_config)
+        _system_config_prior = _cfg.apply(_system_config)
     if is_initialized():
         if ignore_reinit_error:
             return {"address": _worker_mod.global_worker.conductor_address}
@@ -132,7 +133,7 @@ def _detect_tpu_chips() -> int:
 
 
 def shutdown() -> None:
-    global _conductor
+    global _conductor, _system_config_prior
     w = _worker_mod.global_worker
     if w is not None:
         w.shutdown()
@@ -140,6 +141,13 @@ def shutdown() -> None:
     if _conductor is not None:
         _conductor.stop()
         _conductor = None
+    if _system_config_prior is not None:
+        # this cluster's _system_config env exports must not leak into
+        # the next cluster started in this process
+        from ._private.config import config as _cfg
+
+        _cfg.restore(_system_config_prior)
+        _system_config_prior = None
 
 
 def remote(*args, **kwargs):

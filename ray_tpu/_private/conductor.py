@@ -73,6 +73,9 @@ class WorkerRecord:
     # unknown until the worker re-registers (fills pid) or a grace period
     # expires (presumed dead with the old conductor)
     restored_at: Optional[float] = None
+    # why the worker died, when the runtime knows (e.g. "oom: ..." from
+    # the memory monitor) — submitters query this to raise a typed error
+    death_cause: Optional[str] = None
 
 
 @dataclass
@@ -213,9 +216,12 @@ class ConductorHandler:
                     node.available[pk] = node.available.get(pk, 0) + v
 
     def node_heartbeat(self, node_id: str,
-                       dead_worker_ids: Optional[List[str]] = None) -> bool:
+                       dead_worker_ids: Optional[List[str]] = None,
+                       death_causes: Optional[Dict[str, str]] = None
+                       ) -> bool:
         """Agent liveness + push-reported worker deaths (the conductor
-        cannot poll pids on remote hosts)."""
+        cannot poll pids on remote hosts). death_causes carries typed
+        causes (e.g. the agent's memory monitor OOM kills)."""
         dead_recs: List[WorkerRecord] = []
         with self._cv:
             n = self._nodes.get(node_id)
@@ -223,6 +229,10 @@ class ConductorHandler:
                 return False  # unknown (e.g. after conductor restart)
             n.last_heartbeat = time.monotonic()
             n.alive = True
+            for wid, cause in (death_causes or {}).items():
+                w = self._workers.get(wid)
+                if w is not None and w.death_cause is None:
+                    w.death_cause = cause
             for wid in dead_worker_ids or []:
                 w = self._workers.get(wid)
                 if w is not None and w.state != "DEAD":
@@ -1256,9 +1266,18 @@ class ConductorHandler:
 
         node_timeout = config.node_timeout
         restore_grace = config.restore_grace
+        last_mem_check = 0.0
         while not self._stopped:
             time.sleep(0.2)
             self._flush_state()
+            refresh_ms = config.memory_monitor_refresh_ms
+            if refresh_ms > 0 and \
+                    time.monotonic() - last_mem_check >= refresh_ms / 1000.0:
+                last_mem_check = time.monotonic()
+                try:
+                    self._maybe_oom_kill()
+                except Exception:  # noqa: BLE001 — monitor must not kill
+                    pass           # the reap loop
             dead: List[WorkerRecord] = []
             with self._cv:
                 agent_nodes = {nid for nid, n in self._nodes.items()
@@ -1301,6 +1320,40 @@ class ConductorHandler:
                 self._cv.notify_all()
             for w in dead:
                 self._on_worker_death(w)
+
+    def _maybe_oom_kill(self) -> None:
+        """Memory-monitor tick (reference memory_monitor.h:52 +
+        worker_killing_policy.cc): above the threshold, SIGKILL the
+        greediest LOCAL worker — task workers before actors before idle —
+        recording 'oom: ...' as its death cause so the submitter raises
+        OutOfMemoryError instead of a bare crash. Remote nodes police
+        themselves (node agent) and report causes via heartbeat."""
+        from .config import config
+        from .memory_monitor import MemoryMonitor
+
+        threshold = config.memory_usage_threshold
+        mon = getattr(self, "_mem_monitor", None)
+        if mon is None or mon.threshold != threshold:
+            mon = MemoryMonitor(threshold)
+            self._mem_monitor = mon
+        with self._lock:
+            cands = [(w.worker_id, w.proc.pid, w.state)
+                     for w in self._workers.values()
+                     if w.proc is not None and w.proc.poll() is None]
+        res = mon.kill_greediest(cands, "head")
+        if res is None:
+            return
+        worker_id, cause = res
+        with self._lock:
+            rec = self._workers.get(worker_id)
+            if rec is not None:
+                rec.death_cause = cause  # submitters re-query after a
+                # short grace, covering the kill→record window
+
+    def worker_death_cause(self, worker_id: str) -> Optional[str]:
+        with self._lock:
+            w = self._workers.get(worker_id)
+            return w.death_cause if w is not None else None
 
     def _on_worker_death(self, w: WorkerRecord) -> None:
         restart: List[str] = []

@@ -70,6 +70,12 @@ _FLAGS: List[Flag] = [
          "override detected TPU chip count (0 = autodetect)"),
     Flag("pallas_interpret", int, 0,
          "run Pallas kernels in interpret mode (CPU testing)"),
+    # --- memory monitor ------------------------------------------------
+    Flag("memory_usage_threshold", float, 0.95,
+         "node memory fraction above which the monitor OOM-kills the "
+         "greediest worker (<= 0 disables; reference memory_monitor.h)"),
+    Flag("memory_monitor_refresh_ms", int, 250,
+         "memory monitor poll period in milliseconds (0 disables)"),
     # --- misc ----------------------------------------------------------
     Flag("node_ip", str, "",
          "address other hosts can reach this one on (else inferred from "
@@ -105,17 +111,31 @@ class RayTpuConfig:
             raise AttributeError(name)
         return self.get(name)
 
-    def apply(self, overrides: Dict[str, Any]) -> None:
+    def apply(self, overrides: Dict[str, Any]) -> Dict[str, Optional[str]]:
         """Install `_system_config` overrides: validated against the
         table and exported to the environment so child processes and
-        lazy readers agree."""
+        lazy readers agree. Returns {env_var: previous value or None}
+        for `restore` — a cluster's overrides must die with it, not
+        poison the next cluster in this process."""
+        prior: Dict[str, Optional[str]] = {}
         for name, value in overrides.items():
             flag = _BY_NAME.get(name)
             if flag is None:
                 raise ValueError(
                     f"unknown _system_config flag {name!r}; known flags: "
                     f"{sorted(_BY_NAME)}")
+            prior[flag.env_var] = os.environ.get(flag.env_var)
             os.environ[flag.env_var] = str(_coerce(flag, value))
+        return prior
+
+    @staticmethod
+    def restore(prior: Dict[str, Optional[str]]) -> None:
+        """Undo an `apply` using its returned token."""
+        for var, old in prior.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
 
     def describe(self) -> List[Dict[str, Any]]:
         """All flags with their current value and provenance — the

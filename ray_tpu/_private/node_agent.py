@@ -60,6 +60,14 @@ class NodeAgentHandler:
                     del self._procs[wid]
         return dead
 
+    def oom_tick(self, mon) -> Optional[Tuple[str, str]]:
+        """One memory-monitor tick over this host's workers. The agent
+        has no task/actor state, so the victim is purely highest-RSS."""
+        with self._lock:
+            cands = [(wid, p.pid, "BUSY")
+                     for wid, p in self._procs.items() if p.poll() is None]
+        return mon.kill_greediest(cands, self.node_id[:12])
+
     def ping(self) -> str:
         return "pong"
 
@@ -115,13 +123,44 @@ class NodeAgent:
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="node-agent-heartbeat",
             daemon=True)
+        # OOM causes awaiting a successful heartbeat ack — a dropped
+        # heartbeat (or conductor restart) must not lose the diagnosis
+        self._pending_causes: Dict[str, str] = {}
+        self._causes_lock = threading.Lock()
+        self._mem_thread = threading.Thread(
+            target=self._memory_loop, name="node-agent-memmon", daemon=True)
 
     def start(self) -> "NodeAgent":
         self.server.start()
         self._conductor.call("register_node", self.node_id, self.resources,
                              self.server.address, timeout=10.0)
         self._hb_thread.start()
+        self._mem_thread.start()
         return self
+
+    def _memory_loop(self) -> None:
+        """Memory monitor at its OWN cadence (memory_monitor_refresh_ms)
+        — the heartbeat period may be seconds, far too slow to beat the
+        kernel OOM killer to a runaway task."""
+        from .config import config
+        from .memory_monitor import MemoryMonitor
+
+        mon = None
+        while not self._stopped.is_set():
+            ms = config.memory_monitor_refresh_ms
+            if ms <= 0:
+                self._stopped.wait(1.0)
+                continue
+            self._stopped.wait(ms / 1000.0)
+            if mon is None or mon.threshold != config.memory_usage_threshold:
+                mon = MemoryMonitor(config.memory_usage_threshold)
+            try:
+                res = self.handler.oom_tick(mon)
+            except Exception:  # noqa: BLE001 — monitor must keep running
+                continue
+            if res is not None:
+                with self._causes_lock:
+                    self._pending_causes[res[0]] = res[1]
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -132,16 +171,26 @@ class NodeAgent:
 
         grace = config.node_orphan_grace
         last_ok = time.monotonic()
+        pending_dead: List[str] = []
         while not self._stopped.wait(_heartbeat_period()):
-            dead = self.handler.reap_dead()
+            with self._causes_lock:
+                causes = dict(self._pending_causes)
+            pending_dead.extend(self.handler.reap_dead())
             try:
                 known = self._conductor.call("node_heartbeat", self.node_id,
-                                             dead, timeout=5.0)
+                                             pending_dead, causes,
+                                             timeout=5.0)
                 if not known:
-                    # conductor restarted and lost us: re-register
+                    # conductor restarted and lost us: re-register (keep
+                    # the causes/dead lists for the next heartbeat)
                     self._conductor.call("register_node", self.node_id,
                                          self.resources, self.server.address,
                                          timeout=5.0)
+                else:
+                    pending_dead.clear()
+                    with self._causes_lock:
+                        for wid in causes:
+                            self._pending_causes.pop(wid, None)
                 last_ok = time.monotonic()
             except Exception:
                 # tolerate a brief outage (conductor restart); a sustained

@@ -582,8 +582,7 @@ class Worker:
                 # force-cancel killed the worker mid-task: that is the
                 # requested outcome, not a crash to retry
                 raise exc.TaskCancelledError(spec.name) from e
-            raise exc.WorkerCrashedError(
-                f"worker {worker_id[:12]}… died running {spec.name}") from e
+            raise self._worker_crash_error(worker_id, spec.name) from e
         finally:
             with self._state_lock:
                 for oid in spec.return_ids:
@@ -603,6 +602,31 @@ class Worker:
         status = "FAILED" if any(entry[1] == "error" for entry in reply) \
             else "FINISHED"
         self._record_event(spec, t0, tuple(address), status)
+
+    def _worker_crash_error(self, worker_id: str,
+                            task_name: str) -> exc.WorkerCrashedError:
+        """Typed error for a worker dying mid-task: the memory monitor
+        records 'oom: ...' BEFORE killing locally, and agent OOM kills
+        arrive with the next heartbeat — so query, once immediately and
+        once after a short grace, before settling for a generic crash."""
+        cause = None
+        for delay in (0.0, 0.3):
+            if delay:
+                time.sleep(delay)
+            try:
+                cause = self.conductor.call("worker_death_cause", worker_id,
+                                            timeout=5.0)
+            except (ConnectionLost, TimeoutError, RemoteError):
+                break
+            if cause is not None:
+                break
+        if cause and cause.startswith("oom"):
+            return exc.OutOfMemoryError(
+                f"worker {worker_id[:12]}… was OOM-killed running "
+                f"{task_name} — {cause}")
+        return exc.WorkerCrashedError(
+            f"worker {worker_id[:12]}… died running {task_name}"
+            + (f" ({cause})" if cause else ""))
 
     def _wire_spec(self, spec: TaskSpec) -> dict:
         # "machine" tells the executor whether a shm-name result reply is

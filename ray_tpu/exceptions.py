@@ -45,6 +45,13 @@ class WorkerCrashedError(RayTpuError):
     """The worker executing the task died (e.g. OOM-killed)."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The memory monitor killed the worker to protect the node
+    (reference: OOM-killed task errors, memory_monitor.h). Subclasses
+    WorkerCrashedError so retry semantics match any worker death; the
+    final error names the cause with usage numbers."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

@@ -114,7 +114,7 @@ class MultiAgentEnvRunner:
         n = self.env.num_envs
         self._ep_ret = {a: np.zeros(n) for a in self.env.agent_ids}
         self._ep_len = {a: np.zeros(n, np.int64) for a in self.env.agent_ids}
-        self._act_fns: Dict[PolicyID, Any] = {}
+        self._act_fns: Dict[bool, Any] = {}  # continuous? -> jitted act
         self._rng_key = None
 
     def policies_needed(self) -> Dict[PolicyID, Dict[str, int]]:
@@ -132,11 +132,13 @@ class MultiAgentEnvRunner:
         return out
 
     def _act_fn(self, pid: PolicyID, continuous: bool):
-        if pid not in self._act_fns:
+        # keyed by action-space KIND, not policy id: N same-kind policies
+        # share one jitted act program instead of compiling N copies
+        if continuous not in self._act_fns:
             from .env_runner import build_act_fn
 
-            self._act_fns[pid] = build_act_fn(continuous)
-        return self._act_fns[pid]
+            self._act_fns[continuous] = build_act_fn(continuous)
+        return self._act_fns[continuous]
 
     def sample(self, params_by_policy: Dict[PolicyID, Any]
                ) -> Dict[PolicyID, Dict[str, Any]]:

@@ -23,15 +23,22 @@ def test_unknown_flag_rejected():
         config.apply({"not_a_flag": 1})
 
 
-def test_apply_exports_env(monkeypatch):
-    monkeypatch.delenv("RAY_TPU_FETCH_CHUNK", raising=False)
-    cfg = RayTpuConfig()
-    cfg.apply({"fetch_chunk": 12345})
+def test_apply_exports_env():
+    # plain os.environ, NOT monkeypatch: apply() writes outside
+    # monkeypatch's book-keeping, so a trailing monkeypatch.delenv would
+    # RECORD the leaked value and teardown would restore it — the exact
+    # cross-test poisoning this suite has been bitten by twice
     import os
 
-    assert os.environ["RAY_TPU_FETCH_CHUNK"] == "12345"
-    assert cfg.get("fetch_chunk") == 12345
-    monkeypatch.delenv("RAY_TPU_FETCH_CHUNK", raising=False)
+    os.environ.pop("RAY_TPU_FETCH_CHUNK", None)
+    cfg = RayTpuConfig()
+    prior = cfg.apply({"fetch_chunk": 12345})
+    try:
+        assert os.environ["RAY_TPU_FETCH_CHUNK"] == "12345"
+        assert cfg.get("fetch_chunk") == 12345
+    finally:
+        cfg.restore(prior)
+    assert os.environ.get("RAY_TPU_FETCH_CHUNK") is None
 
 
 def test_describe_lists_all_flags(monkeypatch):
@@ -66,3 +73,19 @@ def test_system_config_reaches_the_runtime():
     finally:
         ray_tpu.shutdown()
         os.environ.pop("RAY_TPU_OBJECT_STORE_CAP", None)
+
+
+def test_system_config_restored_on_shutdown():
+    """A cluster's _system_config env exports must die with it — the r2
+    livelock and an OOM-monitor cross-test kill both traced back to
+    leaked RAY_TPU_* overrides poisoning the NEXT cluster."""
+    import os
+
+    import ray_tpu
+
+    assert os.environ.get("RAY_TPU_FETCH_CHUNK") is None
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"fetch_chunk": 1024 * 1024})
+    assert os.environ.get("RAY_TPU_FETCH_CHUNK") == str(1024 * 1024)
+    ray_tpu.shutdown()
+    assert os.environ.get("RAY_TPU_FETCH_CHUNK") is None

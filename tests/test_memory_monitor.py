@@ -1,0 +1,88 @@
+"""Memory monitor / OOM protection (reference memory_monitor.h:52 +
+worker_killing_policy.cc): over-threshold nodes kill the greediest
+worker, task workers before actors, and the submitter sees a typed
+OutOfMemoryError instead of a generic crash."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import (MemoryMonitor, node_usage,
+                                             pid_rss)
+from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
+
+
+def test_threshold_logic():
+    mon = MemoryMonitor(0.9, usage_fn=lambda: (95, 100))
+    assert mon.over_threshold() == (95, 100)
+    mon = MemoryMonitor(0.9, usage_fn=lambda: (50, 100))
+    assert mon.over_threshold() is None
+    # disabled
+    mon = MemoryMonitor(0.0, usage_fn=lambda: (100, 100))
+    assert mon.over_threshold() is None
+
+
+def test_victim_prefers_busy_then_rss():
+    rss = {1: 100, 2: 900, 3: 500, 4: 5000}
+    mon = MemoryMonitor(0.9, rss_fn=lambda pid: rss.get(pid, 0))
+    # BUSY beats ACTOR even at lower RSS (tasks are retriable, actors
+    # lose state); within a class, highest RSS wins
+    victim = mon.pick_victim([("w1", 1, "BUSY"), ("w2", 2, "BUSY"),
+                              ("w3", 3, "ACTOR"), ("w4", 4, "ACTOR")])
+    assert victim == ("w2", 2, 900)
+    # no BUSY: greediest actor
+    victim = mon.pick_victim([("w3", 3, "ACTOR"), ("w4", 4, "ACTOR")])
+    assert victim == ("w4", 4, 5000)
+    # dead pids (rss 0) skipped
+    assert mon.pick_victim([("w9", 9, "BUSY")]) is None
+    assert mon.pick_victim([]) is None
+
+
+def test_real_readers_sane():
+    used, total = node_usage()
+    assert 0 < used <= total
+    import os
+    assert pid_rss(os.getpid()) > 1024 * 1024  # a python process > 1MB
+    assert pid_rss(2**22 + 12345) == 0  # nonexistent pid
+
+
+def test_oom_kill_surfaces_typed_error():
+    """Threshold ~0 makes ANY usage 'over': the first running task's
+    worker is killed by the monitor and the caller gets OutOfMemoryError
+    naming the cause, not a bare WorkerCrashedError."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "memory_usage_threshold": 1e-9,
+        "memory_monitor_refresh_ms": 100,
+    })
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        ref = hog.remote()
+        with pytest.raises(OutOfMemoryError) as ei:
+            ray_tpu.get(ref, timeout=30.0)
+        assert "oom" in str(ei.value)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_retries_then_fails_typed():
+    """OOM kills consume retries like any worker death; the final error
+    is still the typed one."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "memory_usage_threshold": 1e-9,
+        "memory_monitor_refresh_ms": 100,
+    })
+    try:
+        @ray_tpu.remote(max_retries=1)
+        def hog():
+            time.sleep(30)
+
+        with pytest.raises(WorkerCrashedError):
+            ray_tpu.get(hog.remote(), timeout=60.0)
+    finally:
+        ray_tpu.shutdown()
