@@ -1147,8 +1147,11 @@ class ConductorHandler:
         return "pong"
 
     def session_info(self) -> Dict[str, Any]:
+        from .worker import _MACHINE_ID
+
         return {"session_dir": self._session_dir,
-                "head_node_id": self._head_node_id}
+                "head_node_id": self._head_node_id,
+                "machine": _MACHINE_ID}
 
     # ----------------------------------------------------------- persistence
 
@@ -1436,6 +1439,14 @@ class Conductor:
     def start(self) -> "Conductor":
         self.server.start()
         self.handler._monitor.start()
+        # head-node log tailer: worker prints ride the worker_logs pubsub
+        # channel to subscribed drivers (reference log_monitor.py)
+        from .log_monitor import LogMonitor
+
+        self._log_monitor = LogMonitor(
+            os.path.join(self.handler._session_dir, "logs"),
+            lambda batch: self.handler.publish("worker_logs", batch),
+            node_label="head").start()
         return self
 
     @property

@@ -76,6 +76,9 @@ _FLAGS: List[Flag] = [
          "greediest worker (<= 0 disables; reference memory_monitor.h)"),
     Flag("memory_monitor_refresh_ms", int, 250,
          "memory monitor poll period in milliseconds (0 disables)"),
+    Flag("log_to_driver", int, 1,
+         "1 = mirror worker stdout/stderr lines to the driver console "
+         "via the worker_logs pubsub channel (reference log_monitor.py)"),
     # --- misc ----------------------------------------------------------
     Flag("node_ip", str, "",
          "address other hosts can reach this one on (else inferred from "

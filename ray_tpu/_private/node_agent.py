@@ -110,10 +110,11 @@ class NodeAgent:
         self.resources = dict(resources)
         self.conductor_address = tuple(conductor_address)
         self._conductor = ReconnectingClient(self.conductor_address)
+        info = self._conductor.call("session_info", timeout=10.0)
         if session_dir is None:
-            info = self._conductor.call("session_info", timeout=10.0)
             session_dir = info["session_dir"]
         self.session_dir = session_dir
+        self._conductor_machine = info.get("machine")
         self.handler = NodeAgentHandler(self.node_id,
                                         self.conductor_address,
                                         session_dir, worker_env=worker_env)
@@ -136,6 +137,20 @@ class NodeAgent:
                              self.server.address, timeout=10.0)
         self._hb_thread.start()
         self._mem_thread.start()
+        # tail THIS host's worker logs into the worker_logs channel — but
+        # only when the head is a different machine: on a shared host the
+        # conductor's own tailer already covers the shared session dir
+        # (reference: one log_monitor per node)
+        from .worker import _MACHINE_ID
+
+        if self._conductor_machine != _MACHINE_ID:
+            from .log_monitor import LogMonitor
+
+            self._log_monitor = LogMonitor(
+                os.path.join(self.session_dir, "logs"),
+                lambda batch: self._conductor.notify(
+                    "publish", "worker_logs", batch),
+                node_label=self.node_id[:12]).start()
         return self
 
     def _memory_loop(self) -> None:

@@ -173,6 +173,33 @@ class Worker:
         from . import refcount
 
         refcount.tracker.attach(self)
+        if mode == "driver":
+            self._maybe_mirror_worker_logs()
+
+    def _maybe_mirror_worker_logs(self) -> None:
+        """log_to_driver (reference log_monitor.py): print worker
+        stdout/stderr lines arriving on the worker_logs channel to this
+        driver's stderr."""
+        from .config import config
+
+        if not config.log_to_driver:
+            return
+        import sys
+
+        from .log_monitor import format_log_line
+
+        def on_lines(batch) -> None:
+            try:
+                for entry in batch:
+                    sys.stderr.write(format_log_line(entry) + "\n")
+                sys.stderr.flush()
+            except Exception:  # noqa: BLE001 — closed stderr on teardown
+                pass
+
+        try:
+            self.subscribe_channel("worker_logs", on_lines)
+        except Exception:  # noqa: BLE001 — conductor not up yet (tests
+            pass           # constructing a bare Worker)
 
     # ------------------------------------------------------------ put / get
 
