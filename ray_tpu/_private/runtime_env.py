@@ -4,20 +4,26 @@ to GCS KV, URI-cached per node; env_vars; pip plugin pip.py; the plugin
 protocol plugin.py) + the runtime-env agent flow
 (agent/runtime_env_agent.py:161).
 
-Built-in keys: env_vars, working_dir, py_modules, pip. Directories are
-zipped, content-addressed, staged through the conductor KV (the GCS-KV
-analog), and extracted once per worker into a hash-keyed cache. `pip`
-creates a content-keyed venv (--system-site-packages, --no-index: this
-runtime installs LOCAL wheels/dirs at env-setup time, never from the
-network — TPU images are baked) whose site-packages is prepended for the
-task/actor. conda/container stay rejected; third-party keys can hook in
-via register_plugin (reference plugin.py RuntimeEnvPlugin)."""
+Built-in keys: env_vars, working_dir, py_modules, pip, uv, conda.
+Directories are zipped, content-addressed, staged through the conductor
+KV (the GCS-KV analog), and extracted once per worker into a hash-keyed
+cache. `pip`/`uv` create a content-keyed venv (--system-site-packages,
+--no-index: this runtime installs LOCAL wheels/dirs at env-setup time,
+never from the network — TPU images are baked; `uv` uses the uv
+installer when the binary exists, reference runtime_env/uv.py, and
+falls back to pip otherwise). `conda` ACTIVATES an existing local env
+by prefix or name (reference runtime_env/conda.py minus env creation —
+same zero-egress stance). container/image_uri stay rejected: workers
+come from a pre-started process pool on baked images, there is no
+container runtime to launch them in. Third-party keys hook in via
+register_plugin (reference plugin.py RuntimeEnvPlugin)."""
 from __future__ import annotations
 
 import contextlib
 import hashlib
 import io
 import os
+import shutil as _shutil
 import subprocess
 import sys
 import tempfile
@@ -26,8 +32,9 @@ from typing import Any, Dict, List, Optional
 
 _KV_NS = "runtime_env"
 _MAX_PACKAGE_BYTES = 256 * 1024 * 1024
-_UNSUPPORTED = ("conda", "container", "uv", "image_uri")
-_BUILTIN = ("env_vars", "working_dir", "py_modules", "pip", "config")
+_UNSUPPORTED = ("container", "image_uri")
+_BUILTIN = ("env_vars", "working_dir", "py_modules", "pip", "uv", "conda",
+            "config")
 
 
 class RuntimeEnvPlugin:
@@ -90,10 +97,12 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     for key in _UNSUPPORTED:
         if key in env:
             raise ValueError(
-                f"runtime_env[{key!r}] is not supported: ray_tpu never "
-                "builds images/envs from the network at task time (bake "
-                "them into the image); supported keys: env_vars, "
-                "working_dir, py_modules, pip (local wheels/dirs)")
+                f"runtime_env[{key!r}] is not supported: workers come "
+                "from a pre-started process pool on baked TPU images — "
+                "there is no container runtime to launch them in; bake "
+                "the image instead. Supported keys: env_vars, "
+                "working_dir, py_modules, pip/uv (local wheels/dirs), "
+                "conda (existing local env)")
     for key in env:
         if key not in _BUILTIN and key not in _plugins():
             raise ValueError(
@@ -103,19 +112,43 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
         raise ValueError("runtime_env['env_vars'] must be Dict[str, str]")
-    pip = env.get("pip")
-    if pip is not None:
-        if not (isinstance(pip, list)
-                and all(isinstance(s, str) for s in pip)):
-            raise ValueError("runtime_env['pip'] must be List[str] of local "
-                             "wheel/sdist/directory paths")
-        for s in pip:
+    if "pip" in env and "uv" in env:
+        raise ValueError("runtime_env accepts 'pip' OR 'uv', not both "
+                         "(they describe the same environment)")
+    for installer in ("pip", "uv"):
+        specs = env.get(installer)
+        if specs is None or (isinstance(specs, dict) and "key" in specs):
+            continue  # absent, or already prepared
+        if not (isinstance(specs, list)
+                and all(isinstance(s, str) for s in specs)):
+            raise ValueError(
+                f"runtime_env[{installer!r}] must be List[str] of local "
+                "wheel/sdist/directory paths")
+        for s in specs:
             if not (os.path.isfile(s) or os.path.isdir(s)):
                 raise ValueError(
-                    f"runtime_env['pip'] entry {s!r} is not supported: "
-                    "network installs at task time never happen in "
-                    "ray_tpu (TPU images are baked; zero egress) — pass "
-                    "a local wheel/sdist/directory path instead")
+                    f"runtime_env[{installer!r}] entry {s!r} is not "
+                    "supported: network installs at task time never "
+                    "happen in ray_tpu (TPU images are baked; zero "
+                    "egress) — pass a local wheel/sdist/directory path "
+                    "instead")
+    conda = env.get("conda")
+    if conda is not None:
+        if isinstance(conda, dict) and ("dependencies" in conda
+                                        or "channels" in conda):
+            raise ValueError(
+                "runtime_env['conda'] with an environment spec "
+                "(dependencies/channels) is not supported: ray_tpu never "
+                "creates envs from the network at task time — pass the "
+                "NAME or PREFIX PATH of an env that already exists on "
+                "the workers")
+        if isinstance(conda, dict):
+            if not (conda.get("prefix") or conda.get("name")):
+                raise ValueError("runtime_env['conda'] dict needs "
+                                 "'prefix' or 'name'")
+        elif not isinstance(conda, str):
+            raise ValueError("runtime_env['conda'] must be an env name, "
+                             "a prefix path, or {'prefix'|'name': ...}")
     for key, plugin in _plugins().items():
         if key in env:
             env[key] = plugin.validate(env[key])
@@ -204,9 +237,10 @@ def prepare(conductor, runtime_env: Dict[str, Any]) -> Dict[str, Any]:
                     else package_dir(conductor, m))
     if mods:
         out["py_modules"] = mods
-    pip = env.get("pip")
-    if pip and not (isinstance(pip, dict) and "key" in pip):
-        out["pip"] = _prepare_pip(conductor, pip)
+    for installer in ("pip", "uv"):
+        specs = env.get(installer)
+        if specs and not (isinstance(specs, dict) and "key" in specs):
+            out[installer] = _prepare_pip(conductor, specs)
     for key, plugin in _plugins().items():
         if key in env:
             out[key] = plugin.prepare(conductor, env[key])
@@ -241,12 +275,16 @@ def ensure_local(conductor, uri: str) -> str:
     return dest
 
 
-def ensure_pip_env(conductor, prepared: Dict[str, Any]) -> str:
-    """Worker-side: materialize the staged pip env once; returns its
+def ensure_pip_env(conductor, prepared: Dict[str, Any],
+                   installer: str = "pip") -> str:
+    """Worker-side: materialize the staged pip/uv env once; returns its
     site-packages dir. A content-keyed venv (--system-site-packages so
     the baked jax stack stays visible; --no-index so nothing touches the
     network) mirrors the reference's per-env virtualenv (pip.py:282) —
-    shared by every task/actor with the same spec on this machine."""
+    shared by every task/actor with the same spec on this machine.
+    installer='uv' uses the uv binary when present (reference uv.py's
+    faster installs) and falls back to pip — the resulting env is
+    identical either way."""
     key = prepared["key"]
     venv_dir = os.path.join(_cache_root(), "venvs", key)
     ok_marker = os.path.join(venv_dir, ".ray_tpu_ok")
@@ -275,20 +313,91 @@ def ensure_pip_env(conductor, prepared: Dict[str, Any]) -> str:
             targets.append(ensure_local(conductor, s["uri"]))
         else:
             targets.append(s["spec"])
-    subprocess.run([sys.executable, "-m", "venv", "--system-site-packages",
-                    venv_dir], check=True, capture_output=True)
-    pip = os.path.join(venv_dir, "bin", "pip")
-    r = subprocess.run(
-        [pip, "install", "--quiet", "--no-index",
-         "--no-build-isolation", *targets],
-        capture_output=True, text=True)
+    uv = _shutil.which("uv") if installer == "uv" else None
+    if uv:
+        subprocess.run([uv, "venv", "--system-site-packages",
+                        "--python", sys.executable, venv_dir],
+                       check=True, capture_output=True)
+        cmd = [uv, "pip", "install", "--quiet", "--no-index",
+               "--python", os.path.join(venv_dir, "bin", "python"),
+               *targets]
+    else:
+        subprocess.run([sys.executable, "-m", "venv",
+                        "--system-site-packages", venv_dir],
+                       check=True, capture_output=True)
+        cmd = [os.path.join(venv_dir, "bin", "pip"), "install", "--quiet",
+               "--no-index", "--no-build-isolation", *targets]
+    r = subprocess.run(cmd, capture_output=True, text=True)
     if r.returncode != 0:
         raise RuntimeError(
-            f"pip runtime_env failed (offline install of {targets}): "
-            f"{r.stdout}\n{r.stderr}")
+            f"{installer} runtime_env failed (offline install of "
+            f"{targets}): {r.stdout}\n{r.stderr}")
     with open(ok_marker, "w") as f:
         f.write("ok")
     return lib
+
+
+def resolve_conda_prefix(value: Any) -> str:
+    """Locate an EXISTING local conda env (reference conda.py
+    get_conda_env_dir — minus creation). Accepts a prefix path directly;
+    names are searched in CONDA_ENVS_PATH, the active conda install's
+    envs/ dir, and the conventional roots."""
+    from .. import exceptions as exc
+
+    if isinstance(value, dict):
+        value = value.get("prefix") or value.get("name")
+    value = str(value)
+    if os.path.sep in value or os.path.isdir(value):
+        prefix = os.path.abspath(os.path.expanduser(value))
+        if os.path.exists(os.path.join(prefix, "bin", "python")):
+            return prefix
+        raise exc.RuntimeEnvSetupError(
+            f"runtime_env['conda'] prefix {value!r} has no bin/python — "
+            "not a conda env (ray_tpu never creates envs at task time; "
+            "create it beforehand)")
+    roots: List[str] = []
+    for d in os.environ.get("CONDA_ENVS_PATH", "").split(os.pathsep):
+        if d:
+            roots.append(d)
+    conda_exe = os.environ.get("CONDA_EXE") or _shutil.which("conda")
+    if conda_exe:
+        roots.append(os.path.join(
+            os.path.dirname(os.path.dirname(conda_exe)), "envs"))
+    for base in ("~/miniconda3", "~/anaconda3", "/opt/conda"):
+        roots.append(os.path.join(os.path.expanduser(base), "envs"))
+    for root in roots:
+        prefix = os.path.join(root, value)
+        if os.path.exists(os.path.join(prefix, "bin", "python")):
+            return prefix
+    raise exc.RuntimeEnvSetupError(
+        f"runtime_env['conda'] env {value!r} not found on this worker "
+        f"(searched {roots}); ray_tpu activates EXISTING envs only — "
+        "create it on every node beforehand (baked images, zero egress)")
+
+
+def _apply_conda(value: Any) -> Dict[str, Optional[str]]:
+    """Activate an existing conda env for this process: PATH, CONDA_*
+    env vars, and its site-packages at the front of sys.path. Returns
+    {env_var: previous} so a task-scoped application can be undone."""
+    prefix = resolve_conda_prefix(value)
+    saved: Dict[str, Optional[str]] = {
+        "PATH": os.environ.get("PATH"),
+        "CONDA_PREFIX": os.environ.get("CONDA_PREFIX"),
+        "CONDA_DEFAULT_ENV": os.environ.get("CONDA_DEFAULT_ENV"),
+    }
+    os.environ["PATH"] = (os.path.join(prefix, "bin") + os.pathsep
+                          + os.environ.get("PATH", ""))
+    os.environ["CONDA_PREFIX"] = prefix
+    os.environ["CONDA_DEFAULT_ENV"] = os.path.basename(prefix)
+    lib = os.path.join(prefix, "lib")
+    if os.path.isdir(lib):
+        for entry in sorted(os.listdir(lib)):
+            sp = os.path.join(lib, entry, "site-packages")
+            if entry.startswith("python") and os.path.isdir(sp):
+                if sp not in sys.path:
+                    sys.path.insert(0, sp)
+                break
+    return saved
 
 
 @contextlib.contextmanager
@@ -318,11 +427,16 @@ def applied(conductor, runtime_env: Optional[Dict[str, Any]],
             local = ensure_local(conductor, uri)
             if local not in sys.path:
                 sys.path.insert(0, local)
-        pip = env.get("pip")
-        if pip:
-            sp = ensure_pip_env(conductor, pip)
-            if sp not in sys.path:
-                sys.path.insert(0, sp)
+        for installer in ("pip", "uv"):
+            specs = env.get(installer)
+            if specs:
+                sp = ensure_pip_env(conductor, specs, installer=installer)
+                if sp not in sys.path:
+                    sys.path.insert(0, sp)
+        conda = env.get("conda")
+        if conda:
+            for var, old in _apply_conda(conda).items():
+                saved_env.setdefault(var, old)
         for key, plugin in _plugins().items():
             if key in env:
                 plugin.apply(conductor, env[key])

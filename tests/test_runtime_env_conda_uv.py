@@ -1,0 +1,114 @@
+"""runtime_env conda/uv (reference python/ray/_private/runtime_env/
+conda.py, uv.py): uv installs local artifacts into a content-keyed venv
+(uv binary when present, pip fallback — identical env either way);
+conda ACTIVATES an existing local env by name or prefix. Container
+keys stay rejected with the design rationale."""
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import runtime_env as renv
+
+
+def _make_pkg(tmp_path, name, value):
+    pkg = tmp_path / name
+    (pkg / name).mkdir(parents=True)
+    (pkg / name / "__init__.py").write_text(f"VALUE = {value!r}\n")
+    (pkg / "pyproject.toml").write_text(textwrap.dedent(f"""
+        [build-system]
+        requires = []
+        build-backend = "setuptools.build_meta"
+        [project]
+        name = "{name}"
+        version = "0.0.1"
+    """))
+    return str(pkg)
+
+
+def test_validate_uv_and_conda_accepted(tmp_path):
+    pkg = _make_pkg(tmp_path, "uvpkg", 1)
+    out = renv.validate({"uv": [pkg]})
+    assert out["uv"] == [pkg]
+    assert renv.validate({"conda": "myenv"})["conda"] == "myenv"
+    assert renv.validate({"conda": {"prefix": "/x"}})
+    with pytest.raises(ValueError, match="OR"):
+        renv.validate({"pip": [pkg], "uv": [pkg]})
+    with pytest.raises(ValueError, match="dependencies"):
+        renv.validate({"conda": {"dependencies": ["numpy"]}})
+    with pytest.raises(ValueError, match="container"):
+        renv.validate({"container": {"image": "x"}})
+    with pytest.raises(ValueError, match="not"):
+        renv.validate({"uv": ["requests==2.0"]})  # network spec rejected
+
+
+def test_uv_env_installs_local_package(tmp_path):
+    """End-to-end: a task under runtime_env={'uv': [...]} imports the
+    package (pip fallback exercises the same venv when uv is absent)."""
+    pkg = _make_pkg(tmp_path, "uvdemo_pkg", 41)
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"uv": [pkg]})
+        def use():
+            import uvdemo_pkg
+            return uvdemo_pkg.VALUE
+
+        assert ray_tpu.get(use.remote(), timeout=120.0) == 41
+    finally:
+        ray_tpu.shutdown()
+
+
+def _fake_conda_env(root, name):
+    """A minimal 'conda env': bin/python + a site-packages marker."""
+    prefix = root / name
+    (prefix / "bin").mkdir(parents=True)
+    os.symlink(sys.executable, prefix / "bin" / "python")
+    vi = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    sp = prefix / "lib" / vi / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "conda_marker_mod.py").write_text("WHERE = 'conda-env'\n")
+    return prefix
+
+
+def test_resolve_conda_prefix_by_path_and_name(tmp_path, monkeypatch):
+    prefix = _fake_conda_env(tmp_path, "env_a")
+    assert renv.resolve_conda_prefix(str(prefix)) == str(prefix)
+    monkeypatch.setenv("CONDA_ENVS_PATH", str(tmp_path))
+    assert renv.resolve_conda_prefix("env_a") == str(prefix)
+    assert renv.resolve_conda_prefix({"name": "env_a"}) == str(prefix)
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+    with pytest.raises(RuntimeEnvSetupError, match="not found"):
+        renv.resolve_conda_prefix("no_such_env")
+    with pytest.raises(RuntimeEnvSetupError, match="bin/python"):
+        renv.resolve_conda_prefix(str(tmp_path))  # dir but not an env
+
+
+def test_conda_env_activates_in_task(tmp_path, monkeypatch):
+    prefix = _fake_conda_env(tmp_path, "env_b")
+    monkeypatch.setenv("CONDA_ENVS_PATH", str(tmp_path))
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"conda": "env_b"})
+        def use():
+            import conda_marker_mod
+            return (conda_marker_mod.WHERE,
+                    os.environ.get("CONDA_DEFAULT_ENV"),
+                    os.environ["PATH"].split(os.pathsep)[0])
+
+        where, env_name, path0 = ray_tpu.get(use.remote(), timeout=60.0)
+        assert where == "conda-env"
+        assert env_name == "env_b"
+        assert path0 == str(prefix / "bin")
+
+        # task-scoped: the env does NOT leak into the next task
+        @ray_tpu.remote
+        def plain():
+            return os.environ.get("CONDA_DEFAULT_ENV")
+
+        assert ray_tpu.get(plain.remote(), timeout=60.0) is None
+    finally:
+        ray_tpu.shutdown()
