@@ -828,6 +828,17 @@ class ConductorHandler:
                 subs.append(tuple(address))
 
     def publish(self, channel: str, message: Any) -> None:
+        if channel == "worker_logs":
+            # ring buffer for the dashboard's log viewer (reference: the
+            # dashboard's log tailing endpoints)
+            buf = getattr(self, "_recent_logs", None)
+            if buf is None:
+                import collections
+
+                buf = self._recent_logs = collections.deque(maxlen=2000)
+            ts = time.time()
+            for entry in (message if isinstance(message, list) else ()):
+                buf.append({**entry, "ts": ts})
         with self._lock:
             subs = list(self._subs.get(channel, []))
         for addr in subs:
@@ -835,6 +846,12 @@ class ConductorHandler:
                 self._clients.get(addr).notify("on_published", channel, message)
             except Exception:
                 pass
+
+    def get_recent_logs(self, limit: int = 500) -> List[Dict[str, Any]]:
+        buf = getattr(self, "_recent_logs", None)
+        if not buf:
+            return []
+        return list(buf)[-limit:]
 
     # ------------------------------------------------------- placement groups
 

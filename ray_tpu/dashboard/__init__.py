@@ -51,6 +51,9 @@ class _ClusterData:
     def simple(self, method: str) -> Any:
         return self.conductor.call(method, timeout=10.0)
 
+    def simple_args(self, method: str, *args) -> Any:
+        return self.conductor.call(method, *args, timeout=10.0)
+
     def objects(self) -> List[Dict[str, Any]]:
         out = []
         for rec in self.conductor.call("list_workers", timeout=5.0):
@@ -162,6 +165,9 @@ class DashboardServer:
         app.router.add_get("/api/objects", self._json_route(d.objects))
         app.router.add_get("/api/tasks", self._json_route(d.tasks_summary))
         app.router.add_get("/api/timeline", self._json_route(d.timeline))
+        app.router.add_get("/api/logs",
+                           self._json_route(
+                               lambda: d.simple_args("get_recent_logs", 500)))
         app.router.add_get("/api/metrics", self._metrics)
         return app
 

@@ -85,3 +85,23 @@ def test_entity_endpoints_reflect_cluster(dashboard):
 
     status, ctype, _ = _get(dashboard.url + "/api/metrics")
     assert status == 200 and ctype == "text/plain"
+
+
+def test_logs_endpoint_carries_worker_prints(dashboard):
+    @ray_tpu.remote
+    def chatty():
+        print("DASHBOARD_LOG_MARKER")
+        return 1
+
+    assert ray_tpu.get(chatty.remote()) == 1
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        status, _, body = _get(dashboard.url + "/api/logs")
+        assert status == 200
+        entries = json.loads(body)
+        if any("DASHBOARD_LOG_MARKER" in e.get("line", "")
+               for e in entries):
+            assert all({"worker", "line", "ts"} <= set(e) for e in entries)
+            return
+        time.sleep(0.3)
+    raise AssertionError("worker print never reached /api/logs")
