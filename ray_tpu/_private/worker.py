@@ -1543,6 +1543,18 @@ class WorkerHandler:
         q.put((seq, payload))
         return True
 
+    def start_device_profile(self, tag: str) -> str:
+        """Begin a jax.profiler trace in THIS worker process (driver-side
+        API: ray_tpu.util.profiling.profile_actor)."""
+        from ray_tpu.util import profiling
+
+        return profiling.start_profile(tag)
+
+    def stop_device_profile(self) -> str:
+        from ray_tpu.util import profiling
+
+        return profiling.stop_profile()
+
     def refcount_update(self, from_addr, entries) -> None:
         """Batched borrower incref/adopt/drop messages (reference
         reference_count.h borrower protocol)."""

@@ -1,0 +1,73 @@
+"""jax.profiler integration (SURVEY §5.1 gap: device-level profiling
+next to the span tracer): in-process traces, annotations, and remote
+capture on an actor's worker."""
+from __future__ import annotations
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import ray_tpu
+from ray_tpu.util import profiling
+
+
+def _has_trace_files(d):
+    return bool(glob.glob(os.path.join(d, "**", "*.xplane.pb"),
+                          recursive=True)
+                or glob.glob(os.path.join(d, "**", "*.trace.json*"),
+                             recursive=True))
+
+
+def test_profile_context_writes_trace(tmp_path):
+    d = str(tmp_path / "trace")
+    with profiling.profile(log_dir=d):
+        with profiling.annotate("matmul_block"):
+            x = jnp.ones((256, 256))
+            jax.block_until_ready(jnp.dot(x, x))
+    assert _has_trace_files(d), os.listdir(d)
+
+
+def test_profile_double_start_rejected(tmp_path):
+    d = str(tmp_path / "t")
+    profiling.start_profile(log_dir=d)
+    try:
+        with pytest.raises(RuntimeError, match="already running"):
+            profiling.start_profile(log_dir=str(tmp_path / "t2"))
+    finally:
+        profiling.stop_profile()
+    with pytest.raises(RuntimeError, match="no profile"):
+        profiling.stop_profile()
+
+
+def test_profile_actor_remote_capture():
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Model:
+            def step(self, n):
+                x = jnp.ones((n, n))
+                return float(jnp.dot(x, x).sum())
+
+        m = Model.remote()
+        assert ray_tpu.get(m.step.remote(64)) > 0
+        import threading
+
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                ray_tpu.get(m.step.remote(128))
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            d = profiling.profile_actor(m, seconds=1.0)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert _has_trace_files(d), d
+    finally:
+        ray_tpu.shutdown()
