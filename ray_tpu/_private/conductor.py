@@ -65,6 +65,9 @@ class WorkerRecord:
     # node whose resources the current lease took (an autoscaled accounting
     # node may differ from the spawn node on this single-host runtime)
     lease_node_id: Optional[str] = None
+    # lease resources parked while the worker blocks in get()/wait()
+    # (reference: raylet releases blocked workers' resources)
+    blocked_resources: Optional[Dict[str, float]] = None
     # TPU chips this process was bound to at spawn (TPU_VISIBLE_CHIPS);
     # chips stay bound for the process lifetime — its TPU runtime owns the
     # devices — and return to the node pool only on death
@@ -634,8 +637,43 @@ class ConductorHandler:
                 return
             self._release_resources(self._lease_release_node(w), w.resources)
             w.resources = {}
+            w.blocked_resources = None  # a parked lease dies with the task
             if w.state == "BUSY":
                 w.state = "IDLE"
+            self._cv.notify_all()
+
+    def worker_blocked(self, worker_id: str) -> None:
+        """A worker's executor thread entered a blocking get()/wait():
+        its lease resources return to the pool so the tasks it is
+        waiting ON can schedule — without this, dependent tasks each
+        get()ing their dep deadlock the moment tasks outnumber CPUs
+        (reference: raylet releases resources of workers blocked in
+        ray.get, node_manager.cc HandleWorkerBlocked)."""
+        with self._cv:
+            w = self._workers.get(worker_id)
+            if w is None or w.state != "BUSY" or not w.resources \
+                    or w.blocked_resources:
+                return
+            self._release_resources(self._lease_release_node(w),
+                                    w.resources)
+            w.blocked_resources = w.resources
+            w.resources = {}
+            self._cv.notify_all()
+
+    def worker_unblocked(self, worker_id: str) -> None:
+        """Re-take the parked lease on wake. Transient oversubscription
+        is allowed (availability may go negative, stalling new leases
+        until it recovers) — the reference's resume semantics."""
+        with self._cv:
+            w = self._workers.get(worker_id)
+            if w is None or not w.blocked_resources or w.state != "BUSY":
+                return
+            node = self._lease_release_node(w)
+            if node is not None:
+                for k, v in w.blocked_resources.items():
+                    node.available[k] = node.available.get(k, 0.0) - v
+            w.resources = w.blocked_resources
+            w.blocked_resources = None
             self._cv.notify_all()
 
     def prestart_workers(self, n: int) -> None:

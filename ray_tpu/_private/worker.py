@@ -23,6 +23,7 @@ Submission protocol (reference direct_task_transport.h:75 kept):
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import queue
 import threading
@@ -158,6 +159,9 @@ class Worker:
         # executor-side: return_id -> thread ident running it (for the
         # cooperative async-exception interrupt)
         self._exec_threads: Dict[str, int] = {}
+        # executor threads currently blocked in get()/wait() with their
+        # lease parked at the conductor
+        self._blocked_idents: set = set()
         self._state_lock = threading.Lock()
         # per-caller actor-call send ordering: frames must hit the socket in
         # seqno order or the server's reorder buffer can adopt a too-high
@@ -224,25 +228,74 @@ class Worker:
         return out[0] if single else out
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        if self.store.contains(ref.id):  # fast path: no lease dance
+            return self._load_local(ref)
         deadline = None if timeout is None else time.monotonic() + timeout
         attempts = 0
-        while True:
-            if self.store.contains(ref.id):
-                return self._load_local(ref)
-            if self._is_pending_local(ref.id):
-                self._wait_result(ref.id, deadline)
-                continue
+        with self._lease_released_while_blocked():
+            while True:
+                if self.store.contains(ref.id):
+                    return self._load_local(ref)
+                if self._is_pending_local(ref.id):
+                    self._wait_result(ref.id, deadline)
+                    continue
+                try:
+                    self._fetch(ref, deadline)
+                    continue
+                except (ConnectionLost, KeyError, FileNotFoundError,
+                        exc.ObjectLostError) as e:
+                    attempts += 1
+                    if attempts > 1 + self._lineage_retries(ref.id) or \
+                            not self._try_reconstruct(ref):
+                        raise exc.ObjectLostError(
+                            ref.id, f"fetch failed ({e}) and "
+                            "reconstruction unavailable") from e
+
+    @contextlib.contextmanager
+    def _lease_released_while_blocked(self):
+        """An EXECUTOR thread entering a blocking get()/wait() parks its
+        lease at the conductor so the tasks it waits on can schedule
+        (reference: raylet resource release for workers blocked in
+        ray.get — without it, dependent tasks deadlock the moment they
+        outnumber CPUs). No-op for drivers, non-executor threads, and
+        nested blocking sections."""
+        # actor workers hold no CPU lease (state ACTOR, resources ~0) —
+        # the conductor would no-op, so skip the RPC pair entirely
+        if self.mode != "worker" or self._actor_runtime is not None:
+            yield
+            return
+        ident = threading.get_ident()
+        with self._state_lock:
+            hook = ident in self._exec_threads.values() \
+                and ident not in self._blocked_idents
+        if not hook:
+            yield
+            return
+        try:
+            # registration inside the try: an async-exc cancel landing
+            # anywhere past this point unwinds through the finally, so
+            # the ident can never leak (a leak would permanently disable
+            # lease-parking for this pool thread)
+            with self._state_lock:
+                self._blocked_idents.add(ident)
             try:
-                self._fetch(ref, deadline)
-                continue
-            except (ConnectionLost, KeyError, FileNotFoundError,
-                    exc.ObjectLostError) as e:
-                attempts += 1
-                if attempts > 1 + self._lineage_retries(ref.id) or \
-                        not self._try_reconstruct(ref):
-                    raise exc.ObjectLostError(
-                        ref.id, f"fetch failed ({e}) and reconstruction "
-                        "unavailable") from e
+                self.conductor.notify("worker_blocked", self.worker_id)
+            except ConnectionLost:
+                pass
+            yield
+        finally:
+            while True:  # injection-proof teardown (cf. _pop_exec_threads)
+                try:
+                    with self._state_lock:
+                        self._blocked_idents.discard(ident)
+                    try:
+                        self.conductor.notify("worker_unblocked",
+                                              self.worker_id)
+                    except ConnectionLost:
+                        pass
+                    break
+                except exc.TaskCancelledError:
+                    continue
 
     def _load_local(self, ref: ObjectRef) -> Any:
         value = self.store.get_local(ref.id)  # raises stored errors
@@ -425,33 +478,45 @@ class Worker:
         # a transient connection drop after it already forgot the waiter,
         # or the owner restarted) — without it a single failed push would
         # wedge this waiter forever.
-        ready_ids: set = set()
+        # fast path first: enough already-ready refs (or a zero timeout)
+        # must not pay the lease park/unpark RPC pair — polling loops
+        # call wait(timeout=0) hot
+        ready_ids: set = {r.id for r in refs if self._ref_ready(r)}
         idle_cycles = 0
-        while True:
-            progressed = False
-            for r in refs:
-                if r.id not in ready_ids and self._ref_ready(r):
-                    ready_ids.add(r.id)
-                    progressed = True
-            if len(ready_ids) >= num_returns or (
-                    deadline is not None and time.monotonic() >= deadline):
-                break
-            idle_cycles = 0 if progressed else idle_cycles + 1
-            if idle_cycles >= 20:  # ~5s of silence: re-probe the owners
-                idle_cycles = 0
-                with self._state_lock:
-                    for r in refs:
-                        if r.id not in ready_ids:
-                            self._subscribed.discard(r.id)
-            rem = None if deadline is None else deadline - time.monotonic()
-            self.store.wait_change(
-                0.25 if rem is None else max(0.0, min(0.25, rem)))
+        if len(ready_ids) >= num_returns or (
+                deadline is not None and time.monotonic() >= deadline):
+            return self._wait_split(refs, ready_ids, num_returns)
+        with self._lease_released_while_blocked():
+            while True:
+                progressed = False
+                for r in refs:
+                    if r.id not in ready_ids and self._ref_ready(r):
+                        ready_ids.add(r.id)
+                        progressed = True
+                if len(ready_ids) >= num_returns or (
+                        deadline is not None
+                        and time.monotonic() >= deadline):
+                    break
+                idle_cycles = 0 if progressed else idle_cycles + 1
+                if idle_cycles >= 20:  # ~5s of silence: re-probe owners
+                    idle_cycles = 0
+                    with self._state_lock:
+                        for r in refs:
+                            if r.id not in ready_ids:
+                                self._subscribed.discard(r.id)
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                self.store.wait_change(
+                    0.25 if rem is None else max(0.0, min(0.25, rem)))
+        return self._wait_split(refs, ready_ids, num_returns)
+
+    @staticmethod
+    def _wait_split(refs, ready_ids: set, num_returns: int):
         ready = [r for r in refs if r.id in ready_ids]
-        extra = ready[num_returns:]
         ready = ready[:num_returns]
-        not_ready = [r for r in refs if r.id not in {x.id for x in ready}]
-        # preserve original order among not_ready (extra ready refs stay there)
-        del extra
+        # preserve original order among not_ready (extra ready refs stay)
+        not_ready = [r for r in refs
+                     if r.id not in {x.id for x in ready}]
         return ready, not_ready
 
     def _ref_ready(self, ref: ObjectRef) -> bool:

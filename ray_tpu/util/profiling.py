@@ -50,15 +50,17 @@ def start_profile(tag: Optional[str] = None,
 
 
 def stop_profile() -> str:
-    """End the running trace; returns its directory."""
+    """End the running trace; returns its directory. On a stop_trace
+    failure the module guard stays set, keeping state in sync with
+    XLA's (still-open) session so the stop can be retried."""
     global _ACTIVE_DIR
     if _ACTIVE_DIR is None:
         raise RuntimeError("no profile running")
     import jax
 
+    jax.profiler.stop_trace()
     d = _ACTIVE_DIR
     _ACTIVE_DIR = None
-    jax.profiler.stop_trace()
     return d
 
 
@@ -109,8 +111,17 @@ def profile_actor(actor, seconds: float = 5.0,
     tag = tag or f"actor-{time.strftime('%H%M%S')}"
     client = global_worker.clients.get(tuple(addr))
     d = client.call("start_device_profile", tag, timeout=30.0)
-    time.sleep(seconds)
-    return client.call("stop_device_profile", timeout=60.0) or d
+    try:
+        time.sleep(seconds)
+        return client.call("stop_device_profile", timeout=60.0) or d
+    except BaseException:
+        # never leave the remote worker tracing forever (unbounded trace
+        # growth + every later profile rejected)
+        try:
+            client.notify("stop_device_profile")
+        except Exception:  # noqa: BLE001 — worker may be gone
+            pass
+        raise
 
 
 def list_profiles() -> list:

@@ -180,3 +180,31 @@ def test_result_larger_than_store_cap():
     finally:
         ray_tpu.shutdown()
         os.environ.pop("RAY_TPU_OBJECT_STORE_CAP", None)
+
+
+def test_nested_get_releases_lease_no_deadlock():
+    """A task blocked in get() must release its CPU lease so the task it
+    waits on can schedule (reference: raylet blocked-worker resource
+    release). With 1 CPU, parent-get()s-child deadlocks without it."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def leaf():
+            return 7
+
+        @ray_tpu.remote
+        def parent():
+            # hold the only CPU while waiting on the child
+            return ray_tpu.get(leaf.remote()) + 1
+
+        assert ray_tpu.get(parent.remote(), timeout=30.0) == 8
+
+        @ray_tpu.remote
+        def grandparent():
+            return ray_tpu.get(parent.remote()) + 1  # two levels deep
+
+        assert ray_tpu.get(grandparent.remote(), timeout=30.0) == 9
+    finally:
+        ray_tpu.shutdown()
