@@ -256,10 +256,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcor_ref,
         # skip mask compute.
         start_q = jnp.maximum(
             0, (ki * block_k - q_offset) // block_q)
+        # clamp below at 0: for tq < tk (decode-style) the numerator goes
+        # negative and python floor division would yield -1, starting the
+        # UNMASKED loop at a phantom qi=-1 block
         first_full_q = jnp.minimum(
             num_q_blocks,
-            (ki * block_k + block_k - 1 - q_offset + block_q - 1)
-            // block_q)
+            jnp.maximum(0, (ki * block_k + block_k - 1 - q_offset
+                            + block_q - 1) // block_q))
 
     def body(qi, carry, apply_mask):
         dk_acc, dv_acc = carry
@@ -305,8 +308,155 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcor_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcor_ref,
+                            dk_ref, dv_ref, dq_ref, *, sm_scale: float,
+                            causal: bool, block_q: int, block_k: int,
+                            q_len: int, q_offset: int):
+    """Single-pass backward: one grid cell = one kv block, computing its
+    dK/dV AND this block's dQ contributions. The two-pass backward
+    recomputes S twice (7 dots per q-kv pair); this computes it once
+    (5 dots) and halves the Q/dO HBM traffic. dq is a REVISITED output
+    ([q_len, D] f32, index ignoring ki): TPU pallas grids execute
+    sequentially, so cell (g, ki) accumulates onto what (g, ki-1)
+    wrote — the standard TPU revisiting-accumulator pattern.
+    Refs: k/v/dk/dv [block_k, D]; q/do [q_len, D]; dq [q_len, D] f32;
+    lse/dcor [q_len, LANES]."""
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    cd = k_ref.dtype
+    k_scaled = (k_ref[...].astype(jnp.float32)
+                * (sm_scale * _LOG2E)).astype(cd)
+    k_raw = k_ref[...]
+    v_blk = v_ref[...]
+    d = k_scaled.shape[-1]
+
+    num_q_blocks = pl.cdiv(q_len, block_q)
+    start_q = 0
+    first_full_q = 0
+    if causal:
+        start_q = jnp.maximum(0, (ki * block_k - q_offset) // block_q)
+        # clamp below at 0 — see _flash_bwd_dkv_kernel: negative numerator
+        # (tq < tk) must not start the unmasked loop at qi=-1
+        first_full_q = jnp.minimum(
+            num_q_blocks,
+            jnp.maximum(0, (ki * block_k + block_k - 1 - q_offset
+                            + block_q - 1) // block_q))
+
+    def body(qi, carry, apply_mask):
+        dk_acc, dv_acc = carry
+        sl = pl.ds(qi * block_q, block_q)
+        q_blk = q_ref[sl, :]
+        do_blk = do_ref[sl, :]
+        lse2 = lse_ref[sl, :1] * _LOG2E
+        dcor = dcor_ref[sl, :1]
+        st = jax.lax.dot_general(
+            k_scaled, q_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [block_k, block_q]
+        if apply_mask:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(q_pos >= k_pos, st, _NEG_INF)
+        pt = jnp.exp2(st - lse2.T)                # [block_k, block_q]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pt.astype(cd), do_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(
+            v_blk, do_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [block_k, block_q]
+        dst = (pt * (dpt - dcor.T)).astype(cd)    # dS^T
+        dk_acc = dk_acc + jax.lax.dot_general(
+            dst, q_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dQ[q_blk] += scale * dS K  (dst^T @ K via contracting dim 0)
+        dq_contrib = jax.lax.dot_general(
+            dst, k_raw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [block_q, D]
+        dq_ref[sl, :] = dq_ref[sl, :] + dq_contrib * sm_scale
+        return dk_acc, dv_acc
+
+    carry = jax.lax.fori_loop(
+        start_q, first_full_q, functools.partial(body, apply_mask=True),
+        (jnp.zeros((k_scaled.shape[0], d), jnp.float32),
+         jnp.zeros((k_scaled.shape[0], d), jnp.float32)))
+    dk, dv = jax.lax.fori_loop(
+        first_full_q, num_q_blocks,
+        functools.partial(body, apply_mask=False), carry)
+    dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _fused_bwd_enabled() -> bool:
+    """Opt-in until profiled on real chips (RAY_TPU_FLASH_FUSED_BWD=1);
+    interpret-mode tests pin its numerics against the two-pass path."""
+    return os.environ.get("RAY_TPU_FLASH_FUSED_BWD", "0") == "1"
+
+
+def _flash_bwd_fused_pallas(q, k, v, o, lse, do, causal: bool,
+                            sm_scale: float, block_q: int, block_k: int,
+                            interpret: bool):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    of = o.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    dof = do.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    dcor = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1,
+                keepdims=True),
+        (b * h, tq, _LANES))
+    kernel = functools.partial(
+        _flash_bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, q_len=tq, q_offset=tk - tq)
+    dkf, dvf, dqf = pl.pallas_call(
+        kernel,
+        grid=(b * h, pl.cdiv(tk, block_k)),
+        in_specs=[
+            pl.BlockSpec((None, tq, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, tq, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tq, _LANES), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((None, tq, _LANES), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda g, i: (g, i, 0)),
+            # dq: revisited across ki (index ignores i) — accumulator
+            pl.BlockSpec((None, tq, d), lambda g, i: (g, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=10 * b * h * tq * tk * d,
+            bytes_accessed=(qf.size + kf.size + vf.size + dof.size)
+            * qf.dtype.itemsize,
+            transcendentals=b * h * tq * tk),
+    )(qf, kf, vf, dof, lse, dcor)
+    dq = dqf.astype(q.dtype).reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    dk = dkf.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    dv = dvf.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
                       block_q: int, block_k: int, interpret: bool):
+    if _fused_bwd_enabled():
+        return _flash_bwd_fused_pallas(q, k, v, o, lse, do, causal,
+                                       sm_scale, block_q, block_k,
+                                       interpret)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)

@@ -251,3 +251,80 @@ def test_fused_ce_padded_rows_masked_when_block_divides_vocab():
     ref, _ = _ce_reference(x, w, t, vocab)
     np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_flash_fused_bwd_matches_two_pass(monkeypatch):
+    """The fused single-pass backward (dq revisiting-accumulator) must
+    match the two-pass backward and the XLA reference gradient."""
+    import numpy as np
+
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 256, 3, 64
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True,
+                               block_q=128, block_k=128
+                               ).astype(jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return mha_reference(q, k, v, causal=True
+                             ).astype(jnp.float32).sum()
+
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("RAY_TPU_FLASH_FUSED_BWD", "0")
+    g_two = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("RAY_TPU_FLASH_FUSED_BWD", "1")
+    g_fused = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, c, name in zip(g_fused, g_two, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"fused vs two-pass d{name}")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"fused vs reference d{name}")
+
+
+def test_flash_fused_bwd_uneven_and_noncausal(monkeypatch):
+    import numpy as np
+
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+
+    rng = np.random.default_rng(1)
+    B, H, D = 1, 2, 64
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    for fused in ("1", "0"):  # the tq<tk causal case was silently wrong
+        monkeypatch.setenv("RAY_TPU_FLASH_FUSED_BWD", fused)
+        _check_uneven_cases(rng, B, H, D)
+
+
+def _check_uneven_cases(rng, B, H, D):
+    import numpy as np
+
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+
+    for tq, tk, causal in ((128, 384, True), (256, 256, False)):
+        q = jnp.asarray(rng.standard_normal((B, tq, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, tk, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, tk, H, D)), jnp.float32)
+
+        def loss_flash(q, k, v, causal=causal):
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=128, block_k=128
+                                   ).astype(jnp.float32).sum()
+
+        def loss_ref(q, k, v, causal=causal):
+            return mha_reference(q, k, v, causal=causal
+                                 ).astype(jnp.float32).sum()
+
+        g_fused = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, c, name in zip(g_fused, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(c), rtol=2e-3, atol=2e-3,
+                err_msg=f"tq={tq} tk={tk} causal={causal} d{name}")
