@@ -12,9 +12,12 @@ from .gpt2 import (  # noqa: F401
     gpt2_loss,
     gpt2_partition_specs,
 )
+from .generate import generate, stream_generate  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig,
+    init_kv_cache,
     llama_forward,
+    llama_forward_cached,
     llama_init,
     llama_loss,
     llama_partition_specs,

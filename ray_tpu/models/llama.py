@@ -111,27 +111,76 @@ def _mm(x: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
 
 
+def _qkv(h: jax.Array, p: Params, c: LlamaConfig):
+    b, t, _ = h.shape
+    q = _mm(h, p["attn"]["wq"]).reshape(b, t, c.num_heads, c.head_dim)
+    k = _mm(h, p["attn"]["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    v = _mm(h, p["attn"]["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    return q, k, v
+
+
+def _repeat_kv(k: jax.Array, v: jax.Array, c: LlamaConfig):
+    if c.num_kv_heads != c.num_heads:  # GQA: broadcast kv to query heads
+        rep = c.num_heads // c.num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def _mlp_res(x: jax.Array, p: Params) -> jax.Array:
+    h = rms_norm(x, p["ffn_norm"]["scale"])
+    gate = jax.nn.silu(_mm(h, p["mlp"]["w_gate"]).astype(jnp.float32))
+    up = _mm(h, p["mlp"]["w_up"]).astype(jnp.float32)
+    return x + _mm((gate * up).astype(x.dtype), p["mlp"]["w_down"])
+
+
 def llama_block(x: jax.Array, p: Params, cos: jax.Array, sin: jax.Array,
                 config: LlamaConfig) -> jax.Array:
     c = config
     b, t, _ = x.shape
     h = rms_norm(x, p["attn_norm"]["scale"])
-    q = _mm(h, p["attn"]["wq"]).reshape(b, t, c.num_heads, c.head_dim)
-    k = _mm(h, p["attn"]["wk"]).reshape(b, t, c.num_kv_heads, c.head_dim)
-    v = _mm(h, p["attn"]["wv"]).reshape(b, t, c.num_kv_heads, c.head_dim)
+    q, k, v = _qkv(h, p, c)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if c.num_kv_heads != c.num_heads:  # GQA: broadcast kv to query heads
-        rep = c.num_heads // c.num_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = _repeat_kv(k, v, c)
     a = flash_attention(q, k, v, True).reshape(b, t, c.d_model)
     x = x + _mm(a, p["attn"]["wo"])
+    return _mlp_res(x, p)
 
-    h = rms_norm(x, p["ffn_norm"]["scale"])
-    gate = jax.nn.silu(_mm(h, p["mlp"]["w_gate"]).astype(jnp.float32))
-    up = _mm(h, p["mlp"]["w_up"]).astype(jnp.float32)
-    return x + _mm((gate * up).astype(x.dtype), p["mlp"]["w_down"])
+
+def llama_block_cached(x: jax.Array, p: Params, cos: jax.Array,
+                       sin: jax.Array, config: LlamaConfig,
+                       cache: Params, pos: jax.Array):
+    """KV-cache path (prefill AND decode — tokens land at position `pos`
+    and attend over everything written so far). Static shapes: the
+    cache is the full [B, S, n_kv, hd] window and masking does the
+    truncation, the standard fixed-shape TPU decode layout.
+    Returns (x, new_cache_for_this_block)."""
+    c = config
+    b, t, _ = x.shape
+    h = rms_norm(x, p["attn_norm"]["scale"])
+    q, k, v = _qkv(h, p, c)
+    positions = jnp.broadcast_to(pos + jnp.arange(t)[None, :], (b, t))
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+    kk, vv = _repeat_kv(ck, cv, c)
+    s = kk.shape[1]
+    # decode t is tiny (1 for autoregressive steps): plain masked
+    # attention over the cache window — flash brings nothing at t=1
+    scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (c.head_dim ** 0.5)
+    col = jnp.arange(s)[None, None, None, :]
+    visible = col <= positions[:, None, :, None]
+    scores = jnp.where(visible, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    a = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(b, t, c.d_model)
+    x = x + _mm(a, p["attn"]["wo"])
+    return _mlp_res(x, p), {"k": ck, "v": cv}
 
 
 def llama_forward(params: Params, tokens: jax.Array,
@@ -145,6 +194,37 @@ def llama_forward(params: Params, tokens: jax.Array,
     x = rms_norm(x, params["norm_f"]["scale"])
     return jnp.dot(x, params["lm_head"],
                    preferred_element_type=jnp.float32)
+
+
+def init_kv_cache(config: LlamaConfig, batch_size: int,
+                  max_len: int = 0, dtype: Any = None) -> list:
+    """Per-layer K/V buffers [B, S, n_kv_heads, head_dim]."""
+    c = config
+    s = max_len or c.max_seq_len
+    dt = dtype or c.dtype
+    return [{"k": jnp.zeros((batch_size, s, c.num_kv_heads, c.head_dim),
+                            dt),
+             "v": jnp.zeros((batch_size, s, c.num_kv_heads, c.head_dim),
+                            dt)}
+            for _ in range(c.num_layers)]
+
+
+def llama_forward_cached(params: Params, tokens: jax.Array,
+                         config: LlamaConfig, cache: list,
+                         pos: jax.Array):
+    """Append `tokens` [B, T] at position `pos` (scalar int32); returns
+    (logits [B, T, padded_vocab] fp32, new_cache). pos=0 with the whole
+    prompt is prefill; T=1 afterwards is autoregressive decode."""
+    c = config
+    cos, sin = rope_table(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_emb"][tokens]
+    new_cache = []
+    for p, blk_cache in zip(params["blocks"], cache):
+        x, nc = llama_block_cached(x, p, cos, sin, c, blk_cache, pos)
+        new_cache.append(nc)
+    x = rms_norm(x, params["norm_f"]["scale"])
+    return jnp.dot(x, params["lm_head"],
+                   preferred_element_type=jnp.float32), new_cache
 
 
 def llama_loss(params: Params, tokens: jax.Array, targets: jax.Array,
