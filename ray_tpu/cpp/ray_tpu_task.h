@@ -1,0 +1,44 @@
+/* ray_tpu C++ task ABI — native tasks on the ray_tpu transport.
+ *
+ * Reference analog: the C++ worker API (src/ray/core_worker C++ task
+ * surface). ray_tpu's wire protocol is python-pickled frames, so instead
+ * of reimplementing serialization in C++, native tasks speak a stable
+ * bytes-in/bytes-out C ABI and the (already running) worker process
+ * loads them via dlopen/ctypes: zero build-system coupling, any
+ * encoding the user likes (raw structs, msgpack, json, protobuf).
+ *
+ * Contract — export with C linkage:
+ *
+ *     extern "C" int64_t my_task(const uint8_t* in, size_t in_len,
+ *                                uint8_t** out, size_t* out_len);
+ *
+ *   - return 0 on success, nonzero on failure (surfaces as a
+ *     TaskError naming the code);
+ *   - on success, *out must point to a malloc()'d buffer of *out_len
+ *     bytes; the runtime frees it with free() after copying;
+ *   - the input buffer is owned by the runtime and valid only for the
+ *     duration of the call.
+ *
+ * Build:  g++ -O2 -shared -fPIC -o libmytasks.so mytasks.cc
+ * Run:    f = ray_tpu.util.cpp.cpp_function("./libmytasks.so", "my_task")
+ *         ray_tpu.get(f.remote(payload_bytes))
+ *
+ * RAY_TPU_TASK_RETURN copies a C++ container's bytes into a malloc'd
+ * output buffer — the one-liner for the common case.
+ */
+#ifndef RAY_TPU_TASK_H_
+#define RAY_TPU_TASK_H_
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#define RAY_TPU_TASK_RETURN(out, out_len, data, len)                   \
+  do {                                                                 \
+    *(out) = static_cast<uint8_t*>(std::malloc(len));                  \
+    if (*(out) == nullptr) return -12; /* ENOMEM */                    \
+    std::memcpy(*(out), (data), (len));                                \
+    *(out_len) = (len);                                                \
+  } while (0)
+
+#endif  // RAY_TPU_TASK_H_

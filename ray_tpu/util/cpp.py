@@ -1,0 +1,125 @@
+"""Native (C++) tasks on the ray_tpu transport.
+
+Reference analog: the C++ worker API (SURVEY §2.1 — the reference lets
+you write tasks/actors in C++ against its gRPC core). ray_tpu's rebuild
+keeps workers python-hosted (the control plane speaks pickled frames)
+and gives native code a stable bytes-in/bytes-out C ABI instead — see
+``ray_tpu/cpp/ray_tpu_task.h``. A task is any ``extern "C"`` symbol in
+a shared library; the executing worker dlopens the library once
+(cached per process) and calls it via ctypes, so the native code runs
+in the worker with no serialization reimplementation and no build-time
+coupling to the framework.
+
+    f = cpp_function("./libmytasks.so", "sum_doubles")
+    out: bytes = ray_tpu.get(f.remote(payload_bytes))
+
+``cpp_actor`` wraps a library as an actor class whose methods are the
+exported symbols — native state lives behind the ABI on the C++ side
+(opaque handle returned by an init symbol).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+__all__ = ["cpp_function", "cpp_actor", "header_path"]
+
+_LIBS: Dict[str, ctypes.CDLL] = {}
+
+
+def header_path() -> str:
+    """Path of ray_tpu_task.h for user build lines (-I$(dirname ...))."""
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "cpp", "ray_tpu_task.h")
+
+
+def _load(lib_path: str) -> ctypes.CDLL:
+    lib_path = os.path.abspath(lib_path)
+    lib = _LIBS.get(lib_path)
+    if lib is None:
+        lib = ctypes.CDLL(lib_path)
+        _LIBS[lib_path] = lib
+    return lib
+
+
+def _call_native(lib_path: str, symbol: str, payload: bytes) -> bytes:
+    """Executor-side: dlopen (cached) + call the bytes ABI."""
+    lib = _load(lib_path)
+    fn = getattr(lib, symbol)
+    fn.restype = ctypes.c_int64
+    fn.argtypes = [ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                   ctypes.POINTER(ctypes.c_size_t)]
+    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
+        if payload else (ctypes.c_uint8 * 1)()
+    out_ptr = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t(0)
+    rc = fn(buf, len(payload), ctypes.byref(out_ptr),
+            ctypes.byref(out_len))
+    if rc != 0:
+        raise RuntimeError(
+            f"native task {symbol} in {os.path.basename(lib_path)} "
+            f"failed with code {rc}")
+    try:
+        return ctypes.string_at(out_ptr, out_len.value) \
+            if out_ptr else b""
+    finally:
+        if out_ptr:
+            libc = ctypes.CDLL(None)
+            libc.free(out_ptr)
+
+
+def cpp_function(lib_path: str, symbol: str, **remote_options: Any):
+    """A remote function executing `symbol` from `lib_path` on a worker
+    (bytes in, bytes out). The library path must be reachable on worker
+    hosts — stage it via runtime_env working_dir for multi-host."""
+    lib_path = os.path.abspath(lib_path)
+
+    def task(payload: bytes = b"", *, _lib=lib_path, _sym=symbol) -> bytes:
+        from ray_tpu.util.cpp import _call_native
+
+        return _call_native(_lib, _sym, bytes(payload))
+
+    task.__name__ = f"cpp:{symbol}"
+    rf = ray_tpu.remote(task)
+    return rf.options(**remote_options) if remote_options else rf
+
+
+def cpp_actor(lib_path: str, symbols: list,
+              init_symbol: Optional[str] = None, **actor_options: Any):
+    """An actor class whose methods call exported symbols of `lib_path`
+    with the same bytes ABI, sharing the dlopened library (and any
+    native state behind it) across calls. `init_symbol`, when given, is
+    invoked once at construction with the init payload."""
+    lib_path = os.path.abspath(lib_path)
+    syms = list(symbols)
+
+    class _CppActor:
+        def __init__(self, init_payload: bytes = b""):
+            from ray_tpu.util.cpp import _call_native, _load
+
+            _load(lib_path)
+            if init_symbol:
+                _call_native(lib_path, init_symbol, bytes(init_payload))
+
+        def call(self, symbol: str, payload: bytes = b"") -> bytes:
+            from ray_tpu.util.cpp import _call_native
+
+            if symbol not in syms:
+                raise AttributeError(
+                    f"symbol {symbol!r} not exported by this cpp_actor "
+                    f"(declared: {syms})")
+            return _call_native(lib_path, symbol, bytes(payload))
+
+    for s in syms:
+        def _m(self, payload: bytes = b"", _s=s) -> bytes:
+            return self.call(_s, payload)
+
+        _m.__name__ = s
+        setattr(_CppActor, s, _m)
+    _CppActor.__name__ = f"CppActor_{os.path.basename(lib_path)}"
+    rc = ray_tpu.remote(_CppActor)
+    return rc.options(**actor_options) if actor_options else rc

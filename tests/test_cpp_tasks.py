@@ -1,0 +1,119 @@
+"""Native C++ tasks (reference: the C++ worker API, SURVEY §2.1):
+bytes-ABI symbols from a g++-built shared library execute as cluster
+tasks and actor methods."""
+from __future__ import annotations
+
+import os
+import struct
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.cpp import cpp_actor, cpp_function, header_path
+
+CC_SRC = r"""
+#include "ray_tpu_task.h"
+#include <string>
+#include <atomic>
+
+extern "C" int64_t sum_doubles(const uint8_t* in, size_t in_len,
+                               uint8_t** out, size_t* out_len) {
+  if (in_len % sizeof(double)) return 22;  // EINVAL
+  const double* xs = reinterpret_cast<const double*>(in);
+  double acc = 0.0;
+  for (size_t i = 0; i < in_len / sizeof(double); ++i) acc += xs[i];
+  RAY_TPU_TASK_RETURN(out, out_len, &acc, sizeof(acc));
+  return 0;
+}
+
+extern "C" int64_t shout(const uint8_t* in, size_t in_len,
+                         uint8_t** out, size_t* out_len) {
+  std::string s(reinterpret_cast<const char*>(in), in_len);
+  for (auto& c : s) c = toupper(c);
+  RAY_TPU_TASK_RETURN(out, out_len, s.data(), s.size());
+  return 0;
+}
+
+extern "C" int64_t always_fails(const uint8_t*, size_t,
+                                uint8_t**, size_t*) {
+  return 42;
+}
+
+static std::atomic<int64_t> counter{0};
+
+extern "C" int64_t reset_counter(const uint8_t* in, size_t in_len,
+                                 uint8_t** out, size_t* out_len) {
+  int64_t v = 0;
+  if (in_len == sizeof(int64_t)) memcpy(&v, in, sizeof(v));
+  counter.store(v);
+  RAY_TPU_TASK_RETURN(out, out_len, &v, sizeof(v));
+  return 0;
+}
+
+extern "C" int64_t bump(const uint8_t*, size_t,
+                        uint8_t** out, size_t* out_len) {
+  int64_t v = ++counter;
+  RAY_TPU_TASK_RETURN(out, out_len, &v, sizeof(v));
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def native_lib(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cpplib")
+    src = d / "tasks.cc"
+    src.write_text(CC_SRC)
+    lib = d / "libtasks.so"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC",
+         f"-I{os.path.dirname(header_path())}",
+         "-o", str(lib), str(src)],
+        check=True, capture_output=True)
+    return str(lib)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cpp_task_roundtrip(cluster, native_lib):
+    f = cpp_function(native_lib, "sum_doubles")
+    payload = struct.pack("<4d", 1.5, 2.5, 3.0, 3.0)
+    out = ray_tpu.get(f.remote(payload))
+    assert struct.unpack("<d", out)[0] == 10.0
+
+    shout = cpp_function(native_lib, "shout")
+    assert ray_tpu.get(shout.remote(b"tpu native")) == b"TPU NATIVE"
+
+
+def test_cpp_task_parallel_fanout(cluster, native_lib):
+    f = cpp_function(native_lib, "sum_doubles")
+    refs = [f.remote(struct.pack("<2d", float(i), 1.0)) for i in range(16)]
+    got = [struct.unpack("<d", b)[0] for b in ray_tpu.get(refs)]
+    assert got == [i + 1.0 for i in range(16)]
+
+
+def test_cpp_task_error_code_surfaces(cluster, native_lib):
+    f = cpp_function(native_lib, "always_fails")
+    with pytest.raises(Exception, match="code 42"):
+        ray_tpu.get(f.remote(b""))
+    g = cpp_function(native_lib, "sum_doubles")
+    with pytest.raises(Exception, match="code 22"):
+        ray_tpu.get(g.remote(b"odd"))
+
+
+def test_cpp_actor_native_state(cluster, native_lib):
+    A = cpp_actor(native_lib, ["bump", "reset_counter"],
+                  init_symbol="reset_counter")
+    a = A.remote(struct.pack("<q", 100))
+    vals = [struct.unpack("<q", ray_tpu.get(a.bump.remote()))[0]
+            for _ in range(3)]
+    assert vals == [101, 102, 103]
+    ray_tpu.get(a.reset_counter.remote(struct.pack("<q", 0)))
+    assert struct.unpack("<q", ray_tpu.get(a.bump.remote()))[0] == 1
