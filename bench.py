@@ -78,6 +78,39 @@ def _time_loop(step, state, batch, iters: int) -> tuple:
     return time.perf_counter() - t0, state, metrics
 
 
+def _probe_fused_flash_bwd() -> bool:
+    """Opt into the fused single-pass flash backward iff it compiles AND
+    matches the two-pass backward numerically on this chip — an
+    unvalidated kernel must degrade to the slower path, never crash or
+    corrupt the benchmark."""
+    import numpy as np
+
+    from ray_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 256, 2, 64)), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    try:
+        os.environ["RAY_TPU_FLASH_FUSED_BWD"] = "0"
+        ref = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+        ref = [np.asarray(g, np.float32) for g in ref]
+        os.environ["RAY_TPU_FLASH_FUSED_BWD"] = "1"
+        got = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+        got = [np.asarray(g, np.float32) for g in got]
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a, b, rtol=3e-2, atol=3e-2)
+        return True
+    except Exception as e:  # noqa: BLE001 — fall back to two-pass
+        os.environ["RAY_TPU_FLASH_FUSED_BWD"] = "0"
+        print(f"bench: fused flash bwd disabled ({type(e).__name__}: "
+              f"{str(e)[:200]})", file=sys.stderr)
+        return False
+
+
 def main() -> None:
     import optax
 
@@ -88,6 +121,9 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    fused_bwd = False
+    if on_tpu and os.environ.get("RAY_TPU_FLASH_FUSED_BWD") != "0":
+        fused_bwd = _probe_fused_flash_bwd()
     cfg = GPT2Config.small() if on_tpu else GPT2Config.tiny()
     seq = cfg.max_seq_len if on_tpu else 64
     per_chip_batch = int(os.environ.get(
@@ -154,6 +190,7 @@ def main() -> None:
         "seq_len": seq,
         "remat": remat,
         "n_chips": n_chips,
+        "fused_flash_bwd": fused_bwd,
     }))
 
 

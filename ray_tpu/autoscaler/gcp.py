@@ -10,7 +10,12 @@ provider never registers accounting entries — node identity flows
 VM -> NodeAgent -> conductor.
 
 The HTTP layer is injectable: unit tests run the full lifecycle against
-a canned transport, and zero-egress environments never dial out."""
+a canned transport, and zero-egress environments never dial out.
+
+STATUS: EXPERIMENTAL. The provider has only ever run against the canned
+transport — the wait_ready + startup-script flow has not created a real
+TPU VM from this environment (zero egress). Treat the REST payloads as
+reviewed-but-unproven until exercised against live GCP."""
 from __future__ import annotations
 
 import json

@@ -35,8 +35,8 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 # block shapes tuned on v5e; env overrides for bench sweeps
-DEFAULT_BLOCK_Q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "512"))
-DEFAULT_BLOCK_K = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K", "512"))
+DEFAULT_BLOCK_Q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", "1024"))
+DEFAULT_BLOCK_K = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K", "1024"))
 _LANES = 8  # LSE/D are broadcast over a small minor dim (sublane tile);
 #             keeping it at 8 rather than the 128-lane width cuts the HBM
 #             traffic of the side outputs 16x
