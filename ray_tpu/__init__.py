@@ -60,6 +60,15 @@ def init(address: Optional[str] = None, *,
             return {"address": _worker_mod.global_worker.conductor_address}
         raise RuntimeError("ray_tpu.init() already called; "
                            "use ignore_reinit_error=True to ignore")
+    if isinstance(address, str) and address.startswith("ray://"):
+        # Ray-Client mode (reference python/ray/util/client): one
+        # outbound connection to the head's ClientProxy, the whole
+        # public API routed through a server-side driver.
+        from .client import connect
+
+        _worker_mod.global_worker = connect(address[len("ray://"):])
+        return {"address": _worker_mod.global_worker.conductor_address,
+                "client": True}
     if address == "auto":
         # Reference semantics of ray.init("auto") / RAY_ADDRESS.
         address = os.environ.get("RAY_TPU_ADDRESS")

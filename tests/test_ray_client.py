@@ -1,0 +1,107 @@
+"""Ray-Client proxy (reference python/ray/util/client): a separate
+process connects with ray_tpu.init("ray://host:port") — one outbound
+connection, no inbound reachability — and drives tasks, actors, puts,
+waits and conductor queries through the server-side driver."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.client import ClientProxy
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import ray_tpu
+
+    info = ray_tpu.init(address="ray://" + sys.argv[1])
+    assert info.get("client") is True
+
+    # put / get
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+    # tasks, with a client ref as an arg
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, ray_tpu.put(10))
+    assert ray_tpu.get(r2) == 13
+
+    # wait
+    ready, not_ready = ray_tpu.wait([r1, r2], num_returns=2, timeout=10)
+    assert len(ready) == 2 and not not_ready
+
+    # errors propagate typed
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("client boom")
+
+    try:
+        ray_tpu.get(boom.remote())
+        raise SystemExit("expected TaskError")
+    except Exception as e:
+        assert "client boom" in str(e)
+
+    # actors
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+        def bump(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.bump.remote()) == 101
+    assert ray_tpu.get(c.bump.remote(by=5)) == 106
+
+    # conductor passthrough
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) > 0
+
+    ray_tpu.shutdown()
+    print("CLIENT_OK")
+""")
+
+
+@pytest.fixture
+def proxy_cluster():
+    ray_tpu.init(num_cpus=4)
+    proxy = ClientProxy(host="127.0.0.1", port=0)
+    yield proxy
+    proxy.stop()
+    ray_tpu.shutdown()
+
+
+def test_client_end_to_end(proxy_cluster):
+    host, port = proxy_cluster.address
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    r = subprocess.run(
+        [sys.executable, "-c", CLIENT_SCRIPT, f"{host}:{port}"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "CLIENT_OK" in r.stdout
+
+
+def test_session_pins_released_on_disconnect(proxy_cluster):
+    handler = proxy_cluster.handler
+    host, port = proxy_cluster.address
+    from ray_tpu.client import ClientWorker
+
+    cw = ClientWorker((host, port))
+    ref = cw.put(list(range(100)))
+    sid = cw.session_id
+    assert len(handler._sessions[sid].refs) == 1
+    assert cw.get(ref) == list(range(100))
+    cw.shutdown()
+    assert sid not in handler._sessions
