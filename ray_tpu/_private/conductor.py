@@ -474,9 +474,13 @@ class ConductorHandler:
 
     def lease_worker(self, resources: Dict[str, float],
                      placement_group_id: Optional[str] = None,
-                     timeout: Optional[float] = None) -> Tuple[str, Tuple[str, int]]:
+                     timeout: Optional[float] = None,
+                     strategy: str = "DEFAULT"
+                     ) -> Tuple[str, Tuple[str, int]]:
         """Grant an idle worker (spawning if below capacity), holding
-        `resources` against the node until return_worker."""
+        `resources` against the node until return_worker. strategy
+        DEFAULT packs (head-first); SPREAD prefers the emptiest node
+        (reference composite_scheduling_policy.h policies)."""
         deadline = time.monotonic() + (timeout if timeout is not None
                                        else _worker_start_timeout())
         resources = dict(resources or {})
@@ -490,7 +494,7 @@ class ConductorHandler:
             self._waiting_leases += 1
             self._pending_demand.append(demand_token)
             try:
-                return self._lease_locked(resources, deadline)
+                return self._lease_locked(resources, deadline, strategy)
             finally:
                 self._waiting_leases -= 1
                 self._pending_demand.remove(demand_token)
@@ -511,7 +515,8 @@ class ConductorHandler:
         return self._nodes.get(w.lease_node_id or w.node_id) \
             or self._nodes.get(w.node_id)
 
-    def _lease_locked(self, resources, deadline):
+    def _lease_locked(self, resources, deadline,
+                      strategy: str = "DEFAULT"):
             while True:
                 if self._stopped:
                     raise RuntimeError("conductor stopped")
@@ -521,6 +526,17 @@ class ConductorHandler:
                 head = self._nodes[self._head_node_id]
                 nodes = [head] + [n for nid, n in self._nodes.items()
                                   if nid != self._head_node_id and n.alive]
+                if strategy == "SPREAD":
+                    # emptiest node first (reference SPREAD policy,
+                    # scheduling/policy/spread_scheduling_policy.cc) —
+                    # the DEFAULT order above is pack/head-first
+                    def busy(n: NodeRecord) -> int:
+                        return sum(1 for w in self._workers.values()
+                                   if w.state in ("BUSY", "ACTOR")
+                                   and (w.lease_node_id or w.node_id)
+                                   == n.node_id)
+
+                    nodes.sort(key=busy)
                 acquired = None
                 for node in nodes:
                     if self._acquire_resources(node, resources):

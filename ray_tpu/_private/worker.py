@@ -97,6 +97,9 @@ class TaskSpec:
     owner: Optional[Tuple[str, int]] = None
     placement_group_id: Optional[str] = None
     runtime_env: Optional[Dict[str, Any]] = None  # prepared (URIs staged)
+    # DEFAULT = pack (head-first); SPREAD = emptiest node first
+    # (reference scheduling_strategy on @ray.remote)
+    scheduling_strategy: str = "DEFAULT"
     # W3C traceparent captured on the SUBMITTING thread (spans are
     # thread-local; the submit-pool thread that serializes the wire has no
     # active span) — reference tracing_helper.py propagation-in-TaskSpec
@@ -572,7 +575,8 @@ class Worker:
                     resources: Optional[Dict[str, float]] = None,
                     max_retries: int = DEFAULT_MAX_RETRIES,
                     placement_group_id: Optional[str] = None,
-                    runtime_env: Optional[Dict[str, Any]] = None):
+                    runtime_env: Optional[Dict[str, Any]] = None,
+                    scheduling_strategy: str = "DEFAULT"):
         if runtime_env:
             from . import runtime_env as renv
 
@@ -589,6 +593,7 @@ class Worker:
             owner=self.address,
             placement_group_id=placement_group_id,
             runtime_env=runtime_env,
+            scheduling_strategy=scheduling_strategy,
             traceparent=_current_traceparent())
         refs = [ObjectRef(oid, locator=None, owner=self.address)
                 for oid in return_ids]
@@ -655,7 +660,7 @@ class Worker:
             self._wait_dep_ready(dep)
         worker_id, address = self.conductor.call(
             "lease_worker", spec.resources, spec.placement_group_id,
-            timeout=None)
+            None, spec.scheduling_strategy, timeout=None)
         if self._is_cancelled(spec.return_ids):  # cancelled during lease
             try:
                 self.conductor.notify("return_worker", worker_id)

@@ -46,7 +46,8 @@ class RemoteFunction:
             resources=resources,
             max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
             placement_group_id=pg_id,
-            runtime_env=o.get("runtime_env"))
+            runtime_env=o.get("runtime_env"),
+            scheduling_strategy=o.get("scheduling_strategy", "DEFAULT"))
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node — reference python/ray/dag/function_node.py

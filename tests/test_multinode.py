@@ -163,3 +163,32 @@ def test_two_process_jax_distributed(small_head):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert "MULTIHOST_OK 3.0" in out, f"rank {rank} output:\n{out}"
+
+
+def test_spread_scheduling_strategy(small_head):
+    """scheduling_strategy='SPREAD' prefers the emptiest node (reference
+    spread_scheduling_policy.cc); DEFAULT packs head-first."""
+    import time as _time
+
+    agent = NodeAgent(_head_address(), {"CPU": 4.0}).start()
+    try:
+        @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+        def where():
+            _time.sleep(0.8)  # keep leases overlapping
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        _time.sleep(1.0)  # let the agent register
+        nodes = set(ray_tpu.get([where.remote() for _ in range(4)],
+                                timeout=60.0))
+        assert len(nodes) == 2, f"SPREAD used only {nodes}"
+
+        @ray_tpu.remote(num_cpus=1)
+        def where_default():
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        # sequential DEFAULT tasks pack onto the head
+        head_nodes = {ray_tpu.get(where_default.remote(), timeout=60.0)
+                      for _ in range(3)}
+        assert agent.node_id not in head_nodes
+    finally:
+        agent.stop()
