@@ -39,6 +39,7 @@ from .multi_agent import (  # noqa: F401
     make_multi_agent_env,
     register_multi_agent_env,
 )
+from .offline import BC, BCConfig, OfflineData, record_batches  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 
 __all__ = [
@@ -49,4 +50,5 @@ __all__ = [
     "GymnasiumVectorEnv", "register_env", "make_env",
     "MultiAgentVectorEnv", "MultiAgentCartPole", "MultiAgentEnvRunner",
     "MultiAgentPPO", "make_multi_agent_env", "register_multi_agent_env",
+    "BC", "BCConfig", "OfflineData", "record_batches",
 ]

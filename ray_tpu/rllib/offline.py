@@ -1,0 +1,206 @@
+"""Offline RL: episode recording, offline datasets, behavior cloning.
+
+Reference: rllib/offline/ (output writers recording EnvRunner samples,
+JsonReader/OfflineData feeding algorithms, BC/MARWIL as the entry
+algorithms). The rebuild keeps the same pipeline shape on numpy shards:
+``record_batches`` writes EnvRunner fragments as .npz files,
+``OfflineData`` loads/iterates them as minibatches, and ``BC`` trains a
+policy by supervised action log-likelihood in one jitted update —
+evaluable against a live env through the standard Algorithm surface.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from . import core
+from .algorithm import Algorithm, AlgorithmConfig
+
+_KEYS = ("obs", "actions", "logp", "rewards", "dones")
+
+
+def record_batches(env: Any, num_fragments: int, out_dir: str, *,
+                   params: Any = None, num_envs: int = 8,
+                   rollout_fragment_length: int = 64, seed: int = 0,
+                   env_config: Optional[Dict] = None) -> List[str]:
+    """Roll out `num_fragments` EnvRunner fragments (with `params`'
+    policy, or a freshly initialized one ≈ random) and write each as an
+    .npz shard (reference offline output writer). Returns the paths."""
+    import jax
+
+    from .env_runner import EnvRunner
+
+    runner = EnvRunner(env, num_envs=num_envs,
+                       rollout_fragment_length=rollout_fragment_length,
+                       seed=seed, env_config=env_config)
+    if params is None:
+        act_out = runner.env.act_dim if runner.continuous \
+            else runner.env.num_actions
+        params = core.policy_init(jax.random.PRNGKey(seed),
+                                  runner.env.observation_dim, act_out,
+                                  continuous=runner.continuous)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for i in range(num_fragments):
+        b = runner.sample(params)
+        path = os.path.join(out_dir, f"fragment_{i:05d}.npz")
+        with open(path, "wb") as f:
+            np.savez(f, **{k: np.asarray(b[k]) for k in _KEYS})
+        paths.append(path)
+    return paths
+
+
+class OfflineData:
+    """Flat transition view over recorded shards, iterated as shuffled
+    minibatches (reference OfflineData / JsonReader)."""
+
+    def __init__(self, paths: Any, seed: int = 0):
+        if isinstance(paths, str):
+            paths = sorted(glob.glob(os.path.join(paths, "*.npz"))) \
+                if os.path.isdir(paths) else [paths]
+        if not paths:
+            raise ValueError("no offline shards found")
+        obs, acts = [], []
+        for p in paths:
+            with np.load(p) as z:
+                o, a = z["obs"], z["actions"]
+            t1 = o.shape[0] - 1
+            obs.append(o[:-1].reshape(t1 * o.shape[1], -1))
+            acts.append(a.reshape(t1 * a.shape[1], *a.shape[3:])
+                        if a.ndim > 2 else a.reshape(-1))
+        self.obs = np.concatenate(obs, axis=0).astype(np.float32)
+        self.actions = np.concatenate(acts, axis=0)
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self.obs)
+
+    @property
+    def obs_dim(self) -> int:
+        return self.obs.shape[-1]
+
+    @property
+    def continuous(self) -> bool:
+        return self.actions.dtype.kind == "f"
+
+    @property
+    def num_actions(self) -> int:
+        return -1 if self.continuous else int(self.actions.max()) + 1
+
+    def minibatches(self, batch_size: int,
+                    num_batches: int) -> Iterator[Dict[str, np.ndarray]]:
+        for _ in range(num_batches):
+            idx = self._rng.integers(0, len(self.obs), batch_size)
+            yield {"obs": self.obs[idx], "actions": self.actions[idx]}
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.train_extra.update({
+            "input_path": None, "train_batch_size": 256,
+            "updates_per_step": 64, "grad_clip": 10.0,
+        })
+
+    def offline_data(self, input_path: str) -> "BCConfig":
+        self.train_extra["input_path"] = input_path
+        return self
+
+
+class BC(Algorithm):
+    """Behavior cloning: maximize log pi(a|s) over the recorded data
+    (reference rllib/algorithms/bc/). `env` is used for evaluation only
+    — spaces come from the data itself."""
+
+    _default_config = {
+        "input_path": None, "train_batch_size": 256,
+        "updates_per_step": 64, "grad_clip": 10.0, "lr": 1e-3,
+        "num_envs_per_env_runner": 8, "rollout_fragment_length": 128,
+    }
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        # data first: BC's spaces come from the shards, the env is only
+        # an evaluation harness — reuse the base setup for the runner
+        cfg = dict(self._default_config)
+        cfg.update(config)
+        if not cfg.get("input_path"):
+            raise ValueError("BC needs config['input_path'] (offline "
+                             "shards dir or file)")
+        self.data = OfflineData(cfg["input_path"],
+                                seed=cfg.get("seed", 0))
+        super().setup(config)
+        if self.obs_dim != self.data.obs_dim:
+            raise ValueError(
+                f"offline data obs_dim {self.data.obs_dim} != eval env "
+                f"obs_dim {self.obs_dim}")
+
+    def _build_learner(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.cfg
+        act_out = self.act_dim if self.continuous else self.num_actions
+        self.params = core.policy_init(
+            jax.random.PRNGKey(cfg.get("seed", 0)), self.obs_dim, act_out,
+            tuple(cfg.get("hidden", (64, 64))),
+            continuous=self.continuous)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.get("grad_clip", 10.0)),
+            optax.adam(cfg.get("lr", 1e-3)))
+        self.opt_state = self.optimizer.init(self.params)
+        continuous = self.continuous
+
+        def loss_fn(params, batch):
+            if continuous:
+                mean = core.policy_logits(params, batch["obs"])
+                logp = core.gaussian_logp(mean, params["log_std"],
+                                          batch["actions"])
+            else:
+                logits = core.policy_logits(params, batch["obs"])
+                logp = core.categorical_logp(logits, batch["actions"])
+            return -logp.mean()
+
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        def update(params, opt_state, batch):
+            loss, grads = grad_fn(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+        self._jnp = jnp
+
+    def training_step(self) -> Dict[str, Any]:
+        jnp = self._jnp
+        cfg = self.cfg
+        losses = []
+        for mb in self.data.minibatches(cfg.get("train_batch_size", 256),
+                                        cfg.get("updates_per_step", 64)):
+            act_dtype = jnp.float32 if self.continuous else jnp.int32
+            batch = {"obs": jnp.asarray(mb["obs"]),
+                     "actions": jnp.asarray(mb["actions"], act_dtype)}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch)
+            losses.append(float(loss))
+        result = {"bc_loss": float(np.mean(losses))}
+        result.update(self.evaluate())
+        return result
+
+    def evaluate(self, num_fragments: int = 1) -> Dict[str, Any]:
+        """Greedy rollouts on the eval env (reference evaluation
+        workers, condensed)."""
+        for _ in range(num_fragments):
+            b = self.local_runner.sample(self.params)
+            self._episode_returns.extend(b["episode_returns"])
+            self._episode_lens.extend(b["episode_lens"])
+            self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+        return {}
+
+
+__all__ = ["BC", "BCConfig", "OfflineData", "record_batches"]
