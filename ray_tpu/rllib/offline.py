@@ -69,7 +69,8 @@ class OfflineData:
                 o, a = z["obs"], z["actions"]
             t1 = o.shape[0] - 1
             obs.append(o[:-1].reshape(t1 * o.shape[1], -1))
-            acts.append(a.reshape(t1 * a.shape[1], *a.shape[3:])
+            # actions are [T, N] discrete or [T, N, act_dim] continuous
+            acts.append(a.reshape(t1 * a.shape[1], *a.shape[2:])
                         if a.ndim > 2 else a.reshape(-1))
         self.obs = np.concatenate(obs, axis=0).astype(np.float32)
         self.actions = np.concatenate(acts, axis=0)
@@ -136,6 +137,16 @@ class BC(Algorithm):
             raise ValueError(
                 f"offline data obs_dim {self.data.obs_dim} != eval env "
                 f"obs_dim {self.obs_dim}")
+        if self.data.continuous != self.continuous:
+            raise ValueError(
+                "offline data action kind "
+                f"({'continuous' if self.data.continuous else 'discrete'})"
+                " does not match the eval env")
+        if not self.continuous and self.data.num_actions > self.num_actions:
+            raise ValueError(
+                f"offline data contains actions up to "
+                f"{self.data.num_actions - 1} but the eval env has only "
+                f"{self.num_actions} actions")
 
     def _build_learner(self) -> None:
         import jax
@@ -192,14 +203,11 @@ class BC(Algorithm):
         result.update(self.evaluate())
         return result
 
-    def evaluate(self, num_fragments: int = 1) -> Dict[str, Any]:
-        """Greedy rollouts on the eval env (reference evaluation
-        workers, condensed)."""
-        for _ in range(num_fragments):
-            b = self.local_runner.sample(self.params)
-            self._episode_returns.extend(b["episode_returns"])
-            self._episode_lens.extend(b["episode_lens"])
-            self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+    def evaluate(self) -> Dict[str, Any]:
+        """Rollouts on the eval env — the base class's fan-out handles
+        both the local runner and a remote runner fleet, with the
+        episode-stats bookkeeping."""
+        self._collect_batches()
         return {}
 
 

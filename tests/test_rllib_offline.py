@@ -61,3 +61,28 @@ def test_bc_clones_expert(tmp_path):
 def test_bc_requires_input(tmp_path):
     with pytest.raises(ValueError, match="input_path"):
         (BCConfig().environment("CartPole-v1").build())
+
+
+def test_continuous_actions_roundtrip(tmp_path):
+    """Pendulum shards keep their act_dim through OfflineData and a BC
+    update runs on them (the continuous head)."""
+    from ray_tpu.rllib import BC
+
+    record_batches("Pendulum-v1", 2, str(tmp_path / "pend"),
+                   num_envs=4, rollout_fragment_length=16)
+    data = OfflineData(str(tmp_path / "pend"))
+    assert data.continuous
+    assert data.actions.shape == (2 * 16 * 4, 1)
+    algo = (BCConfig().environment("Pendulum-v1")
+            .offline_data(str(tmp_path / "pend"))
+            .training(updates_per_step=4).build())
+    r = algo.step()
+    assert np.isfinite(r["bc_loss"])
+
+
+def test_space_mismatch_rejected(tmp_path):
+    record_batches("Pendulum-v1", 1, str(tmp_path / "pend"),
+                   num_envs=2, rollout_fragment_length=8)
+    with pytest.raises(ValueError, match="obs_dim|action kind"):
+        (BCConfig().environment("CartPole-v1")
+         .offline_data(str(tmp_path / "pend")).build())
