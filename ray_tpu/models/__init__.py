@@ -12,6 +12,7 @@ from .gpt2 import (  # noqa: F401
     gpt2_loss,
     gpt2_partition_specs,
 )
+from .engine import ContinuousBatchingEngine  # noqa: F401
 from .generate import generate, stream_generate  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig,

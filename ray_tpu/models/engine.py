@@ -1,0 +1,194 @@
+"""Continuous-batching generation engine — the LLM serving throughput
+story (BASELINE "Llama JAX replica, batched inference"; the reference
+serves torch models and leaves batching to the replica, Serve's @batch
+being request-level — this is TOKEN-level continuous batching in the
+vLLM sense, rebuilt TPU-first).
+
+Design: one fixed-shape decode loop over `max_batch` slots. Every tick
+runs ONE jitted ragged-batch step (`llama_decode` — per-slot positions,
+per-slot masking, static shapes throughout, so XLA compiles exactly one
+program no matter how requests interleave). New requests prefill into a
+free slot (one jitted prefill per distinct prompt length — exact
+lengths, so cache rows beyond a slot's own depth are never attended)
+and JOIN the running batch between ticks; finished sequences (EOS or
+their token budget) free their slot between ticks. Slots the engine
+isn't using decode garbage that nothing reads — the cost of static
+shapes, paid once, instead of a recompile per batch composition.
+
+Per-request token queues make it the natural producer for Serve's
+streaming path; `ContinuousBatchingEngine` is thread-safe for
+concurrent submit/iterate from replica request threads.
+"""
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import (LlamaConfig, init_kv_cache, llama_decode,
+                    llama_forward_cached)
+
+_DONE = object()
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _prefill_one(params, prompt, config, cache1):
+    """Prefill a single sequence into its own B=1 cache; returns the
+    last-position logits and the filled cache. One compile per distinct
+    prompt length (exact lengths: a padded prefill would leave pad
+    entries inside the attended window)."""
+    logits, cache1 = llama_forward_cached(params, prompt, config,
+                                          cache1, 0)
+    return logits[:, -1], cache1
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def _adopt_slot(cache, cache1, slot, config):
+    """Copy a prefilled single-sequence cache into batch slot `slot`."""
+    del config
+    out = []
+    for blk, one in zip(cache, cache1):
+        out.append({
+            "k": blk["k"].at[slot].set(one["k"][0]),
+            "v": blk["v"].at[slot].set(one["v"][0]),
+        })
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(2,))
+def _tick(params, config, cache, tokens, pos_vec):
+    logits, cache = llama_decode(params, tokens, config, cache, pos_vec)
+    nxt = jnp.argmax(logits[:, :config.vocab_size], axis=-1).astype(
+        jnp.int32)
+    return cache, nxt
+
+
+class _Request:
+    def __init__(self, rid: int, prompt: np.ndarray, max_new: int,
+                 eos_token: Optional[int]):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_token = eos_token
+        self.out: "queue.Queue" = queue.Queue()
+        self.produced = 0
+        self.slot: Optional[int] = None
+
+
+class ContinuousBatchingEngine:
+    """Greedy continuous-batching decode over `max_batch` slots."""
+
+    def __init__(self, params: Any, config: LlamaConfig, *,
+                 max_batch: int = 8, idle_sleep_s: float = 0.002):
+        self.params = params
+        self.config = config
+        self.max_batch = max_batch
+        self.idle_sleep_s = idle_sleep_s
+        self._cache = init_kv_cache(config, max_batch)
+        self._tokens = np.zeros(max_batch, np.int32)
+        self._pos = np.zeros(max_batch, np.int32)
+        self._slot_req: List[Optional[_Request]] = [None] * max_batch
+        self._free = list(range(max_batch))
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cb-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------- API
+    def submit(self, prompt_tokens, max_new_tokens: int,
+               eos_token: Optional[int] = None) -> "_Request":
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        if prompt.shape[1] + max_new_tokens > self.config.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = _Request(rid, prompt, max_new_tokens, eos_token)
+        self._pending.put(req)
+        return req
+
+    def stream(self, prompt_tokens, max_new_tokens: int,
+               eos_token: Optional[int] = None,
+               timeout_s: float = 120.0) -> Iterator[int]:
+        """Submit and yield tokens as the shared loop produces them."""
+        req = self.submit(prompt_tokens, max_new_tokens, eos_token)
+        while True:
+            tok = req.out.get(timeout=timeout_s)
+            if tok is _DONE:
+                return
+            yield int(tok)
+
+    def generate(self, prompt_tokens, max_new_tokens: int,
+                 eos_token: Optional[int] = None,
+                 timeout_s: float = 120.0) -> List[int]:
+        return list(self.stream(prompt_tokens, max_new_tokens, eos_token,
+                                timeout_s))
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread.join(timeout=10.0)
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return self.max_batch - len(self._free)
+
+    # ------------------------------------------------------------ loop
+    def _admit(self) -> None:
+        while self._free:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            with self._lock:
+                slot = self._free.pop()
+            cache1 = init_kv_cache(self.config, 1)
+            last_logits, cache1 = _prefill_one(self.params, req.prompt,
+                                               self.config, cache1)
+            self._cache = _adopt_slot(self._cache, cache1, slot,
+                                      self.config)
+            first = int(np.argmax(
+                np.asarray(last_logits[0, :self.config.vocab_size])))
+            req.slot = slot
+            self._slot_req[slot] = req
+            self._tokens[slot] = first
+            self._pos[slot] = req.prompt.shape[1]
+            self._emit(req, first)
+
+    def _emit(self, req: _Request, tok: int) -> None:
+        req.out.put(tok)
+        req.produced += 1
+        if (req.eos_token is not None and tok == req.eos_token) \
+                or req.produced >= req.max_new:
+            req.out.put(_DONE)
+            slot = req.slot
+            self._slot_req[slot] = None
+            with self._lock:
+                self._free.append(slot)
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            self._admit()
+            if all(r is None for r in self._slot_req):
+                self._stopped.wait(self.idle_sleep_s)
+                continue
+            cache, nxt = _tick(self.params, self.config, self._cache,
+                               jnp.asarray(self._tokens),
+                               jnp.asarray(self._pos))
+            self._cache = cache
+            nxt_np = np.asarray(nxt)
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                self._pos[slot] += 1
+                tok = int(nxt_np[slot])
+                self._tokens[slot] = tok
+                self._emit(req, tok)

@@ -196,6 +196,54 @@ def llama_forward(params: Params, tokens: jax.Array,
                    preferred_element_type=jnp.float32)
 
 
+def llama_block_decode(x: jax.Array, p: Params, cos: jax.Array,
+                       sin: jax.Array, config: LlamaConfig,
+                       cache: Params, pos_vec: jax.Array):
+    """Single-token decode with PER-SLOT positions (continuous batching:
+    every batch slot is a different sequence at its own depth).
+    x [B, 1, D]; pos_vec [B] int32. Writes each slot's new K/V at its
+    own position (scatter) and masks attention per slot."""
+    c = config
+    b = x.shape[0]
+    h = rms_norm(x, p["attn_norm"]["scale"])
+    q, k, v = _qkv(h, p, c)
+    positions = pos_vec[:, None]                       # [B, 1]
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    rows = jnp.arange(b)
+    ck = cache["k"].at[rows, pos_vec].set(k[:, 0].astype(cache["k"].dtype))
+    cv = cache["v"].at[rows, pos_vec].set(v[:, 0].astype(cache["v"].dtype))
+    kk, vv = _repeat_kv(ck, cv, c)
+    s = kk.shape[1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (c.head_dim ** 0.5)
+    col = jnp.arange(s)[None, None, None, :]
+    visible = col <= pos_vec[:, None, None, None]
+    scores = jnp.where(visible, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    a = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(b, 1, c.d_model)
+    x = x + _mm(a, p["attn"]["wo"])
+    return _mlp_res(x, p), {"k": ck, "v": cv}
+
+
+def llama_decode(params: Params, tokens: jax.Array, config: LlamaConfig,
+                 cache: list, pos_vec: jax.Array):
+    """One decode step for a ragged batch: tokens [B] at per-slot
+    positions pos_vec [B]. Returns (logits [B, padded_vocab] fp32,
+    new_cache)."""
+    c = config
+    cos, sin = rope_table(c.head_dim, c.max_seq_len, c.rope_theta)
+    x = params["tok_emb"][tokens[:, None]]
+    new_cache = []
+    for p, blk_cache in zip(params["blocks"], cache):
+        x, nc = llama_block_decode(x, p, cos, sin, c, blk_cache, pos_vec)
+        new_cache.append(nc)
+    x = rms_norm(x, params["norm_f"]["scale"])
+    return jnp.dot(x[:, 0], params["lm_head"],
+                   preferred_element_type=jnp.float32), new_cache
+
+
 def init_kv_cache(config: LlamaConfig, batch_size: int,
                   max_len: int = 0, dtype: Any = None) -> list:
     """Per-layer K/V buffers [B, S, n_kv_heads, head_dim]."""
