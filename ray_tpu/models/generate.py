@@ -18,7 +18,19 @@ from typing import Any, Callable, Iterator, Optional
 import jax
 import jax.numpy as jnp
 
-from .llama import (LlamaConfig, init_kv_cache, llama_forward_cached)
+from .llama import LlamaConfig, init_kv_cache, llama_forward_cached
+
+
+def _model_fns(config):
+    """(forward_cached, init_cache) for the config's model family —
+    generation is model-agnostic over the cache protocol."""
+    if isinstance(config, LlamaConfig):
+        return llama_forward_cached, init_kv_cache
+    from .gpt2 import GPT2Config, gpt2_forward_cached, gpt2_init_kv_cache
+
+    if isinstance(config, GPT2Config):
+        return gpt2_forward_cached, gpt2_init_kv_cache
+    raise TypeError(f"no generation support for {type(config).__name__}")
 
 
 def _sample_fn(vocab_size: int, temperature: float, top_k: int):
@@ -39,7 +51,8 @@ def _sample_fn(vocab_size: int, temperature: float, top_k: int):
 
 @functools.partial(jax.jit, static_argnums=(2,))
 def _prefill(params, prompt, config, cache):
-    logits, cache = llama_forward_cached(params, prompt, config, cache, 0)
+    fwd, _ = _model_fns(config)
+    logits, cache = fwd(params, prompt, config, cache, 0)
     return logits[:, -1], cache
 
 
@@ -47,10 +60,11 @@ def _decode_many(params, config, cache, first_token, start_pos, steps,
                  key, temperature, top_k):
     sample = _sample_fn(config.vocab_size, temperature, top_k)
 
+    fwd, _ = _model_fns(config)
+
     def step(carry, _):
         cache, tok, pos, key = carry
-        logits, cache = llama_forward_cached(
-            params, tok[:, None], config, cache, pos)
+        logits, cache = fwd(params, tok[:, None], config, cache, pos)
         key, sub = jax.random.split(key)
         nxt = sample(sub, logits[:, -1])
         return (cache, nxt, pos + 1, key), nxt
@@ -78,7 +92,7 @@ def generate(params: Any, config: LlamaConfig, prompt: jax.Array, *,
             f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_seq_len ({config.max_seq_len})")
     key = key if key is not None else jax.random.PRNGKey(0)
-    cache = init_kv_cache(config, b)
+    cache = _model_fns(config)[1](config, b)
     last_logits, cache = _prefill(params, prompt, config, cache)
     key, k0 = jax.random.split(key)
     first = _sample_fn(config.vocab_size, temperature, top_k)(
@@ -113,7 +127,7 @@ def stream_generate(params: Any, config: LlamaConfig, prompt: jax.Array,
         raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
     key = key if key is not None else jax.random.PRNGKey(0)
     sample = _sample_fn(config.vocab_size, temperature, top_k)
-    cache = init_kv_cache(config, b)
+    cache = _model_fns(config)[1](config, b)
     last_logits, cache = _prefill(params, prompt, config, cache)
     key, sub = jax.random.split(key)
     tok = sample(sub, last_logits)
@@ -140,8 +154,8 @@ def _stream_step(params, cache, config, tok, pos, temperature, top_k,
     # module-level so the compiled step is shared across every
     # stream_generate call with the same (config, sampling) — a serving
     # replica must not recompile per request
-    logits, cache = llama_forward_cached(
-        params, tok[:, None], config, cache, pos)
+    fwd, _ = _model_fns(config)
+    logits, cache = fwd(params, tok[:, None], config, cache, pos)
     key, sub = jax.random.split(key)
     nxt = _sample_fn(config.vocab_size, temperature, top_k)(
         sub, logits[:, -1])

@@ -169,6 +169,81 @@ def gpt2_forward(params: Params, tokens: jax.Array,
     return jnp.dot(x, params["wte"].T, preferred_element_type=jnp.float32)
 
 
+# ------------------------------------------------------- KV-cache decode
+
+
+def gpt2_init_kv_cache(config: GPT2Config, batch_size: int,
+                       max_len: int = 0, dtype: Any = None) -> list:
+    """Per-layer K/V buffers [B, S, heads, head_dim] (same layout as
+    models/llama.py init_kv_cache — learned positions instead of rope)."""
+    c = config
+    s = max_len or c.max_seq_len
+    dt = dtype or c.dtype
+    return [{"k": jnp.zeros((batch_size, s, c.num_heads, c.head_dim), dt),
+             "v": jnp.zeros((batch_size, s, c.num_heads, c.head_dim), dt)}
+            for _ in range(c.num_layers)]
+
+
+def _block_cached(x: jax.Array, p: Params, config: GPT2Config,
+                  cache: Params, pos: jax.Array):
+    """Cache-path block: tokens at [pos, pos+t) attend the full written
+    prefix — the GPT-2 analog of llama_block_cached."""
+    c = config
+    b, t, _ = x.shape
+    h = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    qkv = jnp.dot(h, p["attn"]["qkv"],
+                  preferred_element_type=jnp.float32).astype(c.dtype)
+    qkv = qkv + p["attn"]["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, c.num_heads, c.head_dim)
+    k = k.reshape(b, t, c.num_heads, c.head_dim)
+    v = v.reshape(b, t, c.num_heads, c.head_dim)
+    ck = jax.lax.dynamic_update_slice(
+        cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+    s = ck.shape[1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, ck,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (c.head_dim ** 0.5)
+    positions = pos + jnp.arange(t)[None, :]
+    col = jnp.arange(s)[None, None, None, :]
+    visible = col <= positions[:, None, :, None]
+    scores = jnp.where(visible, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    a = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(b, t, c.d_model)
+    a = jnp.dot(a, p["attn"]["proj"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    x = x + a + p["attn"]["proj_b"]
+
+    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    h = jnp.dot(h, p["mlp"]["fc"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
+    h = jnp.dot(h, p["mlp"]["proj"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    return x + h + p["mlp"]["proj_b"], {"k": ck, "v": cv}
+
+
+def gpt2_forward_cached(params: Params, tokens: jax.Array,
+                        config: GPT2Config, cache: list, pos: jax.Array):
+    """Append tokens [B, T] at scalar position `pos`; returns (logits
+    [B, T, padded_vocab] fp32, new_cache). pos=0 + whole prompt =
+    prefill; T=1 afterwards = decode."""
+    c = config
+    t = tokens.shape[1]
+    wpe = jax.lax.dynamic_slice(params["wpe"], (pos, 0),
+                                (t, c.d_model))
+    x = params["wte"][tokens] + wpe
+    new_cache = []
+    for p, blk in zip(params["blocks"], cache):
+        x, nc = _block_cached(x, p, c, blk, pos)
+        new_cache.append(nc)
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return jnp.dot(x, params["wte"].T,
+                   preferred_element_type=jnp.float32), new_cache
+
+
 def _ce_sum(x: jax.Array, targets: jax.Array, wte: jax.Array,
             vocab_size: int) -> jax.Array:
     """Sum of next-token cross-entropy. x [..., d], targets [...]."""

@@ -98,3 +98,36 @@ def test_prompt_overflow_rejected(model):
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(model, CFG, jnp.zeros((1, 120), jnp.int32),
                  max_new_tokens=20)
+
+
+def test_gpt2_generation_matches_full_forward():
+    """GPT-2 rides the same generation loop (learned positions instead
+    of rope): cached logits match the full forward and greedy decode
+    matches a no-cache rollout."""
+    from ray_tpu.models.gpt2 import (GPT2Config, gpt2_forward,
+                                     gpt2_forward_cached,
+                                     gpt2_init_kv_cache, gpt2_init)
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+    params = gpt2_init(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(5)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)
+    full = gpt2_forward(params, toks, cfg)
+    cache = gpt2_init_kv_cache(cfg, 2)
+    cached, cache = gpt2_forward_cached(params, toks[:, :8], cfg, cache, 0)
+    np.testing.assert_allclose(np.asarray(cached),
+                               np.asarray(full[:, :8]),
+                               rtol=3e-4, atol=3e-4)
+    step, cache = gpt2_forward_cached(params, toks[:, 8:9], cfg, cache, 8)
+    np.testing.assert_allclose(np.asarray(step[:, 0]),
+                               np.asarray(full[:, 8]),
+                               rtol=3e-4, atol=3e-4)
+
+    prompt = toks[:, :6]
+    out = np.asarray(generate(params, cfg, prompt, max_new_tokens=5))
+    seq = prompt
+    for i in range(5):
+        logits = gpt2_forward(params, seq, cfg)[:, -1, :cfg.vocab_size]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        np.testing.assert_array_equal(out[:, i], np.asarray(nxt))
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
