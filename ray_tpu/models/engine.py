@@ -30,10 +30,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .llama import (LlamaConfig, init_kv_cache, llama_decode,
-                    llama_forward_cached)
+from .generate import _model_fns
+from .llama import LlamaConfig, llama_decode
 
 _DONE = object()
+
+
+def _decode_fn(config):
+    """Ragged per-slot decode for the config's model family."""
+    if isinstance(config, LlamaConfig):
+        return llama_decode
+    from .gpt2 import gpt2_decode
+
+    return gpt2_decode
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -42,8 +51,8 @@ def _prefill_one(params, prompt, config, cache1):
     last-position logits and the filled cache. One compile per distinct
     prompt length (exact lengths: a padded prefill would leave pad
     entries inside the attended window)."""
-    logits, cache1 = llama_forward_cached(params, prompt, config,
-                                          cache1, 0)
+    fwd, _ = _model_fns(config)
+    logits, cache1 = fwd(params, prompt, config, cache1, 0)
     return logits[:, -1], cache1
 
 
@@ -62,7 +71,8 @@ def _adopt_slot(cache, cache1, slot, config):
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(2,))
 def _tick(params, config, cache, tokens, pos_vec):
-    logits, cache = llama_decode(params, tokens, config, cache, pos_vec)
+    logits, cache = _decode_fn(config)(params, tokens, config, cache,
+                                       pos_vec)
     nxt = jnp.argmax(logits[:, :config.vocab_size], axis=-1).astype(
         jnp.int32)
     return cache, nxt
@@ -89,7 +99,7 @@ class ContinuousBatchingEngine:
         self.config = config
         self.max_batch = max_batch
         self.idle_sleep_s = idle_sleep_s
-        self._cache = init_kv_cache(config, max_batch)
+        self._cache = _model_fns(config)[1](config, max_batch)
         self._tokens = np.zeros(max_batch, np.int32)
         self._pos = np.zeros(max_batch, np.int32)
         self._slot_req: List[Optional[_Request]] = [None] * max_batch
@@ -150,7 +160,7 @@ class ContinuousBatchingEngine:
                 return
             with self._lock:
                 slot = self._free.pop()
-            cache1 = init_kv_cache(self.config, 1)
+            cache1 = _model_fns(self.config)[1](self.config, 1)
             last_logits, cache1 = _prefill_one(self.params, req.prompt,
                                                self.config, cache1)
             self._cache = _adopt_slot(self._cache, cache1, slot,

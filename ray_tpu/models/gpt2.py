@@ -225,6 +225,59 @@ def _block_cached(x: jax.Array, p: Params, config: GPT2Config,
     return x + h + p["mlp"]["proj_b"], {"k": ck, "v": cv}
 
 
+def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
+                  cache: Params, pos_vec: jax.Array):
+    """Single-token decode with PER-SLOT positions (continuous
+    batching) — the GPT-2 analog of llama_block_decode."""
+    c = config
+    b = x.shape[0]
+    h = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
+    qkv = jnp.dot(h, p["attn"]["qkv"],
+                  preferred_element_type=jnp.float32).astype(c.dtype)
+    qkv = qkv + p["attn"]["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, 1, c.num_heads, c.head_dim)
+    k = k.reshape(b, 1, c.num_heads, c.head_dim)
+    v = v.reshape(b, 1, c.num_heads, c.head_dim)
+    rows = jnp.arange(b)
+    ck = cache["k"].at[rows, pos_vec].set(k[:, 0].astype(cache["k"].dtype))
+    cv = cache["v"].at[rows, pos_vec].set(v[:, 0].astype(cache["v"].dtype))
+    s = ck.shape[1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, ck,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (c.head_dim ** 0.5)
+    col = jnp.arange(s)[None, None, None, :]
+    visible = col <= pos_vec[:, None, None, None]
+    scores = jnp.where(visible, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    a = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(b, 1, c.d_model)
+    a = jnp.dot(a, p["attn"]["proj"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    x = x + a + p["attn"]["proj_b"]
+    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    h = jnp.dot(h, p["mlp"]["fc"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
+    h = jnp.dot(h, p["mlp"]["proj"],
+                preferred_element_type=jnp.float32).astype(c.dtype)
+    return x + h + p["mlp"]["proj_b"], {"k": ck, "v": cv}
+
+
+def gpt2_decode(params: Params, tokens: jax.Array, config: GPT2Config,
+                cache: list, pos_vec: jax.Array):
+    """One decode step for a ragged batch: tokens [B] at per-slot
+    positions pos_vec [B]."""
+    c = config
+    x = params["wte"][tokens[:, None]] + params["wpe"][pos_vec][:, None]
+    new_cache = []
+    for p, blk in zip(params["blocks"], cache):
+        x, nc = _block_decode(x, p, c, blk, pos_vec)
+        new_cache.append(nc)
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return jnp.dot(x[:, 0], params["wte"].T,
+                   preferred_element_type=jnp.float32), new_cache
+
+
 def gpt2_forward_cached(params: Params, tokens: jax.Array,
                         config: GPT2Config, cache: list, pos: jax.Array):
     """Append tokens [B, T] at scalar position `pos`; returns (logits

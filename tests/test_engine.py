@@ -101,3 +101,25 @@ def test_slot_reuse_is_clean(model, engine):
     b = engine.generate([2], 6)                        # short successor
     assert a == _reference(model, [9] * 8, 6)
     assert b == _reference(model, [2], 6)
+
+
+def test_gpt2_engine_matches_generate():
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+    params = gpt2_init(cfg, jax.random.PRNGKey(3))
+    eng = ContinuousBatchingEngine(params, cfg, max_batch=2)
+    try:
+        import concurrent.futures as cf
+
+        prompts = [[1, 2, 3], [4, 5]]
+        with cf.ThreadPoolExecutor(2) as pool:
+            got = [f.result(timeout=120) for f in
+                   [pool.submit(eng.generate, p, 5) for p in prompts]]
+        for p, g in zip(prompts, got):
+            want = np.asarray(generate(params, cfg,
+                                       jnp.asarray([p], jnp.int32),
+                                       max_new_tokens=5))[0].tolist()
+            assert g == want, p
+    finally:
+        eng.stop()
