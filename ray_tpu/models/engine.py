@@ -5,9 +5,10 @@ being request-level — this is TOKEN-level continuous batching in the
 vLLM sense, rebuilt TPU-first).
 
 Design: one fixed-shape decode loop over `max_batch` slots. Every tick
-runs ONE jitted ragged-batch step (`llama_decode` — per-slot positions,
-per-slot masking, static shapes throughout, so XLA compiles exactly one
-program no matter how requests interleave). New requests prefill into a
+runs ONE jitted ragged-batch step (the model family's per-slot decode —
+llama_decode / gpt2_decode — with per-slot positions and masking,
+static shapes throughout, so XLA compiles exactly one program no
+matter how requests interleave). New requests prefill into a
 free slot (one jitted prefill per distinct prompt length — exact
 lengths, so cache rows beyond a slot's own depth are never attended)
 and JOIN the running batch between ticks; finished sequences (EOS or
@@ -31,18 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generate import _model_fns
-from .llama import LlamaConfig, llama_decode
-
 _DONE = object()
-
-
-def _decode_fn(config):
-    """Ragged per-slot decode for the config's model family."""
-    if isinstance(config, LlamaConfig):
-        return llama_decode
-    from .gpt2 import gpt2_decode
-
-    return gpt2_decode
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -51,7 +41,7 @@ def _prefill_one(params, prompt, config, cache1):
     last-position logits and the filled cache. One compile per distinct
     prompt length (exact lengths: a padded prefill would leave pad
     entries inside the attended window)."""
-    fwd, _ = _model_fns(config)
+    fwd = _model_fns(config)[0]
     logits, cache1 = fwd(params, prompt, config, cache1, 0)
     return logits[:, -1], cache1
 
@@ -71,8 +61,8 @@ def _adopt_slot(cache, cache1, slot, config):
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(2,))
 def _tick(params, config, cache, tokens, pos_vec):
-    logits, cache = _decode_fn(config)(params, tokens, config, cache,
-                                       pos_vec)
+    logits, cache = _model_fns(config)[2](params, tokens, config, cache,
+                                          pos_vec)
     nxt = jnp.argmax(logits[:, :config.vocab_size], axis=-1).astype(
         jnp.int32)
     return cache, nxt
@@ -93,8 +83,9 @@ class _Request:
 class ContinuousBatchingEngine:
     """Greedy continuous-batching decode over `max_batch` slots."""
 
-    def __init__(self, params: Any, config: LlamaConfig, *,
+    def __init__(self, params: Any, config: Any, *,
                  max_batch: int = 8, idle_sleep_s: float = 0.002):
+        # config: any family _model_fns knows (LlamaConfig, GPT2Config)
         self.params = params
         self.config = config
         self.max_batch = max_batch

@@ -22,14 +22,18 @@ from .llama import LlamaConfig, init_kv_cache, llama_forward_cached
 
 
 def _model_fns(config):
-    """(forward_cached, init_cache) for the config's model family —
-    generation is model-agnostic over the cache protocol."""
+    """(forward_cached, init_cache, ragged_decode) for the config's
+    model family — generation and the continuous-batching engine are
+    model-agnostic over this cache protocol."""
     if isinstance(config, LlamaConfig):
-        return llama_forward_cached, init_kv_cache
-    from .gpt2 import GPT2Config, gpt2_forward_cached, gpt2_init_kv_cache
+        from .llama import llama_decode
+
+        return llama_forward_cached, init_kv_cache, llama_decode
+    from .gpt2 import (GPT2Config, gpt2_decode, gpt2_forward_cached,
+                       gpt2_init_kv_cache)
 
     if isinstance(config, GPT2Config):
-        return gpt2_forward_cached, gpt2_init_kv_cache
+        return gpt2_forward_cached, gpt2_init_kv_cache, gpt2_decode
     raise TypeError(f"no generation support for {type(config).__name__}")
 
 
@@ -51,7 +55,7 @@ def _sample_fn(vocab_size: int, temperature: float, top_k: int):
 
 @functools.partial(jax.jit, static_argnums=(2,))
 def _prefill(params, prompt, config, cache):
-    fwd, _ = _model_fns(config)
+    fwd = _model_fns(config)[0]
     logits, cache = fwd(params, prompt, config, cache, 0)
     return logits[:, -1], cache
 
@@ -60,7 +64,7 @@ def _decode_many(params, config, cache, first_token, start_pos, steps,
                  key, temperature, top_k):
     sample = _sample_fn(config.vocab_size, temperature, top_k)
 
-    fwd, _ = _model_fns(config)
+    fwd = _model_fns(config)[0]
 
     def step(carry, _):
         cache, tok, pos, key = carry
@@ -154,7 +158,7 @@ def _stream_step(params, cache, config, tok, pos, temperature, top_k,
     # module-level so the compiled step is shared across every
     # stream_generate call with the same (config, sampling) — a serving
     # replica must not recompile per request
-    fwd, _ = _model_fns(config)
+    fwd = _model_fns(config)[0]
     logits, cache = fwd(params, tok[:, None], config, cache, pos)
     key, sub = jax.random.split(key)
     nxt = _sample_fn(config.vocab_size, temperature, top_k)(

@@ -105,6 +105,27 @@ def gpt2_init(config: GPT2Config, key: jax.Array) -> Params:
     return params
 
 
+def _attn_proj_res(x: jax.Array, a: jax.Array, p: Params,
+                   config: GPT2Config) -> jax.Array:
+    """Attention output projection + residual (shared by the training,
+    prefix-cache, and per-slot decode blocks)."""
+    a = jnp.dot(a, p["attn"]["proj"],
+                preferred_element_type=jnp.float32).astype(config.dtype)
+    return x + a + p["attn"]["proj_b"]
+
+
+def _mlp_res(x: jax.Array, p: Params, config: GPT2Config) -> jax.Array:
+    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
+    h = jnp.dot(h, p["mlp"]["fc"],
+                preferred_element_type=jnp.float32).astype(config.dtype)
+    # tanh-approximate gelu: GPT-2's historical activation, and cheaper
+    # on the VPU than the erf form
+    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
+    h = jnp.dot(h, p["mlp"]["proj"],
+                preferred_element_type=jnp.float32).astype(config.dtype)
+    return x + h + p["mlp"]["proj_b"]
+
+
 def _block(x: jax.Array, p: Params, config: GPT2Config) -> jax.Array:
     c = config
     b, t, _ = x.shape
@@ -117,19 +138,7 @@ def _block(x: jax.Array, p: Params, config: GPT2Config) -> jax.Array:
     k = k.reshape(b, t, c.num_heads, c.head_dim)
     v = v.reshape(b, t, c.num_heads, c.head_dim)
     a = flash_attention(q, k, v, True).reshape(b, t, c.d_model)
-    a = jnp.dot(a, p["attn"]["proj"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    x = x + a + p["attn"]["proj_b"]
-
-    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
-    h = jnp.dot(h, p["mlp"]["fc"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    # tanh-approximate gelu: GPT-2's historical activation, and cheaper
-    # on the VPU than the erf form
-    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
-    h = jnp.dot(h, p["mlp"]["proj"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    return x + h + p["mlp"]["proj_b"]
+    return _mlp_res(_attn_proj_res(x, a, p, c), p, c)
 
 
 def _constrain(x: jax.Array, spec: Optional[P]) -> jax.Array:
@@ -212,17 +221,7 @@ def _block_cached(x: jax.Array, p: Params, config: GPT2Config,
     scores = jnp.where(visible, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     a = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(b, t, c.d_model)
-    a = jnp.dot(a, p["attn"]["proj"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    x = x + a + p["attn"]["proj_b"]
-
-    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
-    h = jnp.dot(h, p["mlp"]["fc"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
-    h = jnp.dot(h, p["mlp"]["proj"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    return x + h + p["mlp"]["proj_b"], {"k": ck, "v": cv}
+    return _mlp_res(_attn_proj_res(x, a, p, c), p, c), {"k": ck, "v": cv}
 
 
 def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
@@ -251,16 +250,7 @@ def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
     scores = jnp.where(visible, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     a = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(b, 1, c.d_model)
-    a = jnp.dot(a, p["attn"]["proj"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    x = x + a + p["attn"]["proj_b"]
-    h = layer_norm(x, p["ln_2"]["scale"], p["ln_2"]["bias"])
-    h = jnp.dot(h, p["mlp"]["fc"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    h = jax.nn.gelu(h + p["mlp"]["fc_b"], approximate=True)
-    h = jnp.dot(h, p["mlp"]["proj"],
-                preferred_element_type=jnp.float32).astype(c.dtype)
-    return x + h + p["mlp"]["proj_b"], {"k": ck, "v": cv}
+    return _mlp_res(_attn_proj_res(x, a, p, c), p, c), {"k": ck, "v": cv}
 
 
 def gpt2_decode(params: Params, tokens: jax.Array, config: GPT2Config,
