@@ -11,8 +11,11 @@ SPMD over a dp mesh axis on TPU.
 Algorithms: PPO (sync on-policy, ppo.py), IMPALA (async off-policy with
 V-trace, impala.py), APPO (IMPALA's async loop + clipped surrogate +
 target network, appo.py — the reference's v4-32 north-star variant), and
-DQN (replay buffer + double-Q + target sync, dqn.py) — covering the
-reference's sync/async/off-policy execution plans. Multi-agent:
+DQN (replay buffer + double-Q + target sync, dqn.py), and SAC (twin
+soft-Q + squashed gaussian + auto-alpha for continuous control, sac.py)
+— covering the reference's sync/async/off-policy execution plans.
+Offline RL: shard recording, OfflineData, behavior cloning
+(offline.py). Multi-agent:
 MultiAgentEnvRunner collects per-policy batches via policy_mapping_fn
 (multi_agent.py). Native vectorized CartPole/Pendulum remove the
 gymnasium dependency from tests; any gymnasium env id works via the
@@ -41,6 +44,7 @@ from .multi_agent import (  # noqa: F401
 )
 from .offline import BC, BCConfig, OfflineData, record_batches  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
+from .sac import SAC, SACConfig  # noqa: F401
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
@@ -50,5 +54,5 @@ __all__ = [
     "GymnasiumVectorEnv", "register_env", "make_env",
     "MultiAgentVectorEnv", "MultiAgentCartPole", "MultiAgentEnvRunner",
     "MultiAgentPPO", "make_multi_agent_env", "register_multi_agent_env",
-    "BC", "BCConfig", "OfflineData", "record_batches",
+    "BC", "BCConfig", "OfflineData", "record_batches", "SAC", "SACConfig",
 ]

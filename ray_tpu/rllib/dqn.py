@@ -38,13 +38,17 @@ class DQNConfig(AlgorithmConfig):
 class ReplayBuffer:
     """Flat uniform ring buffer (reference utils/replay_buffers/
     replay_buffer.py) — numpy host-side; minibatches become device
-    arrays only at update time."""
+    arrays only at update time. act_dim=0 stores discrete int actions
+    (DQN); act_dim>0 stores continuous [.., act_dim] floats (SAC)."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int = 0):
         self.capacity = capacity
+        self.act_dim = act_dim
         self._obs = np.empty((capacity, obs_dim), np.float32)
         self._next_obs = np.empty((capacity, obs_dim), np.float32)
-        self._actions = np.empty(capacity, np.int32)
+        self._actions = np.empty(
+            (capacity, act_dim) if act_dim else capacity,
+            np.float32 if act_dim else np.int32)
         self._rewards = np.empty(capacity, np.float32)
         self._dones = np.empty(capacity, np.float32)
         self._size = 0
@@ -62,7 +66,8 @@ class ReplayBuffer:
         T = t1 - 1
         obs = batch["obs"][:-1].reshape(T * n, d)
         next_obs = batch["obs"][1:].reshape(T * n, d)
-        actions = batch["actions"].reshape(T * n)
+        actions = batch["actions"].reshape(
+            (T * n, self.act_dim) if self.act_dim else T * n)
         rewards = batch["rewards"].reshape(T * n)
         dones = batch["dones"].reshape(T * n).astype(np.float32)
         m = T * n

@@ -1,0 +1,260 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference: rllib/algorithms/sac/ (twin soft Q networks with polyak
+target averaging, tanh-squashed gaussian policy with state-dependent
+std, automatic entropy-coefficient tuning against a target entropy of
+-act_dim; losses in sac_torch_learner.py). Kept in DQN's replay-train
+shape — the host-side ring buffer feeds one jitted update covering
+both critics, the actor, and the alpha dual variable.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import core
+from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import ReplayBuffer
+from .env_runner import EnvRunner
+
+_LOG_STD_MIN, _LOG_STD_MAX = -10.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.train_extra.update({
+            "buffer_capacity": 100_000, "train_batch_size": 256,
+            "updates_per_step": 32, "learning_starts": 1_500,
+            "tau": 0.005, "initial_alpha": 0.2, "grad_clip": 10.0,
+        })
+
+
+def sac_init(key: jax.Array, obs_dim: int, act_dim: int,
+             hidden=(64, 64)) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        # policy head emits [mean, log_std] per action dim
+        "pi": core.mlp_init(k1, [obs_dim, *hidden, 2 * act_dim]),
+        "q1": core.mlp_init(k2, [obs_dim + act_dim, *hidden, 1]),
+        "q2": core.mlp_init(k3, [obs_dim + act_dim, *hidden, 1]),
+        "log_alpha": jnp.zeros(()),
+    }
+
+
+def _pi_dist(params, obs):
+    out = core.mlp_apply(params["pi"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+    return mean, log_std
+
+
+def _sample_squashed(key, mean, log_std):
+    """tanh-squashed gaussian sample + its log-prob (with the tanh
+    jacobian correction, reference squashed_gaussian distribution)."""
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + std * eps
+    a = jnp.tanh(pre)
+    logp = jnp.sum(
+        -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - jnp.log(1.0 - a ** 2 + 1e-6), axis=-1)
+    return a, logp
+
+
+def _q(params_q, obs, act):
+    return core.mlp_apply(params_q, jnp.concatenate([obs, act],
+                                                    axis=-1))[..., 0]
+
+
+class SACEnvRunner(EnvRunner):
+    """Collects with the squashed-gaussian policy scaled to the action
+    bound; `params` = {"pi": mlp, "scale": float}."""
+
+    def _build_act(self):
+        @jax.jit
+        def act(params, obs, key):
+            mean, log_std = _pi_dist(params, obs)
+            a, logp = _sample_squashed(key, mean, log_std)
+            return a * params["scale"], logp
+
+        return act
+
+
+def make_sac_update(cfg: Dict[str, Any], act_scale: float, act_dim: int,
+                    pi_opt, q_opt, a_opt):
+    gamma, tau = cfg["gamma"], cfg["tau"]
+    target_entropy = -float(act_dim)
+
+    def update(params, target_q, opt_state, key, batch):
+        obs, act = batch["obs"], batch["actions"] / act_scale
+        next_obs = batch["next_obs"]
+        k1, k2 = jax.random.split(key)
+        alpha = jnp.exp(params["log_alpha"])
+
+        # -- critic targets (no grad) ---------------------------------
+        mean_n, log_std_n = _pi_dist(params, next_obs)
+        a_n, logp_n = _sample_squashed(k1, mean_n, log_std_n)
+        tq = jnp.minimum(_q(target_q["q1"], next_obs, a_n),
+                         _q(target_q["q2"], next_obs, a_n))
+        y = batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+            tq - alpha * logp_n)
+        y = jax.lax.stop_gradient(y)
+
+        def critic_loss(p):
+            l1 = ((_q(p["q1"], obs, act) - y) ** 2).mean()
+            l2 = ((_q(p["q2"], obs, act) - y) ** 2).mean()
+            return l1 + l2
+
+        def actor_loss(p):
+            mean, log_std = _pi_dist(p, obs)
+            a, logp = _sample_squashed(k2, mean, log_std)
+            q = jnp.minimum(
+                _q(jax.lax.stop_gradient(p["q1"]), obs, a),
+                _q(jax.lax.stop_gradient(p["q2"]), obs, a))
+            return (jnp.exp(jax.lax.stop_gradient(p["log_alpha"]))
+                    * logp - q).mean(), logp
+
+        def alpha_loss(p, logp):
+            return -(p["log_alpha"] * jax.lax.stop_gradient(
+                logp + target_entropy)).mean()
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss)(params)
+        (a_loss, logp), a_grads = jax.value_and_grad(
+            actor_loss, has_aux=True)(params)
+        al_loss, al_grads = jax.value_and_grad(
+            lambda p: alpha_loss(p, logp))(params)
+
+        updates = {}
+        new_opt = {}
+        for name, grads, opt in (("q", c_grads, q_opt),
+                                 ("pi", a_grads, pi_opt),
+                                 ("alpha", al_grads, a_opt)):
+            u, new_opt[name] = opt.update(grads, opt_state[name], params)
+            updates[name] = u
+        params = optax.apply_updates(params, updates["q"])
+        params = optax.apply_updates(params, updates["pi"])
+        params = optax.apply_updates(params, updates["alpha"])
+        target_q = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                target_q,
+                                {"q1": params["q1"], "q2": params["q2"]})
+        aux = {"critic_loss": c_loss, "actor_loss": a_loss,
+               "alpha": jnp.exp(params["log_alpha"]),
+               "entropy": -logp.mean()}
+        return params, target_q, new_opt, aux
+
+    return jax.jit(update, donate_argnums=(0, 1, 2))
+
+
+class SAC(Algorithm):
+    _default_config = {
+        "buffer_capacity": 100_000, "train_batch_size": 256,
+        "updates_per_step": 32, "learning_starts": 1_500,
+        "tau": 0.005, "grad_clip": 10.0, "lr": 3e-4,
+        "rollout_fragment_length": 32, "num_envs_per_env_runner": 8,
+    }
+    _runner_cls = SACEnvRunner
+
+    def _build_learner(self) -> None:
+        cfg = self.cfg
+        if not self.continuous:
+            raise ValueError("SAC requires a continuous action space")
+        # the native Pendulum env bounds torque at ±2; a generic bound
+        # API would come from the env — use 2.0 unless configured
+        self.act_scale = float(cfg.get("action_scale", 2.0))
+        key = jax.random.PRNGKey(cfg.get("seed", 0))
+        hidden = tuple(cfg.get("hidden", (64, 64)))
+        self.params = sac_init(key, self.obs_dim, self.act_dim, hidden)
+        self.target_q = jax.tree.map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        lr = cfg.get("lr", 3e-4)
+        clip = cfg.get("grad_clip", 10.0)
+
+        # Per-component optimizers over ONE params pytree: leaves outside
+        # a component get set_to_zero (NOT optax.masked, whose unmasked
+        # updates pass through as raw gradients and would corrupt the
+        # other components on apply_updates).
+        def component_opt(keys):
+            labels = {k: jax.tree.map(
+                lambda _: "on" if k in keys else "off", v)
+                for k, v in self.params.items()}
+            return optax.multi_transform(
+                {"on": optax.chain(optax.clip_by_global_norm(clip),
+                                   optax.adam(lr)),
+                 "off": optax.set_to_zero()},
+                labels)
+
+        self._q_opt = component_opt({"q1", "q2"})
+        self._pi_opt = component_opt({"pi"})
+        self._a_opt = component_opt({"log_alpha"})
+        self.opt_state = {
+            "q": self._q_opt.init(self.params),
+            "pi": self._pi_opt.init(self.params),
+            "alpha": self._a_opt.init(self.params),
+        }
+        self._update = make_sac_update(cfg, self.act_scale, self.act_dim,
+                                       self._pi_opt, self._q_opt,
+                                       self._a_opt)
+        self.buffer = ReplayBuffer(cfg.get("buffer_capacity", 100_000),
+                                   self.obs_dim, act_dim=self.act_dim)
+        self._np_rng = np.random.default_rng(cfg.get("seed", 0))
+        self._key = jax.random.PRNGKey(cfg.get("seed", 0) + 1)
+
+    def _sample_params(self):
+        return {"pi": self.params["pi"],
+                "scale": jnp.asarray(self.act_scale, jnp.float32)}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        if self.local_runner is not None:
+            batches = [self.local_runner.sample(self._sample_params())]
+        else:
+            import ray_tpu
+
+            p = jax.device_get(self._sample_params())
+            batches = ray_tpu.get(
+                [r.sample.remote(p) for r in self.runners])
+        for b in batches:
+            self._episode_returns.extend(b["episode_returns"])
+            self._episode_lens.extend(b["episode_lens"])
+            self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+            self.buffer.add_fragment(b)
+        metrics: Dict[str, Any] = {"buffer_size": float(len(self.buffer))}
+        if len(self.buffer) < cfg.get("learning_starts", 1_500):
+            return metrics
+        accum = []
+        for _ in range(cfg.get("updates_per_step", 32)):
+            mb = self.buffer.sample(self._np_rng,
+                                    cfg.get("train_batch_size", 256))
+            mb = {k: jnp.asarray(v) for k, v in mb.items()}
+            self._key, sub = jax.random.split(self._key)
+            self.params, self.target_q, self.opt_state, aux = \
+                self._update(self.params, self.target_q, self.opt_state,
+                             sub, mb)
+            accum.append(aux)
+        metrics.update({k: float(np.mean([float(a[k]) for a in accum]))
+                        for k in accum[0]})
+        return metrics
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
+        data = super().save_checkpoint(checkpoint_dir)
+        data["target_q"] = jax.device_get(self.target_q)
+        return data
+
+    def load_checkpoint(self, data: Any) -> None:
+        super().load_checkpoint(data)
+        self.target_q = data.get(
+            "target_q", {"q1": self.params["q1"],
+                         "q2": self.params["q2"]})
+
+    def compute_single_action(self, obs: np.ndarray) -> Any:
+        mean, _ = _pi_dist(self.params,
+                           jnp.asarray(obs[None], jnp.float32))
+        return np.asarray(jnp.tanh(mean[0]) * self.act_scale)
+
+
+__all__ = ["SAC", "SACConfig", "sac_init", "make_sac_update"]
