@@ -14,6 +14,7 @@ state is snapshotted through the same checkpoint layer as ray_tpu.train.
 from ..train.session import get_checkpoint, get_context, report  # noqa: F401
 from .search import (  # noqa: F401
     BasicVariantGenerator,
+    HaltonSearchGenerator,
     Searcher,
     choice,
     grid_search,
@@ -49,7 +50,8 @@ __all__ = [
     "with_parameters", "with_resources", "report", "get_checkpoint",
     "get_context", "uniform", "quniform", "loguniform", "qloguniform",
     "randint", "choice", "sample_from", "grid_search", "Searcher",
-    "BasicVariantGenerator", "TrialScheduler", "FIFOScheduler",
+    "BasicVariantGenerator", "HaltonSearchGenerator",
+    "TrialScheduler", "FIFOScheduler",
     "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]
