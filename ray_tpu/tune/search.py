@@ -179,6 +179,21 @@ def _resolve(space: Dict[str, Any], rng: random.Random,
     return out
 
 
+def _expand_grids(space: Dict[str, Any]):
+    """Yield deepcopies of `space` with every grid_search combination
+    pre-set (one plain copy when there are no grids) — shared by the
+    random and quasi-random generators."""
+    grids = _split_grid(space)
+    if not grids:
+        yield copy.deepcopy(space)
+        return
+    for combo in itertools.product(*(vals for _, vals in grids)):
+        cfg = copy.deepcopy(space)
+        for (path, _), val in zip(grids, combo):
+            _set_path(cfg, path, val)
+        yield cfg
+
+
 class BasicVariantGenerator(Searcher):
     """Grid cartesian product x num_samples random samples (reference
     tune/search/basic_variant.py)."""
@@ -197,16 +212,9 @@ class BasicVariantGenerator(Searcher):
             cfg = dict(copy.deepcopy(space))
             cfg.update(p)
             yield self._sample_leaves(cfg)
-        grids = _split_grid(space)
         for _ in range(num_samples):
-            if grids:
-                for combo in itertools.product(*(vals for _, vals in grids)):
-                    cfg = copy.deepcopy(space)
-                    for (path, _), val in zip(grids, combo):
-                        _set_path(cfg, path, val)
-                    yield self._sample_leaves(cfg)
-            else:
-                yield self._sample_leaves(copy.deepcopy(space))
+            for cfg in _expand_grids(space):
+                yield self._sample_leaves(cfg)
 
     def _sample_leaves(self, space: Dict[str, Any]) -> Dict[str, Any]:
         return _resolve(space, self._rng, {})
@@ -259,7 +267,7 @@ class HaltonSearchGenerator(Searcher):
     def __init__(self, space: Dict[str, Any], num_samples: int = 1,
                  seed: Optional[int] = None, skip: int = 0):
         super().__init__()
-        self._rng = random.Random(seed)  # SampleFrom + overflow dims
+        self._rng = random.Random(seed)  # SampleFrom leaves only
         paths = _domain_paths(space)
         if len(paths) > len(_PRIMES):
             raise ValueError(
@@ -269,24 +277,18 @@ class HaltonSearchGenerator(Searcher):
             self._generate(space, paths, num_samples, skip))
 
     def _generate(self, space, paths, num_samples, skip):
-        grids = _split_grid(space)
-        for i in range(num_samples):
-            idx = skip + i + 1  # Halton index 0 is all-zeros: skip it
-            def one(cfg):
+        idx = skip  # Halton index 0 is all-zeros; advance before use
+        for _ in range(num_samples):
+            for cfg in _expand_grids(space):
+                # one Halton point PER TRIAL — grid combos must not
+                # share a point or continuous dims collapse to
+                # num_samples distinct values across the product
+                idx += 1
                 for (path, dom), base in zip(paths, _PRIMES):
                     _set_path(cfg, path,
                               dom.from_uniform(_halton(idx, base)))
-                # remaining Domain/SampleFrom leaves resolve normally
-                return _resolve(cfg, self._rng, {})
-            if grids:
-                for combo in itertools.product(
-                        *(vals for _, vals in grids)):
-                    cfg = copy.deepcopy(space)
-                    for (path, _), val in zip(grids, combo):
-                        _set_path(cfg, path, val)
-                    yield one(cfg)
-            else:
-                yield one(copy.deepcopy(space))
+                # remaining SampleFrom leaves resolve normally
+                yield _resolve(cfg, self._rng, {})
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         try:

@@ -221,6 +221,8 @@ def test_halton_with_grid_and_choice():
     assert len(cfgs) == 8  # 2 grid x 4 samples
     assert {c["opt"] for c in cfgs} == {"adam", "sgd"}
     assert all(c["depth"] in (2, 4, 8) for c in cfgs)
+    # each trial gets its OWN Halton point: grid twins must not share x
+    assert len({c["x"] for c in cfgs}) == 8
 
 
 def test_tuner_runs_with_halton(tmp_path):
