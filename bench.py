@@ -297,8 +297,10 @@ def _supervise() -> int:
         print(json.dumps(rec))
         return 0
 
+    # same metric name as the TPU success record so consumers keyed on
+    # it see the failure, not a silent series gap
     print(json.dumps({
-        "metric": "gpt2_train_tokens_per_sec_per_chip",
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
         "value": 0.0,
         "unit": "tokens/s/chip",
         "vs_baseline": 0.0,

@@ -29,7 +29,8 @@ class LogMonitor:
         self.node_label = node_label
         self.poll_s = poll_s
         self._offsets: Dict[str, int] = {}
-        self._partial: Dict[str, bytes] = {}
+        self._partial: Dict[str, bytes] = {}   # unterminated trailing line
+        self._backlog: Dict[str, List[bytes]] = {}  # cap-hit surplus lines
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -60,26 +61,37 @@ class LogMonitor:
             if len(out) >= _MAX_LINES_PER_TICK:
                 break
             worker = os.path.basename(path)[len("worker-"):-len(".log")]
-            try:
-                size = os.path.getsize(path)
-                offset = self._offsets.get(path, 0)
-                if size < offset:  # truncated/rotated: start over
-                    offset = 0
-                    self._partial.pop(path, None)
-                if size == offset:
+            lines = self._backlog.pop(path, None)
+            if lines is None:
+                # No retained surplus: read new bytes. While a backlog
+                # exists we do NOT read — otherwise a log-spamming worker
+                # grows the buffer without bound (each tick drains only
+                # _MAX_LINES_PER_TICK but could read ~1MB more).
+                try:
+                    size = os.path.getsize(path)
+                    offset = self._offsets.get(path, 0)
+                    if size < offset:  # truncated/rotated: start over
+                        offset = 0
+                        self._partial.pop(path, None)
+                    if size == offset:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        data = f.read(min(size - offset, 1 << 20))
+                        self._offsets[path] = f.tell()
+                except OSError:
                     continue
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    data = f.read(min(size - offset, 1 << 20))
-                    self._offsets[path] = f.tell()
-            except OSError:
-                continue
-            data = self._partial.pop(path, b"") + data
-            *lines, tail = data.split(b"\n")
-            if tail:
-                self._partial[path] = tail
-            for raw in lines:
+                data = self._partial.pop(path, b"") + data
+                *lines, tail = data.split(b"\n")
+                if tail:
+                    self._partial[path] = tail
+            for i, raw in enumerate(lines):
                 if len(out) >= _MAX_LINES_PER_TICK:
+                    # Cap hit inside an already-read chunk: the offset has
+                    # advanced past these lines, so retain the surplus for
+                    # the next tick instead of dropping it (bounded at one
+                    # read's worth — see the no-read-while-backlog rule).
+                    self._backlog[path] = lines[i:]
                     break
                 line = raw[:_MAX_LINE].decode("utf-8", "replace").rstrip()
                 if line:

@@ -657,7 +657,14 @@ class Worker:
         if self._is_cancelled(spec.return_ids):
             raise exc.TaskCancelledError(spec.name)
         for dep in _top_level_refs(spec.args, spec.kwargs):
-            self._wait_dep_ready(dep)
+            self._wait_dep_ready(
+                dep,
+                should_abort=lambda: self._is_cancelled(spec.return_ids))
+        if self._is_cancelled(spec.return_ids):
+            # cancelled during the dep wait: stop HERE — falling through
+            # would park this submit slot in the unbounded lease_worker
+            # wait, re-pinning the slot the bounded dep loop just freed
+            raise exc.TaskCancelledError(spec.name)
         worker_id, address = self.conductor.call(
             "lease_worker", spec.resources, spec.placement_group_id,
             None, spec.scheduling_strategy, timeout=None)
@@ -778,20 +785,39 @@ class Worker:
                 refcount.tracker.on_result_recorded(oid)
         return cancelled
 
-    def _wait_dep_ready(self, ref: ObjectRef) -> None:
-        """Block until `ref`'s value exists somewhere reachable."""
-        if self.store.contains(ref.id) or self._locator_of(ref.id):
-            return
-        if self._is_pending_local(ref.id):
-            while self._is_pending_local(ref.id) and \
-                    not self.store.contains(ref.id):
+    def _wait_dep_ready(self, ref: ObjectRef, should_abort=None) -> None:
+        """Block until `ref`'s value exists somewhere reachable.
+
+        Bounded wait + re-check: every blocking step caps at ~2s, so a
+        submit-pool slot is never pinned by one unbounded RPC — with only
+        16 submit threads, 16 tasks each waiting forever on a borrowed
+        dep would stall all submission. Between steps the loop re-checks
+        local state, shutdown, and `should_abort` (task cancellation).
+        """
+        while True:
+            if self.store.contains(ref.id) or self._locator_of(ref.id):
+                return
+            if self._shutdown or (should_abort is not None
+                                  and should_abort()):
+                return
+            if self._is_pending_local(ref.id):
                 self.store.wait_ready(ref.id, 0.2)
-            return
-        owner = ref.owner
-        if owner is None or tuple(owner) == self.address:
-            return  # nothing to wait on; executor fetch will surface errors
-        self.clients.get(tuple(owner)).call("resolve_object_location", ref.id,
-                                            timeout=None)
+                continue
+            owner = ref.owner
+            if owner is None or tuple(owner) == self.address:
+                # nothing to wait on; executor fetch will surface errors
+                return
+            # owner-side wait bounded at 2s per round trip; False means
+            # "still pending" — loop and re-check. A TimeoutError is
+            # owner-side queueing (its handler pool is busy), not a task
+            # failure: re-poll.
+            try:
+                if self.clients.get(tuple(owner)).call(
+                        "resolve_object_location", ref.id, 2.0,
+                        timeout=15.0):
+                    return
+            except TimeoutError:
+                continue
 
     def _record_event(self, spec: TaskSpec, t0: float, address,
                       status: str = "FINISHED") -> None:
@@ -1551,13 +1577,20 @@ class WorkerHandler:
                                                      "unknown to owner"))
             w.store.wait_ready(object_id, 0.2)
 
-    def resolve_object_location(self, object_id: str) -> bool:
+    def resolve_object_location(self, object_id: str,
+                                max_wait: Optional[float] = None) -> bool:
+        """True once the object is reachable; False if `max_wait` elapses
+        while it is still legitimately pending (caller re-polls — keeps
+        the requester's RPC bounded instead of parking it here)."""
         w = self.w
+        deadline = None if max_wait is None else time.monotonic() + max_wait
         while True:
             if w.store.contains(object_id) or w._locator_of(object_id):
                 return True
             if not w._is_pending_local(object_id):
                 raise exc.ObjectLostError(object_id, "unknown to owner")
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
             w.store.wait_ready(object_id, 0.2)
 
     def subscribe_object(self, object_id: str,

@@ -259,12 +259,18 @@ class ClientWorker:
         self._closed = False
         self._pending_release: List[str] = []
         self._release_lock = threading.Lock()
+        self._last_release_flush = time.monotonic()
         info = self._call("client_connect")
         self.conductor_address = tuple(info["conductor"])
         self.conductor = _ConductorShim(self)
 
     # -- plumbing ----------------------------------------------------------
     def _call(self, method: str, *args):
+        # Piggyback pending releases on session traffic every few seconds
+        # regardless of batch size.
+        if (self._pending_release
+                and time.monotonic() - self._last_release_flush > 3.0):
+            self._flush_releases()
         try:
             return self._rpc.call(method, self.session_id, *args,
                                   timeout=None)
@@ -275,14 +281,21 @@ class ClientWorker:
     def _release_later(self, oid: str) -> None:
         with self._release_lock:
             self._pending_release.append(oid)
-            batch = None
-            if len(self._pending_release) >= 100:
-                batch, self._pending_release = self._pending_release, []
-        if batch:
-            try:
-                self._rpc.notify("client_release", self.session_id, batch)
-            except Exception:  # noqa: BLE001 — reaper will collect
-                pass
+        # Size-triggered flush; _flush_releases also runs time-based from
+        # _call so a slow-dropping session cannot pin objects server-side
+        # behind the 100-entry batch threshold indefinitely.
+        self._flush_releases(min_batch=100)
+
+    def _flush_releases(self, min_batch: int = 1) -> None:
+        with self._release_lock:
+            if len(self._pending_release) < min_batch:
+                return
+            batch, self._pending_release = self._pending_release, []
+            self._last_release_flush = time.monotonic()
+        try:
+            self._rpc.notify("client_release", self.session_id, batch)
+        except Exception:  # noqa: BLE001 — reaper will collect
+            pass
 
     def _swap_out(self, x: Any) -> Any:
         if isinstance(x, ClientObjectRef):
@@ -350,6 +363,7 @@ class ClientWorker:
         if self._closed:
             return
         self._closed = True
+        self._flush_releases()
         try:
             self._rpc.call("client_disconnect", self.session_id,
                            timeout=5.0)

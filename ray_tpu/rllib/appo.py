@@ -123,7 +123,11 @@ class APPO(IMPALA):
 
     def load_checkpoint(self, data: Any) -> None:
         super().load_checkpoint(data)
-        self.target_params = data.get("target_params", self.params)
+        if "target_params" in data:
+            self.target_params = data["target_params"]
+        else:
+            # Copy, never alias (see dqn.py load_checkpoint).
+            self.target_params = jax.tree.map(jnp.copy, self.params)
 
 
 __all__ = ["APPO", "APPOConfig", "make_appo_update"]

@@ -231,7 +231,13 @@ class DQN(Algorithm):
 
     def load_checkpoint(self, data: Any) -> None:
         super().load_checkpoint(data)
-        self.target_params = data.get("target_params", self.params)
+        if "target_params" in data:
+            self.target_params = data["target_params"]
+        else:
+            # Copy, never alias: an aliased target would track the online
+            # params exactly until the next sync and re-expose the
+            # donation-aliasing hazard _build_learner guards against.
+            self.target_params = jax.tree.map(jnp.copy, self.params)
 
     def compute_single_action(self, obs: np.ndarray) -> Any:
         q = core.mlp_apply(self.params["q"],

@@ -247,9 +247,13 @@ class SAC(Algorithm):
 
     def load_checkpoint(self, data: Any) -> None:
         super().load_checkpoint(data)
-        self.target_q = data.get(
-            "target_q", {"q1": self.params["q1"],
-                         "q2": self.params["q2"]})
+        if "target_q" in data:
+            self.target_q = data["target_q"]
+        else:
+            # Copy, never alias (see dqn.py load_checkpoint).
+            self.target_q = jax.tree.map(
+                jnp.copy, {"q1": self.params["q1"],
+                           "q2": self.params["q2"]})
 
     def compute_single_action(self, obs: np.ndarray) -> Any:
         mean, _ = _pi_dist(self.params,

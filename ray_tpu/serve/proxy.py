@@ -137,8 +137,17 @@ class ProxyActor:
             ThreadPoolExecutor(max_workers=16,
                                thread_name_prefix="proxy-grpc"))
         self._grpc_server.add_generic_rpc_handlers((service,))
-        self._grpc_bound_port = self._grpc_server.add_insecure_port(
+        bound = self._grpc_server.add_insecure_port(
             f"{self._host}:{grpc_port}")
+        if bound == 0 and grpc_port != 0:
+            # grpc signals bind failure by returning 0 — fall back to an
+            # ephemeral port rather than publishing (host, 0) as live.
+            bound = self._grpc_server.add_insecure_port(f"{self._host}:0")
+        if bound == 0:
+            raise RuntimeError(
+                f"could not bind gRPC ingress on {self._host} "
+                f"(requested port {grpc_port})")
+        self._grpc_bound_port = bound
         self._grpc_server.start()
 
     def _controller(self):

@@ -202,3 +202,44 @@ def test_cancel_borrowed_ref_forwards_to_owner(cluster):
             break
         time.sleep(0.2)
     assert seen == "TaskCancelledError", seen
+
+
+def test_borrowed_dep_wait_releases_submit_slots(cluster):
+    """Regression: a submitter thread waiting on a borrowed (other-owner,
+    still pending) dep must use a bounded wait + re-check loop, not one
+    unbounded RPC. With only 16 submit threads, 17+ cancelled tasks stuck
+    on never-ready deps would otherwise pin every slot and stall all
+    further submission from that worker (worker._wait_dep_ready)."""
+    @ray_tpu.remote
+    def never():
+        time.sleep(120)
+        return 1
+
+    @ray_tpu.remote
+    def child(x):
+        return x
+
+    @ray_tpu.remote
+    class Spawner:
+        def spawn(self, refs):
+            # children are owned by THIS actor's worker; each dep is a
+            # borrowed driver-owned ref that is still pending
+            return [child.remote(r) for r in refs]
+
+        def probe(self):
+            # submitted through the same 16-slot submit pool
+            return ray_tpu.get(child.remote(ray_tpu.put("pong")))
+
+    s = Spawner.remote()
+    dep = never.remote()
+    children = ray_tpu.get(s.spawn.remote([dep] * 20), timeout=30.0)
+    assert len(children) == 20
+    time.sleep(1.0)  # let the submit pool fill with dep waiters
+    for c in children:
+        ray_tpu.cancel(c)
+    # cancelled waiters must drain from the pool: an unrelated task
+    # submitted by the same owner completes promptly
+    t0 = time.monotonic()
+    assert ray_tpu.get(s.probe.remote(), timeout=30.0) == "pong"
+    assert time.monotonic() - t0 < 15.0
+    ray_tpu.cancel(dep, force=True)

@@ -86,3 +86,31 @@ def test_log_to_driver_disabled(capfd):
         assert "MARKER_SILENCED_99" not in capfd.readouterr().err
     finally:
         ray_tpu.shutdown()
+
+
+def test_burst_beyond_tick_cap_is_retained(tmp_path):
+    """Lines past the per-tick cap inside an already-read chunk must be
+    retained for the next tick, not dropped (the offset has already
+    advanced past them). Advisor r3 finding."""
+    from ray_tpu._private.log_monitor import LogMonitor, _MAX_LINES_PER_TICK
+
+    p = tmp_path / "worker-burst.log"
+    with open(p, "w") as f:
+        for i in range(_MAX_LINES_PER_TICK + 50):
+            f.write(f"line-{i}\n")
+        f.write("partial-no-newline")
+
+    lm = LogMonitor(str(tmp_path), publish_fn=lambda b: None,
+                    node_label="n")
+    g1 = lm.poll_once()
+    g2 = lm.poll_once()
+    g3 = lm.poll_once()
+    assert len(g1) == _MAX_LINES_PER_TICK
+    assert [e["line"] for e in g2] == [
+        f"line-{i}" for i in range(_MAX_LINES_PER_TICK,
+                                   _MAX_LINES_PER_TICK + 50)]
+    assert g3 == []
+    # the unterminated tail is still a partial: completing it emits it
+    with open(p, "a") as f:
+        f.write("-done\n")
+    assert [e["line"] for e in lm.poll_once()] == ["partial-no-newline-done"]
