@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import exceptions as exc
 from . import serialization
 from .ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from .rpc import ClientPool, RpcServer
@@ -96,6 +97,8 @@ class ActorRecord:
     death_cause: Optional[str] = None
     num_restarts: int = 0
     placement_group_id: Optional[str] = None
+    # "DEFAULT" | "SPREAD" | ("NODE_AFFINITY", node_id, soft)
+    scheduling_strategy: Any = "DEFAULT"
 
 
 @dataclass
@@ -475,12 +478,18 @@ class ConductorHandler:
     def lease_worker(self, resources: Dict[str, float],
                      placement_group_id: Optional[str] = None,
                      timeout: Optional[float] = None,
-                     strategy: str = "DEFAULT"
-                     ) -> Tuple[str, Tuple[str, int]]:
+                     strategy: str = "DEFAULT",
+                     arg_locations=None) -> Tuple[str, Tuple[str, int]]:
         """Grant an idle worker (spawning if below capacity), holding
         `resources` against the node until return_worker. strategy
-        DEFAULT packs (head-first); SPREAD prefers the emptiest node
-        (reference composite_scheduling_policy.h policies)."""
+        DEFAULT packs (head-first, biased toward the node holding the
+        most argument bytes — reference lease_policy.cc); SPREAD prefers
+        the emptiest node; ("NODE_AFFINITY", node_id, soft) pins
+        (reference node_affinity_scheduling_policy.cc).
+
+        `arg_locations`: [(holder_address, nbytes), ...] locality hints
+        from the submitter; addresses not belonging to a registered
+        worker (e.g. a driver) are ignored."""
         deadline = time.monotonic() + (timeout if timeout is not None
                                        else _worker_start_timeout())
         resources = dict(resources or {})
@@ -494,7 +503,8 @@ class ConductorHandler:
             self._waiting_leases += 1
             self._pending_demand.append(demand_token)
             try:
-                return self._lease_locked(resources, deadline, strategy)
+                return self._lease_locked(resources, deadline, strategy,
+                                          arg_locations)
             finally:
                 self._waiting_leases -= 1
                 self._pending_demand.remove(demand_token)
@@ -516,7 +526,11 @@ class ConductorHandler:
             or self._nodes.get(w.node_id)
 
     def _lease_locked(self, resources, deadline,
-                      strategy: str = "DEFAULT"):
+                      strategy: str = "DEFAULT", arg_locations=None):
+            affinity = None
+            if isinstance(strategy, (tuple, list)) and strategy \
+                    and strategy[0] == "NODE_AFFINITY":
+                affinity = (str(strategy[1]), bool(strategy[2]))
             while True:
                 if self._stopped:
                     raise RuntimeError("conductor stopped")
@@ -526,7 +540,13 @@ class ConductorHandler:
                 head = self._nodes[self._head_node_id]
                 nodes = [head] + [n for nid, n in self._nodes.items()
                                   if nid != self._head_node_id and n.alive]
-                if strategy == "SPREAD":
+                pinned = None
+                if affinity is not None:
+                    pinned = self._affinity_nodes_locked(
+                        affinity, resources)
+                if pinned is not None:
+                    nodes = pinned
+                elif strategy == "SPREAD":
                     # emptiest node first (reference SPREAD policy,
                     # scheduling/policy/spread_scheduling_policy.cc) —
                     # the DEFAULT order above is pack/head-first
@@ -537,6 +557,14 @@ class ConductorHandler:
                                    == n.node_id)
 
                     nodes.sort(key=busy)
+                elif arg_locations:
+                    # data locality: stable-sort candidates by argument
+                    # bytes resident on each node, most first (reference
+                    # core_worker/lease_policy.cc LocalityAwareLeasePolicy)
+                    score = self._locality_scores_locked(arg_locations)
+                    if score:
+                        nodes.sort(
+                            key=lambda n: -score.get(n.node_id, 0.0))
                 acquired = None
                 for node in nodes:
                     if self._acquire_resources(node, resources):
@@ -557,6 +585,47 @@ class ConductorHandler:
                         f"no worker available for {resources} within timeout; "
                         f"available={head.available}")
                 self._cv.wait(min(remaining, 0.1))
+
+    def _affinity_nodes_locked(self, affinity, resources):
+        """Candidate list under ("NODE_AFFINITY", node_id, soft):
+        [target] while the node is alive and can ever fit the request
+        (merely-busy waits, reference node_affinity semantics); soft
+        degrades to None (caller keeps the default order); hard raises
+        SchedulingError — failing the task beats waiting forever."""
+        node_id, soft = affinity
+        target = self._nodes.get(node_id)
+        feasible = target is not None and target.alive and all(
+            target.total.get(k, 0.0) + 1e-9 >= v
+            for k, v in resources.items() if not k.startswith("_pg_"))
+        if feasible:
+            # _pg_-prefixed keys exist only in `available` on the node(s)
+            # holding the reservation: a pin to a node without the bundle
+            # can never succeed and must not wait out the lease timeout
+            feasible = all(k in target.available for k in resources
+                           if k.startswith("_pg_"))
+        if feasible:
+            return [target]
+        if soft:
+            return None
+        raise exc.SchedulingError(
+            f"NodeAffinity(node_id={node_id!r}, soft=False) cannot be "
+            "satisfied: node is "
+            + ("dead or unknown" if target is None or not target.alive
+               else f"too small for {resources}"))
+
+    def _locality_scores_locked(self, arg_locations) -> Dict[str, float]:
+        """node_id -> argument bytes held there, from (address, nbytes)
+        hints. Unknown addresses (drivers, departed workers) score 0."""
+        addr_to_node = {tuple(w.address): (w.lease_node_id or w.node_id)
+                        for w in self._workers.values()
+                        if w.address is not None}
+        score: Dict[str, float] = {}
+        for addr, nbytes in arg_locations:
+            nid = addr_to_node.get(tuple(addr))
+            if nid is not None:
+                # unknown size still signals presence
+                score[nid] = score.get(nid, 0.0) + max(float(nbytes), 1.0)
+        return score
 
     def _spawn_node_id(self, node: NodeRecord) -> str:
         """The node whose worker pool serves a lease on `node`: agent
@@ -709,7 +778,8 @@ class ConductorHandler:
                      namespace: str, resources: Dict[str, float],
                      max_restarts: int, max_task_retries: int,
                      placement_group_id: Optional[str] = None,
-                     get_if_exists: bool = False) -> Dict[str, Any]:
+                     get_if_exists: bool = False,
+                     scheduling_strategy: Any = "DEFAULT") -> Dict[str, Any]:
         """GCS-mediated actor creation (reference gcs_actor_manager.cc:255,280)."""
         with self._cv:
             if name is not None:
@@ -728,7 +798,8 @@ class ConductorHandler:
                               restarts_remaining=max_restarts,
                               max_task_retries=max_task_retries,
                               resources=dict(resources or {}),
-                              placement_group_id=placement_group_id)
+                              placement_group_id=placement_group_id,
+                              scheduling_strategy=scheduling_strategy)
             self._actors[actor_id] = rec
             self._dirty = True
             if name is not None:
@@ -742,9 +813,13 @@ class ConductorHandler:
         with self._lock:
             rec = self._actors[actor_id]
             spec, res, pg = rec.spec, rec.resources, rec.placement_group_id
+            # getattr: records restored from a pre-upgrade snapshot were
+            # pickled without the field (pickle bypasses dataclass defaults)
+            strat = getattr(rec, "scheduling_strategy", "DEFAULT")
         try:
-            worker_id, address = self.lease_worker(res, placement_group_id=pg)
-        except (TimeoutError, RuntimeError) as e:
+            worker_id, address = self.lease_worker(
+                res, placement_group_id=pg, strategy=strat)
+        except (TimeoutError, RuntimeError, exc.SchedulingError) as e:
             with self._cv:
                 rec.state = "DEAD"
                 rec.death_cause = f"scheduling failed: {e}"

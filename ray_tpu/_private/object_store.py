@@ -259,6 +259,12 @@ class LocalObjectStore:
             e = self._entries.get(object_id)
             return e is not None and e.ready
 
+    def size_of(self, object_id: str) -> int:
+        """Stored size in bytes, 0 if absent/not ready (locality hints)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e.nbytes if e is not None and e.ready else 0
+
     def notify_waiters(self) -> None:
         """Wake wait_ready()/Worker._wait_result waiters so they re-check
         out-of-store readiness signals (e.g. a large result recorded as a

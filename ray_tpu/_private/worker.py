@@ -623,6 +623,11 @@ class Worker:
                         raise
                     retries -= 1
         except BaseException as e:  # noqa: BLE001 — deliver to waiters
+            if isinstance(e, RemoteError) and isinstance(
+                    e.cause, exc.RayTpuError):
+                # e.g. SchedulingError from a hard NodeAffinity lease —
+                # surface the typed error, not an opaque RPC wrapper
+                e = e.cause
             err = e if isinstance(e, exc.RayTpuError) else exc.TaskError(
                 e, traceback.format_exc(), spec.name)
             for oid in spec.return_ids:
@@ -656,7 +661,8 @@ class Worker:
     def _submit_once(self, spec: TaskSpec) -> None:
         if self._is_cancelled(spec.return_ids):
             raise exc.TaskCancelledError(spec.name)
-        for dep in _top_level_refs(spec.args, spec.kwargs):
+        deps = _top_level_refs(spec.args, spec.kwargs)
+        for dep in deps:
             self._wait_dep_ready(
                 dep,
                 should_abort=lambda: self._is_cancelled(spec.return_ids))
@@ -667,7 +673,8 @@ class Worker:
             raise exc.TaskCancelledError(spec.name)
         worker_id, address = self.conductor.call(
             "lease_worker", spec.resources, spec.placement_group_id,
-            None, spec.scheduling_strategy, timeout=None)
+            None, spec.scheduling_strategy, self._arg_locations(deps),
+            timeout=None)
         if self._is_cancelled(spec.return_ids):  # cancelled during lease
             try:
                 self.conductor.notify("return_worker", worker_id)
@@ -784,6 +791,22 @@ class Worker:
             if refcount.tracker.was_freed_pending(oid):
                 refcount.tracker.on_result_recorded(oid)
         return cancelled
+
+    def _arg_locations(self, deps) -> Optional[List[Tuple[Tuple[str, int],
+                                                          int]]]:
+        """(holder_address, nbytes) per arg ref — the conductor's
+        locality signal (reference core_worker/lease_policy.cc: lease
+        from the raylet holding the most argument bytes). Size is 0 when
+        only a remote locator is known (presence still counts)."""
+        locs = []
+        for dep in deps:
+            addr = self._locator_of(dep.id) or dep.locator
+            nbytes = self.store.size_of(dep.id)
+            if addr is None and nbytes > 0:
+                addr = self.address  # value lives in this process
+            if addr is not None:
+                locs.append((tuple(addr), int(nbytes)))
+        return locs or None
 
     def _wait_dep_ready(self, ref: ObjectRef, should_abort=None) -> None:
         """Block until `ref`'s value exists somewhere reachable.
@@ -972,6 +995,8 @@ class Worker:
         # pin cluster CPUs — this is what makes 40k actors/cluster possible
         # (release/benchmarks/README.md:10). Tasks keep the 1-CPU default.
         resources["CPU"] = 0.0 if num_cpus is None else float(num_cpus)
+        from ray_tpu.util import scheduling_strategies as _sched
+
         info = self.conductor.call(
             "create_actor", spec_bytes,
             options.get("name"), options.get("namespace", "default"),
@@ -980,6 +1005,7 @@ class Worker:
             options.get("max_task_retries", 0),
             options.get("placement_group_id"),
             options.get("get_if_exists", False),
+            _sched.to_wire(options.get("scheduling_strategy", "DEFAULT")),
             timeout=None)
         if info["state"] == "DEAD":
             raise exc.ActorDiedError(info["actor_id"],

@@ -74,5 +74,10 @@ class RuntimeEnvSetupError(RayTpuError):
     pass
 
 
+class SchedulingError(RayTpuError):
+    """Placement can never be satisfied (e.g. hard NodeAffinity to a dead
+    or too-small node) — fails the task instead of waiting forever."""
+
+
 class PlacementGroupSchedulingError(RayTpuError):
     pass

@@ -9,6 +9,7 @@ from typing import Any, Dict, Optional
 
 from ._private import worker as worker_mod
 from ._private.worker import DEFAULT_MAX_RETRIES
+from .util import scheduling_strategies as _sched
 
 
 class RemoteFunction:
@@ -47,7 +48,8 @@ class RemoteFunction:
             max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
             placement_group_id=pg_id,
             runtime_env=o.get("runtime_env"),
-            scheduling_strategy=o.get("scheduling_strategy", "DEFAULT"))
+            scheduling_strategy=_sched.to_wire(
+                o.get("scheduling_strategy", "DEFAULT")))
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node — reference python/ray/dag/function_node.py

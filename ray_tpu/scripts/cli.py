@@ -182,6 +182,12 @@ def cmd_config(args) -> None:
     print("\n(* = overridden via environment / _system_config)")
 
 
+def cmd_microbench(args) -> None:
+    from ray_tpu._private import perf
+
+    perf.run(scale=args.scale, out=args.out)
+
+
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -258,6 +264,13 @@ def main(argv=None) -> None:
     sp.add_argument("--output", default="ray_tpu_timeline.json")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("microbench",
+                        help="core-runtime micro benchmarks (ray_perf "
+                             "analog): task/actor/put-get/queue/churn")
+    sp.add_argument("--scale", type=float, default=1.0)
+    sp.add_argument("--out", default="")
+    sp.set_defaults(fn=cmd_microbench)
 
     sp = sub.add_parser("job", help="job submission")
     sp.add_argument("--address")

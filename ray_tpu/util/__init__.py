@@ -6,3 +6,6 @@ from .placement_group import (  # noqa: F401
     placement_group,
     remove_placement_group,
 )
+from .scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+)

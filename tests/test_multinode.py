@@ -192,3 +192,76 @@ def test_spread_scheduling_strategy(small_head):
         assert agent.node_id not in head_nodes
     finally:
         agent.stop()
+
+
+def test_locality_aware_leasing(small_head):
+    """A task whose (large, locator-only) arg lives on the agent node must
+    lease there even though the head also has room (reference
+    core_worker/lease_policy.cc LocalityAwareLeasePolicy)."""
+    agent = NodeAgent(_head_address(), {"CPU": 4.0}).start()
+    try:
+        import numpy as np
+
+        @ray_tpu.remote(num_cpus=2)  # head has 1 CPU: runs on the agent
+        def big():
+            return np.zeros(16 << 20, np.uint8)  # >8MB: stays with holder
+
+        ref = big.remote()
+        ray_tpu.wait([ref], timeout=60.0)
+
+        @ray_tpu.remote(num_cpus=1)  # fits the head too
+        def consume(a):
+            return (os.environ.get("RAY_TPU_NODE_ID"), a.nbytes)
+
+        node, nbytes = ray_tpu.get(consume.remote(ref), timeout=60.0)
+        assert nbytes == 16 << 20
+        assert node == agent.node_id, \
+            f"consumer ran on {node}, arg lives on {agent.node_id}"
+    finally:
+        agent.stop()
+
+
+def test_node_affinity_strategies(small_head):
+    """NodeAffinity: hard pins (or fails for unknown nodes), soft degrades
+    (reference node_affinity_scheduling_policy.cc)."""
+    from ray_tpu.exceptions import SchedulingError
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+
+    agent = NodeAgent(_head_address(), {"CPU": 4.0}).start()
+    try:
+        @ray_tpu.remote
+        def where():
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        # hard pin to the agent: must run there though the head has room
+        pinned = where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                agent.node_id, soft=False))
+        assert ray_tpu.get(pinned.remote(), timeout=60.0) == agent.node_id
+
+        # hard pin to a dead node: typed failure, no infinite wait
+        with pytest.raises(SchedulingError):
+            ray_tpu.get(where.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    "no-such-node", soft=False)).remote(), timeout=30.0)
+
+        # soft pin to a dead node: degrades to DEFAULT placement
+        soft = where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                "no-such-node", soft=True))
+        assert ray_tpu.get(soft.remote(), timeout=60.0) is not None
+
+        # actors honor the strategy too
+        @ray_tpu.remote(num_cpus=1)
+        class Where:
+            def node(self):
+                return os.environ.get("RAY_TPU_NODE_ID")
+
+        a = Where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                agent.node_id, soft=False)).remote()
+        assert ray_tpu.get(a.node.remote(), timeout=60.0) == agent.node_id
+        ray_tpu.kill(a)
+    finally:
+        agent.stop()

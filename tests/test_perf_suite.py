@@ -1,0 +1,27 @@
+"""Smoke for the micro-benchmark suite (reference ray_perf.py) — every
+bench runs end-to-end at tiny scale and emits well-formed records."""
+from __future__ import annotations
+
+import json
+import os
+
+
+def test_microbench_smoke(tmp_path):
+    from ray_tpu._private import perf
+
+    out = str(tmp_path / "micro.json")
+    sink = perf.run(scale=0.02, out=out)
+    names = {r["name"] for r in sink}
+    assert {"task_roundtrip_sync", "tasks_async", "actor_call_sync",
+            "actor_calls_async", "put_1kb", "put_100mb",
+            "task_result_fetch_100mb", "queue_drain",
+            "actor_churn"} <= names
+    for r in sink:
+        assert r["iters"] > 0
+        ops = [v for k, v in r.items()
+               if k.endswith(("_per_s", "gb_per_s"))]
+        assert ops and all(v > 0 for v in ops), r
+    assert os.path.exists(out)
+    with open(out) as f:
+        data = json.load(f)
+    assert data["results"] == sink
