@@ -1563,10 +1563,18 @@ class ConductorHandler:
                 except subprocess.TimeoutExpired:
                     try:
                         w.proc.kill()
-                    except OSError:
+                        # reap: an unreaped zombie still passes the
+                        # sweeper's os.kill(pid, 0) liveness probe, so
+                        # its leaked segments would be skipped
+                        w.proc.wait(2.0)
+                    except (OSError, subprocess.TimeoutExpired):
                         pass
         self._clients.close_all()
         self._flush_state()
+        # workers that needed SIGKILL leaked their shm arena segments
+        from .object_store import cleanup_leaked_segments
+
+        cleanup_leaked_segments()
 
 
 class Conductor:

@@ -33,6 +33,33 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import serialization
 from .ids import ObjectID
 
+def cleanup_leaked_segments() -> int:
+    """Unlink /dev/shm/rtpu_a_<pid>_* arena segments whose owning process
+    is dead. SIGKILL'ed workers cannot unlink their own segments; left to
+    accumulate they hold tmpfs RAM and measurably degrade the shm object
+    plane (observed 20-30x on 100MB fetches at ~4GB of leakage). Called
+    from cluster stop/start; returns the number removed."""
+    import glob
+    import re
+
+    removed = 0
+    for path in glob.glob("/dev/shm/rtpu_a_*"):
+        m = re.match(r"rtpu_a_(\d+)_", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            os.kill(int(m.group(1)), 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                pass
+        except (PermissionError, OSError):
+            pass  # alive under another uid / odd pid — not ours to touch
+    return removed
+
+
 def shm_threshold() -> int:
     """Bytes above which host objects go to shared memory — resolved via
     the flag table at use time (ray_config_def.h analog)."""

@@ -217,9 +217,12 @@ def bench_actor_churn(ray_tpu, sink, scale: float) -> None:
            "actors_per_s": round(n / dt, 1)}, sink)
 
 
+# fetch runs BEFORE put_get: the ~1GB of locally-pinned put payloads
+# creates shm/page-cache pressure that would contaminate the fetch
+# numbers (measured 20x degradation when ordered after)
 BENCHES: List[Callable] = [
     bench_task_roundtrip, bench_tasks_async, bench_actor_calls,
-    bench_put_get, bench_task_result_fetch, bench_queue_drain,
+    bench_task_result_fetch, bench_put_get, bench_queue_drain,
     bench_actor_churn,
 ]
 

@@ -113,9 +113,20 @@ def initialize_jax_distributed(group_key: str, rank: int, world: int,
                                num_processes=world, process_id=rank)
 
 
+def is_jax_distributed_initialized() -> bool:
+    """True once jax.distributed.initialize succeeded in this process."""
+    import jax
+
+    return jax.distributed.is_initialized()
+
+
 def setup_jax_distributed(timeout: float = 120.0) -> Tuple[int, int]:
     """Inside a JaxTrainer(mode="workers") train_fn: rendezvous this
     worker gang into one jax.distributed job and return (rank, world).
+
+    JaxTrainer performs this automatically before train_fn when
+    ScalingConfig.setup_jax_distributed (the default) — calling it again
+    is a no-op, so train_fns written for older versions keep working.
 
     After this returns, `jax.devices()` is the GLOBAL device set across
     all gang workers; build a Mesh over it (parallel.make_mesh) and jit
@@ -126,8 +137,9 @@ def setup_jax_distributed(timeout: float = 120.0) -> Tuple[int, int]:
     from ..train.session import get_context
 
     ctx = get_context()
-    group_key = getattr(ctx, "jax_dist_key", None) or \
-        f"group/{ctx.experiment_name}"
-    initialize_jax_distributed(group_key, ctx.rank, ctx.world_size,
-                               timeout=timeout)
+    if not is_jax_distributed_initialized():
+        group_key = getattr(ctx, "jax_dist_key", None) or \
+            f"group/{ctx.experiment_name}"
+        initialize_jax_distributed(group_key, ctx.rank, ctx.world_size,
+                                   timeout=timeout)
     return ctx.rank, ctx.world_size

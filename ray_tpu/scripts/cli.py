@@ -112,6 +112,21 @@ def cmd_stop(args) -> None:
         os.unlink(_ADDR_FILE)
     except OSError:
         pass
+    import glob
+
+    from ray_tpu._private.object_store import cleanup_leaked_segments
+
+    # The head tears down asynchronously (SIGTERM grace then SIGKILL can
+    # take >3s): poll-sweep until the segments' owners are gone.
+    removed, deadline = 0, time.monotonic() + 6.0
+    while True:
+        removed += cleanup_leaked_segments()
+        if not glob.glob("/dev/shm/rtpu_a_*") \
+                or time.monotonic() >= deadline:
+            break
+        time.sleep(0.5)
+    if removed:
+        print(f"removed {removed} leaked shm segment(s)")
 
 
 def cmd_status(args) -> None:

@@ -18,6 +18,11 @@ class ScalingConfig:
     num_workers: int = 1
     use_tpu: bool = True
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    # mode="workers": rendezvous the gang into one jax.distributed job
+    # BEFORE train_fn runs (the reference does process-group setup for
+    # the user — train/torch/config.py:64-117). Opt out for gangs doing
+    # pure host-side work with no jax in the loop.
+    setup_jax_distributed: bool = True
 
 
 @dataclass

@@ -25,6 +25,9 @@ class TrainContext:
     trial_dir: str = ""
     dataset_shards: Dict[str, Any] = field(default_factory=dict)
     latest_checkpoint: Optional[Checkpoint] = None
+    # rendezvous namespace for this gang (unique per fit); consumed by
+    # parallel.distributed.setup_jax_distributed
+    jax_dist_key: Optional[str] = None
     # set by the trainer: called with (metrics, checkpoint)
     _report_fn: Optional[Callable[[Dict[str, Any], Optional[Checkpoint]],
                                   None]] = None

@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import time
 import traceback
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -260,7 +261,8 @@ class JaxTrainer:
 
             def run(self, fn_bytes: bytes, cfg: Dict[str, Any],
                     trial_dir: str, shards: Dict[str, Any],
-                    latest_path: Optional[str]) -> List[Any]:
+                    latest_path: Optional[str],
+                    dist_key: Optional[str] = None) -> List[Any]:
                 from ray_tpu._private import serialization
                 from ray_tpu.train.session import (TrainContext,
                                                    _set_session, StopTrial)
@@ -277,9 +279,18 @@ class JaxTrainer:
                     trial_dir=trial_dir, dataset_shards=shards,
                     latest_checkpoint=(Ckpt(latest_path)
                                        if latest_path else None),
+                    jax_dist_key=dist_key,
                     _report_fn=report_fn)
                 _set_session(ctx)
                 try:
+                    if dist_key is not None and self.world > 1:
+                        # form the gang's global jax mesh FOR the user —
+                        # the reference does process-group setup in the
+                        # backend (train/torch/config.py:64-117), train_fn
+                        # should see the world already assembled
+                        from ray_tpu.parallel.distributed import \
+                            setup_jax_distributed
+                        setup_jax_distributed()
                     fn(cfg)
                 except StopTrial:
                     pass
@@ -309,12 +320,16 @@ class JaxTrainer:
         fn_bytes = serialization.dumps(self.train_fn)
         cfg = dict(self.train_loop_config)
         cfg["sharding_config"] = self.sharding_config
+        dist_key = None
+        if n > 1 and getattr(self.scaling_config,
+                             "setup_jax_distributed", True):
+            dist_key = f"train-gang/{uuid.uuid4().hex}"
         workers = [_TrainWorker.options(placement_group=pg)
                    .remote(rank=i, world=n) for i in range(n)]
         try:
             refs = [w.run.remote(
                 fn_bytes, cfg, storage, self._shard_datasets(i, n),
-                latest.path if latest else None)
+                latest.path if latest else None, dist_key)
                 for i, w in enumerate(workers)]
             all_reports = ray_tpu.get(refs)
         finally:
@@ -324,10 +339,22 @@ class JaxTrainer:
                 except Exception:
                     pass
             remove_placement_group(pg)
+        # Aggregate EVERY rank's reports per step: the lowest reporting
+        # rank's metrics are the headline (rank 0 whenever it reported),
+        # and "rank_metrics" is ALWAYS present with one entry per rank
+        # that reported that step — a stable schema even when ranks
+        # report unequal step counts. A checkpoint path from ANY rank
+        # registers (first one wins).
         history, last_metrics = [], {}
-        for metrics, ckpt_path in all_reports[0]:  # rank 0 wins
+        n_steps = max((len(r) for r in all_reports), default=0)
+        for i in range(n_steps):
+            per_rank = [(rank, r[i]) for rank, r in enumerate(all_reports)
+                        if len(r) > i]
+            metrics = dict(per_rank[0][1][0] or {})
+            metrics["rank_metrics"] = [m for _, (m, _p) in per_rank]
             history.append(metrics)
             last_metrics = metrics
+            ckpt_path = next((p for _, (_m, p) in per_rank if p), None)
             if ckpt_path:
                 manager.register(Checkpoint(ckpt_path), metrics)
         return Result(metrics=last_metrics,

@@ -178,3 +178,26 @@ def test_store_integration_uses_arena():
         assert ray_tpu.get(roundtrip.remote(ref)) == x.sum()
     finally:
         ray_tpu.shutdown()
+
+
+def test_cleanup_leaked_segments():
+    """Dead-pid arena segments are swept; live-pid ones are kept."""
+    import os
+
+    from ray_tpu._private.object_store import cleanup_leaked_segments
+
+    dead = "/dev/shm/rtpu_a_999999999_deadbeef"
+    live = f"/dev/shm/rtpu_a_{os.getpid()}_cafecafe"
+    for p in (dead, live):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    try:
+        assert cleanup_leaked_segments() >= 1
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)
+    finally:
+        for p in (dead, live):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
