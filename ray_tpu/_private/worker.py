@@ -1507,6 +1507,13 @@ class ActorRuntime:
                                        "exit_actor() called", timeout=5.0)
         except Exception:
             pass
+        try:
+            # unlink shm before os._exit (which skips atexit/GC): a
+            # graceful exit must not leak its arena segments — consumers
+            # that already mapped them keep valid mappings after unlink
+            self.worker.store.shutdown()
+        except Exception:  # noqa: BLE001 — exiting regardless
+            pass
         os._exit(0)
 
 

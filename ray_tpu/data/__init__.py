@@ -1,6 +1,7 @@
 """ray_tpu.data — distributed datasets over ray_tpu tasks (reference
 python/ray/data: lazy plans, streaming execution, Arrow blocks)."""
 from .block import Block, BlockAccessor  # noqa: F401
+from .compute import ActorPoolStrategy, TaskPoolStrategy  # noqa: F401
 from .dataset import Dataset, GroupedData  # noqa: F401
 from .datasource import (from_arrow, from_items, from_numpy,  # noqa: F401
                          from_pandas, range, range_tensor, read_binary_files,
@@ -9,6 +10,7 @@ from .datasource import (from_arrow, from_items, from_numpy,  # noqa: F401
 from .iterator import DataIterator  # noqa: F401
 
 __all__ = [
+    "ActorPoolStrategy", "TaskPoolStrategy",
     "Block", "BlockAccessor", "Dataset", "GroupedData", "DataIterator",
     "range", "range_tensor", "from_items", "from_numpy", "from_pandas",
     "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",

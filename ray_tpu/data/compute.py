@@ -1,0 +1,47 @@
+"""Compute strategies for map operators.
+
+Reference: python/ray/data/_internal/compute.py:65 (ActorPoolStrategy) —
+`map_batches(compute=ActorPoolStrategy(...))` runs the UDF on a bounded,
+autoscaling pool of dedicated actors so stateful/expensive-to-construct
+UDFs (model weights, tokenizers) are built once per actor and reused
+across batches, instead of once per worker that happens to pull a task.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TaskPoolStrategy:
+    """Default: stateless tasks on the shared worker pool; `size` caps
+    the stage's in-flight tasks (per-operator backpressure knob)."""
+
+    size: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class ActorPoolStrategy:
+    """Bounded pool of dedicated actors for one map stage.
+
+    The pool starts at `min_size` and grows up to `max_size` (defaults
+    to min_size) when every actor already has
+    `max_tasks_in_flight_per_actor` blocks queued; it is torn down when
+    the stage finishes. Construction-per-actor + reuse-across-batches is
+    the contract (reference compute.py ActorPoolStrategy semantics).
+    """
+
+    min_size: int = 1
+    max_size: Optional[int] = None
+    max_tasks_in_flight_per_actor: int = 2
+    num_cpus: float = 1.0
+
+    def __post_init__(self):
+        if self.min_size < 1:
+            raise ValueError("min_size must be >= 1")
+        if self.max_size is not None and self.max_size < self.min_size:
+            raise ValueError("max_size must be >= min_size")
+
+    @property
+    def resolved_max_size(self) -> int:
+        return self.max_size if self.max_size is not None else self.min_size

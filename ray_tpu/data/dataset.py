@@ -37,16 +37,24 @@ class Dataset:
                     batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
                     concurrency: Optional[int] = None,
+                    compute: Optional[Any] = None,
                     **_ignored) -> "Dataset":
-        """fn: batch->batch, or a callable class (constructed once per
-        worker — the reference's ActorPoolStrategy)."""
+        """fn: batch->batch, or a callable class. With
+        compute=ActorPoolStrategy(...) the stage runs on a bounded pool
+        of dedicated actors (stateful UDF constructed once per actor,
+        reused across batches — reference _internal/compute.py:65);
+        without it a callable class is constructed once per worker
+        process. Plain functions may also use a pool. `concurrency` caps
+        this stage's in-flight tasks."""
         if isinstance(fn, type):
             return self._chain(P.MapBatches(
                 "map_batches", None, batch_size, batch_format,
-                fn_constructor=fn, concurrency=concurrency))
+                fn_constructor=fn, concurrency=concurrency,
+                compute=compute))
         return self._chain(P.MapBatches("map_batches", fn, batch_size,
                                         batch_format,
-                                        concurrency=concurrency))
+                                        concurrency=concurrency,
+                                        compute=compute))
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
         return self._chain(P.FlatMap("flat_map", fn))

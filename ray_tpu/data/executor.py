@@ -37,6 +37,27 @@ def _run_stage(stage: P.FusedStage, block: Block) -> Block:
     return stage(block)
 
 
+class _PoolWorker:
+    """One actor of an ActorPoolStrategy pool: the stage (and any
+    callable-class UDF inside it) is constructed once here and reused
+    for every block routed to this actor (reference
+    _internal/compute.py ActorPoolStrategy semantics)."""
+
+    def __init__(self, stage: P.FusedStage):
+        self._stage = stage
+
+    def apply(self, block: Block) -> Block:
+        return self._stage(block)
+
+    def exit(self) -> None:
+        """Graceful teardown: queued after the actor's in-flight applies,
+        so they finish first; the graceful path unlinks the actor's shm
+        arena (no /dev/shm leak, unlike kill's SIGKILL)."""
+        from ray_tpu.actor import exit_actor
+
+        exit_actor()
+
+
 def _count_rows(block: Block) -> int:
     return block.num_rows
 
@@ -146,10 +167,73 @@ class StreamingExecutor:
 
     def _run_map(self, stage: P.FusedStage,
                  upstream: Iterator[Any]) -> Iterator[Any]:
+        strategy = stage.compute
+        if strategy is not None:
+            return self._run_actor_pool(stage, upstream, strategy)
         task = _remote(_run_stage)
         window = stage.concurrency or self.max_in_flight
         return self._windowed(
             (task.remote(stage, ref) for ref in upstream), window)
+
+    def _run_actor_pool(self, stage: P.FusedStage, upstream: Iterator[Any],
+                        strategy) -> Iterator[Any]:
+        """Bounded autoscaling actor pool for one map stage: round-robin
+        block routing (each actor's queue stays FIFO), ordered yield, pool
+        growth when every actor is saturated, teardown when the stage
+        drains (reference ActorPoolStrategy + _ActorPool).
+
+        Outputs are made durable AT YIELD TIME: the pool dies at stage
+        end, so before a ref leaves this generator its block is completed
+        and (if its bytes live only on a pool actor) locally materialized
+        — a zero-copy shm mapping on the same host. Memory stays
+        O(window), refs the consumer drops free normally, and early
+        abandonment can never strand a yielded ref on a dead actor."""
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        actor_cls = ray_tpu.remote(num_cpus=strategy.num_cpus)(_PoolWorker)
+        per_actor = max(1, strategy.max_tasks_in_flight_per_actor)
+        actors = [actor_cls.remote(stage)
+                  for _ in range(strategy.min_size)]
+        inflight: List[Any] = []
+        rr = 0
+
+        def durable(ref):
+            ray_tpu.wait([ref], num_returns=1)
+            w = worker_mod.global_worker
+            # error results are stored at the owner already (contains()
+            # is true for them) — the consumer's get() surfaces those
+            if w is not None and not w.store.contains(ref.id):
+                try:
+                    ray_tpu.get(ref, timeout=120.0)
+                except Exception:  # noqa: BLE001 — fetch-infra failure:
+                    pass  # consumer's own get() retries/surfaces it
+            return ref
+
+        try:
+            for ref in upstream:
+                if len(inflight) >= len(actors) * per_actor:
+                    if len(actors) < strategy.resolved_max_size:
+                        actors.append(actor_cls.remote(stage))
+                    else:
+                        yield durable(inflight.pop(0))
+                inflight.append(
+                    actors[rr % len(actors)].apply.remote(ref))
+                rr += 1
+            for out in inflight:
+                yield durable(out)
+        finally:
+            for a in actors:
+                try:
+                    # graceful: queued behind in-flight applies; unlinks
+                    # the actor's arena instead of leaking it (SIGKILL
+                    # via ray_tpu.kill would strand /dev/shm segments)
+                    a.exit.remote()
+                except Exception:  # noqa: BLE001 — already dead
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def _windowed(self, submissions: Iterator[Any],
                   window: int) -> Iterator[Any]:

@@ -52,6 +52,7 @@ class MapBatches(LogicalOp):
     batch_format: str = "numpy"
     fn_constructor: Optional[Callable] = None  # actor-mode callable class
     concurrency: Optional[int] = None
+    compute: Optional[Any] = None  # compute.ActorPoolStrategy | TaskPool
 
 
 @dataclass(frozen=True)
@@ -135,6 +136,21 @@ class FusedStage:
         for o in self.ops:
             if isinstance(o, MapBatches) and o.concurrency:
                 return o.concurrency
+            if isinstance(o, MapBatches) and o.compute is not None \
+                    and getattr(o.compute, "size", None):
+                return o.compute.size
+        return None
+
+    @property
+    def compute(self) -> Optional[Any]:
+        """The ActorPoolStrategy when this stage is a standalone
+        actor-pool map (fusion keeps such stages unfused)."""
+        from .compute import ActorPoolStrategy
+
+        for o in self.ops:
+            if isinstance(o, MapBatches) and \
+                    isinstance(o.compute, ActorPoolStrategy):
+                return o.compute
         return None
 
     def __call__(self, block: Block) -> Block:
@@ -206,7 +222,13 @@ def _actor_callable_cache(ctor: Callable) -> Any:
 
 
 def fuse(ops: List[LogicalOp]) -> List[Any]:
-    """[LogicalOp] -> [source | FusedStage | barrier op] pipeline."""
+    """[LogicalOp] -> [source | FusedStage | barrier op] pipeline.
+
+    An actor-pool MapBatches never fuses with neighbours: its stage maps
+    1:1 onto a dedicated actor pool (reference: actor-pool operators are
+    their own physical operator)."""
+    from .compute import ActorPoolStrategy
+
     stages: List[Any] = []
     current: Optional[FusedStage] = None
     for op in ops:
@@ -216,6 +238,12 @@ def fuse(ops: List[LogicalOp]) -> List[Any]:
                 stages.append(current)
             current = None
             stages.append(op)
+        elif isinstance(op, MapBatches) and \
+                isinstance(op.compute, ActorPoolStrategy):
+            if current is not None and current.ops:
+                stages.append(current)
+            current = None
+            stages.append(FusedStage(ops=[op]))
         else:
             if current is None:
                 current = FusedStage()
