@@ -1507,13 +1507,12 @@ class ActorRuntime:
                                        "exit_actor() called", timeout=5.0)
         except Exception:
             pass
-        try:
-            # unlink shm before os._exit (which skips atexit/GC): a
-            # graceful exit must not leak its arena segments — consumers
-            # that already mapped them keep valid mappings after unlink
-            self.worker.store.shutdown()
-        except Exception:  # noqa: BLE001 — exiting regardless
-            pass
+        # Deliberately NOT unlinking the shm arena here: a consumer may
+        # hold a fetched-but-not-yet-mapped reference to a block in it
+        # (put_shm_reference records the segment NAME lazily); unlinking
+        # would turn its first get() into ObjectLostError. The leaked
+        # segment is bounded per exited actor and swept at cluster stop
+        # (object_store.cleanup_leaked_segments).
         os._exit(0)
 
 

@@ -51,8 +51,9 @@ class _PoolWorker:
 
     def exit(self) -> None:
         """Graceful teardown: queued after the actor's in-flight applies,
-        so they finish first; the graceful path unlinks the actor's shm
-        arena (no /dev/shm leak, unlike kill's SIGKILL)."""
+        so they finish first. The arena segment is left for the
+        cluster-stop sweep — unlinking here could break refs fetched but
+        not yet mapped by consumers."""
         from ray_tpu.actor import exit_actor
 
         exit_actor()
@@ -202,12 +203,14 @@ class StreamingExecutor:
             ray_tpu.wait([ref], num_returns=1)
             w = worker_mod.global_worker
             # error results are stored at the owner already (contains()
-            # is true for them) — the consumer's get() surfaces those
+            # is true for them) — the consumer's get() surfaces those.
+            # A failed fetch must RAISE: once the pool exits, the data is
+            # unrecoverable, so silently yielding the ref would convert
+            # a loud failure here into a confusing one later. (Known
+            # limitation: driver-store eviction under extreme pressure
+            # can still drop the fetched copy as "refetchable".)
             if w is not None and not w.store.contains(ref.id):
-                try:
-                    ray_tpu.get(ref, timeout=120.0)
-                except Exception:  # noqa: BLE001 — fetch-infra failure:
-                    pass  # consumer's own get() retries/surfaces it
+                ray_tpu.get(ref, timeout=120.0)
             return ref
 
         try:
@@ -225,9 +228,9 @@ class StreamingExecutor:
         finally:
             for a in actors:
                 try:
-                    # graceful: queued behind in-flight applies; unlinks
-                    # the actor's arena instead of leaking it (SIGKILL
-                    # via ray_tpu.kill would strand /dev/shm segments)
+                    # graceful: queued behind in-flight applies, so none
+                    # are killed mid-computation (ray_tpu.kill would be
+                    # immediate SIGKILL)
                     a.exit.remote()
                 except Exception:  # noqa: BLE001 — already dead
                     try:

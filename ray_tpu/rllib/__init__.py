@@ -15,7 +15,8 @@ DQN (replay buffer + double-Q + target sync, dqn.py), and SAC (twin
 soft-Q + squashed gaussian + auto-alpha for continuous control, sac.py)
 — covering the reference's sync/async/off-policy execution plans.
 Offline RL: shard recording, OfflineData, behavior cloning
-(offline.py). Multi-agent:
+(offline.py), MARWIL advantage-weighted imitation (marwil.py), and
+CQL conservative Q-learning (cql.py). Multi-agent:
 MultiAgentEnvRunner collects per-policy batches via policy_mapping_fn
 (multi_agent.py). Native vectorized CartPole/Pendulum remove the
 gymnasium dependency from tests; any gymnasium env id works via the
@@ -42,6 +43,8 @@ from .multi_agent import (  # noqa: F401
     make_multi_agent_env,
     register_multi_agent_env,
 )
+from .cql import CQL, CQLConfig  # noqa: F401
+from .marwil import MARWIL, MARWILConfig  # noqa: F401
 from .offline import BC, BCConfig, OfflineData, record_batches  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
@@ -55,4 +58,5 @@ __all__ = [
     "MultiAgentVectorEnv", "MultiAgentCartPole", "MultiAgentEnvRunner",
     "MultiAgentPPO", "make_multi_agent_env", "register_multi_agent_env",
     "BC", "BCConfig", "OfflineData", "record_batches", "SAC", "SACConfig",
+    "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
 ]

@@ -173,16 +173,21 @@ class Algorithm(Trainable):
 
     # ----------------------------------------------------------- sampling
 
+    def _sample_params(self):
+        """Params handed to EnvRunners — variants whose runner wants a
+        different layout (SAC/CQL's {"pi", "scale"}) override this."""
+        return self.params
+
     def _host_params(self):
         import jax
 
-        return jax.device_get(self.params)
+        return jax.device_get(self._sample_params())
 
     def _collect_batches(self) -> List[Dict[str, Any]]:
         """Synchronous fan-out (reference rollout_ops.py
         synchronous_parallel_sample)."""
         if self.local_runner is not None:
-            batches = [self.local_runner.sample(self.params)]
+            batches = [self.local_runner.sample(self._sample_params())]
         else:
             import ray_tpu
 

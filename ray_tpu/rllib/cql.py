@@ -1,0 +1,94 @@
+"""CQL: Conservative Q-Learning — offline continuous control.
+
+Reference: rllib/algorithms/cql/ (cql.py config, cql_torch_policy loss):
+SAC's twin-soft-Q machinery trained purely from recorded transitions,
+with a conservative penalty that pushes Q down on out-of-distribution
+actions (logsumexp over sampled actions) and up on dataset actions, so
+the learned policy cannot exploit over-estimated Q in states the data
+never covered. The live env is an evaluation harness only.
+
+The update is SAC's single jitted step with the penalty fused in
+(sac.make_sac_update(cql=...)) — one XLA program per minibatch.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .offline import OfflineData
+from .sac import SAC, SACConfig, make_sac_update
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.train_extra.update({
+            "input_path": None, "cql_alpha": 1.0, "cql_n_actions": 4,
+            "updates_per_step": 64,
+        })
+
+    def offline_data(self, input_path: str) -> "CQLConfig":
+        self.train_extra["input_path"] = input_path
+        return self
+
+
+class CQL(SAC):
+    """SAC substrate (networks, per-component optimizers, target sync,
+    squashed-gaussian eval runner) trained from OfflineData shards."""
+
+    _default_config = dict(SAC._default_config)
+    _default_config.update({
+        "input_path": None, "cql_alpha": 1.0, "cql_n_actions": 4,
+        "updates_per_step": 64,
+    })
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg = dict(self._default_config)
+        cfg.update(config)
+        if not cfg.get("input_path"):
+            raise ValueError("CQL needs config['input_path'] (offline "
+                             "shards dir or file)")
+        self.data = OfflineData(cfg["input_path"], seed=cfg.get("seed", 0),
+                                gamma=cfg.get("gamma", 0.99))
+        if not self.data.continuous:
+            raise ValueError("CQL requires continuous-action data")
+        super().setup(config)
+        if self.data.obs_dim != self.obs_dim:
+            raise ValueError(
+                f"offline data obs_dim {self.data.obs_dim} != eval env "
+                f"obs_dim {self.obs_dim}")
+
+    def _make_update(self):
+        return make_sac_update(
+            self.cfg, self.act_scale, self.act_dim, self._pi_opt,
+            self._q_opt, self._a_opt,
+            cql={"alpha": float(self.cfg.get("cql_alpha", 1.0)),
+                 "n_actions": int(self.cfg.get("cql_n_actions", 4))})
+
+    def _build_buffer(self):
+        return None  # offline: minibatches come from self.data
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        accum = []
+        for mb in self.data.minibatches(
+                cfg.get("train_batch_size", 256),
+                cfg.get("updates_per_step", 64),
+                keys=("obs", "actions", "rewards", "next_obs", "dones")):
+            batch = {k: jnp.asarray(v) for k, v in mb.items()}
+            self._key, sub = jax.random.split(self._key)
+            self.params, self.target_q, self.opt_state, aux = \
+                self._update(self.params, self.target_q, self.opt_state,
+                             sub, batch)
+            accum.append(aux)
+        metrics = {k: float(np.mean([float(a[k]) for a in accum]))
+                   for k in accum[0]}
+        # evaluation rollouts: episode stats only, nothing trains on them
+        self._collect_batches()
+        return metrics
+
+
+__all__ = ["CQL", "CQLConfig"]

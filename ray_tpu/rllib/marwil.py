@@ -1,0 +1,129 @@
+"""MARWIL: Monotonic Advantage Re-Weighted Imitation Learning.
+
+Reference: rllib/algorithms/marwil/ (marwil.py config surface,
+marwil_torch_policy loss): supervised imitation where each action's
+log-likelihood is weighted by exp(beta * advantage / c), the advantage
+being (return-to-go - V(s)) from a jointly-learned value head, and c a
+running sqrt of the squared-advantage norm. beta=0 degrades to BC.
+
+TPU-first shape: one jitted update step carrying (params, opt_state,
+c2) — the moving normalizer lives inside the donated carry instead of a
+Python-side stat, so the whole update (policy loss + value loss + norm
+EMA) compiles into a single XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import core
+from .offline import BC, BCConfig
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        # NB: no "gamma" here — it would shadow AlgorithmConfig.gamma in
+        # to_dict() and silently pin the return-to-go discount to 0.99
+        self.train_extra.update({
+            "beta": 1.0, "vf_coeff": 1.0, "moving_adv_eta": 1e-2,
+        })
+
+
+class MARWIL(BC):
+    """BC substrate (shard loading, space checks, eval harness) with the
+    advantage-weighted loss and a value head."""
+
+    _default_config = dict(BC._default_config)
+    _default_config.update({
+        "beta": 1.0, "vf_coeff": 1.0, "moving_adv_eta": 1e-2,
+    })
+
+    def _build_learner(self) -> None:
+        cfg = self.cfg
+        act_out = self.act_dim if self.continuous else self.num_actions
+        hidden = tuple(cfg.get("hidden", (64, 64)))
+        # policy_init's standard layout (pi + vf torsos) keeps the eval
+        # EnvRunner's act function working on self.params unchanged
+        self.params = core.policy_init(
+            jax.random.PRNGKey(cfg.get("seed", 0)), self.obs_dim, act_out,
+            hidden, continuous=self.continuous)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.get("grad_clip", 10.0)),
+            optax.adam(cfg.get("lr", 1e-3)))
+        self.opt_state = self.optimizer.init(self.params)
+        self._c2 = jnp.asarray(1.0, jnp.float32)  # running E[adv^2]
+
+        beta = float(cfg.get("beta", 1.0))
+        vf_coeff = float(cfg.get("vf_coeff", 1.0))
+        eta = float(cfg.get("moving_adv_eta", 1e-2))
+        continuous = self.continuous
+
+        def loss_fn(params, c2, batch):
+            v = core.value(params, batch["obs"])
+            adv = batch["returns"] - v
+            # reference: squared-advantage moving norm keeps exp() stable
+            c = jnp.sqrt(c2) + 1e-8
+            w = jnp.exp(beta * jax.lax.stop_gradient(adv) / c)
+            w = jnp.minimum(w, 20.0)  # exp blowup guard (ref clamps too)
+            if continuous:
+                mean = core.policy_logits(params, batch["obs"])
+                logp = core.gaussian_logp(mean, params["log_std"],
+                                          batch["actions"])
+            else:
+                logits = core.policy_logits(params, batch["obs"])
+                logp = core.categorical_logp(logits, batch["actions"])
+            policy_loss = -(w * logp).mean()
+            value_loss = 0.5 * (adv ** 2).mean()
+            total = policy_loss + vf_coeff * value_loss
+            new_c2 = c2 + eta * (jax.lax.stop_gradient(
+                (adv ** 2).mean()) - c2)
+            return total, (policy_loss, value_loss, new_c2)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def update(params, opt_state, c2, batch):
+            (_, (pl, vl, new_c2)), grads = grad_fn(params, c2, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_c2, pl, vl
+
+        self._update = jax.jit(update, donate_argnums=(0, 1, 2))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        pls, vls = [], []
+        for mb in self.data.minibatches(
+                cfg.get("train_batch_size", 256),
+                cfg.get("updates_per_step", 64),
+                keys=("obs", "actions", "returns")):
+            act_dtype = jnp.float32 if self.continuous else jnp.int32
+            batch = {"obs": jnp.asarray(mb["obs"]),
+                     "actions": jnp.asarray(mb["actions"], act_dtype),
+                     "returns": jnp.asarray(mb["returns"])}
+            self.params, self.opt_state, self._c2, pl, vl = self._update(
+                self.params, self.opt_state, self._c2, batch)
+            pls.append(float(pl))
+            vls.append(float(vl))
+        result = {"policy_loss": float(np.mean(pls)),
+                  "vf_loss": float(np.mean(vls)),
+                  "adv_norm": float(jnp.sqrt(self._c2))}
+        result.update(self.evaluate())
+        return result
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
+        data = super().save_checkpoint(checkpoint_dir)
+        data["c2"] = float(self._c2)
+        return data
+
+    def load_checkpoint(self, data: Any) -> None:
+        super().load_checkpoint(data)
+        self._c2 = jnp.asarray(data.get("c2", 1.0), jnp.float32)
+
+
+__all__ = ["MARWIL", "MARWILConfig"]

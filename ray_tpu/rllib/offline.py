@@ -55,25 +55,59 @@ def record_batches(env: Any, num_fragments: int, out_dir: str, *,
 
 class OfflineData:
     """Flat transition view over recorded shards, iterated as shuffled
-    minibatches (reference OfflineData / JsonReader)."""
+    minibatches (reference OfflineData / JsonReader).
 
-    def __init__(self, paths: Any, seed: int = 0):
+    Besides (obs, actions) for BC, full transitions are exposed for
+    offline RL: next_obs / rewards / dones (CQL) and the per-transition
+    discounted return-to-go `returns` (MARWIL's advantage target),
+    computed per fragment column and truncated at the fragment boundary
+    (zero bootstrap — the standard offline approximation)."""
+
+    def __init__(self, paths: Any, seed: int = 0, gamma: float = 0.99):
         if isinstance(paths, str):
             paths = sorted(glob.glob(os.path.join(paths, "*.npz"))) \
                 if os.path.isdir(paths) else [paths]
         if not paths:
             raise ValueError("no offline shards found")
-        obs, acts = [], []
+        obs, acts, nobs, rews, dones, rets = [], [], [], [], [], []
+        have_transitions = True
         for p in paths:
             with np.load(p) as z:
                 o, a = z["obs"], z["actions"]
+                # obs/actions-only shards stay valid for BC — the
+                # transition columns just come out as None
+                r = z["rewards"].astype(np.float32) \
+                    if "rewards" in z else None
+                d = z["dones"].astype(np.float32) if "dones" in z else None
             t1 = o.shape[0] - 1
-            obs.append(o[:-1].reshape(t1 * o.shape[1], -1))
+            n = o.shape[1]
+            obs.append(o[:-1].reshape(t1 * n, -1))
+            if r is None or d is None:
+                have_transitions = False
+            if have_transitions:
+                nobs.append(o[1:].reshape(t1 * n, -1))
+                rews.append(r[:t1].reshape(t1 * n))
+                dones.append(d[:t1].reshape(t1 * n))
+                # return-to-go per env column, truncated at fragment end
+                ret = np.zeros((t1, n), np.float32)
+                acc = np.zeros(n, np.float32)
+                for t in reversed(range(t1)):
+                    acc = r[t] + gamma * (1.0 - d[t]) * acc
+                    ret[t] = acc
+                rets.append(ret.reshape(t1 * n))
             # actions are [T, N] discrete or [T, N, act_dim] continuous
             acts.append(a.reshape(t1 * a.shape[1], *a.shape[2:])
                         if a.ndim > 2 else a.reshape(-1))
         self.obs = np.concatenate(obs, axis=0).astype(np.float32)
         self.actions = np.concatenate(acts, axis=0)
+        if have_transitions:
+            self.next_obs = np.concatenate(nobs, axis=0).astype(np.float32)
+            self.rewards = np.concatenate(rews, axis=0)
+            self.dones = np.concatenate(dones, axis=0)
+            self.returns = np.concatenate(rets, axis=0)
+        else:
+            self.next_obs = self.rewards = self.dones = None
+            self.returns = None
         self._rng = np.random.default_rng(seed)
 
     def __len__(self) -> int:
@@ -91,11 +125,18 @@ class OfflineData:
     def num_actions(self) -> int:
         return -1 if self.continuous else int(self.actions.max()) + 1
 
-    def minibatches(self, batch_size: int,
-                    num_batches: int) -> Iterator[Dict[str, np.ndarray]]:
+    def minibatches(self, batch_size: int, num_batches: int,
+                    keys: tuple = ("obs", "actions")
+                    ) -> Iterator[Dict[str, np.ndarray]]:
+        missing = [k for k in keys if getattr(self, k) is None]
+        if missing:
+            raise ValueError(
+                f"shards lack rewards/dones, so {missing} are "
+                "unavailable (obs/actions-only data supports BC, not "
+                "MARWIL/CQL)")
         for _ in range(num_batches):
             idx = self._rng.integers(0, len(self.obs), batch_size)
-            yield {"obs": self.obs[idx], "actions": self.actions[idx]}
+            yield {k: getattr(self, k)[idx] for k in keys}
 
 
 class BCConfig(AlgorithmConfig):
@@ -131,7 +172,8 @@ class BC(Algorithm):
             raise ValueError("BC needs config['input_path'] (offline "
                              "shards dir or file)")
         self.data = OfflineData(cfg["input_path"],
-                                seed=cfg.get("seed", 0))
+                                seed=cfg.get("seed", 0),
+                                gamma=cfg.get("gamma", 0.99))
         super().setup(config)
         if self.obs_dim != self.data.obs_dim:
             raise ValueError(

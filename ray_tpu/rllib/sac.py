@@ -86,7 +86,14 @@ class SACEnvRunner(EnvRunner):
 
 
 def make_sac_update(cfg: Dict[str, Any], act_scale: float, act_dim: int,
-                    pi_opt, q_opt, a_opt):
+                    pi_opt, q_opt, a_opt,
+                    cql: "Dict[str, Any] | None" = None):
+    """SAC update step; with `cql` = {"alpha": λ, "n_actions": n} the
+    critic loss gains the conservative penalty
+    λ·(logsumexp_a Q(s,a) − Q(s,a_data)) over n uniform + n
+    current-policy action samples per state (reference
+    rllib/algorithms/cql/ cql_torch_policy loss, simplified: no
+    importance-density subtraction, no lagrangian threshold)."""
     gamma, tau = cfg["gamma"], cfg["tau"]
     target_entropy = -float(act_dim)
 
@@ -106,9 +113,27 @@ def make_sac_update(cfg: Dict[str, Any], act_scale: float, act_dim: int,
         y = jax.lax.stop_gradient(y)
 
         def critic_loss(p):
-            l1 = ((_q(p["q1"], obs, act) - y) ** 2).mean()
-            l2 = ((_q(p["q2"], obs, act) - y) ** 2).mean()
-            return l1 + l2
+            q1d = _q(p["q1"], obs, act)
+            q2d = _q(p["q2"], obs, act)
+            loss = ((q1d - y) ** 2).mean() + ((q2d - y) ** 2).mean()
+            if cql is not None:
+                n = int(cql.get("n_actions", 4))
+                kr, kp = jax.random.split(jax.random.fold_in(key, 7))
+                obs_b = jnp.broadcast_to(obs, (n,) + obs.shape)
+                rand_a = jax.random.uniform(
+                    kr, (n,) + act.shape, minval=-1.0, maxval=1.0)
+                mean_c, log_std_c = _pi_dist(p, obs)
+                pol_a, _ = _sample_squashed(
+                    kp, jnp.broadcast_to(mean_c, (n,) + mean_c.shape),
+                    jnp.broadcast_to(log_std_c, (n,) + log_std_c.shape))
+                pol_a = jax.lax.stop_gradient(pol_a)  # penalize Q only
+                for qk, qd in (("q1", q1d), ("q2", q2d)):
+                    cat = jnp.concatenate([_q(p[qk], obs_b, rand_a),
+                                           _q(p[qk], obs_b, pol_a)],
+                                          axis=0)  # (2n, B)
+                    loss = loss + cql["alpha"] * (
+                        jax.nn.logsumexp(cat, axis=0) - qd).mean()
+            return loss
 
         def actor_loss(p):
             mean, log_std = _pi_dist(p, obs)
@@ -196,13 +221,21 @@ class SAC(Algorithm):
             "pi": self._pi_opt.init(self.params),
             "alpha": self._a_opt.init(self.params),
         }
-        self._update = make_sac_update(cfg, self.act_scale, self.act_dim,
-                                       self._pi_opt, self._q_opt,
-                                       self._a_opt)
-        self.buffer = ReplayBuffer(cfg.get("buffer_capacity", 100_000),
-                                   self.obs_dim, act_dim=self.act_dim)
+        self._update = self._make_update()
+        self.buffer = self._build_buffer()
         self._np_rng = np.random.default_rng(cfg.get("seed", 0))
         self._key = jax.random.PRNGKey(cfg.get("seed", 0) + 1)
+
+    def _make_update(self):
+        """Hook for variants (CQL) to augment the jitted update."""
+        return make_sac_update(self.cfg, self.act_scale, self.act_dim,
+                               self._pi_opt, self._q_opt, self._a_opt)
+
+    def _build_buffer(self):
+        """Hook: offline variants (CQL) train from shards, not a replay
+        buffer — no point allocating 100k-capacity arrays."""
+        return ReplayBuffer(self.cfg.get("buffer_capacity", 100_000),
+                            self.obs_dim, act_dim=self.act_dim)
 
     def _sample_params(self):
         return {"pi": self.params["pi"],
@@ -210,18 +243,7 @@ class SAC(Algorithm):
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.cfg
-        if self.local_runner is not None:
-            batches = [self.local_runner.sample(self._sample_params())]
-        else:
-            import ray_tpu
-
-            p = jax.device_get(self._sample_params())
-            batches = ray_tpu.get(
-                [r.sample.remote(p) for r in self.runners])
-        for b in batches:
-            self._episode_returns.extend(b["episode_returns"])
-            self._episode_lens.extend(b["episode_lens"])
-            self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+        for b in self._collect_batches():
             self.buffer.add_fragment(b)
         metrics: Dict[str, Any] = {"buffer_size": float(len(self.buffer))}
         if len(self.buffer) < cfg.get("learning_starts", 1_500):

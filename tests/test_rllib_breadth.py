@@ -199,3 +199,18 @@ def test_sac_rejects_discrete():
     with pytest.raises(ValueError, match="continuous"):
         (SACConfig().environment("CartPole-v1")
          .env_runners(num_env_runners=0).build())
+
+
+def test_rllib_bench_smoke(tmp_path):
+    """The env-steps/sec benchmark runs and emits well-formed records."""
+    import json
+
+    from ray_tpu.rllib.bench import main
+
+    out = str(tmp_path / "bench.json")
+    main(["--out", out, "--steps", "2"])
+    with open(out) as f:
+        data = json.load(f)
+    algos = {r["algo"] for r in data["results"]}
+    assert algos == {"ppo", "impala", "appo"}
+    assert all(r["env_steps_per_sec"] > 0 for r in data["results"])
