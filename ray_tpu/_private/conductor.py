@@ -373,7 +373,10 @@ class ConductorHandler:
     def nodes(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [{"node_id": n.node_id, "alive": n.alive, "total": n.total,
-                     "available": n.available} for n in self._nodes.values()]
+                     "available": n.available,
+                     "head": n.node_id == self._head_node_id,
+                     "address": list(n.address) if n.address else None}
+                    for n in self._nodes.values()]
 
     # ---------------------------------------------------------------- workers
 

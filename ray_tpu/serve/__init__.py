@@ -14,12 +14,13 @@ from typing import Any, Dict, List, Optional, Union
 
 import cloudpickle
 
+from .asgi import ingress  # noqa: F401
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
 from .context import get_request_context  # noqa: F401
 from .controller import ServeController
 from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
-from .http_util import Request  # noqa: F401
+from .http_util import Request, Response  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .replica import HandleMarker
 
@@ -114,7 +115,8 @@ def _get_controller(create: bool = True, http_options:
     http_options = http_options or HTTPOptions()
     ctrl = ray_tpu.remote(ServeController).options(
         name=CONTROLLER_NAME, max_concurrency=64).remote(
-            http_options.host, http_options.port, http_options.grpc_port)
+            http_options.host, http_options.port, http_options.grpc_port,
+            http_options.proxy_location)
     return ctrl
 
 

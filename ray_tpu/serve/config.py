@@ -59,3 +59,8 @@ class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
     grpc_port: Optional[int] = None
+    # "EveryNode" runs a proxy replica on each cluster node (reference
+    # ProxyLocation.EveryNode, proxy_state.py); "HeadOnly" restricts to
+    # the head. Non-head proxies bind ephemeral ports; discover them via
+    # serve.status()["proxies"].
+    proxy_location: str = "EveryNode"

@@ -50,7 +50,8 @@ class ServeController:
     """Reference controller.py:85 — singleton detached actor."""
 
     def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000,
-                 grpc_port: Optional[int] = None):
+                 grpc_port: Optional[int] = None,
+                 proxy_location: str = "EveryNode"):
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
         self._version = 0
@@ -60,8 +61,14 @@ class ServeController:
         self._http_port = http_port
         self._grpc_port = grpc_port
         self._grpc_addr: Optional[Tuple[str, int]] = None
-        self._proxy = None
+        self._proxy = None  # the head-node proxy (primary address)
         self._proxy_addr: Optional[Tuple[str, int]] = None
+        self._proxy_location = proxy_location
+        # per-node proxy fleet (reference proxy_state.py ProxyStateManager:
+        # one ProxyActor per alive node, reconciled with cluster topology)
+        self._proxies: Dict[str, Any] = {}
+        self._proxy_addrs: Dict[str, Tuple[str, int]] = {}
+        self._last_topology_check = 0.0
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile")
         self._reconcile_thread.start()
@@ -113,13 +120,15 @@ class ServeController:
         for st in states:
             for tag, handle in st.replicas:
                 self._stop_replica(handle, st.config)
-        if self._proxy is not None:
+        for actor in list(self._proxies.values()):
             try:
-                ray_tpu.get(self._proxy.graceful_shutdown.remote(),
-                            timeout=5.0)
-                ray_tpu.kill(self._proxy)
+                ray_tpu.get(actor.graceful_shutdown.remote(), timeout=5.0)
+                ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001 — proxy may already be gone
                 pass
+        self._proxies.clear()
+        self._proxy_addrs.clear()
+        self._proxy = None
 
     # -- introspection (state API / routers / proxy) ------------------------
     def get_replicas(self, app: str, deployment: str
@@ -150,6 +159,8 @@ class ServeController:
             return {
                 "proxy": {"host": self._http_host, "port": self._http_port,
                           "ready": self._proxy_addr is not None},
+                "proxies": {nid: list(addr) for nid, addr
+                            in self._proxy_addrs.items()},
                 "applications": {
                     app: {
                         "route_prefix": info["route_prefix"],
@@ -173,6 +184,10 @@ class ServeController:
     def get_proxy_address(self) -> Optional[Tuple[str, int]]:
         return self._proxy_addr
 
+    def get_proxy_addresses(self) -> Dict[str, Tuple[str, int]]:
+        """node_id -> bound (host, port) for every live proxy."""
+        return dict(self._proxy_addrs)
+
     def get_grpc_address(self):
         """('disabled', None) when no grpc_port was configured — lets
         clients return immediately instead of polling out a deadline —
@@ -193,19 +208,73 @@ class ServeController:
             time.sleep(0.25)
 
     def _ensure_proxy(self):
-        if self._proxy is not None:
-            return
+        """Reconcile the proxy fleet with cluster topology: one
+        ProxyActor pinned to every alive node (EveryNode), each polling
+        the same route table (reference proxy.py:1111 per-node proxies +
+        proxy_state.py). The head node's proxy keeps the configured
+        port and carries gRPC; the rest bind ephemeral ports."""
         import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
         from .proxy import ProxyActor
-        self._proxy = ray_tpu.remote(ProxyActor).options(
-            name=PROXY_NAME, max_concurrency=32).remote(
-                self._http_host, self._http_port, self._grpc_port)
-        self._proxy_addr = tuple(ray_tpu.get(self._proxy.ready.remote()))
-        # The proxy skips ports already in use — report the bound one.
-        self._http_host, self._http_port = self._proxy_addr
-        if self._grpc_port is not None:
-            addr = ray_tpu.get(self._proxy.grpc_address.remote())
-            self._grpc_addr = tuple(addr) if addr else None
+
+        # topology changes are rare: poll it on its own slow cadence
+        # instead of burdening every 0.25s reconcile tick with a
+        # conductor RPC (and the proxy-ready wait below)
+        now = time.monotonic()
+        if self._proxies and now - self._last_topology_check < 5.0:
+            return
+        self._last_topology_check = now
+        w = worker_mod.global_worker
+        try:
+            nodes = w.conductor.call("nodes", timeout=5.0)
+        except Exception:  # noqa: BLE001 — conductor briefly unreachable
+            return
+        alive = [n for n in nodes if n["alive"]]
+        head_id = next((n["node_id"] for n in alive if n.get("head")), None)
+        if self._proxy_location != "EveryNode":
+            alive = [n for n in alive if n.get("head")]
+        for n in alive:
+            nid = n["node_id"]
+            if nid in self._proxies:
+                continue
+            is_head = nid == head_id
+            # non-head proxies bind wildcard (the head's configured host
+            # may not exist on that machine) and advertise their node's
+            # reachable address
+            node_host = (n.get("address") or [None])[0]
+            try:
+                actor = ray_tpu.remote(ProxyActor).options(
+                    name=f"{PROXY_NAME}:{nid}", max_concurrency=32,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        nid, soft=False)).remote(
+                    self._http_host if is_head else "0.0.0.0",
+                    self._http_port if is_head else 0,
+                    self._grpc_port if is_head else None,
+                    None if is_head else (node_host or self._http_host))
+                addr = tuple(ray_tpu.get(actor.ready.remote(),
+                                         timeout=60.0))
+            except Exception:  # noqa: BLE001 — node died mid-create;
+                continue       # next reconcile tick retries
+            self._proxies[nid] = actor
+            self._proxy_addrs[nid] = addr
+            if is_head:
+                self._proxy = actor
+                self._proxy_addr = addr
+                # The proxy skips ports already in use — report bound.
+                self._http_host, self._http_port = addr
+                if self._grpc_port is not None:
+                    ga = ray_tpu.get(actor.grpc_address.remote())
+                    self._grpc_addr = tuple(ga) if ga else None
+        alive_ids = {n["node_id"] for n in alive}
+        for nid in [x for x in self._proxies if x not in alive_ids]:
+            actor = self._proxies.pop(nid)
+            self._proxy_addrs.pop(nid, None)
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001 — died with its node
+                pass
 
     def _reconcile_once(self):
         import ray_tpu

@@ -5,7 +5,7 @@ cross the proxy->replica actor boundary without an ASGI dependency)."""
 from __future__ import annotations
 
 import json as _json
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple  # noqa: F401
 from urllib.parse import parse_qsl
 
 
@@ -35,9 +35,39 @@ class Request:
         return f"Request({self.method} {self.path})"
 
 
-def coerce_response(result: Any) -> Tuple[int, Dict[str, str], bytes]:
+class Response:
+    """Explicit HTTP response with header control (the tuple/str/dict
+    shorthands cannot carry headers) — what the ASGI ingress adapter
+    returns, and available to plain deployments too.
+
+    `headers` may be a dict or a list of (name, value) pairs; the list
+    form preserves duplicates (multiple Set-Cookie headers)."""
+
+    __slots__ = ("status", "headers", "body")
+
+    def __init__(self, body: Any = b"", status: int = 200,
+                 headers: Any = None):
+        self.status = int(status)
+        if headers is None:
+            self.headers: List[Tuple[str, str]] = []
+        elif isinstance(headers, dict):
+            self.headers = list(headers.items())
+        else:
+            self.headers = [(str(k), str(v)) for k, v in headers]
+        if isinstance(body, str):
+            body = body.encode()
+        elif not isinstance(body, (bytes, bytearray)):
+            body = _json.dumps(body, default=str).encode()
+        self.body = bytes(body)
+
+
+def coerce_response(result: Any) -> Tuple[int, Any, bytes]:
     """Map a user return value to (status, headers, body) the way the
-    reference proxy does for Starlette responses / raw returns."""
+    reference proxy does for Starlette responses / raw returns. Headers
+    come back as a dict for the shorthand forms and as a list of pairs
+    (duplicate-preserving) for Response objects."""
+    if isinstance(result, Response):
+        return result.status, result.headers, result.body
     if isinstance(result, tuple) and len(result) == 2 and \
             isinstance(result[0], int):
         status, payload = result

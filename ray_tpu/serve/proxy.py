@@ -40,8 +40,12 @@ def _encode_chunk(item, sse: bool) -> bytes:
 
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 grpc_port: Optional[int] = None):
+                 grpc_port: Optional[int] = None,
+                 advertise_host: Optional[str] = None):
+        # bind on `host`; report `advertise_host` (a non-head node's
+        # reachable IP when binding a wildcard address) to clients
         self._host = host
+        self._advertise_host = advertise_host or host
         self._port = port
         self._routes: Dict[str, Tuple[str, str]] = {}
         self._handles: Dict[Tuple[str, str], DeploymentHandle] = {}
@@ -64,12 +68,12 @@ class ProxyActor:
     def ready(self) -> Tuple[str, int]:
         if not self._ready.wait(timeout=30.0):
             raise RuntimeError("proxy HTTP server failed to start")
-        return (self._host, self._bound_port)
+        return (self._advertise_host, self._bound_port)
 
     def grpc_address(self) -> Optional[Tuple[str, int]]:
         if self._grpc_bound_port is None:
             return None
-        return (self._host, self._grpc_bound_port)
+        return (self._advertise_host, self._grpc_bound_port)
 
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
@@ -232,7 +236,12 @@ class ProxyActor:
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
             if first[0] == "value":
                 status, headers, payload = coerce_response(first[1])
-                return web.Response(status=status, headers=headers,
+                from multidict import CIMultiDict
+
+                # list-of-pairs headers preserve duplicates (Set-Cookie)
+                hdrs = CIMultiDict(headers if isinstance(headers, list)
+                                   else list(headers.items()))
+                return web.Response(status=status, headers=hdrs,
                                     body=payload)
             # generator result: chunked transfer; SSE framing when the
             # client asked for text/event-stream
@@ -268,10 +277,16 @@ class ProxyActor:
                     await site.start()
                     break
                 except OSError:
+                    if port == 0:  # ephemeral bind cannot EADDRINUSE
+                        raise
                     port += 1
                     site = None
             if site is None:
                 raise RuntimeError("could not bind proxy port")
+            if port == 0:
+                # ephemeral request (non-head per-node proxies): report
+                # the port the kernel actually assigned
+                port = site._server.sockets[0].getsockname()[1]
             self._bound_port = port
             self._ready.set()
             while not self._shutdown.is_set():

@@ -7,6 +7,7 @@ and the controller's autoscaler, mirroring the reference's
 ReplicaMetricsManager."""
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -52,12 +53,11 @@ def _as_iterator(result: Any):
     return None
 
 
-def _drain_async_gen(agen):
-    """Sync iterator over an async generator, run on the ACTOR's
-    persistent event loop — the same loop async methods run on, so
-    loop-bound primitives (asyncio.Queue/Lock created during async init)
-    keep working inside streamed generators. Falls back to a private
-    loop only outside an actor runtime (unit tests)."""
+def _loop_runner():
+    """(run, owns_loop, loop): `run(coro)` resolves a coroutine on the
+    ACTOR's persistent event loop when one exists — the same loop async
+    methods run on, so loop-bound primitives from async init keep
+    working — else on a private loop the caller must close."""
     import asyncio
 
     from ray_tpu._private.worker import global_worker
@@ -69,11 +69,41 @@ def _drain_async_gen(agen):
         def run(coro):
             return asyncio.run_coroutine_threadsafe(coro, loop).result()
 
-        owns_loop = False
-    else:
-        loop = asyncio.new_event_loop()
-        run = loop.run_until_complete
-        owns_loop = True
+        return run, False, loop
+    loop = asyncio.new_event_loop()
+    return loop.run_until_complete, True, loop
+
+
+def _run_coro(coro, request_ctx=None):
+    """Resolve one coroutine, re-applying the request context INSIDE the
+    loop thread — run_coroutine_threadsafe tasks capture the loop
+    thread's contextvars, not the submitting request thread's, so
+    get_request_context() would otherwise read empty inside async
+    methods."""
+    async def with_ctx():
+        token = None
+        if request_ctx is not None:
+            token = set_request_context(request_ctx)
+        try:
+            return await coro
+        finally:
+            if token is not None:
+                from .context import _request_context
+
+                _request_context.reset(token)
+
+    run, owns_loop, loop = _loop_runner()
+    try:
+        return run(with_ctx())
+    finally:
+        if owns_loop:
+            loop.close()
+
+
+def _drain_async_gen(agen):
+    """Sync iterator over an async generator (see _loop_runner for the
+    loop-affinity rationale)."""
+    run, owns_loop, loop = _loop_runner()
     try:
         while True:
             try:
@@ -129,20 +159,29 @@ class ReplicaActor:
                 for a in args]
         kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
                   for k, v in kwargs.items()}
-        token = set_request_context(RequestContext(
+        rc = RequestContext(
             route=meta.get("route", ""),
             app_name=meta.get("app_name", self.app_name),
-            multiplexed_model_id=meta.get("multiplexed_model_id", "")))
+            multiplexed_model_id=meta.get("multiplexed_model_id", ""))
+        token = set_request_context(rc)
         try:
             if self._is_function:
-                return self._callable(*args, **kwargs)
-            method_name = meta.get("call_method") or "__call__"
-            method = getattr(self._callable, method_name, None)
-            if method is None:
-                raise AttributeError(
-                    f"deployment {self.deployment_name} has no method "
-                    f"'{method_name}'")
-            return method(*args, **kwargs)
+                fn = self._callable
+            else:
+                method_name = meta.get("call_method") or "__call__"
+                fn = getattr(self._callable, method_name, None)
+                if fn is None:
+                    raise AttributeError(
+                        f"deployment {self.deployment_name} has no method "
+                        f"'{method_name}'")
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                # async callables (incl. the ASGI ingress adapter and
+                # async function deployments) resolve on the actor's
+                # persistent loop, with the request context re-applied
+                # inside the loop thread
+                result = _run_coro(result, rc)
+            return result
         finally:
             from .context import _request_context
             _request_context.reset(token)

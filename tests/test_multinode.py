@@ -265,3 +265,47 @@ def test_node_affinity_strategies(small_head):
         ray_tpu.kill(a)
     finally:
         agent.stop()
+
+
+def test_serve_proxy_on_every_node(small_head):
+    """Serve runs a proxy replica per cluster node, each serving the
+    shared route table: a request through the NON-head node's proxy must
+    succeed (reference serve/_private/proxy.py:1111 + proxy_state.py)."""
+    import requests
+
+    from ray_tpu import serve
+
+    agent = NodeAgent(_head_address(), {"CPU": 4.0}).start()
+    try:
+        serve.start()
+
+        @serve.deployment
+        def hello(request):
+            return {"from": os.environ.get("RAY_TPU_NODE_ID", "driver")}
+
+        serve.run(hello.bind(), name="mn_app", route_prefix="/hello")
+
+        proxies = {}
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            proxies = serve.status().get("proxies", {})
+            if len(proxies) >= 2 and agent.node_id in proxies:
+                break
+            time.sleep(0.5)
+        assert agent.node_id in proxies, \
+            f"no proxy on agent node: {proxies}"
+
+        host, port = proxies[agent.node_id]
+        head_addr = serve.proxy_address()
+        assert (host, port) != tuple(head_addr)
+        r = requests.get(f"http://{host}:{port}/hello", timeout=30)
+        assert r.status_code == 200 and "from" in r.json()
+        # the same route serves through the head proxy too
+        r2 = requests.get(
+            f"http://{head_addr[0]}:{head_addr[1]}/hello", timeout=30)
+        assert r2.status_code == 200
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            agent.stop()

@@ -245,3 +245,74 @@ def test_multiplex_cache_is_per_instance():
 def test_404_and_healthz(serve_cluster):
     assert requests.get(_url("/-/healthz")).text == "success"
     assert requests.get(_url("/definitely-not-a-route-xyz")).status_code == 404
+
+
+def test_asgi_ingress(serve_cluster):
+    """@serve.ingress(asgi_app): HTTP requests route through any ASGI-3
+    callable (reference serve.ingress / FastAPI integration) with
+    status, headers, query strings, and request bodies intact."""
+    async def asgi_app(scope, receive, send):
+        assert scope["type"] == "http"
+        msg = await receive()
+        body = msg.get("body", b"")
+        path = scope["path"]
+        if path.endswith("/hello"):
+            status, payload = 200, b'{"hello": "world"}'
+        elif path.endswith("/echo"):
+            status, payload = 201, body
+        else:
+            status, payload = 404, b"nope"
+        await send({"type": "http.response.start", "status": status,
+                    "headers": [(b"content-type", b"application/json"),
+                                (b"x-served-by", b"asgi")]})
+        await send({"type": "http.response.body", "body": payload})
+
+    @serve.deployment
+    @serve.ingress(asgi_app)
+    class Api:
+        pass
+
+    serve.run(Api.bind(), name="asgi_app", route_prefix="/api")
+    r = requests.get(_url("/api/hello"))
+    assert r.status_code == 200 and r.json() == {"hello": "world"}
+    assert r.headers["x-served-by"] == "asgi"
+    r = requests.post(_url("/api/echo"), data=b'{"x": 5}')
+    assert r.status_code == 201 and r.json() == {"x": 5}
+    r = requests.get(_url("/api/missing"))
+    assert r.status_code == 404
+    serve.delete("asgi_app")
+
+
+def test_response_duplicate_headers(serve_cluster):
+    """serve.Response with list-of-pairs headers preserves duplicates
+    (multiple Set-Cookie) end-to-end through the proxy."""
+    @serve.deployment
+    def cookies(request):
+        return serve.Response(
+            "ok", headers=[("Set-Cookie", "a=1"), ("Set-Cookie", "b=2"),
+                           ("X-One", "yes")])
+
+    serve.run(cookies.bind(), name="cookie_app", route_prefix="/ck")
+    r = requests.get(_url("/ck"))
+    assert r.status_code == 200 and r.text == "ok"
+    got = [v for k, v in r.raw.headers.items() if k == "Set-Cookie"]
+    assert got == ["a=1", "b=2"], got
+    assert r.headers["X-One"] == "yes"
+    serve.delete("cookie_app")
+
+
+def test_async_function_deployment(serve_cluster):
+    """async def function deployments resolve their coroutine and see
+    the request context."""
+    @serve.deployment
+    async def afn(request):
+        from ray_tpu.serve import get_request_context
+
+        return {"route": get_request_context().route,
+                "v": request.json()}
+
+    serve.run(afn.bind(), name="afn_app", route_prefix="/afn")
+    r = requests.post(_url("/afn"), json=7)
+    assert r.status_code == 200
+    assert r.json() == {"route": "/afn", "v": 7}
+    serve.delete("afn_app")
