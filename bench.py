@@ -123,6 +123,49 @@ def _probe_fused_flash_bwd() -> bool:
         return False
 
 
+def _autotune_flash_blocks(make_step, params, batch, warmup: int = 2,
+                           iters: int = 6):
+    """On-chip sweep of flash-attention block sizes: time the FULL train
+    step under each candidate and leave the winner as the module default
+    (the attention kernel is the known MFU limiter — BENCH_AUTOTUNE=0
+    skips, BENCH_BLOCKS="q,k" pins without sweeping). Each candidate
+    pays one recompile; a failing candidate scores 0 and is skipped."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import attention
+
+    pinned = os.environ.get("BENCH_BLOCKS")
+    if pinned:
+        bq, bk = (int(x) for x in pinned.split(","))
+        attention.set_default_blocks(bq, bk)
+        return (bq, bk)
+    configs = ((1024, 1024), (512, 1024), (1024, 512), (512, 512),
+               (256, 512))
+    orig = (attention.DEFAULT_BLOCK_Q, attention.DEFAULT_BLOCK_K)
+    best = (0.0, None)
+    for bq, bk in configs:
+        attention.set_default_blocks(bq, bk)
+        try:
+            step = make_step()
+            state = step.init_state(jax.tree.map(jnp.copy, params))
+            _, state, _ = _time_loop(step, state, batch, warmup)
+            dt, state, _ = _time_loop(step, state, batch, iters)
+            rate = iters / dt
+        except Exception as e:  # noqa: BLE001 — candidate failed
+            print(f"bench: blocks ({bq},{bk}) failed "
+                  f"({type(e).__name__}: {str(e)[:120]})", file=sys.stderr)
+            continue
+        print(f"bench: blocks ({bq},{bk}) -> {rate:.2f} steps/s",
+              file=sys.stderr)
+        if rate > best[0]:
+            best = (rate, (bq, bk))
+    # no winner (every candidate failed): restore the documented
+    # defaults rather than leaving the last-swept config installed
+    attention.set_default_blocks(*(best[1] or orig))
+    return best[1]
+
+
 def main() -> None:
     # The axon sitecustomize force-sets JAX_PLATFORMS, so the cpu
     # fallback must win through jax.config (same guard as tests/conftest):
@@ -158,12 +201,14 @@ def main() -> None:
     mesh = make_mesh(MeshConfig(dp=-1), devices=devices)
     n_chips = len(devices)
 
-    step = TrainStep(
-        lambda p, b: gpt2_loss(p, b["tokens"], b["targets"], cfg,
-                               remat=remat),
-        optax.adamw(3e-4, weight_decay=0.1), mesh,
-        gpt2_partition_specs(cfg))
-    state = step.init_state(gpt2_init(cfg, jax.random.PRNGKey(0)))
+    def make_step():
+        return TrainStep(
+            lambda p, b: gpt2_loss(p, b["tokens"], b["targets"], cfg,
+                                   remat=remat),
+            optax.adamw(3e-4, weight_decay=0.1), mesh,
+            gpt2_partition_specs(cfg))
+
+    params0 = gpt2_init(cfg, jax.random.PRNGKey(0))
 
     rng = np.random.default_rng(0)
     batch_np = rng.integers(
@@ -172,6 +217,13 @@ def main() -> None:
     batch = {"tokens": jnp.asarray(batch_np[:, :-1]),
              "targets": jnp.asarray(batch_np[:, 1:])}
     tokens_per_step = per_chip_batch * n_chips * seq
+
+    flash_blocks = None
+    if on_tpu and os.environ.get("BENCH_AUTOTUNE", "1") == "1":
+        flash_blocks = _autotune_flash_blocks(make_step, params0, batch)
+
+    step = make_step()
+    state = step.init_state(jax.tree.map(jnp.copy, params0))
 
     _, state, metrics = _time_loop(step, state, batch, warmup)
 
@@ -214,6 +266,7 @@ def main() -> None:
         "remat": remat,
         "n_chips": n_chips,
         "fused_flash_bwd": fused_bwd,
+        "flash_blocks": list(flash_blocks) if flash_blocks else None,
     }))
 
 

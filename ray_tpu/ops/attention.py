@@ -563,18 +563,34 @@ def _shapes_ok(q, k, block_q: int, block_k: int) -> bool:
             and (q.shape[-1] % 128 == 0 or q.shape[-1] == 64))
 
 
+def set_default_blocks(block_q: Optional[int] = None,
+                       block_k: Optional[int] = None) -> None:
+    """Runtime override of the default flash block sizes — calls that
+    did not pin block_q/block_k pick the new values up on their next
+    trace (autotuning hook; bench.py sweeps these on chip)."""
+    global DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    if block_q is not None:
+        DEFAULT_BLOCK_Q = int(block_q)
+    if block_k is not None:
+        DEFAULT_BLOCK_K = int(block_k)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None) -> jax.Array:
     """Fused attention. q,k,v: [batch, time, heads, head_dim] (kv time may
     differ). Pallas on TPU (fwd and bwd kernels); XLA reference elsewhere.
+    block_q/block_k default to the module-level (env/autotune-settable)
+    values at trace time.
     """
     return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    block_q = DEFAULT_BLOCK_Q if block_q is None else block_q
+    block_k = DEFAULT_BLOCK_K if block_k is None else block_k
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
     if _use_pallas() and _shapes_ok(q, k, block_q, block_k):
         out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
@@ -586,6 +602,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     q, k, v, o, lse = res
+    block_q = DEFAULT_BLOCK_Q if block_q is None else block_q
+    block_k = DEFAULT_BLOCK_K if block_k is None else block_k
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
     if lse is not None:
         return _flash_bwd_pallas(q, k, v, o, lse, g, causal, scale,

@@ -16,7 +16,7 @@ from typing import Any, Callable
 from .http_util import Request, Response
 
 
-def _build_scope(request: Request) -> dict:
+def _build_scope(request: Request, root_path: str = "") -> dict:
     path = request.path
     return {
         "type": "http",
@@ -26,7 +26,10 @@ def _build_scope(request: Request) -> dict:
         "scheme": "http",
         "path": path,
         "raw_path": path.encode(),
-        "root_path": "",
+        # the deployment's route prefix: frameworks route on
+        # path[len(root_path):], so @app.get("/hello") matches under
+        # route_prefix="/api" (reference sets root_path the same way)
+        "root_path": root_path,
         "query_string": request.query_string.encode(),
         "headers": [(k.lower().encode(), v.encode())
                     for k, v in request.headers.items()],
@@ -35,7 +38,8 @@ def _build_scope(request: Request) -> dict:
     }
 
 
-async def _run_asgi(app: Callable, request: Request) -> Response:
+async def _run_asgi(app: Callable, request: Request,
+                    root_path: str = "") -> Response:
     body_sent = False
 
     async def receive():
@@ -57,7 +61,7 @@ async def _run_asgi(app: Callable, request: Request) -> Response:
         elif message["type"] == "http.response.body":
             out["body"] += message.get("body", b"")
 
-    await app(_build_scope(request), receive, send)
+    await app(_build_scope(request, root_path), receive, send)
     headers = [(k.decode("latin-1"), v.decode("latin-1"))
                for k, v in out["headers"]]  # pairs: duplicates survive
     return Response(bytes(out["body"]), status=out["status"],
@@ -93,7 +97,12 @@ def ingress(asgi_app: Any) -> Callable[[type], type]:
 
         class ASGIIngressWrapper(cls):  # type: ignore[misc, valid-type]
             async def __call__(self, request: Request) -> Response:
-                return await _run_asgi(asgi_app, request)
+                from .context import get_request_context
+
+                prefix = get_request_context().route
+                root = "" if prefix in ("", "/") \
+                    else prefix.rstrip("/")
+                return await _run_asgi(asgi_app, request, root)
 
         ASGIIngressWrapper.__name__ = cls.__name__
         ASGIIngressWrapper.__qualname__ = getattr(cls, "__qualname__",

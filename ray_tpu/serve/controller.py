@@ -68,6 +68,7 @@ class ServeController:
         # one ProxyActor per alive node, reconciled with cluster topology)
         self._proxies: Dict[str, Any] = {}
         self._proxy_addrs: Dict[str, Tuple[str, int]] = {}
+        self._proxy_pending: set = set()
         self._last_topology_check = 0.0
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile")
@@ -120,15 +121,17 @@ class ServeController:
         for st in states:
             for tag, handle in st.replicas:
                 self._stop_replica(handle, st.config)
-        for actor in list(self._proxies.values()):
+        with self._lock:
+            doomed_proxies = list(self._proxies.values())
+            self._proxies.clear()
+            self._proxy_addrs.clear()
+            self._proxy = None
+        for actor in doomed_proxies:
             try:
                 ray_tpu.get(actor.graceful_shutdown.remote(), timeout=5.0)
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001 — proxy may already be gone
                 pass
-        self._proxies.clear()
-        self._proxy_addrs.clear()
-        self._proxy = None
 
     # -- introspection (state API / routers / proxy) ------------------------
     def get_replicas(self, app: str, deployment: str
@@ -186,7 +189,8 @@ class ServeController:
 
     def get_proxy_addresses(self) -> Dict[str, Tuple[str, int]]:
         """node_id -> bound (host, port) for every live proxy."""
-        return dict(self._proxy_addrs)
+        with self._lock:
+            return dict(self._proxy_addrs)
 
     def get_grpc_address(self):
         """('disabled', None) when no grpc_port was configured — lets
@@ -237,43 +241,74 @@ class ServeController:
             alive = [n for n in alive if n.get("head")]
         for n in alive:
             nid = n["node_id"]
-            if nid in self._proxies:
-                continue
-            is_head = nid == head_id
-            # non-head proxies bind wildcard (the head's configured host
-            # may not exist on that machine) and advertise their node's
-            # reachable address
-            node_host = (n.get("address") or [None])[0]
-            try:
-                actor = ray_tpu.remote(ProxyActor).options(
-                    name=f"{PROXY_NAME}:{nid}", max_concurrency=32,
-                    scheduling_strategy=NodeAffinitySchedulingStrategy(
-                        nid, soft=False)).remote(
-                    self._http_host if is_head else "0.0.0.0",
-                    self._http_port if is_head else 0,
-                    self._grpc_port if is_head else None,
-                    None if is_head else (node_host or self._http_host))
-                addr = tuple(ray_tpu.get(actor.ready.remote(),
-                                         timeout=60.0))
-            except Exception:  # noqa: BLE001 — node died mid-create;
-                continue       # next reconcile tick retries
-            self._proxies[nid] = actor
-            self._proxy_addrs[nid] = addr
-            if is_head:
-                self._proxy = actor
-                self._proxy_addr = addr
-                # The proxy skips ports already in use — report bound.
-                self._http_host, self._http_port = addr
-                if self._grpc_port is not None:
-                    ga = ray_tpu.get(actor.grpc_address.remote())
-                    self._grpc_addr = tuple(ga) if ga else None
+            with self._lock:
+                if nid in self._proxies or nid in self._proxy_pending:
+                    continue
+                self._proxy_pending.add(nid)
+            # proxy startup (actor create + ready wait) runs OFF the
+            # reconcile thread: a slow node must not stall replica
+            # health checks and autoscaling for every app
+            threading.Thread(
+                target=self._create_proxy,
+                args=(nid, nid == head_id,
+                      (n.get("address") or [None])[0]),
+                daemon=True, name=f"serve-proxy-create-{nid[:8]}").start()
         alive_ids = {n["node_id"] for n in alive}
-        for nid in [x for x in self._proxies if x not in alive_ids]:
-            actor = self._proxies.pop(nid)
-            self._proxy_addrs.pop(nid, None)
+        with self._lock:
+            dead = [(x, self._proxies.pop(x))
+                    for x in list(self._proxies) if x not in alive_ids]
+            for nid, _ in dead:
+                self._proxy_addrs.pop(nid, None)
+        for _, actor in dead:
             try:
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001 — died with its node
+                pass
+
+    def _create_proxy(self, nid: str, is_head: bool,
+                      node_host: Optional[str]) -> None:
+        import ray_tpu
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        from .proxy import ProxyActor
+
+        actor = None
+        try:
+            # non-head proxies bind wildcard (the head's configured host
+            # may not exist on that machine) and advertise their node's
+            # reachable address
+            actor = ray_tpu.remote(ProxyActor).options(
+                name=f"{PROXY_NAME}:{nid}", max_concurrency=32,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    nid, soft=False)).remote(
+                self._http_host if is_head else "0.0.0.0",
+                self._http_port if is_head else 0,
+                self._grpc_port if is_head else None,
+                None if is_head else (node_host or self._http_host))
+            addr = tuple(ray_tpu.get(actor.ready.remote(), timeout=60.0))
+            grpc_addr = None
+            if is_head and self._grpc_port is not None:
+                ga = ray_tpu.get(actor.grpc_address.remote())
+                grpc_addr = tuple(ga) if ga else None
+        except Exception:  # noqa: BLE001 — node died mid-create; a
+            actor = None   # later topology tick retries
+        finally:
+            with self._lock:
+                self._proxy_pending.discard(nid)
+                if actor is not None and not self._shutting_down:
+                    self._proxies[nid] = actor
+                    self._proxy_addrs[nid] = addr
+                    if is_head:
+                        self._proxy = actor
+                        self._proxy_addr = addr
+                        # the proxy skips busy ports — report the bound
+                        self._http_host, self._http_port = addr
+                        self._grpc_addr = grpc_addr
+                    actor = None
+        if actor is not None:  # shutdown raced the create: reap it
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
                 pass
 
     def _reconcile_once(self):

@@ -51,7 +51,7 @@ class Response:
         if headers is None:
             self.headers: List[Tuple[str, str]] = []
         elif isinstance(headers, dict):
-            self.headers = list(headers.items())
+            self.headers = [(str(k), str(v)) for k, v in headers.items()]
         else:
             self.headers = [(str(k), str(v)) for k, v in headers]
         if isinstance(body, str):

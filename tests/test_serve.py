@@ -253,6 +253,9 @@ def test_asgi_ingress(serve_cluster):
     status, headers, query strings, and request bodies intact."""
     async def asgi_app(scope, receive, send):
         assert scope["type"] == "http"
+        # route prefix arrives as root_path so frameworks can route on
+        # path[len(root_path):]
+        assert scope["root_path"] == "/api", scope["root_path"]
         msg = await receive()
         body = msg.get("body", b"")
         path = scope["path"]
