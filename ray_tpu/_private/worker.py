@@ -844,7 +844,11 @@ class Worker:
 
     def _record_event(self, spec: TaskSpec, t0: float, address,
                       status: str = "FINISHED") -> None:
-        ev = {"task_id": spec.task_id, "name": spec.name, "start": t0,
+        self._record_event_raw(spec.task_id, spec.name, t0, address, status)
+
+    def _record_event_raw(self, task_id: str, name: str, t0: float,
+                          address, status: str) -> None:
+        ev = {"task_id": task_id, "name": name, "start": t0,
               "end": time.time(),
               "worker": tuple(address) if address else None,
               "job_id": self.job_id, "status": status}
@@ -1053,6 +1057,8 @@ class Worker:
         from . import refcount
 
         arg_refs = refcount.collect_refs(args, kwargs)
+        t0 = time.time()
+        ev_name = f"{actor_id[:8]}.{method}"
         try:
             while True:
                 pending = client = None
@@ -1093,6 +1099,11 @@ class Worker:
                     if retries > 0:
                         retries -= 1
             self._record_results(return_ids, reply, holder=tuple(address))
+            # actor calls show up in the task timeline / actor
+            # drill-down like plain tasks (reference task events cover
+            # both NORMAL_TASK and ACTOR_TASK)
+            self._record_event_raw(return_ids[0], ev_name, t0,
+                                   tuple(address), "FINISHED")
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, RemoteError) and isinstance(e.cause,
                                                          exc.RayTpuError):
@@ -1109,6 +1120,10 @@ class Worker:
                 for oid in return_ids:
                     self._inflight.pop(oid, None)
             self._notify_object_waiters(return_ids)
+            self._record_event_raw(
+                return_ids[0], ev_name, t0, tuple(address),
+                "CANCELLED" if isinstance(err, exc.TaskCancelledError)
+                else "FAILED")
         finally:
             refcount.tracker.wire_decref(arg_refs)
 

@@ -14,6 +14,10 @@ Routes:
   /api/tasks             task-name summary table
   /api/timeline          chrome-trace JSON of task events
   /api/metrics           Prometheus exposition (text)
+  /api/serve             Serve apps/deployments/proxies (controller's
+                         KV-mirrored status)
+  /api/actors/{id}       actor drill-down (record, worker, recent task
+                         events, store stats)
 """
 from __future__ import annotations
 
@@ -100,6 +104,40 @@ class _ClusterData:
         return _render_prometheus(self.conductor.call("get_metrics",
                                                       timeout=5.0))
 
+    def serve_status(self) -> Dict[str, Any]:
+        """Serve apps/deployments/proxies, mirrored into the conductor
+        KV by the Serve controller's reconcile loop."""
+        status = self.conductor.call("kv_get", "serve:status", "serve",
+                                     timeout=5.0)
+        return status or {"applications": {}, "proxies": {}}
+
+    def actor_detail(self, actor_id: str) -> Dict[str, Any]:
+        """One actor's record + its worker + its recent task events —
+        the actors-table drill-down."""
+        actors = self.conductor.call("list_actors", timeout=5.0)
+        rec = next((a for a in actors if a.get("actor_id") == actor_id),
+                   None)
+        if rec is None:
+            return {"error": f"no actor {actor_id!r}"}
+        addr = tuple(rec["address"]) if rec.get("address") else None
+        workers = self.conductor.call("list_workers", timeout=5.0)
+        worker = next((w for w in workers if addr and w.get("address")
+                       and tuple(w["address"]) == addr), None)
+        events = self.conductor.call("get_task_events", 10_000,
+                                     timeout=10.0)
+        mine = [ev for ev in events
+                if addr and ev.get("worker")
+                and tuple(ev["worker"]) == addr][-100:]
+        store = None
+        if addr and worker is not None and worker.get("state") != "DEAD":
+            try:
+                store = self.pool.get(addr).call("store_stats",
+                                                 timeout=3.0)
+            except Exception:  # noqa: BLE001 — worker mid-restart
+                pass
+        return {"actor": rec, "worker": worker, "recent_tasks": mine,
+                "store": store}
+
 
 class DashboardServer:
     """aiohttp app on its own thread+loop — works beside a blocking
@@ -169,6 +207,18 @@ class DashboardServer:
                            self._json_route(
                                lambda: d.simple_args("get_recent_logs", 500)))
         app.router.add_get("/api/metrics", self._metrics)
+        app.router.add_get("/api/serve", self._json_route(d.serve_status))
+
+        async def actor_detail(request):
+            from aiohttp import web
+
+            try:
+                return web.json_response(await self._call(
+                    d.actor_detail, request.match_info["actor_id"]))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=503)
+
+        app.router.add_get("/api/actors/{actor_id}", actor_detail)
         return app
 
     def _run(self) -> None:

@@ -126,6 +126,15 @@ class ServeController:
             self._proxies.clear()
             self._proxy_addrs.clear()
             self._proxy = None
+        # the reconcile loop is gone: clear the KV mirror so the
+        # dashboard doesn't show the dead apps as RUNNING forever
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            worker_mod.global_worker.conductor.notify(
+                "kv_del", "serve:status", "serve")
+        except Exception:  # noqa: BLE001 — conductor may be gone too
+            pass
         for actor in doomed_proxies:
             try:
                 ray_tpu.get(actor.graceful_shutdown.remote(), timeout=5.0)
@@ -202,14 +211,34 @@ class ServeController:
 
     # -- reconciliation -----------------------------------------------------
     def _reconcile_loop(self):
+        last_publish = 0.0
         while not self._shutting_down:
             try:
                 self._ensure_proxy()
                 self._reconcile_once()
+                now = time.monotonic()
+                if now - last_publish > 2.0:
+                    last_publish = now
+                    self._publish_status()
             except Exception:  # noqa: BLE001 — keep the loop alive
                 import traceback
                 traceback.print_exc()
             time.sleep(0.25)
+
+    def _publish_status(self):
+        """Mirror serve status into the conductor KV so out-of-band
+        consumers (the dashboard) can render Serve apps without an
+        actor-call path into this controller."""
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        if w is None:
+            return
+        try:
+            w.conductor.notify("kv_put", "serve:status",
+                               self.get_serve_status(), True, "serve")
+        except Exception:  # noqa: BLE001 — conductor briefly away
+            pass
 
     def _ensure_proxy(self):
         """Reconcile the proxy fleet with cluster topology: one

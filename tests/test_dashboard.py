@@ -105,3 +105,65 @@ def test_logs_endpoint_carries_worker_prints(dashboard):
             return
         time.sleep(0.3)
     raise AssertionError("worker print never reached /api/logs")
+
+
+def test_actor_drilldown_and_serve_view(dashboard):
+    """/api/actors/{id} aggregates record+worker+events; /api/serve
+    mirrors the Serve controller's KV-published status."""
+    @ray_tpu.remote
+    class Counter:
+        def bump(self):
+            return 1
+
+    a = Counter.remote()
+    ray_tpu.get(a.bump.remote())
+    w = ray_tpu._private.worker.global_worker
+    w._flush_task_events()
+    actor_id = w.conductor.call("list_actors", timeout=5.0)[0]["actor_id"]
+
+    status, _, body = _get(dashboard.url + f"/api/actors/{actor_id}")
+    assert status == 200
+    d = json.loads(body)
+    assert d["actor"]["actor_id"] == actor_id
+    assert d["worker"] is not None
+    assert any(ev["name"].endswith(".bump")
+               for ev in d["recent_tasks"]), d["recent_tasks"]
+
+    status, _, body = _get(dashboard.url + "/api/actors/nope")
+    assert json.loads(body)["error"]
+
+    # serve view: empty before serve starts
+    status, _, body = _get(dashboard.url + "/api/serve")
+    assert status == 200 and json.loads(body)["applications"] == {}
+
+    from ray_tpu import serve
+
+    serve.start()
+    try:
+        @serve.deployment
+        def hello(request):
+            return "hi"
+
+        serve.run(hello.bind(), name="dash_app", route_prefix="/h")
+        deadline = time.monotonic() + 30.0
+        apps = {}
+        while time.monotonic() < deadline:
+            apps = json.loads(_get(dashboard.url + "/api/serve")[2]).get(
+                "applications", {})
+            if "dash_app" in apps and \
+                    apps["dash_app"]["status"] == "RUNNING":
+                break
+            time.sleep(0.5)
+        assert "dash_app" in apps, apps
+        assert "hello" in apps["dash_app"]["deployments"]
+    finally:
+        serve.shutdown()
+    # shutdown clears the KV mirror: no ghost RUNNING apps
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        apps = json.loads(_get(dashboard.url + "/api/serve")[2]).get(
+            "applications", {})
+        if not apps:
+            break
+        time.sleep(0.5)
+    assert apps == {}, apps
