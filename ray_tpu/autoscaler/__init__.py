@@ -35,6 +35,13 @@ class AutoscalerConfig:
     update_interval_s: float = 1.0
     # only scale for demand that has waited at least this long (debounce)
     min_demand_age_s: float = 0.0
+    # bootstrap watchdog (reference _private/updater.py NodeUpdater):
+    # a launched node must register with the conductor within this long
+    # or it is torn down and relaunched, up to max_bootstrap_retries;
+    # after that its node type backs off before any new launch
+    bootstrap_timeout_s: float = 300.0
+    max_bootstrap_retries: int = 2
+    bootstrap_backoff_s: float = 60.0
 
 
 class NodeProvider(ABC):
@@ -107,6 +114,16 @@ class _TrackedNode:
     idle_since: Optional[float] = None
 
 
+@dataclass
+class _PendingLaunch:
+    """A created-but-not-yet-registered node under the bootstrap
+    watchdog."""
+    node_type: str
+    resources: Dict[str, float]
+    launched_at: float
+    attempt: int = 0
+
+
 class StandardAutoscaler:
     """The reconcile loop — reference autoscaler.py:172 update():
     read demand → enforce min_workers → bin-pack unmet demand onto node
@@ -125,16 +142,55 @@ class StandardAutoscaler:
         # nodes we launched that haven't shown up in the cluster view yet —
         # their capacity must count as free or every reconcile round
         # re-launches for the same demand (the reference tracks pending
-        # launches for exactly this reason)
-        self._provisioning: Dict[str, Dict[str, float]] = {}
+        # launches for exactly this reason); each carries its bootstrap
+        # deadline/attempt for the watchdog
+        self._provisioning: Dict[str, _PendingLaunch] = {}
+        # node_type -> monotonic time before which no new launches
+        # (bootstrap repeatedly failed — stop the relaunch storm)
+        self._type_backoff: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _launch(self, type_name: str, resources: Dict[str, float]) -> str:
+    def _launch(self, type_name: str, resources: Dict[str, float],
+                attempt: int = 0) -> str:
         nid = self.provider.create_node(type_name, dict(resources))
         self._tracked.setdefault(nid, _TrackedNode(nid, type_name))
-        self._provisioning[nid] = dict(resources)
+        self._provisioning[nid] = _PendingLaunch(
+            type_name, dict(resources), time.monotonic(), attempt)
         return nid
+
+    def _launchable(self, type_name: str, now: float) -> bool:
+        return now >= self._type_backoff.get(type_name, 0.0)
+
+    def _bootstrap_watchdog(self, now: float, cluster_nodes) -> List[str]:
+        """Tear down nodes that never registered within
+        bootstrap_timeout_s; relaunch up to max_bootstrap_retries, then
+        back the node type off (reference updater.py: a NodeUpdater that
+        fails marks the node failed and the node is terminated)."""
+        failed: List[str] = []
+        for nid, p in list(self._provisioning.items()):
+            if nid in cluster_nodes:
+                # REGISTERING ends bootstrap — a later death is the
+                # failure-detection domain, and its capacity must not
+                # keep counting as provisioning-free
+                del self._provisioning[nid]
+                continue
+            if now - p.launched_at < self.config.bootstrap_timeout_s:
+                continue
+            try:
+                self.provider.terminate_node(nid)
+            except Exception:  # noqa: BLE001 — may not exist anymore
+                pass
+            del self._provisioning[nid]
+            self._tracked.pop(nid, None)
+            failed.append(nid)
+            if p.attempt < self.config.max_bootstrap_retries and \
+                    self._launchable(p.node_type, now):
+                self._launch(p.node_type, p.resources, p.attempt + 1)
+            else:
+                self._type_backoff[p.node_type] = \
+                    now + self.config.bootstrap_backoff_s
+        return failed
 
     # -- one reconcile round -------------------------------------------------
     def update(self) -> Dict[str, Any]:
@@ -153,25 +209,27 @@ class StandardAutoscaler:
         for nid in list(self._tracked):
             if nid not in provider_nodes:
                 del self._tracked[nid]
+        # provider forgot a node we thought was provisioning
+        for nid in list(self._provisioning):
+            if nid not in provider_nodes:
+                del self._provisioning[nid]
+
+        bootstrap_failed = self._bootstrap_watchdog(now, cluster_nodes)
 
         counts: Dict[str, int] = {t: 0 for t in self.config.node_types}
         for t in self._tracked.values():
             counts[t.node_type] = counts.get(t.node_type, 0) + 1
 
-        # nodes now visible in the cluster are no longer "provisioning"
-        for nid in list(self._provisioning):
-            if nid in cluster_nodes or nid not in provider_nodes:
-                del self._provisioning[nid]
-
         launched: List[str] = []
         free: List[Dict[str, float]] = [
             dict(n["available"]) for n in cluster_nodes.values()
             if n.get("alive")]
-        free += [dict(r) for r in self._provisioning.values()]
+        free += [dict(p.resources) for p in self._provisioning.values()]
 
-        # 1) enforce min_workers
+        # 1) enforce min_workers (respecting bootstrap backoff)
         for type_name, cfg in self.config.node_types.items():
-            while counts.get(type_name, 0) < cfg.min_workers:
+            while counts.get(type_name, 0) < cfg.min_workers and \
+                    self._launchable(type_name, now):
                 self._launch(type_name, cfg.resources)
                 counts[type_name] = counts.get(type_name, 0) + 1
                 launched.append(type_name)
@@ -190,6 +248,8 @@ class StandardAutoscaler:
         for req in unmet:
             for type_name, cfg in self.config.node_types.items():
                 if counts.get(type_name, 0) >= cfg.max_workers:
+                    continue
+                if not self._launchable(type_name, now):
                     continue
                 if _fits(dict(cfg.resources), req):
                     self._launch(type_name, cfg.resources)
@@ -224,7 +284,8 @@ class StandardAutoscaler:
                 self._provisioning.pop(nid, None)
                 terminated.append(nid)
         return {"pending_demand": len(demand), "launched": launched,
-                "terminated": terminated, "counts": counts}
+                "terminated": terminated, "counts": counts,
+                "bootstrap_failed": bootstrap_failed}
 
     # -- loop ----------------------------------------------------------------
     def start(self) -> "StandardAutoscaler":

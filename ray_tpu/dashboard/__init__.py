@@ -120,6 +120,9 @@ class _ClusterData:
         if rec is None:
             return {"error": f"no actor {actor_id!r}"}
         addr = tuple(rec["address"]) if rec.get("address") else None
+        if addr is None:  # PENDING/DEAD actor: nothing to join against
+            return {"actor": rec, "worker": None, "recent_tasks": [],
+                    "store": None}
         workers = self.conductor.call("list_workers", timeout=5.0)
         worker = next((w for w in workers if addr and w.get("address")
                        and tuple(w["address"]) == addr), None)

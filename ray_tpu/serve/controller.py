@@ -126,8 +126,10 @@ class ServeController:
             self._proxies.clear()
             self._proxy_addrs.clear()
             self._proxy = None
-        # the reconcile loop is gone: clear the KV mirror so the
-        # dashboard doesn't show the dead apps as RUNNING forever
+        # join the reconcile thread BEFORE clearing the KV mirror: an
+        # in-flight _publish_status must not re-publish ghost status
+        # after the delete (nothing would ever overwrite it again)
+        self._reconcile_thread.join(timeout=10.0)
         try:
             from ray_tpu._private import worker as worker_mod
 

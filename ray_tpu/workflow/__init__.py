@@ -20,10 +20,15 @@ from ray_tpu.dag.dag_node import (ClassMethodNode, DAGNode, FunctionNode,
                                   MultiOutputNode)
 
 from . import storage as _storage
+from .events import (EventListener, HTTPListener,  # noqa: F401
+                     TimerListener, get_event, http_event_provider,
+                     wait_for_event)
 from .storage import WorkflowStorage, delete_workflow, list_workflow_ids
 
 __all__ = ["run", "run_async", "resume", "resume_async", "get_status",
-           "get_output", "list_all", "cancel", "delete", "WorkflowStatus"]
+           "get_output", "list_all", "cancel", "delete", "WorkflowStatus",
+           "EventListener", "TimerListener", "HTTPListener",
+           "wait_for_event", "http_event_provider", "get_event"]
 
 
 class WorkflowStatus:
@@ -49,6 +54,17 @@ def _step_key(node: DAGNode, index: int) -> str:
     return f"{index:04d}_{name}"
 
 
+def _cancel_refs(pending) -> None:
+    """Cooperatively cancel every submitted-but-unconsumed step."""
+    import ray_tpu
+
+    for _node, _key, ref in pending:
+        try:
+            ray_tpu.cancel(ref)
+        except Exception:  # noqa: BLE001 — may already be done
+            pass
+
+
 def _execute_workflow(workflow_id: str, store: WorkflowStorage) -> Any:
     """Run (or finish) the stored DAG, checkpointing each step."""
     import ray_tpu
@@ -72,6 +88,7 @@ def _execute_workflow(workflow_id: str, store: WorkflowStorage) -> Any:
             if cancel.is_set():
                 store.update_meta(status=WorkflowStatus.CANCELED,
                                   finished=time.time())
+                _cancel_refs(pending)
                 raise RuntimeError(f"workflow {workflow_id} canceled")
             key = keys[node._id]
             if isinstance(node, (InputNode, InputAttributeNode,
@@ -85,15 +102,34 @@ def _execute_workflow(workflow_id: str, store: WorkflowStorage) -> Any:
                 continue
             ref = node._execute_impl(resolved, run_args, run_kwargs)
             resolved[node._id] = ref
-            pending.append((node._id, key, ref))
-        for node_id, key, ref in pending:
-            if cancel.is_set():
-                store.update_meta(status=WorkflowStatus.CANCELED,
-                                  finished=time.time())
-                raise RuntimeError(f"workflow {workflow_id} canceled")
-            value = ray_tpu.get(ref)
+            pending.append((node, key, ref))
+        for node, key, ref in pending:
+            # bounded waits so a cancel interrupts even a step that will
+            # never finish (e.g. wait_for_event with no event coming) —
+            # and the in-flight tasks are cooperatively cancelled so
+            # they stop occupying workers
+            while True:
+                if cancel.is_set():
+                    store.update_meta(status=WorkflowStatus.CANCELED,
+                                      finished=time.time())
+                    _cancel_refs(pending)
+                    raise RuntimeError(f"workflow {workflow_id} canceled")
+                try:
+                    value = ray_tpu.get(ref, timeout=1.0)
+                    break
+                except ray_tpu.exceptions.GetTimeoutError:
+                    continue
             store.save_step(key, value)
-            resolved[node_id] = value
+            resolved[node._id] = value
+            listener_cls = getattr(node, "_wf_event_listener", None)
+            if listener_cls is not None:
+                # the event is durably recorded: let the provider drop
+                # its copy (exactly-once into the workflow — see
+                # events.py module docstring)
+                try:
+                    listener_cls().event_checkpointed(value)
+                except Exception:  # noqa: BLE001 — commit hook is
+                    pass           # best-effort; re-delivery is benign
         output = resolved[dag._id]
         if isinstance(output, list):  # MultiOutputNode members
             output = [resolved[n._id] for n in dag._outputs] \

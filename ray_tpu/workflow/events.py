@@ -1,0 +1,133 @@
+"""Workflow event integration — wait_for_event + event providers.
+
+Reference: python/ray/workflow/event_listener.py (EventListener,
+TimerListener) and workflow/http_event_provider.py (HTTPEventProvider, a
+Serve deployment external systems POST events to, + HTTPListener). The
+same contract here: a `wait_for_event(ListenerType, ...)` DAG node polls
+the listener inside a durable step; after the step's result is
+CHECKPOINTED the executor calls `event_checkpointed(event)` so the
+provider may discard its copy — exactly-once delivery into the workflow
+(crash before checkpoint → the event is still held and re-polled;
+crash after → resume skips the step entirely).
+
+The HTTP provider stores events in the conductor KV (namespace
+"workflow_events"), so listeners poll one RPC, not the Serve replica.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Tuple
+
+from ..dag import FunctionNode
+
+_KV_NAMESPACE = "workflow_events"
+
+
+class EventListener:
+    """Subclass with `poll_for_event(*args, **kwargs) -> event` (block
+    until available) and optionally `event_checkpointed(event)` (called
+    once the workflow has durably recorded it)."""
+
+    def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+    def event_checkpointed(self, event: Any) -> None:  # noqa: B027
+        """Post-checkpoint commit hook; default: nothing to release."""
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix timestamp (reference TimerListener)."""
+
+    def poll_for_event(self, timestamp: float) -> float:
+        time.sleep(max(0.0, timestamp - time.time()))
+        return timestamp
+
+
+def _kv(method: str, *args):
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("workflow events need ray_tpu.init()")
+    return w.conductor.call(method, *args, timeout=10.0)
+
+
+class HTTPListener(EventListener):
+    """Waits for an event POSTed to the HTTPEventProvider under
+    `event_key` (reference http_event_provider.py HTTPListener)."""
+
+    poll_interval_s = 0.2
+
+    def poll_for_event(self, event_key: str) -> Tuple[str, Any]:
+        while True:
+            msg = _kv("kv_get", f"event:{event_key}", _KV_NAMESPACE)
+            if msg is not None:
+                return (event_key, msg)
+            time.sleep(self.poll_interval_s)
+
+    def event_checkpointed(self, event: Tuple[str, Any]) -> None:
+        _kv("kv_del", f"event:{event[0]}", _KV_NAMESPACE)
+
+
+def wait_for_event(listener_type: type, *args, **kwargs) -> FunctionNode:
+    """A DAG node that resolves to the listener's event (reference
+    workflow/api.py:607 wait_for_event). Compose it like any other bound
+    step:
+
+        event = wait_for_event(HTTPListener, event_key="approved")
+        result = decide.bind(event)
+        workflow.run(result)
+    """
+    if not (isinstance(listener_type, type)
+            and issubclass(listener_type, EventListener)):
+        raise TypeError(f"{listener_type!r} is not an EventListener "
+                        "subclass")
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _poll_event(*a, **kw):
+        return listener_type().poll_for_event(*a, **kw)
+
+    # stable step identity across resumes (_step_key reads __name__)
+    _poll_event.__name__ = f"event_{listener_type.__name__}"
+    node = FunctionNode(_poll_event, args, kwargs)
+    node._wf_event_listener = listener_type
+    return node
+
+
+def http_event_provider():
+    """The Serve deployment external systems POST events to (reference
+    HTTPEventProvider — bind and `serve.run` it):
+
+        serve.run(http_event_provider().bind(),
+                  name="event_provider", route_prefix="/event")
+
+    POST {"event_key": "...", "event_payload": ...} to /event/send_event;
+    the provider stores the payload for the matching HTTPListener and
+    replies 200. Replays before the workflow checkpoints overwrite the
+    stored copy (same at-least-once ingest as the reference)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class HTTPEventProvider:
+        def __call__(self, request):
+            if not request.path.rstrip("/").endswith("send_event"):
+                return (404, {"error": "POST to <prefix>/send_event"})
+            body = request.json()
+            key = body.get("event_key")
+            if not key:
+                return (400, {"error": "missing event_key"})
+            _kv("kv_put", f"event:{key}", body.get("event_payload"),
+                True, _KV_NAMESPACE)
+            return {"status": "ok", "event_key": key}
+
+    return HTTPEventProvider
+
+
+def get_event(event_key: str) -> Optional[Any]:
+    """Peek at a stored, not-yet-consumed event (debugging aid)."""
+    return _kv("kv_get", f"event:{event_key}", _KV_NAMESPACE)
+
+
+__all__ = ["EventListener", "TimerListener", "HTTPListener",
+           "wait_for_event", "http_event_provider", "get_event"]

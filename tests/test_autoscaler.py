@@ -128,3 +128,83 @@ def test_background_loop(cluster):
         assert provider.non_terminated_nodes()
     finally:
         scaler.stop()
+
+
+class _HalfBootProvider(FakeNodeProvider):
+    """Creates nodes that NEVER register with the conductor — the
+    half-bootstrapped failure the watchdog exists for."""
+
+    def __init__(self, conductor_client=None):
+        super().__init__(conductor_client)
+        self.terminated = []
+
+    def create_node(self, node_type, resources):
+        import uuid as _uuid
+
+        node_id = f"halfboot_{_uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._nodes[node_id] = {"node_id": node_id,
+                                    "node_type": node_type,
+                                    "resources": dict(resources)}
+        return node_id  # deliberately no conductor registration
+
+    def terminate_node(self, node_id):
+        self.terminated.append(node_id)
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+
+def test_bootstrap_watchdog_retries_and_backs_off(cluster):
+    """A node that never becomes ready is torn down and relaunched up to
+    max_bootstrap_retries; then the node type backs off (reference
+    _private/updater.py lifecycle)."""
+    import time as _time
+
+    provider = _HalfBootProvider()
+    asc = StandardAutoscaler(
+        AutoscalerConfig(
+            node_types={"slice": NodeTypeConfig({"CPU": 4.0},
+                                                min_workers=1)},
+            bootstrap_timeout_s=0.4, max_bootstrap_retries=1,
+            bootstrap_backoff_s=5.0),
+        provider)
+
+    r = asc.update()               # launch attempt 0
+    assert r["counts"]["slice"] == 1 and not r["bootstrap_failed"]
+    _time.sleep(0.5)
+    r = asc.update()               # attempt 0 failed -> relaunch (1)
+    assert len(r["bootstrap_failed"]) == 1
+    assert len(provider.terminated) == 1
+    assert len(provider.non_terminated_nodes()) == 1  # the retry
+    _time.sleep(0.5)
+    r = asc.update()               # attempt 1 failed -> backoff, no new
+    assert len(provider.terminated) == 2
+    assert provider.non_terminated_nodes() == []
+    assert r["counts"]["slice"] == 0
+    r = asc.update()               # still backing off: no launch storm
+    assert provider.non_terminated_nodes() == []
+    # after the backoff expires, min_workers enforcement resumes
+    asc._type_backoff["slice"] = 0.0
+    r = asc.update()
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_bootstrap_success_clears_watchdog(cluster):
+    """A node that registers in time leaves the provisioning set and is
+    never torn down."""
+    provider = FakeNodeProvider()
+    asc = StandardAutoscaler(
+        AutoscalerConfig(
+            node_types={"slice": NodeTypeConfig({"CPU": 2.0},
+                                                min_workers=1)},
+            bootstrap_timeout_s=0.2, max_bootstrap_retries=0),
+        provider)
+    asc.update()
+    import time as _time
+
+    _time.sleep(0.3)
+    r = asc.update()  # registered instantly: watchdog must not fire
+    assert r["bootstrap_failed"] == []
+    assert asc._provisioning == {}
+    assert len(provider.non_terminated_nodes()) == 1
+    provider.terminate_node(provider.non_terminated_nodes()[0]["node_id"])

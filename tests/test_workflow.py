@@ -145,3 +145,81 @@ def test_kwargs_input(cluster):
     with InputNode() as inp:
         dag = add.bind(inp.x, inp.y)
     assert workflow.run(dag, x=2, y=3, workflow_id="wf_kw") == 5
+
+
+def test_timer_event(cluster):
+    """wait_for_event(TimerListener, t): the workflow blocks until the
+    timestamp then proceeds (reference event_listener.py TimerListener)."""
+    import time
+
+    fire_at = time.time() + 1.0
+    ev = workflow.wait_for_event(workflow.TimerListener, fire_at)
+    out = workflow.run(double.bind(ev), workflow_id="wf_timer")
+    assert out == fire_at * 2
+    assert time.time() >= fire_at
+
+
+def test_http_event_provider_end_to_end(cluster):
+    """External POST -> HTTPEventProvider -> KV -> HTTPListener inside a
+    durable step; the provider's copy is dropped once checkpointed
+    (reference workflow/http_event_provider.py)."""
+    import json
+    import time
+    import urllib.request
+
+    from ray_tpu import serve
+
+    serve.start()
+    try:
+        serve.run(workflow.http_event_provider().bind(),
+                  name="event_provider", route_prefix="/event")
+        ev = workflow.wait_for_event(workflow.HTTPListener,
+                                     event_key="approval")
+        fut = workflow.run_async(double.bind(ev),
+                                 workflow_id="wf_http_event")
+        time.sleep(1.0)  # listener is polling; no event yet
+        assert not fut.done()
+
+        host, port = serve.proxy_address()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/event/send_event",
+            data=json.dumps({"event_key": "approval",
+                             "event_payload": 21}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+
+        out = fut.result(timeout=30)
+        # the event resolves to (key, payload); double(tuple) concatenates
+        assert out == ("approval", 21, "approval", 21), out
+        # checkpointed -> the provider's stored copy is gone
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                workflow.get_event("approval") is not None:
+            time.sleep(0.2)
+        assert workflow.get_event("approval") is None
+    finally:
+        serve.shutdown()
+
+
+def test_cancel_interrupts_event_wait(cluster):
+    """cancel() must interrupt a workflow parked on an event that never
+    arrives AND cooperatively stop the polling step so it frees its
+    worker (events.py + bounded executor waits)."""
+    import time
+
+    ev = workflow.wait_for_event(workflow.HTTPListener,
+                                 event_key="never_comes")
+    fut = workflow.run_async(double.bind(ev), workflow_id="wf_cancelled")
+    time.sleep(0.8)
+    assert not fut.done()
+    t0 = time.monotonic()
+    workflow.cancel("wf_cancelled")
+    with pytest.raises(Exception):
+        fut.result(timeout=30)
+    assert time.monotonic() - t0 < 10.0
+    assert workflow.get_status("wf_cancelled") == \
+        workflow.WorkflowStatus.CANCELED
+    # the poller was cancelled, not orphaned: the cluster still has
+    # capacity for fresh work
+    assert ray_tpu.get(add.remote(1, 2), timeout=30.0) == 3
