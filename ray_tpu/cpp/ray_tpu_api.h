@@ -1,0 +1,60 @@
+/* ray_tpu C++ runtime API — put/get/submit from native tasks.
+ *
+ * Reference analog: the C++ worker API's driver surface
+ * (/root/reference/cpp/include/ray/api.h: ray::Put, ray::Get,
+ * ray::Task(...).Remote()). The v1 bytes ABI (ray_tpu_task.h) keeps
+ * native code pure-compute; this v2 ABI hands the task a table of
+ * runtime entry points so C++ can hold object refs, create objects,
+ * and fan out subtasks — without linking against the framework: the
+ * hosting worker passes the table in, every pointer lives only for the
+ * duration of the call.
+ *
+ * v2 contract — export with C linkage:
+ *
+ *     extern "C" int64_t my_task(const ray_tpu_api_t* api,
+ *                                const uint8_t* in, size_t in_len,
+ *                                uint8_t** out, size_t* out_len);
+ *
+ * Object ids are opaque NUL-terminated hex strings (up to 64 chars);
+ * treat them as strings, never fixed-width — id buffers must be at
+ * least RAY_TPU_OBJECT_ID_BUF bytes. All entry points return 0 on
+ * success. get()'s timeout_s: negative blocks forever, 0 polls
+ * (returns 11/EAGAIN when not ready), positive bounds the wait. Ids
+ * minted by put()/submit() are pinned in the hosting worker until
+ * release() — release what you mint, or the objects live until the
+ * worker exits.
+ *
+ * Run:  f = ray_tpu.util.cpp.cpp_function(lib, sym, api=True)
+ */
+#ifndef RAY_TPU_API_H_
+#define RAY_TPU_API_H_
+
+#include "ray_tpu_task.h"
+
+#define RAY_TPU_OBJECT_ID_BUF 65
+
+typedef struct ray_tpu_api {
+  void* ctx; /* pass as the first argument to every entry point */
+
+  /* Store `len` bytes as a cluster object owned by this worker;
+   * writes the object id into id_out (RAY_TPU_OBJECT_ID_BUF bytes). */
+  int64_t (*put)(void* ctx, const uint8_t* data, size_t len,
+                 char* id_out);
+
+  /* Fetch an object's bytes (ids minted by this API). On success *out
+   * is a malloc'd buffer of *out_len bytes — free with free_buf. */
+  int64_t (*get)(void* ctx, const char* object_id, double timeout_s,
+                 uint8_t** out, size_t* out_len);
+
+  /* Submit another v2 symbol from the SAME library as a cluster task;
+   * writes the result object id into id_out. */
+  int64_t (*submit)(void* ctx, const char* symbol, const uint8_t* arg,
+                    size_t arg_len, char* id_out);
+
+  /* Drop this worker's pin on an id from put()/submit(). */
+  int64_t (*release)(void* ctx, const char* object_id);
+
+  void (*free_buf)(uint8_t* p);
+} ray_tpu_api_t;
+
+#endif  /* RAY_TPU_API_H_ */

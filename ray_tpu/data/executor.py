@@ -63,6 +63,10 @@ def _count_rows(block: Block) -> int:
     return block.num_rows
 
 
+def _block_info(block: Block) -> Tuple[int, int]:
+    return (BlockAccessor(block).num_rows(), int(block.nbytes))
+
+
 def _slice_block(block: Block, start: int, end: int) -> Block:
     return BlockAccessor(block).slice(start, end)
 
@@ -117,10 +121,21 @@ class StreamingExecutor:
     """Executes a fused stage list, yielding output block refs in order."""
 
     def __init__(self, stages: List[Any], *, max_in_flight: int = 8,
-                 default_shuffle_blocks: int = 8):
+                 default_shuffle_blocks: int = 8,
+                 target_block_size: Optional[int] = None):
         self.stages = stages
         self.max_in_flight = max_in_flight
         self.default_shuffle_blocks = default_shuffle_blocks
+        # reference DataContext.target_max_block_size (128MB default):
+        # source/map outputs larger than this split into row ranges so
+        # one fat file/UDF can't monopolize downstream task memory
+        if target_block_size is None:
+            from .block import TARGET_MAX_BLOCK_SIZE
+
+            target_block_size = int(os.environ.get(
+                "RAY_TPU_DATA_TARGET_BLOCK_SIZE",
+                str(TARGET_MAX_BLOCK_SIZE)))
+        self.target_block_size = target_block_size
 
     def run(self) -> Iterator[Any]:
         """Yields ObjectRefs of output blocks."""
@@ -137,9 +152,9 @@ class StreamingExecutor:
         if isinstance(stage, P.Union):
             return self._run_union(stage)
         if isinstance(stage, P.Read):
-            return self._run_source(stage)
+            return self._resized(self._run_source(stage))
         if isinstance(stage, P.FusedStage):
-            return self._run_map(stage, upstream)
+            return self._resized(self._run_map(stage, upstream))
         if isinstance(stage, P.Repartition):
             return self._run_shuffle(upstream, stage.num_blocks, "even",
                                      None, None, None, None)
@@ -159,7 +174,8 @@ class StreamingExecutor:
     def _run_union(self, union: P.Union) -> Iterator[Any]:
         for branch in union.branches:
             yield from execute(list(branch),
-                               max_in_flight=self.max_in_flight)
+                               max_in_flight=self.max_in_flight,
+                               target_block_size=self.target_block_size)
 
     def _run_source(self, read: P.Read) -> Iterator[Any]:
         task = _remote(_run_read_task)
@@ -237,6 +253,34 @@ class StreamingExecutor:
                         ray_tpu.kill(a)
                     except Exception:  # noqa: BLE001
                         pass
+
+    def _resized(self, upstream: Iterator[Any]) -> Iterator[Any]:
+        """Split oversized output blocks into ~target_block_size row
+        ranges (reference _internal/output_buffer.py BlockOutputBuffer,
+        which splits inside the producing task via dynamic returns — a
+        mechanism this runtime lacks, so the split runs as follow-up
+        tasks). That stays cheap HERE because blocks over the shm
+        threshold are zero-copy mappings on the holder's host and
+        locality-aware leasing steers the probe/slice tasks to that
+        node: no wire re-transfer of the fat block, just in-memory
+        arrow slicing. The per-block probe get() is a tiny message."""
+        if not self.target_block_size:
+            yield from upstream
+            return
+        import ray_tpu
+
+        info = _remote(_block_info)
+        sl = _remote(_slice_block)
+        for ref in upstream:
+            rows, nbytes = ray_tpu.get(info.remote(ref))
+            if nbytes <= self.target_block_size or rows <= 1:
+                yield ref
+                continue
+            k = min(rows, -(-nbytes // self.target_block_size))
+            cuts = np.linspace(0, rows, k + 1).astype(int)
+            for a, b in zip(cuts, cuts[1:]):
+                if b > a:
+                    yield sl.remote(ref, int(a), int(b))
 
     def _windowed(self, submissions: Iterator[Any],
                   window: int) -> Iterator[Any]:

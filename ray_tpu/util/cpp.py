@@ -16,6 +16,12 @@ coupling to the framework.
 ``cpp_actor`` wraps a library as an actor class whose methods are the
 exported symbols — native state lives behind the ABI on the C++ side
 (opaque handle returned by an init symbol).
+
+``cpp_function(lib, sym, api=True)`` selects the v2 ABI
+(``ray_tpu/cpp/ray_tpu_api.h``): the task receives a table of runtime
+entry points — put/get/submit/release — mirroring the reference C++
+driver surface (cpp/include/ray/api.h ray::Put/Get/Task().Remote()), so
+native code can create cluster objects and fan out subtasks.
 """
 from __future__ import annotations
 
@@ -45,20 +51,27 @@ def _load(lib_path: str) -> ctypes.CDLL:
     return lib
 
 
-def _call_native(lib_path: str, symbol: str, payload: bytes) -> bytes:
-    """Executor-side: dlopen (cached) + call the bytes ABI."""
+def _invoke_native(lib_path: str, symbol: str, payload: bytes,
+                   api: Optional[Any] = None) -> bytes:
+    """Executor-side: dlopen (cached) + call the bytes ABI; with `api`,
+    the v2 form that passes the runtime table first."""
     lib = _load(lib_path)
     fn = getattr(lib, symbol)
     fn.restype = ctypes.c_int64
-    fn.argtypes = [ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
-                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-                   ctypes.POINTER(ctypes.c_size_t)]
+    base = [ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t)]
+    fn.argtypes = ([ctypes.POINTER(_ApiStruct)] + base) \
+        if api is not None else base
     buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
         if payload else (ctypes.c_uint8 * 1)()
     out_ptr = ctypes.POINTER(ctypes.c_uint8)()
     out_len = ctypes.c_size_t(0)
-    rc = fn(buf, len(payload), ctypes.byref(out_ptr),
-            ctypes.byref(out_len))
+    args = [buf, len(payload), ctypes.byref(out_ptr),
+            ctypes.byref(out_len)]
+    if api is not None:
+        args.insert(0, ctypes.byref(api))
+    rc = fn(*args)
     if rc != 0:
         raise RuntimeError(
             f"native task {symbol} in {os.path.basename(lib_path)} "
@@ -68,20 +81,141 @@ def _call_native(lib_path: str, symbol: str, payload: bytes) -> bytes:
             if out_ptr else b""
     finally:
         if out_ptr:
-            libc = ctypes.CDLL(None)
-            libc.free(out_ptr)
+            ctypes.CDLL(None).free(out_ptr)
 
 
-def cpp_function(lib_path: str, symbol: str, **remote_options: Any):
+def _call_native(lib_path: str, symbol: str, payload: bytes) -> bytes:
+    return _invoke_native(lib_path, symbol, payload)
+
+
+# ---------------------------------------------------------------- v2 API
+# (ray_tpu_api.h: put/get/submit/release handed to native tasks —
+# reference cpp/include/ray/api.h ray::Put/Get/Task().Remote())
+
+_PUT_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
+                          ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                          ctypes.c_void_p)
+_GET_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
+                          ctypes.c_char_p, ctypes.c_double,
+                          ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                          ctypes.POINTER(ctypes.c_size_t))
+_SUBMIT_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
+                             ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_uint8),
+                             ctypes.c_size_t, ctypes.c_void_p)
+_RELEASE_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
+                              ctypes.c_char_p)
+_FREE_T = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_uint8))
+
+
+class _ApiStruct(ctypes.Structure):
+    _fields_ = [("ctx", ctypes.c_void_p), ("put", _PUT_T),
+                ("get", _GET_T), ("submit", _SUBMIT_T),
+                ("release", _RELEASE_T), ("free_buf", _FREE_T)]
+
+
+# id -> ObjectRef pins for objects minted through the native API (per
+# worker process; released via api->release or at process exit)
+_API_REFS: Dict[str, Any] = {}
+_API_STRUCTS: Dict[str, Any] = {}  # lib_path -> (_ApiStruct, callbacks)
+
+
+def _libc():
+    lib = ctypes.CDLL(None)
+    lib.malloc.restype = ctypes.c_void_p
+    lib.malloc.argtypes = [ctypes.c_size_t]
+    lib.free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _write_id(id_out, ref_id: str) -> None:
+    ctypes.memmove(id_out, ref_id.encode() + b"\0", len(ref_id) + 1)
+
+
+def _make_api(lib_path: str) -> "_ApiStruct":
+    """Per-library API table; closures bridge into the hosting worker.
+    Exceptions never cross the C boundary — they map to error codes."""
+    cached = _API_STRUCTS.get(lib_path)
+    if cached is not None:
+        return cached[0]
+    libc = _libc()
+
+    def _put(ctx, data, length, id_out):
+        try:
+            ref = ray_tpu.put(ctypes.string_at(data, length))
+            _API_REFS[ref.id] = ref
+            _write_id(id_out, ref.id)
+            return 0
+        except Exception:  # noqa: BLE001 — code, not unwinding into C
+            return 5  # EIO
+
+    def _get(ctx, object_id, timeout_s, out, out_len):
+        try:
+            ref = _API_REFS.get(object_id.decode())
+            if ref is None:
+                return 2  # ENOENT — not an id minted by this API
+            # timeout semantics (documented in ray_tpu_api.h): < 0
+            # blocks forever, 0 polls, > 0 bounds the wait
+            timeout = None if timeout_s < 0 else timeout_s
+            try:
+                value = ray_tpu.get(ref, timeout=timeout)
+            except ray_tpu.exceptions.GetTimeoutError:
+                return 11  # EAGAIN — not ready within timeout
+            if not isinstance(value, (bytes, bytearray)):
+                return 22  # EINVAL — non-bytes object
+            buf = libc.malloc(len(value))
+            if not buf:
+                return 12  # ENOMEM
+            ctypes.memmove(buf, bytes(value), len(value))
+            out[0] = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+            out_len[0] = len(value)
+            return 0
+        except Exception:  # noqa: BLE001
+            return 5
+
+    def _submit(ctx, symbol, arg, arg_len, id_out):
+        try:
+            f = cpp_function(lib_path, symbol.decode(), api=True)
+            ref = f.remote(ctypes.string_at(arg, arg_len))
+            _API_REFS[ref.id] = ref
+            _write_id(id_out, ref.id)
+            return 0
+        except Exception:  # noqa: BLE001
+            return 5
+
+    def _release(ctx, object_id):
+        return 0 if _API_REFS.pop(object_id.decode(), None) \
+            is not None else 2
+
+    def _free(p):
+        libc.free(ctypes.cast(p, ctypes.c_void_p))
+
+    cbs = (_PUT_T(_put), _GET_T(_get), _SUBMIT_T(_submit),
+           _RELEASE_T(_release), _FREE_T(_free))
+    api = _ApiStruct(None, *cbs)
+    _API_STRUCTS[lib_path] = (api, cbs)  # keep callbacks alive
+    return api
+
+
+def _call_native_api(lib_path: str, symbol: str, payload: bytes) -> bytes:
+    return _invoke_native(lib_path, symbol, payload, _make_api(lib_path))
+
+
+def cpp_function(lib_path: str, symbol: str, api: bool = False,
+                 **remote_options: Any):
     """A remote function executing `symbol` from `lib_path` on a worker
-    (bytes in, bytes out). The library path must be reachable on worker
-    hosts — stage it via runtime_env working_dir for multi-host."""
+    (bytes in, bytes out). With api=True the symbol uses the v2 ABI
+    (ray_tpu_api.h) and receives put/get/submit/release entry points.
+    The library path must be reachable on worker hosts — stage it via
+    runtime_env working_dir for multi-host."""
     lib_path = os.path.abspath(lib_path)
 
-    def task(payload: bytes = b"", *, _lib=lib_path, _sym=symbol) -> bytes:
-        from ray_tpu.util.cpp import _call_native
+    def task(payload: bytes = b"", *, _lib=lib_path, _sym=symbol,
+             _api=api) -> bytes:
+        from ray_tpu.util import cpp as _cpp
 
-        return _call_native(_lib, _sym, bytes(payload))
+        call = _cpp._call_native_api if _api else _cpp._call_native
+        return call(_lib, _sym, bytes(payload))
 
     task.__name__ = f"cpp:{symbol}"
     rf = ray_tpu.remote(task)

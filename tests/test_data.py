@@ -299,3 +299,33 @@ def test_groupby_distributed_high_cardinality():
         expect[i % 7] = expect.get(i % 7, 0) + i
     got = {r["k"]: r["sum(v)"] for r in out}
     assert got == expect
+
+
+def test_target_block_size_splitting():
+    """Oversized map/source outputs split into ~target-size row ranges
+    (reference DataContext.target_max_block_size); in-target blocks pass
+    through untouched."""
+    from ray_tpu.data.executor import StreamingExecutor
+    from ray_tpu.data import plan as P
+
+    # one fat block: 1000 rows x ~4KB = ~4MB, target 1MB -> ~4 splits
+    ds = rd.range(1000, parallelism=1).map_batches(
+        lambda b: {"id": b["id"],
+                   "pad": np.zeros((len(b["id"]), 1024), np.float32)})
+    ex = StreamingExecutor(P.fuse(ds._ops), target_block_size=1 << 20)
+    refs = list(ex.run())
+    assert len(refs) >= 4, len(refs)
+    rows = [ray_tpu.get(r).num_rows for r in refs]
+    assert sum(rows) == 1000
+    assert max(rows) < 1000  # actually split
+    # ordering preserved across the splits
+    first = ray_tpu.get(refs[0])
+    import pyarrow as pa
+
+    ids = first.column("id").to_pylist()
+    assert ids == list(range(len(ids)))
+
+    # small blocks: no splitting, same refs flow through
+    ds2 = rd.range(100, parallelism=4)
+    ex2 = StreamingExecutor(P.fuse(ds2._ops), target_block_size=1 << 20)
+    assert len(list(ex2.run())) == 4
