@@ -512,6 +512,13 @@ class ConductorHandler:
                 self._waiting_leases -= 1
                 self._pending_demand.remove(demand_token)
 
+    def get_rpc_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-method dispatch latency of the conductor's RPC server —
+        the control plane's instrumented_io_context analog (reference
+        src/ray/common/asio/instrumented_io_context.h stats)."""
+        srv = getattr(self, "_rpc_server", None)
+        return srv.handler_stats() if srv is not None else {}
+
     def get_pending_demand(self) -> List[Dict[str, Any]]:
         """Resource shapes of leases currently waiting, with wait age —
         the autoscaler's scale-up signal (reference LoadMetrics /
@@ -1590,8 +1597,9 @@ class Conductor:
         self.handler = ConductorHandler(resources, session_dir,
                                         worker_env=worker_env)
         self.server = RpcServer(self.handler, host=host, port=port,
-                                max_workers=32)
+                                max_workers=32, warn_slow=True)
         self.handler.address = self.server.address
+        self.handler._rpc_server = self.server
 
     def start(self) -> "Conductor":
         self.server.start()

@@ -15,8 +15,10 @@ Wire format: 8-byte big-endian length, then a pickled tuple:
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
+import sys
 import threading
 import time
 import traceback
@@ -73,9 +75,22 @@ class RpcServer:
     don't block the connection's read loop (needed for concurrent actor calls).
     """
 
+    # handlers slower than this log a warning (reference
+    # instrumented_io_context.h event-loop-lag alerts)
+    WARN_HANDLER_S = float(os.environ.get("RAY_TPU_RPC_WARN_MS",
+                                          "1000")) / 1e3
+
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 16):
+                 max_workers: int = 16, warn_slow: bool = False):
         self._handler = handler
+        # per-method latency accounting (reference instrumented_io_context
+        # .h: post/dispatch counts + queueing and execution times).
+        # warn_slow is for CONTROL-PLANE servers (the conductor): worker
+        # servers run user task code inline in push_task, where >1s is
+        # normal, not dispatch lag.
+        self._warn_slow = warn_slow
+        self._stats: Dict[str, list] = {}
+        self._stats_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if port == 0:
@@ -154,7 +169,8 @@ class RpcServer:
                                                method, args, kwargs)
                 else:
                     self._pool.submit(self._dispatch, conn, send_lock,
-                                      req_id, method, args, kwargs)
+                                      req_id, method, args, kwargs,
+                                      time.perf_counter())
                 # a reader blocked in the next _recv_frame must not pin
                 # the previous request in its frame locals: task args can
                 # hold large values and ObjectRefs whose refcount release
@@ -189,7 +205,35 @@ class RpcServer:
         except BaseException as e:  # noqa: BLE001 — must cross the wire
             reply_cb(False, (e, traceback.format_exc()))
 
-    def _dispatch(self, conn, send_lock, req_id, method, args, kwargs) -> None:
+    def _record(self, method: str, queue_s: float, exec_s: float) -> None:
+        with self._stats_lock:
+            s = self._stats.get(method)
+            if s is None:
+                s = self._stats[method] = [0, 0.0, 0.0, 0.0, 0.0]
+            s[0] += 1
+            s[1] += queue_s
+            s[2] += exec_s
+            s[3] = max(s[3], queue_s)
+            s[4] = max(s[4], exec_s)
+        if self._warn_slow and exec_s > self.WARN_HANDLER_S:
+            print(f"[rpc] slow handler {method}: {exec_s * 1e3:.0f}ms "
+                  f"(queued {queue_s * 1e3:.0f}ms)", file=sys.stderr)
+
+    def handler_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-method dispatch stats: count, mean/max queue and handler
+        time (ms) — the instrumented_io_context analog for this server's
+        thread pool."""
+        with self._stats_lock:
+            return {m: {"count": s[0],
+                        "mean_queue_ms": s[1] / s[0] * 1e3,
+                        "mean_handler_ms": s[2] / s[0] * 1e3,
+                        "max_queue_ms": s[3] * 1e3,
+                        "max_handler_ms": s[4] * 1e3}
+                    for m, s in self._stats.items() if s[0]}
+
+    def _dispatch(self, conn, send_lock, req_id, method, args, kwargs,
+                  enqueued_at: float = 0.0) -> None:
+        t0 = time.perf_counter()
         try:
             if method.startswith("_"):
                 raise AttributeError(f"method {method!r} is not remotely callable")
@@ -199,6 +243,8 @@ class RpcServer:
         except BaseException as e:  # noqa: BLE001 — must cross the wire
             result = (e, traceback.format_exc())
             ok = False
+        self._record(method, t0 - enqueued_at if enqueued_at else 0.0,
+                     time.perf_counter() - t0)
         if req_id < 0:  # one-way
             return
         try:

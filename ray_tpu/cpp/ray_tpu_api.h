@@ -22,7 +22,10 @@
  * (returns 11/EAGAIN when not ready), positive bounds the wait. Ids
  * minted by put()/submit() are pinned in the hosting worker until
  * release() — release what you mint, or the objects live until the
- * worker exits.
+ * worker exits. Ids are PROCESS-LOCAL: get()/release() only resolve
+ * ids minted in the same worker process, so pass VALUES (bytes)
+ * across task boundaries, not id strings — a subtask may execute in a
+ * different worker where the parent's ids are unknown (ENOENT).
  *
  * Run:  f = ray_tpu.util.cpp.cpp_function(lib, sym, api=True)
  */

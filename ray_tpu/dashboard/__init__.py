@@ -211,6 +211,9 @@ class DashboardServer:
                                lambda: d.simple_args("get_recent_logs", 500)))
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/serve", self._json_route(d.serve_status))
+        app.router.add_get(
+            "/api/rpc",
+            self._json_route(lambda: d.simple("get_rpc_stats")))
 
         async def actor_detail(request):
             from aiohttp import web

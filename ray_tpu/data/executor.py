@@ -64,7 +64,8 @@ def _count_rows(block: Block) -> int:
 
 
 def _block_info(block: Block) -> Tuple[int, int]:
-    return (BlockAccessor(block).num_rows(), int(block.nbytes))
+    acc = BlockAccessor(block)
+    return (acc.num_rows(), int(acc.size_bytes()))
 
 
 def _slice_block(block: Block, start: int, end: int) -> Block:
@@ -271,16 +272,28 @@ class StreamingExecutor:
 
         info = _remote(_block_info)
         sl = _remote(_slice_block)
-        for ref in upstream:
-            rows, nbytes = ray_tpu.get(info.remote(ref))
+
+        def emit(ref, info_ref):
+            rows, nbytes = ray_tpu.get(info_ref)
             if nbytes <= self.target_block_size or rows <= 1:
                 yield ref
-                continue
+                return
             k = min(rows, -(-nbytes // self.target_block_size))
             cuts = np.linspace(0, rows, k + 1).astype(int)
             for a, b in zip(cuts, cuts[1:]):
                 if b > a:
                     yield sl.remote(ref, int(a), int(b))
+
+        # probes run concurrently across the window: the per-block
+        # info round-trip overlaps upstream execution instead of
+        # serializing the driver loop
+        buf: List[Tuple[Any, Any]] = []
+        for ref in upstream:
+            buf.append((ref, info.remote(ref)))
+            if len(buf) >= self.max_in_flight:
+                yield from emit(*buf.pop(0))
+        for pair in buf:
+            yield from emit(*pair)
 
     def _windowed(self, submissions: Iterator[Any],
                   window: int) -> Iterator[Any]:

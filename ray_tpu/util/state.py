@@ -178,6 +178,12 @@ def _render_prometheus(per_worker: Dict[str, Any]) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def rpc_stats() -> Dict[str, Dict[str, float]]:
+    """Control-plane dispatch latency by RPC method (count, mean/max
+    queue and handler ms) — see ConductorHandler.get_rpc_stats."""
+    return _conductor().conductor.call("get_rpc_stats", timeout=10.0)
+
+
 def cluster_summary() -> Dict[str, Any]:
     """One-call overview — reference `ray status`."""
     w = _conductor()

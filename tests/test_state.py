@@ -141,3 +141,22 @@ def test_invalid_metric_usage(cluster):
         c.inc(1, tags={"unknown": "x"})
     with pytest.raises(ValueError):
         c.inc(-1)
+
+
+def test_rpc_handler_stats(cluster):
+    """The conductor's RPC server accounts per-method queue/handler
+    latency (reference instrumented_io_context.h stats)."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(5)]) == [1] * 5
+    stats = state.rpc_stats()
+    assert "lease_worker" in stats, sorted(stats)
+    s = stats["lease_worker"]
+    assert s["count"] >= 5
+    assert s["mean_handler_ms"] >= 0.0
+    assert s["max_handler_ms"] >= s["mean_handler_ms"] - 1e-9
+    assert s["max_queue_ms"] >= 0.0
