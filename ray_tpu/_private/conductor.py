@@ -133,6 +133,11 @@ class NodeRecord:
 class ConductorHandler:
     """RPC handler — every public method is remotely callable."""
 
+    # block-by-design handlers (waiting IS their job): exempt from the
+    # RPC server's slow-handler warning — see RpcServer warn_slow.
+    # create_actor blocks on the same capacity wait via _place_actor.
+    _slow_ok_methods = frozenset({"lease_worker", "create_actor"})
+
     def __init__(self, resources: Dict[str, float], session_dir: str,
                  worker_env: Optional[Dict[str, str]] = None):
         self._lock = threading.RLock()

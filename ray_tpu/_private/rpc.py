@@ -75,11 +75,6 @@ class RpcServer:
     don't block the connection's read loop (needed for concurrent actor calls).
     """
 
-    # handlers slower than this log a warning (reference
-    # instrumented_io_context.h event-loop-lag alerts)
-    WARN_HANDLER_S = float(os.environ.get("RAY_TPU_RPC_WARN_MS",
-                                          "1000")) / 1e3
-
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
                  max_workers: int = 16, warn_slow: bool = False):
         self._handler = handler
@@ -87,8 +82,16 @@ class RpcServer:
         # .h: post/dispatch counts + queueing and execution times).
         # warn_slow is for CONTROL-PLANE servers (the conductor): worker
         # servers run user task code inline in push_task, where >1s is
-        # normal, not dispatch lag.
+        # normal, not dispatch lag. Handlers that block BY DESIGN
+        # (lease_worker parks on a condition variable until capacity
+        # frees) opt out via the handler's _slow_ok_methods set.
+        # 5s default: create_actor legitimately takes ~2-3s (process
+        # spawn + imports); the warning is for wedged handlers.
         self._warn_slow = warn_slow
+        self._warn_handler_s = float(
+            os.environ.get("RAY_TPU_RPC_WARN_MS", "5000")) / 1e3
+        self._slow_ok = frozenset(getattr(handler, "_slow_ok_methods",
+                                          ()))
         self._stats: Dict[str, list] = {}
         self._stats_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -215,7 +218,8 @@ class RpcServer:
             s[2] += exec_s
             s[3] = max(s[3], queue_s)
             s[4] = max(s[4], exec_s)
-        if self._warn_slow and exec_s > self.WARN_HANDLER_S:
+        if self._warn_slow and exec_s > self._warn_handler_s \
+                and method not in self._slow_ok:
             print(f"[rpc] slow handler {method}: {exec_s * 1e3:.0f}ms "
                   f"(queued {queue_s * 1e3:.0f}ms)", file=sys.stderr)
 

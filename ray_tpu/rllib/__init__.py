@@ -43,6 +43,14 @@ from .multi_agent import (  # noqa: F401
     make_multi_agent_env,
     register_multi_agent_env,
 )
+from .connectors import (  # noqa: F401
+    ClipActions,
+    ClipObservations,
+    Connector,
+    ConnectorPipeline,
+    NormalizeObservations,
+    ScaleActions,
+)
 from .cql import CQL, CQLConfig  # noqa: F401
 from .marwil import MARWIL, MARWILConfig  # noqa: F401
 from .offline import BC, BCConfig, OfflineData, record_batches  # noqa: F401
@@ -59,4 +67,6 @@ __all__ = [
     "MultiAgentPPO", "make_multi_agent_env", "register_multi_agent_env",
     "BC", "BCConfig", "OfflineData", "record_batches", "SAC", "SACConfig",
     "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
+    "Connector", "ConnectorPipeline", "NormalizeObservations",
+    "ClipObservations", "ClipActions", "ScaleActions",
 ]

@@ -49,7 +49,9 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Any] = None,
+                    module_to_env_connector: Optional[Any] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -57,6 +59,14 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        # zero-arg factories building connector pipelines per runner
+        # (reference ConnectorV2 env_to_module/module_to_env hooks)
+        if env_to_module_connector is not None:
+            self.train_extra["env_to_module_connector"] = \
+                env_to_module_connector
+        if module_to_env_connector is not None:
+            self.train_extra["module_to_env_connector"] = \
+                module_to_env_connector
         return self
 
     def training(self, *, lr: Optional[float] = None,
@@ -131,6 +141,16 @@ class Algorithm(Trainable):
         self.continuous = probe.num_actions < 0
 
         n_runners = cfg.get("num_env_runners", 0)
+        e2m = cfg.get("env_to_module_connector")
+        m2e = cfg.get("module_to_env_connector")
+        # driver-side template instances: define merge semantics for
+        # fleet stat sync and the checkpoint state shape
+        from .connectors import resolve_connector
+
+        self._e2m_template = resolve_connector(e2m)
+        self._m2e_template = resolve_connector(m2e)
+        self._has_connectors = e2m is not None or m2e is not None
+        self._connector_states: Optional[Dict[str, Any]] = None
         if n_runners > 0:
             self.runners = make_remote_runners(
                 cfg["env"], num_runners=n_runners,
@@ -138,7 +158,8 @@ class Algorithm(Trainable):
                 rollout_fragment_length=cfg.get("rollout_fragment_length",
                                                 128),
                 env_config=cfg.get("env_config"),
-                seed=cfg.get("seed", 0), runner_cls=self._runner_cls)
+                seed=cfg.get("seed", 0), runner_cls=self._runner_cls,
+                env_to_module=e2m, module_to_env=m2e)
             self.local_runner = None
         else:
             self.runners = []
@@ -146,7 +167,8 @@ class Algorithm(Trainable):
                 cfg["env"], num_envs=cfg.get("num_envs_per_env_runner", 1),
                 rollout_fragment_length=cfg.get("rollout_fragment_length",
                                                 128),
-                seed=cfg.get("seed", 0), env_config=cfg.get("env_config"))
+                seed=cfg.get("seed", 0), env_config=cfg.get("env_config"),
+                env_to_module=e2m, module_to_env=m2e)
         self._episode_returns: collections.deque = collections.deque(
             maxlen=100)
         self._episode_lens: collections.deque = collections.deque(maxlen=100)
@@ -198,7 +220,42 @@ class Algorithm(Trainable):
             self._episode_returns.extend(b["episode_returns"])
             self._episode_lens.extend(b["episode_lens"])
             self._env_steps_lifetime += int(np.prod(b["rewards"].shape))
+        if self.runners and self._has_connectors:
+            self._sync_connectors()
         return batches
+
+    def _merge_connector_state(self, template, states):
+        from .connectors import ConnectorPipeline
+
+        if template is None or not states:
+            return None
+        if isinstance(template, ConnectorPipeline):
+            return template.merge_pipeline_states(states)
+        return type(template).merge_states(states)
+
+    def _sync_connectors(self) -> None:
+        """Merge each remote runner's NEW connector statistics (deltas
+        since the last sync) into the global state and broadcast it —
+        one policy must train on observations scaled by ONE statistic.
+        Deltas, not absolute states: re-merging absolutes would
+        double-count the shared broadcast history every round
+        (reference: mean-std filter sync pulls per-runner buffers and
+        clears them)."""
+        import ray_tpu
+
+        deltas = ray_tpu.get(
+            [r.pop_connector_deltas.remote() for r in self.runners])
+        prev = self._connector_states or {}
+        merged = {}
+        for key, tmpl in (("env_to_module", self._e2m_template),
+                          ("module_to_env", self._m2e_template)):
+            sts = [d[key] for d in deltas if d.get(key)]
+            if prev.get(key):
+                sts = [prev[key]] + sts
+            merged[key] = self._merge_connector_state(tmpl, sts)
+        ray_tpu.get([r.set_connector_states.remote(merged)
+                     for r in self.runners])
+        self._connector_states = merged
 
     @staticmethod
     def _concat_batches(batches: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -211,14 +268,40 @@ class Algorithm(Trainable):
     def save_checkpoint(self, checkpoint_dir: str) -> Dict[str, Any]:
         import jax
 
+        connector_states = None
+        if self.local_runner is not None:
+            connector_states = self.local_runner.get_connector_states() \
+                if self._has_connectors else None
+        elif self._connector_states is not None:
+            connector_states = self._connector_states
         return {"params": jax.device_get(self.params),
                 "opt_state": jax.device_get(self.opt_state),
-                "env_steps": self._env_steps_lifetime}
+                "env_steps": self._env_steps_lifetime,
+                "connector_states": connector_states}
 
     def load_checkpoint(self, data: Any) -> None:
+        import ray_tpu
+
         self.params = data["params"]
         self.opt_state = data["opt_state"]
         self._env_steps_lifetime = data.get("env_steps", 0)
+        # a policy trained on normalized obs needs its normalizer back
+        # (running stats are part of the policy, not transient state)
+        states = data.get("connector_states")
+        if states is not None and not self._has_connectors:
+            import sys
+
+            print("WARNING: checkpoint carries connector state "
+                  "(normalizer statistics are part of the policy) but "
+                  "this config has no connectors — restored policy "
+                  "will see raw observations", file=sys.stderr)
+        if states is not None and self._has_connectors:
+            self._connector_states = states
+            if self.local_runner is not None:
+                self.local_runner.set_connector_states(states)
+            else:
+                ray_tpu.get([r.set_connector_states.remote(states)
+                             for r in self.runners])
 
     def cleanup(self) -> None:
         import ray_tpu
@@ -231,6 +314,21 @@ class Algorithm(Trainable):
 
     # legacy surface ------------------------------------------------------
 
+    def _transform_obs(self, obs: np.ndarray) -> np.ndarray:
+        """Apply the env-to-module pipeline for out-of-rollout inference
+        (serving/eval): a policy trained on transformed observations
+        must never see raw ones."""
+        if self._e2m_template is None:
+            return obs
+        if self.local_runner is not None and \
+                getattr(self.local_runner, "_env_to_module", None) \
+                is not None:
+            return self.local_runner._env_to_module(obs, update=False)
+        states = (self._connector_states or {}).get("env_to_module")
+        if states is not None:
+            self._e2m_template.set_state(states)
+        return self._e2m_template(obs, update=False)
+
     def compute_single_action(self, obs: np.ndarray) -> Any:
         """Greedy action for serving/eval (reference
         Algorithm.compute_single_action)."""
@@ -238,8 +336,9 @@ class Algorithm(Trainable):
 
         from . import core
 
+        obs = np.asarray(self._transform_obs(np.asarray(obs)[None]))
         logits = core.policy_logits(self.params,
-                                    jnp.asarray(obs[None], jnp.float32))
+                                    jnp.asarray(obs, jnp.float32))
         if self.continuous:
             return np.asarray(logits[0])
         return int(np.argmax(np.asarray(logits[0])))

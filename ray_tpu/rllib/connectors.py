@@ -1,0 +1,227 @@
+"""Connector pipelines — composable obs/action transforms.
+
+Reference: rllib/connectors/ (env-to-module and module-to-env
+ConnectorV2 pipelines run inside EnvRunners: observation preprocessing
+before RLModule inference, action postprocessing before env.step).
+Same shape here: a ConnectorPipeline of stateless or stateful
+transforms applied vectorized over [num_envs, ...] numpy arrays — the
+policy trains on exactly what it saw (transformed observations are what
+the rollout batch records), while logp/actions record the module's raw
+output and only the env receives the transformed action.
+
+Stateful connectors (NormalizeObservations' running mean/var) expose
+get_state/set_state so runner fleets can sync and checkpoints restore.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Connector:
+    """One transform stage. `update=False` marks bookkeeping-only calls
+    (e.g. the fragment's trailing observation) that must not advance
+    running statistics twice."""
+
+    def __call__(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    @staticmethod
+    def merge_states(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Combine per-runner states into one (fleet sync); stateless
+        connectors just keep the first."""
+        return states[0] if states else {}
+
+    def pop_delta(self) -> Dict[str, Any]:
+        """State accumulated since the last pop — what fleet sync
+        collects. Absolute states must NOT be re-merged every sync (each
+        runner already holds the broadcast base; merging absolutes would
+        double-count shared history, reference mean-std sync pulls
+        deltas the same way). Stateless default: empty."""
+        return {}
+
+
+def resolve_connector(c: Any) -> Optional[Connector]:
+    """Accept a Connector instance, a zero-arg factory, or None."""
+    if c is None or isinstance(c, Connector):
+        return c
+    return c()
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, *connectors: Connector):
+        self.connectors: List[Connector] = list(connectors)
+
+    def __call__(self, x: np.ndarray, update: bool = True) -> np.ndarray:
+        for c in self.connectors:
+            x = c(x, update=update)
+        return x
+
+    def get_state(self) -> List[Dict[str, Any]]:
+        return [c.get_state() for c in self.connectors]
+
+    def set_state(self, state: List[Dict[str, Any]]) -> None:
+        for c, s in zip(self.connectors, state):
+            c.set_state(s)
+
+    def merge_pipeline_states(self, states: List[List[Dict[str, Any]]]
+                              ) -> List[Dict[str, Any]]:
+        """Element-wise merge of per-runner pipeline states using each
+        stage's merge_states."""
+        return [type(c).merge_states(
+            [s[i] for s in states if s[i]])
+            for i, c in enumerate(self.connectors)]
+
+    def pop_delta(self) -> List[Dict[str, Any]]:
+        return [c.pop_delta() for c in self.connectors]
+
+
+# ------------------------------------------------------- env-to-module
+
+class NormalizeObservations(Connector):
+    """Running mean/std normalization (reference
+    connectors/env_to_module/mean_std_filter.py): batched Welford update
+    over every observed vector, then (x - mean) / std clipped.
+
+    Statistics are split into a BASE (the fleet-merged state received
+    via set_state) and a local DELTA (samples seen since the last
+    pop_delta), so sync rounds merge only new samples and never
+    double-count shared history. Normalization always uses base+delta
+    combined; get_state returns the combination (what checkpoints
+    persist)."""
+
+    def __init__(self, clip: float = 10.0, epsilon: float = 1e-8):
+        self.clip = clip
+        self.eps = epsilon
+        self._base: Optional[Dict[str, Any]] = None
+        self._d_count = 0.0
+        self._d_mean: Optional[np.ndarray] = None
+        self._d_m2: Optional[np.ndarray] = None
+
+    # the properties tests/tools read: combined statistics
+    @property
+    def count(self) -> float:
+        return self.get_state().get("count", 0.0)
+
+    @property
+    def mean(self):
+        return self.get_state().get("mean")
+
+    @property
+    def m2(self):
+        return self.get_state().get("m2")
+
+    def _ensure_dim(self, dim: int) -> None:
+        if self._d_mean is None:
+            self._d_mean = np.zeros(dim, np.float64)
+            self._d_m2 = np.zeros(dim, np.float64)
+
+    def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        flat = obs.reshape(-1, obs.shape[-1])
+        self._ensure_dim(obs.shape[-1])
+        if update and len(flat):
+            n_b = float(len(flat))
+            mean_b = flat.mean(axis=0)
+            m2_b = ((flat - mean_b) ** 2).sum(axis=0)
+            delta = mean_b - self._d_mean
+            total = self._d_count + n_b
+            self._d_mean = self._d_mean + delta * n_b / total
+            self._d_m2 = self._d_m2 + m2_b \
+                + delta ** 2 * self._d_count * n_b / total
+            self._d_count = total
+        st = self.get_state()
+        if not st.get("count"):
+            return np.clip(obs, -self.clip, self.clip)
+        std = np.sqrt(np.asarray(st["m2"]) / max(st["count"], 1.0)) \
+            + self.eps
+        out = (obs - np.asarray(st["mean"])) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def _delta_state(self) -> Dict[str, Any]:
+        if self._d_mean is None or self._d_count == 0.0:
+            return {}
+        return {"count": self._d_count, "mean": self._d_mean.copy(),
+                "m2": self._d_m2.copy()}
+
+    def get_state(self) -> Dict[str, Any]:
+        parts = [s for s in (self._base, self._delta_state()) if s]
+        if not parts:
+            return {"count": 0.0, "mean": None, "m2": None}
+        return self.merge_states(parts)
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self._base = dict(state) if state and state.get("mean") is not None \
+            else None
+
+    def pop_delta(self) -> Dict[str, Any]:
+        d = self._delta_state()
+        self._d_count = 0.0
+        self._d_mean = None
+        self._d_m2 = None
+        return d
+
+    @staticmethod
+    def merge_states(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Chan et al. pairwise combine of (count, mean, M2) — the fleet
+        sync the reference's mean-std filter does through the driver."""
+        live = [s for s in states if s and s.get("mean") is not None]
+        if not live:
+            return states[0] if states else {}
+        count = live[0]["count"]
+        mean = np.array(live[0]["mean"], np.float64)
+        m2 = np.array(live[0]["m2"], np.float64)
+        for s in live[1:]:
+            nb, mb = s["count"], np.asarray(s["mean"], np.float64)
+            m2b = np.asarray(s["m2"], np.float64)
+            delta = mb - mean
+            total = count + nb
+            mean = mean + delta * nb / total
+            m2 = m2 + m2b + delta ** 2 * count * nb / total
+            count = total
+        return {"count": count, "mean": mean, "m2": m2}
+
+
+class ClipObservations(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        return np.clip(obs, self.low, self.high)
+
+
+# ------------------------------------------------------- module-to-env
+
+class ClipActions(Connector):
+    """Clamp continuous actions into the env's bounds (reference
+    connectors/module_to_env clip_actions)."""
+
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def __call__(self, actions: np.ndarray,
+                 update: bool = True) -> np.ndarray:
+        return np.clip(actions, self.low, self.high)
+
+
+class ScaleActions(Connector):
+    """Affine map from the module's [-1, 1] range to env bounds."""
+
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def __call__(self, actions: np.ndarray,
+                 update: bool = True) -> np.ndarray:
+        return self.low + (np.asarray(actions) + 1.0) * 0.5 * \
+            (self.high - self.low)
+
+
+__all__ = ["Connector", "ConnectorPipeline", "NormalizeObservations",
+           "ClipObservations", "ClipActions", "ScaleActions"]

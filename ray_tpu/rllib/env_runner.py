@@ -41,10 +41,19 @@ def build_act_fn(continuous: bool):
 class EnvRunner:
     def __init__(self, env: Any, *, num_envs: int = 1,
                  rollout_fragment_length: int = 128, seed: int = 0,
-                 env_config: Optional[Dict] = None):
+                 env_config: Optional[Dict] = None,
+                 env_to_module: Optional[Callable] = None,
+                 module_to_env: Optional[Callable] = None):
         self.env = make_env(env, num_envs, env_config, seed=seed)
         self.T = rollout_fragment_length
         self.continuous = self.env.num_actions < 0
+        # connector pipelines (reference rllib/connectors/): accept a
+        # Connector instance or a zero-arg factory (remote runners build
+        # their own stateful instances from the factory)
+        from .connectors import resolve_connector
+
+        self._env_to_module = resolve_connector(env_to_module)
+        self._module_to_env = resolve_connector(module_to_env)
         self._rng_key = None
         self._seed = seed
         self._obs = self.env.reset(seed=seed)
@@ -53,6 +62,33 @@ class EnvRunner:
         self._completed: List[float] = []
         self._completed_lens: List[int] = []
         self._act_fn = None
+
+    # --------------------------------------------------------- connectors
+    def get_connector_states(self):
+        return {
+            "env_to_module": self._env_to_module.get_state()
+            if self._env_to_module is not None else None,
+            "module_to_env": self._module_to_env.get_state()
+            if self._module_to_env is not None else None,
+        }
+
+    def set_connector_states(self, states) -> None:
+        if states.get("env_to_module") is not None \
+                and self._env_to_module is not None:
+            self._env_to_module.set_state(states["env_to_module"])
+        if states.get("module_to_env") is not None \
+                and self._module_to_env is not None:
+            self._module_to_env.set_state(states["module_to_env"])
+
+    def pop_connector_deltas(self):
+        """Per-sync NEW statistics only (see Connector.pop_delta) — the
+        driver merges these into the global state and broadcasts it."""
+        return {
+            "env_to_module": self._env_to_module.pop_delta()
+            if self._env_to_module is not None else None,
+            "module_to_env": self._module_to_env.pop_delta()
+            if self._module_to_env is not None else None,
+        }
 
     # ------------------------------------------------------------- policy
 
@@ -83,12 +119,19 @@ class EnvRunner:
         obs = self._obs
         for t in range(self.T):
             self._rng_key, sub = jax.random.split(self._rng_key)
-            a, logp = self._act_fn(params, obs, sub)
+            # the batch records what the module SAW (transformed obs)
+            # and what it OUTPUT (raw action, consistent with logp);
+            # only the env receives the transformed action
+            mobs = self._env_to_module(obs) \
+                if self._env_to_module is not None else obs
+            a, logp = self._act_fn(params, mobs, sub)
             a = np.asarray(a)
-            obs_buf[t] = obs
+            obs_buf[t] = mobs
             act_buf[t] = a.astype(act_dtype)
             logp_buf[t] = np.asarray(logp)
-            obs, rew, done = self.env.step(a)
+            env_a = self._module_to_env(a) \
+                if self._module_to_env is not None else a
+            obs, rew, done = self.env.step(env_a)
             rew_buf[t] = rew
             done_buf[t] = done
             self._ep_returns += rew
@@ -99,7 +142,8 @@ class EnvRunner:
                     self._completed_lens.append(int(self._ep_lens[i]))
                 self._ep_returns[done] = 0.0
                 self._ep_lens[done] = 0
-        obs_buf[self.T] = obs
+        obs_buf[self.T] = self._env_to_module(obs, update=False) \
+            if self._env_to_module is not None else obs
         self._obs = obs
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
@@ -118,16 +162,21 @@ class EnvRunner:
 def make_remote_runners(env: Any, *, num_runners: int, num_envs: int,
                         rollout_fragment_length: int,
                         env_config: Optional[Dict] = None,
-                        seed: int = 0, runner_cls: type = None) -> List[Any]:
+                        seed: int = 0, runner_cls: type = None,
+                        env_to_module: Optional[Callable] = None,
+                        module_to_env: Optional[Callable] = None
+                        ) -> List[Any]:
     """Spawn EnvRunner actors (reference EnvRunnerGroup /
-    rollout worker set)."""
+    rollout worker set). Connector args should be zero-arg FACTORIES so
+    every runner owns its stateful pipeline instance."""
     import ray_tpu
 
     cls = ray_tpu.remote(runner_cls or EnvRunner)
     return [cls.options(num_cpus=1.0).remote(
         env, num_envs=num_envs,
         rollout_fragment_length=rollout_fragment_length,
-        seed=seed + 1000 * (i + 1), env_config=env_config)
+        seed=seed + 1000 * (i + 1), env_config=env_config,
+        env_to_module=env_to_module, module_to_env=module_to_env)
         for i in range(num_runners)]
 
 
