@@ -30,6 +30,7 @@ from .schedulers import (  # noqa: F401
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -53,5 +54,5 @@ __all__ = [
     "BasicVariantGenerator", "HaltonSearchGenerator",
     "TrialScheduler", "FIFOScheduler",
     "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2",
 ]

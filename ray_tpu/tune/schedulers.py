@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import math
 import random
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Callable, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
@@ -238,6 +238,119 @@ class PopulationBasedTraining(TrialScheduler):
         return self._pending_exploit.pop(trial_id, None)
 
 
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference tune/schedulers/pb2.py,
+    Parker-Holder et al. 2020): PBT's exploit step, but instead of
+    random mutation the new hyperparameters come from a GP-bandit fit on
+    (time, hyperparams) -> per-interval reward CHANGE, maximizing UCB —
+    data-efficient for small populations.
+
+    `hyperparam_bounds`: {name: [low, high]} continuous ranges. The GP
+    is a plain RBF over inputs normalized to [0,1] (the reference's
+    time-varying kernel reduces to this with time as a feature), and
+    the acquisition argmax is random search over the bounds — exact
+    optimizers add scipy for negligible gain at population scale."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None, synch: bool = False,
+                 ucb_kappa: float = 2.0, n_candidates: int = 256):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={}, seed=seed,
+                         quantile_fraction=quantile_fraction, synch=synch)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds "
+                             "{name: [low, high]}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # observations: (t, {hp: v}, reward_delta) — ONE per trial per
+        # perturbation interval (the reference fits on interval data),
+        # windowed so the O(n^3) GP solve stays bounded over long runs
+        self._pb2_obs: deque = deque(maxlen=512)
+        # per-trial (t, reward) anchor at the last interval boundary
+        self._pb2_anchor: Dict[str, tuple] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric in result:
+            r = self._score(result)
+            t = float(result.get(self.time_attr, 0))
+            anchor = self._pb2_anchor.get(trial_id)
+            if anchor is None:
+                self._pb2_anchor[trial_id] = (t, r)
+            elif t - anchor[0] >= self.interval:
+                cfg = self._configs.get(trial_id, {})
+                hp = {k: float(cfg.get(k, (lo + hi) / 2))
+                      for k, (lo, hi) in self.bounds.items()}
+                self._pb2_obs.append((t, hp, r - anchor[1]))
+                self._pb2_anchor[trial_id] = (t, r)
+        return super().on_trial_result(trial_id, result)
+
+    def exploit_directive(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        directive = super().exploit_directive(trial_id)
+        if directive is not None:
+            # the clone swaps this trial's checkpoint for the source's:
+            # a delta spanning the swap would be a spurious reward jump
+            # credited to the NEW config, self-reinforcing the GP fit
+            self._pb2_anchor.pop(trial_id, None)
+        return directive
+
+    # PBT's exploit step calls _mutate(src_config): PB2's proposal
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        names = sorted(self.bounds)
+        if len(self._pb2_obs) < 4:
+            for k in names:  # cold start: explore uniformly
+                lo, hi = self.bounds[k]
+                out[k] = type(config.get(k, lo))(
+                    self._rng.uniform(lo, hi)) \
+                    if isinstance(config.get(k), int) else \
+                    self._rng.uniform(lo, hi)
+            return out
+
+        t_now = max(o[0] for o in self._pb2_obs)
+        tmax = t_now or 1.0
+
+        def norm_x(t, hp):
+            return [t / tmax] + [
+                (hp[k] - self.bounds[k][0])
+                / max(self.bounds[k][1] - self.bounds[k][0], 1e-12)
+                for k in names]
+
+        X = np.array([norm_x(t, hp) for t, hp, _ in self._pb2_obs])
+        y = np.array([d for _, _, d in self._pb2_obs], np.float64)
+        y_std = y.std() or 1.0
+        y = (y - y.mean()) / y_std
+
+        def rbf(a, b, length=0.3):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * length ** 2))
+
+        K = rbf(X, X) + 1e-3 * np.eye(len(X))
+        alpha = np.linalg.solve(K, y)
+        rng = np.random.default_rng(self._rng.randrange(2 ** 31))
+        cand_hp = [{k: rng.uniform(*self.bounds[k]) for k in names}
+                   for _ in range(self.n_candidates)]
+        Xc = np.array([norm_x(t_now, hp) for hp in cand_hp])
+        Kc = rbf(Xc, X)
+        mu = Kc @ alpha
+        # predictive variance (diagonal only)
+        v = np.linalg.solve(K, Kc.T)
+        var = np.clip(1.0 - (Kc * v.T).sum(-1), 1e-9, None)
+        best = cand_hp[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
+        for k in names:
+            out[k] = type(config[k])(best[k]) \
+                if isinstance(config.get(k), int) else best[k]
+        return out
+
+
 __all__ = ["TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
            "HyperBandScheduler", "MedianStoppingRule",
-           "PopulationBasedTraining", "CONTINUE", "STOP", "PAUSE"]
+           "PopulationBasedTraining", "PB2", "CONTINUE", "STOP", "PAUSE"]
