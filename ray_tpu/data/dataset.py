@@ -126,16 +126,21 @@ class Dataset:
                                        for x, y in zip(a, b)))])
 
     # --- execution --------------------------------------------------------
+    def _execute(self) -> Iterator[Any]:
+        ex = StreamingExecutor(P.fuse(self._ops))
+        self._last_executor = ex  # stats() reads stage_stats from here
+        return ex.run()
+
     def _block_refs(self) -> Iterator[Any]:
         if self._materialized is not None:
             return iter(self._materialized)
-        return execute(self._ops)
+        return self._execute()
 
     def _ensure_refs(self) -> List[Any]:
         """Execute once and cache — metadata ops (count/schema/...) must
         not re-run the plan on every call."""
         if self._materialized is None:
-            self._materialized = list(execute(self._ops))
+            self._materialized = list(self._execute())
         return self._materialized
 
     def materialize(self) -> "Dataset":
@@ -143,6 +148,7 @@ class Dataset:
             refs = list(self._block_refs())
             ds = Dataset([P.FromBlocks("materialized", tuple(refs))])
             ds._materialized = refs
+            ds._last_executor = getattr(self, "_last_executor", None)
             return ds
         return self
 
@@ -397,9 +403,27 @@ class Dataset:
                      for i, ref in enumerate(self._block_refs())])
 
     def stats(self) -> str:
-        stages = P.fuse(self._ops)
-        return " -> ".join(getattr(s, "name", type(s).__name__)
-                           for s in stages)
+        """Per-stage execution stats of the most recent run (reference
+        Dataset.stats()): blocks produced and driver-side wall time per
+        stage. Stages pipeline, so times OVERLAP — they are not a sum.
+        Before execution, falls back to the fused plan summary."""
+        ex = getattr(self, "_last_executor", None)
+        if ex is None or not getattr(ex, "stage_stats", None):
+            stages = P.fuse(self._ops)
+            return " -> ".join(getattr(s, "name", type(s).__name__)
+                               for s in stages)
+        width = max(5, max(len(r["stage"]) for r in ex.stage_stats))
+        # wall_s is cumulative (pulls nest through upstream iterators);
+        # self_s isolates each stage as the consecutive difference
+        lines = [f"{'stage':<{width}}  blocks    cum_s   self_s"]
+        prev = 0.0
+        for r in ex.stage_stats:
+            self_s = max(0.0, r["wall_s"] - prev)
+            prev = r["wall_s"]
+            lines.append(f"{r['stage']:<{width}}  "
+                         f"{r['blocks']:>6}  {r['wall_s']:>7.3f}  "
+                         f"{self_s:>7.3f}")
+        return "\n".join(lines)
 
     def __repr__(self):
         return f"Dataset(ops={[o.name for o in self._ops]})"

@@ -139,12 +139,41 @@ class StreamingExecutor:
         self.target_block_size = target_block_size
 
     def run(self) -> Iterator[Any]:
-        """Yields ObjectRefs of output blocks."""
+        """Yields ObjectRefs of output blocks. Per-stage execution stats
+        (blocks yielded, wall time spent producing them) accumulate in
+        self.stage_stats — reference Dataset.stats()."""
+        self.stage_stats: List[Dict[str, Any]] = []
         it: Optional[Iterator[Any]] = None
         for stage in self.stages:
-            it = self._apply(stage, it)
+            name = getattr(stage, "name", type(stage).__name__)
+            it = self._instrumented(name, self._apply(stage, it))
         assert it is not None, "empty plan"
         return it
+
+    def _instrumented(self, name: str, it: Iterator[Any]) -> Iterator[Any]:
+        """Count blocks and time-to-yield per stage. wall_s is
+        CUMULATIVE — pulls nest, so a stage's time includes everything
+        upstream; per-stage self time is derived at report time as the
+        difference of consecutive cumulative times (single-consumer
+        chain)."""
+        import time as _time
+
+        rec = {"stage": name, "blocks": 0, "wall_s": 0.0}
+        self.stage_stats.append(rec)
+
+        def gen():
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    ref = next(it)
+                except StopIteration:
+                    rec["wall_s"] += _time.perf_counter() - t0
+                    return
+                rec["wall_s"] += _time.perf_counter() - t0
+                rec["blocks"] += 1
+                yield ref
+
+        return gen()
 
     # --- stage drivers ----------------------------------------------------
     def _apply(self, stage, upstream: Optional[Iterator[Any]]):

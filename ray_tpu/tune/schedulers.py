@@ -309,10 +309,11 @@ class PB2(PopulationBasedTraining):
         if len(self._pb2_obs) < 4:
             for k in names:  # cold start: explore uniformly
                 lo, hi = self.bounds[k]
-                out[k] = type(config.get(k, lo))(
-                    self._rng.uniform(lo, hi)) \
-                    if isinstance(config.get(k), int) else \
-                    self._rng.uniform(lo, hi)
+                v = self._rng.uniform(lo, hi)
+                # round, don't floor: int() would bias proposals down
+                # and make the upper bound unreachable
+                out[k] = int(round(v)) if isinstance(config.get(k), int) \
+                    else v
             return out
 
         t_now = max(o[0] for o in self._pb2_obs)
@@ -346,7 +347,7 @@ class PB2(PopulationBasedTraining):
         var = np.clip(1.0 - (Kc * v.T).sum(-1), 1e-9, None)
         best = cand_hp[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
         for k in names:
-            out[k] = type(config[k])(best[k]) \
+            out[k] = int(round(best[k])) \
                 if isinstance(config.get(k), int) else best[k]
         return out
 

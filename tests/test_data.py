@@ -329,3 +329,18 @@ def test_target_block_size_splitting():
     ds2 = rd.range(100, parallelism=4)
     ex2 = StreamingExecutor(P.fuse(ds2._ops), target_block_size=1 << 20)
     assert len(list(ex2.run())) == 4
+
+
+def test_dataset_stats():
+    """stats() reports per-stage blocks + wall time for the last run
+    (reference Dataset.stats())."""
+    ds = (rd.range(100, parallelism=4)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .materialize())
+    s = ds.stats()
+    assert "read" in s or "range" in s, s
+    assert "map_batches" in s, s
+    for line in s.splitlines()[1:]:
+        assert int(line.split()[-3]) > 0  # every stage produced blocks
+    # unexecuted dataset: plan summary fallback
+    assert "range" in rd.range(5).stats()
