@@ -16,7 +16,9 @@ soft-Q + squashed gaussian + auto-alpha for continuous control, sac.py)
 — covering the reference's sync/async/off-policy execution plans.
 Offline RL: shard recording, OfflineData, behavior cloning
 (offline.py), MARWIL advantage-weighted imitation (marwil.py), and
-CQL conservative Q-learning (cql.py). Multi-agent:
+CQL conservative Q-learning (cql.py). Model-based: DreamerV3 — RSSM
+world model + imagination actor-critic in one jitted update
+(dreamerv3.py). Multi-agent:
 MultiAgentEnvRunner collects per-policy batches via policy_mapping_fn
 (multi_agent.py). Native vectorized CartPole/Pendulum remove the
 gymnasium dependency from tests; any gymnasium env id works via the
@@ -34,6 +36,7 @@ from .env import (  # noqa: F401
 from .env_runner import EnvRunner, make_remote_runners  # noqa: F401
 from .appo import APPO, APPOConfig  # noqa: F401
 from .dqn import DQN, DQNConfig, QEnvRunner, ReplayBuffer  # noqa: F401
+from .dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401
 from .impala import IMPALA, IMPALAConfig  # noqa: F401
 from .multi_agent import (  # noqa: F401
     MultiAgentCartPole,
@@ -67,6 +70,7 @@ __all__ = [
     "MultiAgentPPO", "make_multi_agent_env", "register_multi_agent_env",
     "BC", "BCConfig", "OfflineData", "record_batches", "SAC", "SACConfig",
     "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
+    "DreamerV3", "DreamerV3Config",
     "Connector", "ConnectorPipeline", "NormalizeObservations",
     "ClipObservations", "ClipActions", "ScaleActions",
 ]

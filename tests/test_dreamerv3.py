@@ -1,0 +1,81 @@
+"""DreamerV3 (reference rllib/algorithms/dreamerv3/): symlog/twohot
+numerics, RSSM mechanics, and imagination-trained control."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_symlog_twohot_numerics():
+    from ray_tpu.rllib.dreamerv3 import (_twohot_bins, symexp, symlog,
+                                         twohot, twohot_expectation)
+
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 30.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))),
+                               np.asarray(x), rtol=1e-5, atol=1e-5)
+    bins = _twohot_bins()
+    y = jnp.asarray([[-7.3, 0.0], [2.5, 199.0]])
+    hot = twohot(y, bins)
+    np.testing.assert_allclose(np.asarray(hot.sum(-1)), 1.0, atol=1e-6)
+    # expectation of the exact two-hot encoding inverts the encoding
+    logits = jnp.log(hot + 1e-12)
+    np.testing.assert_allclose(np.asarray(twohot_expectation(logits, bins)),
+                               np.asarray(y), rtol=2e-2, atol=1e-2)
+
+
+def test_rssm_shapes_and_straight_through():
+    from ray_tpu.rllib.dreamerv3 import (STOCH, _gru, _sample_stoch,
+                                         dreamer_init)
+
+    params = dreamer_init(jax.random.PRNGKey(0), obs_dim=4,
+                          num_actions=2, deter=32, hidden=32)
+    h = jnp.zeros((3, 32))
+    logits = jnp.zeros((3, STOCH))
+    z = _sample_stoch(jax.random.PRNGKey(1), logits)
+    assert z.shape == (3, STOCH)
+    # each categorical group sums to 1 in the straight-through sample
+    np.testing.assert_allclose(
+        np.asarray(z.reshape(3, -1, 8).sum(-1)), 1.0, atol=1e-5)
+    h2 = _gru(params, jnp.concatenate(
+        [z, jnp.zeros((3, 2))], -1), h)
+    assert h2.shape == h.shape
+
+    # gradients flow through the sample to the logits (straight-through)
+    g = jax.grad(lambda lg: _sample_stoch(
+        jax.random.PRNGKey(1), lg).sum())(logits)
+    assert float(jnp.abs(g).sum()) > 0.0
+
+
+def test_dreamer_learns_cartpole():
+    """Imagination-trained policy improves on CartPole within a small
+    env-step budget (the whole update — world model scan, imagination,
+    lambda returns, three optimizers — is one jitted XLA program)."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    algo = (DreamerV3Config().environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                         rollout_fragment_length=32)
+            .training(learning_starts=512, updates_per_step=6,
+                      ent_coef=1e-2)
+            .debugging(seed=0).build())
+    best = 0.0
+    for _ in range(70):
+        r = algo.step()
+        m = r.get("episode_return_mean", float("nan"))
+        if m == m:
+            best = max(best, m)
+        if best >= 50.0:
+            break
+    assert best >= 50.0, f"DreamerV3 stalled at {best}"
+    # checkpoint round-trips model + slow critic + return range
+    ck = algo.save_checkpoint("/tmp/dreamer_ck")
+    algo2 = (DreamerV3Config().environment("CartPole-v1")
+             .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                          rollout_fragment_length=16)
+             .debugging(seed=1).build())
+    algo2.load_checkpoint(ck)
+    assert float(algo2._ret_range) == pytest.approx(float(algo._ret_range))
+    a = algo2.compute_single_action(np.zeros(4, np.float32))
+    assert a in (0, 1)
