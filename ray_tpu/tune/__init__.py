@@ -15,6 +15,7 @@ from ..train.session import get_checkpoint, get_context, report  # noqa: F401
 from .search import (  # noqa: F401
     BasicVariantGenerator,
     HaltonSearchGenerator,
+    TPESearcher,
     Searcher,
     choice,
     grid_search,
@@ -51,7 +52,7 @@ __all__ = [
     "with_parameters", "with_resources", "report", "get_checkpoint",
     "get_context", "uniform", "quniform", "loguniform", "qloguniform",
     "randint", "choice", "sample_from", "grid_search", "Searcher",
-    "BasicVariantGenerator", "HaltonSearchGenerator",
+    "BasicVariantGenerator", "HaltonSearchGenerator", "TPESearcher",
     "TrialScheduler", "FIFOScheduler",
     "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "PB2",

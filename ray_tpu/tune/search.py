@@ -117,14 +117,18 @@ def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
 class Searcher:
     """Reference tune/search/searcher.py surface."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
         self.metric, self.mode = metric, mode
 
     def set_search_properties(self, metric: Optional[str], mode: str,
                               config: Dict[str, Any]) -> bool:
-        if metric:
+        """Fill properties the searcher was NOT constructed with — an
+        explicit TPESearcher(mode="min") must not be flipped by the
+        TuneConfig default."""
+        if self.metric is None and metric:
             self.metric = metric
-        if mode:
+        if self.mode is None and mode:
             self.mode = mode
         return True
 
@@ -297,9 +301,102 @@ class HaltonSearchGenerator(Searcher):
             return None
 
 
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (Bergstra et al. 2011) — the
+    model-based half of a BOHB setup (the reference integrates
+    hyperopt/BOHB as plugin searchers; this is the native
+    implementation). Completed trials split into a good quantile and
+    the rest; per-dimension KDEs over unit space model each group, and
+    suggestions maximize the density ratio l_good/l_bad over sampled
+    candidates. Pair with AsyncHyperBandScheduler for BOHB-style
+    multi-fidelity search:
+
+        tune.run(f, search_alg=TPESearcher(space, num_samples=64),
+                 scheduler=tune.AsyncHyperBandScheduler(...))
+    """
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 32,
+                 metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 n_initial: int = 10, gamma: float = 0.25,
+                 n_ei_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        if _split_grid(space):
+            raise ValueError("TPESearcher models continuous/categorical "
+                             "Domains; use BasicVariantGenerator for "
+                             "grid_search spaces")
+        self._space = space
+        self._paths = _domain_paths(space)
+        if not self._paths:
+            raise ValueError("TPESearcher needs at least one Domain "
+                             "(tune.uniform/randint/choice) in the space")
+        self._num = num_samples
+        self._issued = 0
+        self._n_initial = n_initial
+        self._gamma = gamma
+        self._n_cand = n_ei_candidates
+        self._rng = random.Random(seed)
+        # trial_id -> unit-space vector of the issued config
+        self._pending: Dict[str, List[float]] = {}
+        self._obs: List[tuple] = []  # (unit vector, score)
+
+    # ------------------------------------------------------------ model
+    def _kde_logpdf(self, u: float, centers: List[float]) -> float:
+        n = len(centers)
+        bw = max(0.1, 1.06 * n ** (-0.2) * 0.25)
+        acc = 0.0
+        for c in centers:
+            acc += math.exp(-0.5 * ((u - c) / bw) ** 2)
+        return math.log(acc / (n * bw) + 1e-12)
+
+    def _propose_unit(self) -> List[float]:
+        ordered = sorted(self._obs, key=lambda o: -o[1])
+        k = max(1, int(len(ordered) * self._gamma))
+        good = [o[0] for o in ordered[:k]]
+        bad = [o[0] for o in ordered[k:]] or good
+        best, best_score = None, -math.inf
+        for _ in range(self._n_cand):
+            # draw from the good KDE: pick a good point, jitter per dim
+            base = self._rng.choice(good)
+            cand = [min(max(b + self._rng.gauss(0.0, 0.15), 0.0), 1.0)
+                    for b in base]
+            score = sum(
+                self._kde_logpdf(u, [g[i] for g in good])
+                - self._kde_logpdf(u, [b[i] for b in bad])
+                for i, u in enumerate(cand))
+            if score > best_score:
+                best, best_score = cand, score
+        return best
+
+    # --------------------------------------------------------- protocol
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._issued >= self._num:
+            return None
+        self._issued += 1
+        if len(self._obs) < max(1, self._n_initial):
+            unit = [self._rng.random() for _ in self._paths]
+        else:
+            unit = self._propose_unit()
+        cfg = copy.deepcopy(self._space)
+        for (path, dom), u in zip(self._paths, unit):
+            _set_path(cfg, path, dom.from_uniform(u))
+        self._pending[trial_id] = unit
+        return _resolve(cfg, self._rng, {})
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        unit = self._pending.pop(trial_id, None)
+        if unit is None or error or not result or \
+                self.metric not in result:
+            return
+        v = float(result[self.metric])
+        self._obs.append((unit, -v if self.mode == "min" else v))
+
+
 __all__ = [
     "Domain", "Float", "Integer", "Categorical", "SampleFrom", "Searcher",
-    "BasicVariantGenerator", "HaltonSearchGenerator", "uniform",
+    "BasicVariantGenerator", "HaltonSearchGenerator", "TPESearcher", "uniform",
     "quniform", "loguniform", "qloguniform", "randint", "choice",
     "sample_from", "grid_search",
 ]

@@ -463,11 +463,16 @@ def _json_config(d: Dict[str, Any]) -> Dict[str, Any]:
 def run(trainable, *, config: Optional[Dict] = None, num_samples: int = 1,
         metric: Optional[str] = None, mode: str = "max",
         scheduler: Optional[TrialScheduler] = None,
+        search_alg: Optional[Searcher] = None,
         stop: Optional[Union[Dict, Callable]] = None,
         name: Optional[str] = None,
         storage_path: Optional[str] = None,
         max_concurrent_trials: Optional[int] = None) -> ResultGrid:
     """Legacy tune.run surface (reference python/ray/tune/tune.py)."""
+    if search_alg is not None and num_samples != 1:
+        raise ValueError(
+            "num_samples is ignored when search_alg is given — set the "
+            "searcher's own num_samples instead")
     rc = RunConfig(name=name, storage_path=storage_path)
     if stop is not None:
         rc.stop = stop  # type: ignore[attr-defined]
@@ -476,6 +481,7 @@ def run(trainable, *, config: Optional[Dict] = None, num_samples: int = 1,
         tune_config=TuneConfig(metric=metric, mode=mode,
                                num_samples=num_samples,
                                scheduler=scheduler,
+                               search_alg=search_alg,
                                max_concurrent_trials=max_concurrent_trials),
         run_config=rc)
     return tuner.fit()
