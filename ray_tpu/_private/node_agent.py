@@ -239,6 +239,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--resources", default=None,
                     help='extra resources as JSON, e.g. \'{"TPU": 4}\'')
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--node-id", default=None,
+                    help="pre-assigned node id (autoscaler providers "
+                         "correlate launched nodes this way)")
     args = ap.parse_args(argv)
     host, port = args.address.rsplit(":", 1)
     resources = {"CPU": args.num_cpus}
@@ -246,7 +249,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         import json
 
         resources.update(json.loads(args.resources))
-    agent = NodeAgent((host, int(port)), resources).start()
+    agent = NodeAgent((host, int(port)), resources,
+                      node_id=args.node_id).start()
     print(f"node agent {agent.node_id[:12]} on {agent.address} "
           f"joined {args.address}", flush=True)
     try:

@@ -53,6 +53,8 @@ def cmd_start(args) -> None:
                 str(args.num_cpus)]
         if args.resources:
             argv += ["--resources", args.resources]
+        if getattr(args, "node_id", None):
+            argv += ["--node-id", args.node_id]
         agent_main(argv)
         return
     from ray_tpu._private.conductor import Conductor
@@ -247,6 +249,8 @@ def main(argv=None) -> None:
                     default=float(os.cpu_count() or 1))
     sp.add_argument("--resources", help='extra resources as JSON, e.g. '
                     '\'{"TPU": 4}\'')
+    sp.add_argument("--node-id", help="pre-assigned node id (worker-host "
+                                      "joins launched by a provider)")
     sp.add_argument("--block", action="store_true")
     sp.add_argument("--dashboard-port", type=int, default=8265)
     sp.add_argument("--no-dashboard", action="store_true")
