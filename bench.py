@@ -1,8 +1,9 @@
 """Headline benchmark: GPT-2 125M training throughput, tokens/sec/chip.
 
 Runs the full JaxTrainer TrainStep (fwd+bwd+adamw, donated state, bf16
-params, flash attention, remat) on all local devices with a dp mesh, and
-prints ONE JSON line {metric, value, unit, vs_baseline, ...}.
+params, flash attention) on all local devices with a dp mesh, and prints
+ONE JSON line {metric, value, unit, vs_baseline, ...} as the LAST stdout
+line.
 
 Self-checking (a round-1 recording was physically impossible — 72x over
 chip peak): the script computes the implied model FLOP/s from the
@@ -16,15 +17,29 @@ Ray+NCCL+A100" on GPT-2 125M DDP. We take 140k tokens/sec/chip as the
 A100-class reference point (bf16+flash-attention GPT-2 124M DDP, public
 nanoGPT-scale numbers), so vs_baseline = measured / 140000.
 
-Wedge-proofing: the top-level process never initializes a jax backend.
-It runs the measurement in a child (_BENCH_CHILD=1) with a bounded
-timeout; if the default-backend child dies or hangs (e.g. the TPU relay
-is wedged/UNAVAILABLE), it retries on JAX_PLATFORMS=cpu so a parsed
-number is still emitted, with the TPU failure recorded in the JSON
-instead of a raw traceback.
+Wedge-resistance (the axon TPU relay is fragile: a killed mid-flight
+pallas compile can wedge it for the whole session, and one wedged child
+previously burned the entire 900 s budget and left no number). The
+supervisor therefore:
+  1. sweeps stale /dev/shm/rtpu_a_* slabs (leaked segments degrade or
+     break the shm arena and the measurement);
+  2. enables the persistent XLA compilation cache under .xla_cache/ so
+     a retry never pays the same cold compile twice;
+  3. spends ~2 min on a tiny-jit HEALTH child before committing the big
+     budget — a wedged relay is detected for pennies;
+  4. runs the MEASURE child with known-good defaults only (flash blocks
+     1024/1024, per-chip batch 32, no autotune sweep, no fused-bwd
+     probe): the minimal risk path to a number on disk;
+  5. leaves kernel exploration (fused-bwd probe, block autotune) to
+     opt-in children (BENCH_EXPLORE=1) that run only AFTER a headline
+     number exists, each in its own bounded process;
+  6. falls back to JAX_PLATFORMS=cpu if the TPU path fails so a parsed
+     record is always emitted, with the TPU failure recorded in the
+     JSON instead of a raw traceback.
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
 import subprocess
@@ -77,6 +92,22 @@ def _attn_flops_per_token(cfg, seq: int, causal: bool = True) -> float:
     return per / 2 if causal else per
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache under the repo: a retried child
+    (or a later explore child) skips the cold compile a previous attempt
+    already paid for. Best-effort — the experimental axon platform may
+    not support it."""
+    import jax
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".xla_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        print(f"bench: compile cache unavailable ({e})", file=sys.stderr)
+
+
 def _time_loop(step, state, batch, iters: int) -> tuple:
     # float() forces a device-to-host read: a real synchronization point
     # even on backends whose block_until_ready is asynchronous (remote
@@ -127,9 +158,9 @@ def _autotune_flash_blocks(make_step, params, batch, warmup: int = 2,
                            iters: int = 6):
     """On-chip sweep of flash-attention block sizes: time the FULL train
     step under each candidate and leave the winner as the module default
-    (the attention kernel is the known MFU limiter — BENCH_AUTOTUNE=0
-    skips, BENCH_BLOCKS="q,k" pins without sweeping). Each candidate
-    pays one recompile; a failing candidate scores 0 and is skipped."""
+    (the attention kernel is the known MFU limiter — BENCH_BLOCKS="q,k"
+    pins without sweeping). Each candidate pays one recompile; a failing
+    candidate scores 0 and is skipped."""
     import jax
     import jax.numpy as jnp
 
@@ -166,6 +197,22 @@ def _autotune_flash_blocks(make_step, params, batch, warmup: int = 2,
     return best[1]
 
 
+def _health_main() -> None:
+    """Tiny-jit relay health probe: import jax, list devices, compile and
+    run one small matmul. Finishes in seconds on a healthy backend; hangs
+    on a wedged relay — which the supervisor detects for ~2 min instead
+    of burning the full measurement budget."""
+    forced = os.environ.get("_BENCH_PLATFORM")
+    import jax
+    if forced:
+        jax.config.update("jax_platforms", forced)
+    import jax.numpy as jnp
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x)
+    print(json.dumps({"health": "ok", "value": float(y),
+                      "platform": jax.devices()[0].platform}))
+
+
 def main() -> None:
     # The axon sitecustomize force-sets JAX_PLATFORMS, so the cpu
     # fallback must win through jax.config (same guard as tests/conftest):
@@ -174,6 +221,7 @@ def main() -> None:
     import jax
     if forced:
         jax.config.update("jax_platforms", forced)
+    _enable_compile_cache()
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -185,8 +233,11 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
+    # Fused-bwd probe is explicit opt-in (RAY_TPU_FLASH_FUSED_BWD=1): the
+    # probe costs two extra kernel compiles on the fragile relay, so the
+    # default measurement path never runs it.
     fused_bwd = False
-    if on_tpu and os.environ.get("RAY_TPU_FLASH_FUSED_BWD") != "0":
+    if on_tpu and os.environ.get("RAY_TPU_FLASH_FUSED_BWD") == "1":
         fused_bwd = _probe_fused_flash_bwd()
     cfg = GPT2Config.small() if on_tpu else GPT2Config.tiny()
     seq = cfg.max_seq_len if on_tpu else 64
@@ -218,9 +269,17 @@ def main() -> None:
              "targets": jnp.asarray(batch_np[:, 1:])}
     tokens_per_step = per_chip_batch * n_chips * seq
 
+    # Autotune is opt-in (BENCH_AUTOTUNE=1): the known-good blocks
+    # (1024/1024, measured best in round 3) are the module defaults, and
+    # the sweep's several recompiles belong in an explore child that runs
+    # only after a headline number exists. BENCH_BLOCKS="q,k" pins.
     flash_blocks = None
-    if on_tpu and os.environ.get("BENCH_AUTOTUNE", "1") == "1":
+    if on_tpu and (os.environ.get("BENCH_AUTOTUNE", "0") == "1"
+                   or os.environ.get("BENCH_BLOCKS")):
         flash_blocks = _autotune_flash_blocks(make_step, params0, batch)
+    elif on_tpu:
+        from ray_tpu.ops import attention
+        flash_blocks = (attention.DEFAULT_BLOCK_Q, attention.DEFAULT_BLOCK_K)
 
     step = make_step()
     state = step.init_state(jax.tree.map(jnp.copy, params0))
@@ -270,8 +329,25 @@ def main() -> None:
     }))
 
 
+def _sweep_stale_shm() -> int:
+    """Remove leaked rtpu arena slabs from earlier crashed runs: stale
+    segments eat /dev/shm and have previously degraded or broken the
+    measurement. Only this framework's prefix is touched."""
+    n = 0
+    for path in glob.glob("/dev/shm/rtpu_a_*"):
+        try:
+            os.unlink(path)
+            n += 1
+        except OSError:
+            pass
+    if n:
+        print(f"bench: swept {n} stale /dev/shm/rtpu_a_* segment(s)",
+              file=sys.stderr)
+    return n
+
+
 def _run_child(extra_env: dict, timeout: float):
-    """Run this script as a measurement child; return (json_dict | None,
+    """Run this script as a child stage; return (json_dict | None,
     reason, returncode | None). The last stdout line must be the JSON
     record; stderr is passed through for diagnostics.
 
@@ -316,35 +392,48 @@ def _run_child(extra_env: dict, timeout: float):
 
 
 def _supervise() -> int:
-    """Parent entry: never initializes a jax backend in-process. Tries the
-    default backend (TPU under axon) in a bounded child, falls back to CPU
-    so the driver always gets a parsed number; only if both fail does it
-    emit an {"error": ...} record (still valid single-line JSON)."""
-    # Defaults must leave room for the CPU fallback INSIDE whatever outer
-    # budget the driver enforces: a real on-chip run is ~3-5 min including
-    # cold compile and the fused-bwd probe, a wedged relay burns the full
-    # TPU budget first.
+    """Parent entry: never initializes a jax backend in-process. Stages:
+    shm sweep -> health child -> measure child (known-good defaults) ->
+    optional explore children (BENCH_EXPLORE=1) -> cpu fallback if the
+    TPU path failed. Always emits one parsed JSON line last."""
+    health_timeout = float(os.environ.get("BENCH_HEALTH_TIMEOUT", "150"))
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "240"))
 
-    rec, tpu_err, tpu_rc = _run_child({}, tpu_timeout)
+    _sweep_stale_shm()
+
+    rec, tpu_err = None, ""
+    hrec, herr, _hrc = _run_child({"_BENCH_MODE": "health"}, health_timeout)
+    if hrec is None:
+        tpu_err = f"health probe failed: {herr}"
+        sys.stderr.write(f"bench: {tpu_err}; skipping TPU measurement\n")
+    else:
+        # healthy backend (TPU, or the default platform on a bare-CPU
+        # dev box — main() labels the metric by platform either way)
+        rec, tpu_err, tpu_rc = _run_child({}, tpu_timeout)
+        if rec is None and tpu_rc == INVALID_MEASUREMENT_RC:
+            # The bench's own validity guard fired (impossible MFU /
+            # unstable timing). Fail loudly — a CPU-fallback "success"
+            # would bury it.
+            print(json.dumps({
+                "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                "error": f"measurement declared invalid by child: {tpu_err}",
+            }))
+            return 1
+        if (rec is not None and rec.get("implied_mfu")
+                and os.environ.get("BENCH_EXPLORE") == "1"):
+            rec = _explore(rec, tpu_timeout)
+
     if rec is not None:
         print(json.dumps(rec))
         return 0
-    if tpu_rc == INVALID_MEASUREMENT_RC:
-        # The bench's own validity guard fired (impossible MFU / unstable
-        # timing). Fail loudly — a CPU-fallback "success" would bury it.
-        print(json.dumps({
-            "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
-            "error": f"measurement declared invalid by child: {tpu_err}",
-        }))
-        return 1
 
     sys.stderr.write(f"bench: default-backend run failed ({tpu_err}); "
                      "retrying on cpu\n")
     rec, cpu_err, cpu_rc = _run_child(
-        {"JAX_PLATFORMS": "cpu", "_BENCH_PLATFORM": "cpu"}, cpu_timeout)
+        {"JAX_PLATFORMS": "cpu", "_BENCH_PLATFORM": "cpu",
+         "_BENCH_MODE": "measure"}, cpu_timeout)
     if rec is not None:
         rec["tpu_error"] = tpu_err
         print(json.dumps(rec))
@@ -362,8 +451,30 @@ def _supervise() -> int:
     return 1
 
 
+def _explore(rec: dict, timeout: float) -> dict:
+    """Opt-in kernel exploration, run only once a headline number is
+    already in hand: fused-bwd probe child, then block-autotune child.
+    Keeps whichever child's record is fastest; failures leave the
+    headline record untouched."""
+    best = rec
+    probe, perr, _ = _run_child({"RAY_TPU_FLASH_FUSED_BWD": "1"}, timeout)
+    if probe is not None and probe.get("value", 0) > best.get("value", 0):
+        best = probe
+    elif probe is None:
+        sys.stderr.write(f"bench: fused-bwd explore failed ({perr})\n")
+    tuned, terr, _ = _run_child({"BENCH_AUTOTUNE": "1"}, timeout)
+    if tuned is not None and tuned.get("value", 0) > best.get("value", 0):
+        best = tuned
+    elif tuned is None:
+        sys.stderr.write(f"bench: autotune explore failed ({terr})\n")
+    return best
+
+
 if __name__ == "__main__":
     if os.environ.get("_BENCH_CHILD") == "1":
-        main()
+        if os.environ.get("_BENCH_MODE") == "health":
+            _health_main()
+        else:
+            main()
     else:
         sys.exit(_supervise())

@@ -189,6 +189,12 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     return _require_worker().get(refs, timeout=timeout)
 
 
+async def get_async(ref: ObjectRef):
+    """Await an ObjectRef from asyncio code without blocking the loop
+    (reference: `await ref` support, python/ray/_private/async_compat)."""
+    return await _require_worker().get_async(ref)
+
+
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True
          ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
@@ -263,7 +269,8 @@ def get_runtime_context() -> _RuntimeContext:
 
 
 __all__ = [
-    "init", "shutdown", "is_initialized", "remote", "put", "get", "wait",
+    "init", "shutdown", "is_initialized", "remote", "put", "get",
+    "get_async", "wait",
     "kill", "cancel", "get_actor", "exit_actor", "cluster_resources",
     "available_resources", "nodes", "get_runtime_context", "ObjectRef",
     "ActorClass", "ActorHandle", "exceptions", "__version__",

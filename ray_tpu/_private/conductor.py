@@ -22,6 +22,7 @@ Responsibilities:
 """
 from __future__ import annotations
 
+import collections
 import os
 import signal
 import subprocess
@@ -156,6 +157,14 @@ class ConductorHandler:
         self._clients = ClientPool()
         self._stopped = False
         self._waiting_leases = 0
+        # Parked lease_worker calls, each on its OWN condition sharing
+        # self._lock. Capacity events wake exactly ONE waiter (rotating
+        # for fairness) and a successful grant cascades to the next —
+        # notify_all here caused a measured 6x throughput collapse once
+        # waiters outnumbered workers (every return_worker woke every
+        # parked waiter into a full rescan). The 0.1s wait timeout in
+        # _lease_locked remains the liveness net for any missed wakeup.
+        self._lease_waiter_cvs: "collections.deque" = collections.deque()
         # resource shapes of leases currently blocked (autoscaler signal)
         self._pending_demand: List[Tuple[float, Dict[str, float]]] = []
         self.address: Optional[Tuple[str, int]] = None  # set by Conductor
@@ -201,7 +210,7 @@ class ConductorHandler:
                 free_chips=[c for c in range(int(resources.get("TPU", 0)))
                             if c not in bound])
             self._reapply_pg_reservations(node_id)
-            self._cv.notify_all()
+            self._notify_all_locked()
 
     def _reapply_pg_reservations(self, node_id: str) -> None:
         """A (re-)registered node's record starts with full availability;
@@ -255,7 +264,7 @@ class ConductorHandler:
                     dead_recs.append(w)
                     if w.address:
                         self._clients.invalidate(w.address)
-            self._cv.notify_all()
+            self._notify_all_locked()
         for w in dead_recs:
             self._on_worker_death(w)
         return True
@@ -286,7 +295,7 @@ class ConductorHandler:
                     if w.address:
                         self._clients.invalidate(w.address)
             del self._nodes[node_id]
-            self._cv.notify_all()
+            self._notify_all_locked()
         for w in dead:
             self._on_worker_death(w)
         return True
@@ -347,7 +356,7 @@ class ConductorHandler:
                 if confirmed_gone():
                     with self._cv:
                         self._free_worker_chips(w)
-                        self._cv.notify_all()
+                        self._notify_all_locked()
                     return
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 30.0)
@@ -420,7 +429,7 @@ class ConductorHandler:
                          for c in (rec.chip_ids or ())}
                 if taken & set(chips):
                     w.state = "DEAD"
-                    self._cv.notify_all()
+                    self._notify_all_locked()
                     return False
                 if n is not None:
                     n.free_chips = [c for c in n.free_chips
@@ -428,7 +437,7 @@ class ConductorHandler:
                 w.chip_ids = chips
             if w.state == "STARTING":
                 w.state = "IDLE"
-            self._cv.notify_all()
+            self._notify_all_locked()
             return True
 
     def _spawn_worker(self, env_extra: Optional[Dict[str, str]] = None,
@@ -453,7 +462,7 @@ class ConductorHandler:
                     with self._cv:
                         w.state = "DEAD"
                         self._free_worker_chips(w)
-                        self._cv.notify_all()
+                        self._notify_all_locked()
 
             # RPC outside the conductor lock; the lease loop cv-waits for
             # the worker to register back.
@@ -540,12 +549,44 @@ class ConductorHandler:
         return self._nodes.get(w.lease_node_id or w.node_id) \
             or self._nodes.get(w.node_id)
 
+    def _wake_lease_waiter_locked(self, skip=None) -> None:
+        """Wake ONE parked lease waiter (rotating so consecutive events
+        spread across waiters). `skip` excludes the granting thread's own
+        cv during the grant cascade — notifying it would be a wasted
+        wakeup (it is leaving) and the remaining waiters would sit out
+        the full 0.1s poll. Must hold self._lock."""
+        for _ in range(len(self._lease_waiter_cvs)):
+            cv = self._lease_waiter_cvs[0]
+            self._lease_waiter_cvs.rotate(-1)
+            if cv is not skip:
+                cv.notify()
+                return
+
+    def _notify_all_locked(self) -> None:
+        """State-change fanout: wake shared-cv waiters (actor state, PG,
+        spawn waits) plus one parked lease waiter. Must hold self._lock."""
+        self._cv.notify_all()
+        self._wake_lease_waiter_locked()
+
     def _lease_locked(self, resources, deadline,
                       strategy: str = "DEFAULT", arg_locations=None):
             affinity = None
             if isinstance(strategy, (tuple, list)) and strategy \
                     and strategy[0] == "NODE_AFFINITY":
                 affinity = (str(strategy[1]), bool(strategy[2]))
+            my_cv = threading.Condition(self._lock)
+            self._lease_waiter_cvs.append(my_cv)
+            try:
+                return self._lease_wait_locked(resources, deadline, strategy,
+                                               arg_locations, affinity, my_cv)
+            finally:
+                try:
+                    self._lease_waiter_cvs.remove(my_cv)
+                except ValueError:
+                    pass
+
+    def _lease_wait_locked(self, resources, deadline, strategy,
+                           arg_locations, affinity, my_cv):
             while True:
                 if self._stopped:
                     raise RuntimeError("conductor stopped")
@@ -592,6 +633,9 @@ class ConductorHandler:
                         w.state = "BUSY"
                         w.resources = resources
                         w.lease_node_id = acquired.node_id
+                        # grant cascade: capacity may remain (coalesced
+                        # frees) — hand the baton to the next waiter
+                        self._wake_lease_waiter_locked(skip=my_cv)
                         return w.worker_id, w.address
                     self._release_resources(acquired, resources)
                 remaining = deadline - time.monotonic()
@@ -599,7 +643,7 @@ class ConductorHandler:
                     raise TimeoutError(
                         f"no worker available for {resources} within timeout; "
                         f"available={head.available}")
-                self._cv.wait(min(remaining, 0.1))
+                my_cv.wait(min(remaining, 0.1))
 
     def _affinity_nodes_locked(self, affinity, resources):
         """Candidate list under ("NODE_AFFINITY", node_id, soft):
@@ -740,7 +784,7 @@ class ConductorHandler:
             w.blocked_resources = None  # a parked lease dies with the task
             if w.state == "BUSY":
                 w.state = "IDLE"
-            self._cv.notify_all()
+            self._notify_all_locked()
 
     def worker_blocked(self, worker_id: str) -> None:
         """A worker's executor thread entered a blocking get()/wait():
@@ -758,7 +802,7 @@ class ConductorHandler:
                                     w.resources)
             w.blocked_resources = w.resources
             w.resources = {}
-            self._cv.notify_all()
+            self._notify_all_locked()
 
     def worker_unblocked(self, worker_id: str) -> None:
         """Re-take the parked lease on wake. Transient oversubscription
@@ -774,7 +818,7 @@ class ConductorHandler:
                     node.available[k] = node.available.get(k, 0.0) - v
             w.resources = w.blocked_resources
             w.blocked_resources = None
-            self._cv.notify_all()
+            self._notify_all_locked()
 
     def prestart_workers(self, n: int) -> None:
         with self._cv:
@@ -838,7 +882,7 @@ class ConductorHandler:
             with self._cv:
                 rec.state = "DEAD"
                 rec.death_cause = f"scheduling failed: {e}"
-                self._cv.notify_all()
+                self._notify_all_locked()
             return
         client = self._clients.get(address)
         try:
@@ -849,7 +893,7 @@ class ConductorHandler:
             with self._cv:
                 rec.state = "DEAD"
                 rec.death_cause = f"__init__ failed: {e}"
-                self._cv.notify_all()
+                self._notify_all_locked()
             return
         with self._cv:
             w = self._workers.get(worker_id)
@@ -859,7 +903,7 @@ class ConductorHandler:
             rec.address = address
             rec.state = "ALIVE"
             self._dirty = True
-            self._cv.notify_all()
+            self._notify_all_locked()
         self.publish("actor_state", {"actor_id": actor_id, "state": "ALIVE"})
 
     def get_actor_info(self, actor_id: Optional[str] = None,
@@ -935,7 +979,7 @@ class ConductorHandler:
                                             w.resources)
                     w.resources = {}
                     self._free_worker_chips(w)
-            self._cv.notify_all()
+            self._notify_all_locked()
         self.publish("actor_state", {"actor_id": actor_id, "state": "DEAD"})
 
     # ------------------------------------------------------------------- KV
@@ -1113,7 +1157,7 @@ class ConductorHandler:
                 pg_id=pg_id, bundles=bundles, strategy=strategy, name=name,
                 assignments=assignment)
             self._dirty = True
-            self._cv.notify_all()
+            self._notify_all_locked()
         return pg_id
 
     def placement_group_ready(self, pg_id: str) -> bool:
@@ -1138,7 +1182,7 @@ class ConductorHandler:
                     node.available.pop(pk, None)
                 self._release_resources(node, b)
             self._dirty = True
-            self._cv.notify_all()
+            self._notify_all_locked()
 
     def list_placement_groups(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -1481,7 +1525,7 @@ class ConductorHandler:
                     if (n.has_agent and n.alive
                             and now - n.last_heartbeat > node_timeout):
                         n.alive = False
-                self._cv.notify_all()
+                self._notify_all_locked()
             for w in dead:
                 self._on_worker_death(w)
 
@@ -1534,7 +1578,7 @@ class ConductorHandler:
                         rec.state = "DEAD"
                         rec.death_cause = "worker process died"
             self._dirty = True
-            self._cv.notify_all()
+            self._notify_all_locked()
         for actor_id in restart:
             self.publish("actor_state",
                          {"actor_id": actor_id, "state": "RESTARTING"})
@@ -1552,7 +1596,7 @@ class ConductorHandler:
             jobs = list(getattr(self, "_jobs", {}).values())
             agents = [n.address for n in self._nodes.values()
                       if n.has_agent and n.alive]
-            self._cv.notify_all()
+            self._notify_all_locked()
         for addr in agents:
             try:
                 self._clients.get(addr).call("stop_node", timeout=5.0)
@@ -1566,6 +1610,18 @@ class ConductorHandler:
                     pass
         for w in workers:
             if w.proc is not None and w.proc.poll() is None:
+                # RPC first: an rpc-handler thread can os._exit without
+                # waiting for the MAIN thread to notice a signal flag —
+                # on a contended 1-core host, SIGTERM-only teardown of
+                # fork-server workers measured ~1.7s (the signal lands
+                # on a non-main thread and the main thread must be
+                # scheduled before the handler runs)
+                if w.address:
+                    try:
+                        self._clients.get(tuple(w.address)).notify(
+                            "shutdown_worker")
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
                 try:
                     w.proc.terminate()
                 except OSError:
@@ -1586,6 +1642,9 @@ class ConductorHandler:
                         pass
         self._clients.close_all()
         self._flush_state()
+        from .worker_spawn import stop_fork_server
+
+        stop_fork_server(self._session_dir)
         # workers that needed SIGKILL leaked their shm arena segments
         from .object_store import cleanup_leaked_segments
 

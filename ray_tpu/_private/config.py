@@ -47,6 +47,10 @@ _FLAGS: List[Flag] = [
     Flag("restore_grace", float, 20.0,
          "seconds a snapshot-restored worker record is presumed alive "
          "awaiting its re-register"),
+    Flag("lease_idle_ttl", float, 0.1,
+         "seconds a submitter keeps an idle worker lease for reuse "
+         "before returning it to the conductor (reference: direct task "
+         "submitter worker-lease caching)"),
     # --- object plane --------------------------------------------------
     Flag("object_store_cap", int, 2 * 1024**3,
          "per-process object store memory cap in bytes; eviction spills "
@@ -62,6 +66,12 @@ _FLAGS: List[Flag] = [
          "chunk size for cross-host object pulls"),
     Flag("spill_dir", str, "",
          "directory for eviction spill files (default: tmp)"),
+    Flag("data_memory_budget", int, 512 * 1024 * 1024,
+         "per-operator in-flight byte budget for Dataset execution "
+         "(0 disables; reference data ResourceManager memory budgets)"),
+    Flag("data_shm_high_water", float, 0.85,
+         "host /dev/shm usage fraction above which Dataset operators "
+         "stall task admission (reference object-store backpressure)"),
     Flag("force_remote_fetch", int, 0,
          "testing: every process claims a distinct machine id, forcing "
          "the cross-host chunked fetch path"),

@@ -64,7 +64,13 @@ def main() -> None:
     grace = config.worker_orphan_grace
     last_ok = time.monotonic()
     while True:
-        time.sleep(5.0)
+        # chunked: in fork-server children the kernel often delivers
+        # SIGTERM to a non-main thread, which only sets CPython's signal
+        # flag — the main thread notices at its next bytecode, so a flat
+        # 5s sleep made teardown take seconds (cold-spawned processes
+        # get the signal on the main thread and EINTR out immediately)
+        for _ in range(50):
+            time.sleep(0.1)
         try:
             ok = w.conductor.call(
                 "register_worker", worker_id, w.address, os.getpid(),

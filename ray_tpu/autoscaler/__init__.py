@@ -372,9 +372,43 @@ class StandardAutoscaler:
                 del self._tracked[nid]
                 self._provisioning.pop(nid, None)
                 terminated.append(nid)
-        return {"pending_demand": len(demand), "launched": launched,
-                "terminated": terminated, "counts": counts,
-                "bootstrap_failed": bootstrap_failed}
+        stats = {"pending_demand": len(demand), "launched": launched,
+                 "terminated": terminated, "counts": counts,
+                 "bootstrap_failed": bootstrap_failed}
+        self._publish_status(stats)
+        return stats
+
+    def _publish_status(self, stats: Dict[str, Any]) -> None:
+        """Mirror reconcile results into the conductor KV so the
+        dashboard's autoscaler view works from any process (the analog
+        of the reference's `ray status` debug-state output,
+        autoscaler/_private/monitor.py)."""
+        import json as _json
+
+        status = {
+            "timestamp": time.time(),
+            "counts": stats["counts"],
+            "pending_demand": stats["pending_demand"],
+            "last_launched": stats["launched"],
+            "last_terminated": stats["terminated"],
+            "bootstrap_failed": stats["bootstrap_failed"],
+            "provisioning": [
+                {"node_id": nid, "node_type": p.node_type,
+                 "attempt": p.attempt}
+                for nid, p in self._provisioning.items()],
+            "node_types": {
+                name: {"min_workers": c.min_workers,
+                       "max_workers": c.max_workers,
+                       "resources": c.resources}
+                for name, c in self.config.node_types.items()},
+        }
+        try:
+            self._conductor.call(
+                "kv_put", b"autoscaler:status",
+                _json.dumps(status).encode(), True, "autoscaler",
+                timeout=5.0)
+        except Exception:  # noqa: BLE001 — status mirror is best-effort
+            pass
 
     # -- loop ----------------------------------------------------------------
     def start(self) -> "StandardAutoscaler":

@@ -1,0 +1,202 @@
+/* ray_tpu typed C++ surface — ObjectRef<T> / typed Put/Get/Submit over
+ * the v2 C ABI (ray_tpu_api.h).
+ *
+ * Reference analog: /root/reference/cpp/include/ray/api.h — ray::Put
+ * returning ray::ObjectRef<T>, ray::Get, ray::Task(...).Remote() —
+ * rebuilt header-only over this runtime's function-table ABI so native
+ * tasks never link against the framework.
+ *
+ *   extern "C" int64_t my_task(const ray_tpu_api_t* api,
+ *                              const uint8_t* in, size_t in_len,
+ *                              uint8_t** out, size_t* out_len) {
+ *     ray_tpu::Runtime rt(api);
+ *     Vec3 v{1, 2, 3};
+ *     auto ref = rt.Put(v);                       // ObjectRef<Vec3>
+ *     Vec3 back = rt.Get(ref);                    // typed round-trip
+ *     auto sub = rt.Submit<double>("other_sym", payload);
+ *     double r = rt.Get(sub, /\*timeout_s=\*\/30.0);
+ *     ...
+ *   }
+ *
+ * Serialization: trivially-copyable T's are byte-copied; std::string
+ * and std::vector<trivially-copyable> ship their contents. That covers
+ * structs-of-PODs without a codegen step; anything richer should be
+ * serialized by the caller into bytes (the v2 ABI is always available
+ * underneath via Runtime::raw()).
+ *
+ * Ownership: ObjectRef releases its pin (api->release) when the last
+ * copy is destroyed — mirroring the reference's reference-counted
+ * ObjectRef (api.h ObjectRef dtor). Ids are process-local (see
+ * ray_tpu_api.h): pass values across task boundaries, not refs.
+ */
+#ifndef RAY_TPU_HPP_
+#define RAY_TPU_HPP_
+
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+#include "ray_tpu_api.h"
+
+namespace ray_tpu {
+
+class RayError : public std::runtime_error {
+ public:
+  RayError(const std::string& what, int64_t code)
+      : std::runtime_error(what + " (rc=" + std::to_string(code) + ")"),
+        code_(code) {}
+  int64_t code() const { return code_; }
+
+ private:
+  int64_t code_;
+};
+
+namespace detail {
+
+template <typename T>
+struct Codec {
+  static_assert(std::is_trivially_copyable<T>::value,
+                "ray_tpu::Codec<T>: T must be trivially copyable (or use "
+                "the std::string / std::vector specializations, or the "
+                "raw bytes ABI)");
+  static std::vector<uint8_t> encode(const T& v) {
+    std::vector<uint8_t> buf(sizeof(T));
+    std::memcpy(buf.data(), &v, sizeof(T));
+    return buf;
+  }
+  static T decode(const uint8_t* data, size_t len) {
+    if (len != sizeof(T)) {
+      throw RayError("typed Get: payload size " + std::to_string(len) +
+                         " != sizeof(T) " + std::to_string(sizeof(T)),
+                     22);
+    }
+    T v;
+    std::memcpy(&v, data, sizeof(T));
+    return v;
+  }
+};
+
+template <>
+struct Codec<std::string> {
+  static std::vector<uint8_t> encode(const std::string& s) {
+    return std::vector<uint8_t>(s.begin(), s.end());
+  }
+  static std::string decode(const uint8_t* data, size_t len) {
+    return std::string(reinterpret_cast<const char*>(data), len);
+  }
+};
+
+template <typename E>
+struct Codec<std::vector<E>> {
+  static_assert(std::is_trivially_copyable<E>::value,
+                "vector elements must be trivially copyable");
+  static std::vector<uint8_t> encode(const std::vector<E>& v) {
+    std::vector<uint8_t> buf(v.size() * sizeof(E));
+    if (!v.empty()) std::memcpy(buf.data(), v.data(), buf.size());
+    return buf;
+  }
+  static std::vector<E> decode(const uint8_t* data, size_t len) {
+    if (len % sizeof(E)) {
+      throw RayError("typed Get: payload not a whole number of elements",
+                     22);
+    }
+    std::vector<E> v(len / sizeof(E));
+    if (len) std::memcpy(v.data(), data, len);
+    return v;
+  }
+};
+
+/* Shared pin: api->release fires once, when the last ref copy dies. */
+class Pin {
+ public:
+  Pin(const ray_tpu_api_t* api, std::string id)
+      : api_(api), id_(std::move(id)) {}
+  ~Pin() {
+    if (api_ != nullptr) api_->release(api_->ctx, id_.c_str());
+  }
+  Pin(const Pin&) = delete;
+  Pin& operator=(const Pin&) = delete;
+  const std::string& id() const { return id_; }
+
+ private:
+  const ray_tpu_api_t* api_;
+  std::string id_;
+};
+
+}  // namespace detail
+
+/* Typed handle to a cluster object — reference api.h ObjectRef<T>. */
+template <typename T>
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  ObjectRef(const ray_tpu_api_t* api, std::string id)
+      : pin_(std::make_shared<detail::Pin>(api, std::move(id))) {}
+  const std::string& ID() const {
+    static const std::string kEmpty;
+    return pin_ ? pin_->id() : kEmpty;
+  }
+  bool Valid() const { return static_cast<bool>(pin_); }
+
+ private:
+  std::shared_ptr<detail::Pin> pin_;
+};
+
+class Runtime {
+ public:
+  explicit Runtime(const ray_tpu_api_t* api) : api_(api) {}
+
+  template <typename T>
+  ObjectRef<T> Put(const T& value) {
+    std::vector<uint8_t> buf = detail::Codec<T>::encode(value);
+    char id[RAY_TPU_OBJECT_ID_BUF] = {0};
+    int64_t rc = api_->put(api_->ctx, buf.data(), buf.size(), id);
+    if (rc != 0) throw RayError("Put failed", rc);
+    return ObjectRef<T>(api_, id);
+  }
+
+  /* timeout_s < 0 blocks forever (the default, like reference
+   * ray::Get); 0 polls; > 0 bounds the wait. */
+  template <typename T>
+  T Get(const ObjectRef<T>& ref, double timeout_s = -1.0) {
+    uint8_t* out = nullptr;
+    size_t out_len = 0;
+    int64_t rc = api_->get(api_->ctx, ref.ID().c_str(), timeout_s, &out,
+                           &out_len);
+    if (rc != 0) throw RayError("Get of " + ref.ID() + " failed", rc);
+    try {
+      T v = detail::Codec<T>::decode(out, out_len);
+      api_->free_buf(out);
+      return v;
+    } catch (...) {
+      api_->free_buf(out);
+      throw;
+    }
+  }
+
+  /* Submit another extern-C v2 symbol from the same library; the result
+   * object holds the subtask's output bytes, decoded as R on Get. */
+  template <typename R, typename Arg>
+  ObjectRef<R> Submit(const char* symbol, const Arg& arg) {
+    std::vector<uint8_t> buf = detail::Codec<Arg>::encode(arg);
+    char id[RAY_TPU_OBJECT_ID_BUF] = {0};
+    int64_t rc =
+        api_->submit(api_->ctx, symbol, buf.data(), buf.size(), id);
+    if (rc != 0) throw RayError(std::string("Submit of ") + symbol +
+                                    " failed",
+                                rc);
+    return ObjectRef<R>(api_, id);
+  }
+
+  const ray_tpu_api_t* raw() const { return api_; }
+
+ private:
+  const ray_tpu_api_t* api_;
+};
+
+}  // namespace ray_tpu
+
+#endif  /* RAY_TPU_HPP_ */

@@ -111,6 +111,21 @@ class _ClusterData:
                                      timeout=5.0)
         return status or {"applications": {}, "proxies": {}}
 
+    def autoscaler_status(self) -> Dict[str, Any]:
+        """Autoscaler reconcile state (KV mirror) + live pending demand
+        from the conductor — the `ray status` analog."""
+        import json as _json
+
+        raw = self.conductor.call("kv_get", b"autoscaler:status",
+                                  "autoscaler", timeout=5.0)
+        status = _json.loads(raw.decode()) if raw else {}
+        try:
+            status["live_demand"] = self.conductor.call(
+                "get_pending_demand", timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            status["live_demand"] = []
+        return status
+
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
         the actors-table drill-down."""
@@ -211,6 +226,8 @@ class DashboardServer:
                                lambda: d.simple_args("get_recent_logs", 500)))
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/serve", self._json_route(d.serve_status))
+        app.router.add_get("/api/autoscaler",
+                           self._json_route(d.autoscaler_status))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

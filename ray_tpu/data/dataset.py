@@ -125,6 +125,71 @@ class Dataset:
             [P.FromBlocks("zip", tuple(z.remote(x, y)
                                        for x, y in zip(a, b)))])
 
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: Optional[int] = None,
+             suffix: str = "_r") -> "Dataset":
+        """Distributed hash join on a key column — reference
+        Dataset.join (python/ray/data/dataset.py joins via
+        hash-partitioned shuffle). Both sides are hash-partitioned on
+        `on` into `num_partitions` buckets (tasks), then each bucket
+        pair is joined with pandas merge. `how`: inner/left/right/outer.
+        Right-side duplicate column names get `suffix`."""
+        import ray_tpu
+
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+        a = self.materialize()._materialized
+        b = other.materialize()._materialized
+        # a zero-block side still joins (empty inner/left result, pass-
+        # through for outer): give it one empty key-only block so every
+        # merge partition has something to concat
+        if not a:
+            a = [ray_tpu.put(pa.table({on: []}))]
+        if not b:
+            b = [ray_tpu.put(pa.table({on: []}))]
+        n = num_partitions or max(len(a), len(b), 1)
+
+        def part(block, on=on, n=n):
+            import pandas as pd
+
+            df = BlockAccessor(block).to_pandas()
+            if df.empty:
+                # keep the schema: a merge partition whose every chunk
+                # is empty must still know this side's columns
+                outs = [pa.Table.from_pandas(df, preserve_index=False)] * n
+            else:
+                buckets = pd.util.hash_array(
+                    df[on].to_numpy(), categorize=False) % n
+                outs = [pa.Table.from_pandas(df[buckets == i],
+                                             preserve_index=False)
+                        for i in range(n)]
+            return outs if n > 1 else outs[0]
+
+        def merge(na, *chunks):
+            # on/how/suffix ride the pickled closure; chunks are real
+            # task args so dispatch waits for both partition phases
+            import pandas as pd
+
+            left = [BlockAccessor(c).to_pandas() for c in chunks[:na]]
+            right = [BlockAccessor(c).to_pandas() for c in chunks[na:]]
+            ldf = pd.concat(left, ignore_index=True)
+            rdf = pd.concat(right, ignore_index=True)
+            out = ldf.merge(rdf, on=on, how=how, suffixes=("", suffix))
+            return pa.Table.from_pandas(out, preserve_index=False)
+
+        p = ray_tpu.remote(part)
+        m = ray_tpu.remote(merge)
+        a_chunks = [p.options(num_returns=n).remote(ref) for ref in a]
+        b_chunks = [p.options(num_returns=n).remote(ref) for ref in b]
+        if n == 1:
+            a_chunks = [[c] for c in a_chunks]
+            b_chunks = [[c] for c in b_chunks]
+        out = [m.remote(len(a),
+                        *[c[i] for c in a_chunks],
+                        *[c[i] for c in b_chunks])
+               for i in range(n)]
+        return Dataset([P.FromBlocks("join", tuple(out))])
+
     # --- execution --------------------------------------------------------
     def _execute(self) -> Iterator[Any]:
         ex = StreamingExecutor(P.fuse(self._ops))

@@ -12,7 +12,7 @@ task-graph shape the reference plans.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -118,12 +118,61 @@ def _sample_block_keys(block: Block, key: str, n: int) -> np.ndarray:
     return BlockAccessor(block).sample_keys(key, n)
 
 
+class _ByteBudget:
+    """Per-operator in-flight byte accounting + host-store pressure —
+    the reference's ResourceManager
+    (data/_internal/execution/resource_manager.py:32) scoped to this
+    runtime: operators charge an estimate per admitted task (the input
+    block's measured bytes where known, the target block size
+    otherwise) and admission stalls while the charge would exceed the
+    budget or /dev/shm (the zero-copy block store) is past its
+    high-water fraction. Count-based windows still apply on top."""
+
+    def __init__(self, cap_bytes: int, shm_high_water: float):
+        self.cap = cap_bytes
+        self.shm_high_water = shm_high_water
+        self.inflight = 0
+        self._last_shm_check = 0.0
+        self._shm_pressured = False
+
+    def admit_ok(self, est: int) -> bool:
+        if self.cap and self.inflight > 0 \
+                and self.inflight + est > self.cap:
+            return False
+        return not self._host_pressure()
+
+    def charge(self, est: int) -> None:
+        self.inflight += est
+
+    def release(self, est: int) -> None:
+        self.inflight -= est
+
+    def _host_pressure(self) -> bool:
+        if self.shm_high_water <= 0:
+            return False
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._last_shm_check > 0.2:
+            self._last_shm_check = now
+            try:
+                import shutil
+
+                u = shutil.disk_usage("/dev/shm")
+                self._shm_pressured = (u.used / max(u.total, 1)
+                                       > self.shm_high_water)
+            except OSError:
+                self._shm_pressured = False
+        return self._shm_pressured
+
+
 class StreamingExecutor:
     """Executes a fused stage list, yielding output block refs in order."""
 
     def __init__(self, stages: List[Any], *, max_in_flight: int = 8,
                  default_shuffle_blocks: int = 8,
-                 target_block_size: Optional[int] = None):
+                 target_block_size: Optional[int] = None,
+                 memory_budget: Optional[int] = None):
         self.stages = stages
         self.max_in_flight = max_in_flight
         self.default_shuffle_blocks = default_shuffle_blocks
@@ -137,6 +186,16 @@ class StreamingExecutor:
                 "RAY_TPU_DATA_TARGET_BLOCK_SIZE",
                 str(TARGET_MAX_BLOCK_SIZE)))
         self.target_block_size = target_block_size
+        from ray_tpu._private.config import config as _cfg
+
+        if memory_budget is None:
+            memory_budget = int(os.environ.get(
+                "RAY_TPU_DATA_MEMORY_BUDGET", str(_cfg.data_memory_budget)))
+        self.memory_budget = memory_budget
+        self._shm_high_water = _cfg.data_shm_high_water
+        # measured bytes of upstream blocks (filled by _resized probes),
+        # consumed as admission estimates by the next map stage
+        self._block_bytes: Dict[str, int] = {}
 
     def run(self) -> Iterator[Any]:
         """Yields ObjectRefs of output blocks. Per-stage execution stats
@@ -208,9 +267,13 @@ class StreamingExecutor:
                                target_block_size=self.target_block_size)
 
     def _run_source(self, read: P.Read) -> Iterator[Any]:
+        # read tasks charge 0 bytes (output size unknown before the read
+        # runs — charging the target block size would silently throttle
+        # read concurrency below the count window); count window + the
+        # host high-water stall still bound them
         task = _remote(_run_read_task)
-        return self._windowed(
-            (task.remote(t) for t in read.read_tasks), self.max_in_flight)
+        return self._windowed(iter(read.read_tasks), task.remote,
+                              self.max_in_flight)
 
     def _run_map(self, stage: P.FusedStage,
                  upstream: Iterator[Any]) -> Iterator[Any]:
@@ -220,7 +283,16 @@ class StreamingExecutor:
         task = _remote(_run_stage)
         window = stage.concurrency or self.max_in_flight
         return self._windowed(
-            (task.remote(stage, ref) for ref in upstream), window)
+            upstream, lambda ref: task.remote(stage, ref), window,
+            est=self._estimate_bytes)
+
+    def _estimate_bytes(self, ref) -> int:
+        """Admission estimate for a map task consuming `ref`: the bytes
+        the resize probe measured for that block, 0 when unmeasured
+        (charging a guess like the target block size over-throttles
+        pipelines of small blocks; unmeasured inputs stay bounded by the
+        count window and the host high-water stall)."""
+        return self._block_bytes.pop(getattr(ref, "id", None), None) or 0
 
     def _run_actor_pool(self, stage: P.FusedStage, upstream: Iterator[Any],
                         strategy) -> Iterator[Any]:
@@ -305,13 +377,20 @@ class StreamingExecutor:
         def emit(ref, info_ref):
             rows, nbytes = ray_tpu.get(info_ref)
             if nbytes <= self.target_block_size or rows <= 1:
+                if getattr(ref, "id", None) is not None:
+                    # measured size feeds the next operator's byte-budget
+                    # admission estimate (_estimate_bytes)
+                    self._block_bytes[ref.id] = nbytes
                 yield ref
                 return
             k = min(rows, -(-nbytes // self.target_block_size))
             cuts = np.linspace(0, rows, k + 1).astype(int)
             for a, b in zip(cuts, cuts[1:]):
                 if b > a:
-                    yield sl.remote(ref, int(a), int(b))
+                    piece = sl.remote(ref, int(a), int(b))
+                    if getattr(piece, "id", None) is not None:
+                        self._block_bytes[piece.id] = nbytes // k
+                    yield piece
 
         # probes run concurrently across the window: the per-block
         # info round-trip overlaps upstream execution instead of
@@ -324,20 +403,36 @@ class StreamingExecutor:
         for pair in buf:
             yield from emit(*pair)
 
-    def _windowed(self, submissions: Iterator[Any],
-                  window: int) -> Iterator[Any]:
-        """Backpressure: keep at most `window` tasks in flight, yield refs
+    def _windowed(self, items: Iterator[Any], submit, window: int,
+                  est=None) -> Iterator[Any]:
+        """Backpressure: keep at most `window` tasks in flight AND stay
+        inside the operator byte budget (`est(item)` bytes charged per
+        admitted task, released when its ref is yielded), yielding refs
         in submission order (ordered streaming, like the reference's
-        bundle queues)."""
+        bundle queues + ConcurrencyCapBackpressurePolicy and the
+        ResourceManager memory budgets). Admission happens BEFORE
+        `submit`, so a stalled operator launches nothing."""
         import ray_tpu
 
+        # one budget instance per operator (the flag documents a
+        # per-operator cap): concurrent stages each admit up to the full
+        # budget rather than splitting one shared pool
+        budget = _ByteBudget(self.memory_budget, self._shm_high_water)
         buf: List[Any] = []
-        for ref in submissions:
-            buf.append(ref)
-            if len(buf) >= window:
+        costs: List[int] = []
+        for item in items:
+            e = int(est(item)) if est is not None else 0
+            while buf and (len(buf) >= window or not budget.admit_ok(e)):
                 ray_tpu.wait([buf[0]], num_returns=1)
                 yield buf.pop(0)
-        yield from buf
+                budget.release(costs.pop(0))
+            ref = submit(item)
+            buf.append(ref)
+            costs.append(e)
+            budget.charge(e)
+        for ref, e in zip(buf, costs):
+            yield ref
+            budget.release(e)
 
     def _materialize_refs(self, upstream: Iterator[Any]) -> List[Any]:
         return list(upstream)

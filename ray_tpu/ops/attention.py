@@ -46,6 +46,20 @@ _LN2 = 0.6931471805599453    # cheap VPU transcendental; scale*log2(e) is
 #                              folded into q so softmax needs only exp2.
 
 
+def _parallel_grid_params(n_axes: int, interpret: bool):
+    """Mosaic dimension_semantics: every grid axis of these kernels is
+    embarrassingly parallel (no cross-program carries), which lets the
+    compiler software-pipeline block DMA against compute instead of
+    assuming a sequential grid. No-op in interpret mode / without pltpu."""
+    if interpret or pltpu is None:
+        return None
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * n_axes)
+    except Exception:  # noqa: BLE001 — older pallas: params shape moved
+        return None
+
+
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   causal: bool = True,
                   sm_scale: Optional[float] = None) -> jax.Array:
@@ -168,6 +182,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float,
             jax.ShapeDtypeStruct((b * h, tq, _LANES), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_parallel_grid_params(2, interpret),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * tq * tk * d,
             bytes_accessed=(qf.size + kf.size + vf.size) * qf.dtype.itemsize,
@@ -439,6 +454,7 @@ def _flash_bwd_fused_pallas(q, k, v, o, lse, do, causal: bool,
             jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_parallel_grid_params(2, interpret),
         cost_estimate=pl.CostEstimate(
             flops=10 * b * h * tq * tk * d,
             bytes_accessed=(qf.size + kf.size + vf.size + dof.size)
@@ -489,6 +505,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
         out_specs=pl.BlockSpec((None, block_q, d), lambda g, i: (g, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         interpret=interpret,
+        compiler_params=_parallel_grid_params(2, interpret),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * tq * tk * d,
             bytes_accessed=(qf.size + kf.size + vf.size + dof.size)
@@ -519,6 +536,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal: bool, sm_scale: float,
             jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=_parallel_grid_params(2, interpret),
         cost_estimate=pl.CostEstimate(
             flops=6 * b * h * tq * tk * d,
             bytes_accessed=(qf.size + kf.size + vf.size + dof.size)

@@ -16,6 +16,11 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self._options = dict(options or {})
+        # function bytes serialized once per RemoteFunction, not per
+        # .remote() call (reference: function table export happens once,
+        # function_manager.py) — per-call cloudpickle was a measurable
+        # share of submission cost in the pipelined microbench
+        self._fn_bytes: Optional[bytes] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -40,8 +45,12 @@ class RemoteFunction:
             resources["TPU"] = float(o["num_tpus"])
         pg = o.get("placement_group")
         pg_id = getattr(pg, "id", pg) if pg is not None else None
+        if self._fn_bytes is None:
+            from ._private import serialization
+            self._fn_bytes = serialization.dumps(self._fn)
         return w.submit_task(
             self._fn, args, kwargs,
+            fn_bytes=self._fn_bytes,
             name=o.get("name") or self._fn.__name__,
             num_returns=int(o.get("num_returns", 1)),
             resources=resources,

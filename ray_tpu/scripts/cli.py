@@ -36,7 +36,8 @@ def _resolve_address(explicit: Optional[str]) -> str:
 def _connect(args) -> None:
     import ray_tpu
 
-    ray_tpu.init(address=_resolve_address(getattr(args, "address", None)))
+    ray_tpu.init(address=_resolve_address(getattr(args, "address", None)),
+                 ignore_reinit_error=True)
 
 
 def cmd_start(args) -> None:
@@ -234,6 +235,60 @@ def cmd_job(args) -> None:
         print(json.dumps(client.list_jobs(), indent=2, default=str))
 
 
+def cmd_serve(args) -> None:
+    """`serve run|deploy|status|config|shutdown|delete` — reference
+    python/ray/serve/scripts.py:147-746 (run/deploy/config/status) over
+    the declarative YAML schema (serve/schema.py)."""
+    _connect(args)
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import (ServeDeploySchema, deploy_config,
+                                      get_deployed_config)
+
+    if args.serve_cmd in ("run", "deploy"):
+        if args.config_or_import.endswith((".yaml", ".yml")):
+            schema = ServeDeploySchema.from_yaml_file(args.config_or_import)
+        else:
+            # bare import path: one app with defaults
+            schema = ServeDeploySchema.from_dict({"applications": [
+                {"import_path": args.config_or_import}]})
+        names = deploy_config(schema)
+        print(f"deployed application(s): {', '.join(names)}")
+        addr = serve.proxy_address()
+        if addr:
+            print(f"HTTP ingress at http://{addr[0]}:{addr[1]}")
+        if args.serve_cmd == "run":
+            # reference `serve run` stays attached and tears down on ^C
+            import time as _t
+
+            try:
+                while True:
+                    _t.sleep(3600)
+            except KeyboardInterrupt:
+                for name in names:
+                    serve.delete(name)
+                print("applications deleted")
+    elif args.serve_cmd == "status":
+        try:
+            print(json.dumps(serve.status(), indent=2, default=str))
+        except RuntimeError as e:
+            print(json.dumps({"applications": {}, "error": str(e)}))
+    elif args.serve_cmd == "config":
+        cfg = get_deployed_config()
+        if cfg is None:
+            print("no config deployed (code-deployed apps have no "
+                  "declarative config)")
+        else:
+            import yaml
+
+            sys.stdout.write(yaml.safe_dump(cfg, sort_keys=False))
+    elif args.serve_cmd == "delete":
+        serve.delete(args.name)
+        print(f"application {args.name!r} deleted")
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(
         prog="ray_tpu", description="ray_tpu cluster CLI")
@@ -290,6 +345,24 @@ def main(argv=None) -> None:
     sp.add_argument("--scale", type=float, default=1.0)
     sp.add_argument("--out", default="")
     sp.set_defaults(fn=cmd_microbench)
+
+    sp = sub.add_parser("serve", help="Serve applications: run/deploy from "
+                                      "YAML config, status, shutdown")
+    sp.add_argument("--address")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    for sc in ("run", "deploy"):
+        s = ssub.add_parser(sc, help="deploy apps from a YAML config or a "
+                                     "module:attr import path"
+                                     + (" and stay attached"
+                                        if sc == "run" else ""))
+        s.add_argument("config_or_import",
+                       help="path/to/config.yaml or module:application")
+    ssub.add_parser("status")
+    ssub.add_parser("config", help="echo the last deployed YAML config")
+    s = ssub.add_parser("delete")
+    s.add_argument("name")
+    ssub.add_parser("shutdown")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("job", help="job submission")
     sp.add_argument("--address")

@@ -38,16 +38,35 @@ class DeploymentResponse:
     DeploymentResponse. Pass it to another handle call and it resolves to the
     underlying ObjectRef (model composition without driver round-trips)."""
 
-    def __init__(self, object_ref, router: "Router", replica_tag: str):
+    def __init__(self, object_ref, router: "Router", replica_tag: str,
+                 request: Optional[tuple] = None):
         self._object_ref = object_ref
         self._router = router
         self._replica_tag = replica_tag
         self._done = False
+        # (meta, args, kwargs) for the dead-replica retry in result()
+        self._request = request
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
+
         try:
             return ray_tpu.get(self._object_ref, timeout=timeout_s)
+        except ActorDiedError:
+            # The replica died with this request in flight — the exact
+            # redeploy/drain window. A request that died with its replica
+            # never completed, so re-assigning it to a live replica is
+            # safe (reference router behavior: dead-replica requests are
+            # retried against the refreshed replica set).
+            self._mark_done()
+            if self._request is None:
+                raise
+            meta, args, kwargs = self._request
+            self._router._refresh(force=True)
+            retried = self._router.assign(meta, args, kwargs)
+            retried._request = None  # one retry: a second death raises
+            return retried.result(timeout_s)
         finally:
             self._mark_done()
 
@@ -279,7 +298,8 @@ class Router:
             try:
                 ref = handle.handle_request.remote(
                     meta.to_dict(), list(args), dict(kwargs))
-                return DeploymentResponse(ref, self, tag)
+                return DeploymentResponse(ref, self, tag,
+                                          request=(meta, args, kwargs))
             except Exception as e:  # noqa: BLE001 — dead replica: drop + retry
                 last_err = e
                 self._complete(tag)

@@ -201,6 +201,26 @@ class ProxyActor:
             multiplexed_model_id=req.headers.get(MULTIPLEX_HEADER, ""))
         return handle._router.assign_stream(meta, (req,), {})
 
+    def _call_and_open(self, app: str, ingress: str, req: Request,
+                      route: str):
+        """assign + first_event with a dead-replica retry: a request
+        whose replica died before producing ANY event never executed to
+        completion and is safe to re-route — the redeploy/drain window
+        (reference proxy retries DeploymentUnavailable/actor-death
+        errors against the refreshed replica set)."""
+        from ray_tpu.exceptions import ActorDiedError
+
+        last_err = None
+        for attempt in range(3):
+            sresp = self._call_replica(app, ingress, req, route)
+            try:
+                return sresp, sresp.first_event()
+            except ActorDiedError as e:
+                last_err = e
+                handle = self._handle_for(app, ingress)
+                handle._router._refresh(force=True)
+        raise last_err
+
     def _serve_thread(self):
         from aiohttp import web
 
@@ -227,11 +247,9 @@ class ProxyActor:
                           headers=dict(request.headers), body=body)
             req.headers.setdefault("x-request-id", uuid.uuid4().hex)
             try:
-                sresp = await loop.run_in_executor(
+                sresp, first = await loop.run_in_executor(
                     self._pool,
-                    self._call_replica, app, ingress, req, prefix)
-                first = await loop.run_in_executor(self._pool,
-                                                   sresp.first_event)
+                    self._call_and_open, app, ingress, req, prefix)
             except Exception as e:  # noqa: BLE001 — surface as 500
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
             if first[0] == "value":

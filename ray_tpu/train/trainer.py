@@ -51,6 +51,9 @@ class Result:
     error: Optional[BaseException] = None
     path: str = ""
     metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    # the trial's hyperparameter config (reference Result.config —
+    # populated by Tune, empty for plain Trainer fits)
+    config: Dict[str, Any] = field(default_factory=dict)
 
 
 class TrainStep:

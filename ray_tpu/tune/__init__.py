@@ -26,6 +26,11 @@ from .search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from .external import (  # noqa: F401
+    ExternalSearcher,
+    OptunaSearcher,
+    wrap_searcher,
+)
 from .schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,
@@ -53,6 +58,7 @@ __all__ = [
     "get_context", "uniform", "quniform", "loguniform", "qloguniform",
     "randint", "choice", "sample_from", "grid_search", "Searcher",
     "BasicVariantGenerator", "HaltonSearchGenerator", "TPESearcher",
+    "ExternalSearcher", "OptunaSearcher", "wrap_searcher",
     "TrialScheduler", "FIFOScheduler",
     "AsyncHyperBandScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "PB2",

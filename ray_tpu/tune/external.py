@@ -1,0 +1,161 @@
+"""External-searcher adapters — the plugin half of the reference's
+tune/search/ packages (hyperopt/optuna/ax/...): a protocol that lets any
+suggest/observe optimization library drive trial configs, plus a
+concrete optuna integration behind an optional import.
+
+Reference anchors: python/ray/tune/search/hyperopt/hyperopt_search.py
+(:552-line adapter shape — space conversion, suggest, on_trial_complete
+bookkeeping) and tune/search/optuna/optuna_search.py (ask/tell protocol).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .search import (Categorical, Domain, Float, Integer, Searcher,
+                     _domain_paths, _resolve, _set_path)
+
+__all__ = ["wrap_searcher", "ExternalSearcher", "OptunaSearcher"]
+
+
+class ExternalSearcher(Searcher):
+    """Adapter: any library exposing ask/tell drives the search.
+
+    `ask(trial_id) -> flat {name: value} | None` proposes parameters for
+    the flattened domain names this adapter publishes via
+    `self.param_names`; `tell(trial_id, score | None, error: bool)` feeds
+    the final result back. The adapter owns everything tune-specific:
+    nested-space flattening, SampleFrom resolution, metric extraction,
+    and min/max normalization (tell always receives a score to MINIMIZE,
+    the convention of most optimizers)."""
+
+    def __init__(self, space: Dict[str, Any],
+                 ask: Callable[[str], Optional[Dict[str, Any]]],
+                 tell: Optional[Callable[[str, Optional[float], bool],
+                                         None]] = None,
+                 num_samples: int = 32,
+                 metric: Optional[str] = None,
+                 mode: Optional[str] = None):
+        super().__init__(metric, mode)
+        self._space = space
+        self._paths = _domain_paths(space)
+        self.param_names = ["/".join(p) for p, _ in self._paths]
+        self._domains = {"/".join(p): d for p, d in self._paths}
+        self._ask, self._tell = ask, tell
+        self._budget = num_samples
+        import random
+
+        self._rng = random.Random(0)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._budget <= 0:
+            return None
+        flat = self._ask(trial_id)
+        if flat is None:
+            return None
+        self._budget -= 1
+        cfg = _resolve(self._space, self._rng, {})  # fills SampleFrom etc.
+        for name, value in flat.items():
+            _set_path(cfg, tuple(name.split("/")), value)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        if self._tell is None:
+            return
+        score: Optional[float] = None
+        if result is not None and self.metric in result:
+            score = float(result[self.metric])
+            if (self.mode or "max") == "max":
+                score = -score  # externals minimize
+        self._tell(trial_id, score, error)
+
+
+def wrap_searcher(space: Dict[str, Any], ask, tell=None, *,
+                  num_samples: int = 32, metric: Optional[str] = None,
+                  mode: Optional[str] = None) -> ExternalSearcher:
+    """Functional spelling of ExternalSearcher for quick plug-ins:
+
+        searcher = wrap_searcher(space, ask=my_lib.propose,
+                                 tell=my_lib.report, metric="loss",
+                                 mode="min")
+    """
+    return ExternalSearcher(space, ask, tell, num_samples=num_samples,
+                            metric=metric, mode=mode)
+
+
+class OptunaSearcher(Searcher):
+    """Optuna-backed search via the ask/tell API — reference
+    tune/search/optuna/optuna_search.py. Requires `optuna` (optional
+    dependency; importing this class without it raises ImportError with
+    the install hint)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 32,
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 sampler: Any = None, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearcher requires the optional 'optuna' package"
+            ) from e
+        self._optuna = optuna
+        self._space = space
+        self._paths = _domain_paths(space)
+        if not self._paths:
+            raise ValueError("space has no tunable Domains")
+        self._distributions = {
+            "/".join(p): self._to_distribution(d) for p, d in self._paths}
+        self._budget = num_samples
+        if sampler is None and seed is not None:
+            sampler = optuna.samplers.TPESampler(seed=seed)
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+        # direction fixed to minimize; mode normalization happens in tell
+        self._study = optuna.create_study(sampler=sampler,
+                                          direction="minimize")
+        self._trials: Dict[str, Any] = {}
+        import random
+
+        self._rng = random.Random(seed or 0)
+
+    def _to_distribution(self, dom: Domain):
+        optuna = self._optuna
+        if isinstance(dom, Float):
+            return optuna.distributions.FloatDistribution(
+                dom.low, dom.high, log=dom.log,
+                step=dom.q if (dom.q and not dom.log) else None)
+        if isinstance(dom, Integer):
+            return optuna.distributions.IntDistribution(
+                dom.low, dom.high - 1)  # ours is randrange-style
+        if isinstance(dom, Categorical):
+            return optuna.distributions.CategoricalDistribution(
+                dom.categories)
+        raise TypeError(f"unsupported domain {type(dom).__name__}")
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._budget <= 0:
+            return None
+        self._budget -= 1
+        trial = self._study.ask(self._distributions)
+        self._trials[trial_id] = trial
+        cfg = _resolve(self._space, self._rng, {})
+        for name, value in trial.params.items():
+            _set_path(cfg, tuple(name.split("/")), value)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        state = self._optuna.trial.TrialState.COMPLETE
+        value = None
+        if error or result is None or self.metric not in result:
+            state = self._optuna.trial.TrialState.FAIL
+        else:
+            value = float(result[self.metric])
+            if (self.mode or "max") == "max":
+                value = -value
+        self._study.tell(trial, value, state=state)

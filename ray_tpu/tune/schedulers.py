@@ -50,6 +50,13 @@ class TrialScheduler:
         exploit directives; the controller then resumes all paused
         trials (reference pbt.py synch=True mode)."""
 
+    def resume_decision(self, trial_id: str) -> str:
+        """Barrier follow-up: after on_trials_paused, the controller asks
+        per paused trial whether to resume (CONTINUE) or halt (STOP) —
+        how synchronous HyperBand halves a rung (reference hyperband.py
+        cur_band promotion)."""
+        return CONTINUE
+
 
 class FIFOScheduler(TrialScheduler):
     """Run every trial to completion (reference trial_scheduler.py)."""
@@ -103,10 +110,112 @@ class AsyncHyperBandScheduler(TrialScheduler):
         return CONTINUE if score >= cutoff else STOP
 
 
-class HyperBandScheduler(AsyncHyperBandScheduler):
-    """Synchronous HyperBand collapses to ASHA under a single-authority
-    async controller (reference hyperband.py vs async_hyperband.py — the
-    async variant is the recommended one); kept as an alias surface."""
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference schedulers/hyperband.py): trials
+    are dealt round-robin into brackets; bracket `s` starts its rungs at
+    r0 = max_t / rf^s. Every trial PAUSEs at its bracket's current rung
+    milestone; when the whole population is paused (the controller's
+    synch barrier), each rung is halved — the top 1/rf of the bracket's
+    scores resume toward the next rung, the rest STOP at
+    resume_decision. Unlike ASHA (AsyncHyperBandScheduler), a decision
+    always compares the FULL rung, so no trial is stopped against a
+    partial population — the bracket semantics the async variant trades
+    away for utilization."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3.0):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t, self.rf = max_t, reduction_factor
+        import math
+
+        s_max = max(0, int(math.log(max(max_t / grace_period, 1))
+                           / math.log(reduction_factor)))
+        # bracket s: first rung at max_t / rf^s, then *rf per rung
+        self._bracket_r0 = [max(int(max_t / reduction_factor ** s), 1)
+                            for s in range(s_max + 1)]
+        # band sizing (reference hyperband.py): bracket s admits
+        # n_s = ceil((s_max+1)/(s+1) * rf^s) trials, filled
+        # most-aggressive-first (largest s = smallest starting budget);
+        # when a band is full a fresh band opens
+        import math as _math
+
+        self._quota = [int(_math.ceil((s_max + 1) / (s + 1)
+                                      * reduction_factor ** s))
+                       for s in range(s_max + 1)]
+        self._fill_order = list(range(s_max, -1, -1))
+        self._fill_counts = [0] * (s_max + 1)
+        self._bracket_of: Dict[str, int] = {}
+        self._rung_idx: Dict[str, int] = {}     # trial -> rungs passed
+        self._last_score: Dict[str, float] = {}
+        self._paused_at: Dict[str, int] = {}    # trial -> milestone
+        self._halted: set = set()
+        self._done: set = set()
+
+    def _milestone(self, trial_id: str) -> int:
+        b = self._bracket_of[trial_id]
+        r0 = self._bracket_r0[b]
+        return min(self.max_t,
+                   int(r0 * self.rf ** self._rung_idx[trial_id]))
+
+    def on_trial_add(self, trial_id: str) -> None:
+        for s in self._fill_order:
+            if self._fill_counts[s] < self._quota[s]:
+                break
+        else:  # band full: open a new one
+            self._fill_counts = [0] * len(self._quota)
+            s = self._fill_order[0]
+        self._fill_counts[s] += 1
+        self._bracket_of[trial_id] = s
+        self._rung_idx[trial_id] = 0
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        self._last_score[trial_id] = self._score(result)
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        if t >= self._milestone(trial_id):
+            self._paused_at[trial_id] = self._milestone(trial_id)
+            return PAUSE
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        self._done.add(trial_id)
+        self._paused_at.pop(trial_id, None)
+
+    def on_trials_paused(self, trial_ids: List[str]) -> None:
+        """The halving step: group paused trials by (bracket, milestone)
+        and keep each group's top 1/rf; everyone else is halted at
+        resume_decision."""
+        groups: Dict[tuple, List[str]] = defaultdict(list)
+        for tid in trial_ids:
+            if tid in self._paused_at and tid not in self._done:
+                groups[(self._bracket_of[tid],
+                        self._paused_at[tid])].append(tid)
+        for (_b, _m), members in groups.items():
+            members.sort(key=lambda tid: self._last_score.get(
+                tid, float("-inf")), reverse=True)
+            keep = max(1, int(len(members) / self.rf))
+            for tid in members[:keep]:
+                self._rung_idx[tid] += 1
+            for tid in members[keep:]:
+                self._halted.add(tid)
+            del_milestone = [tid for tid in members]
+            for tid in del_milestone:
+                self._paused_at.pop(tid, None)
+
+    def resume_decision(self, trial_id: str) -> str:
+        if trial_id in self._halted:
+            self._halted.discard(trial_id)
+            return STOP
+        return CONTINUE
 
 
 class MedianStoppingRule(TrialScheduler):

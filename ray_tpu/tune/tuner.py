@@ -271,6 +271,18 @@ class Tuner:
                             self._exploit(trial, trials, directive,
                                           trainable_bytes, resources,
                                           ref_to_trial)
+                        elif scheduler.resume_decision(
+                                trial.trial_id) == STOP:
+                            # synchronous-HyperBand halving: the rung
+                            # compared the full bracket at the barrier
+                            try:
+                                path = ray_tpu.get(
+                                    trial.actor.save.remote(), timeout=30.0)
+                                if path:
+                                    trial.checkpoint_path = path
+                            except Exception:
+                                pass
+                            finalize(trial, TERMINATED)
                         else:
                             nref = trial.actor.step.remote()
                             ref_to_trial[nref] = trial
@@ -353,7 +365,8 @@ class Tuner:
                 checkpoint=(Checkpoint(t.checkpoint_path)
                             if t.checkpoint_path else None),
                 error=RuntimeError(t.error) if t.error else None,
-                path=t.dir, metrics_history=t.history))
+                path=t.dir, metrics_history=t.history,
+                config=dict(t.config or {})))
         grid = ResultGrid(results, trials, exp_dir)
         grid._default_metric = cfg.metric
         grid._default_mode = cfg.mode

@@ -1,0 +1,161 @@
+"""Distributed FIFO queue backed by an asyncio actor.
+
+Same surface as the reference's `ray.util.queue.Queue`
+(/root/reference/python/ray/util/queue.py:21-305): bounded or unbounded,
+blocking put/get with timeouts, *_nowait and *_nowait_batch variants, and
+async put/get coroutines. The actor holds an asyncio.Queue, so blocked
+producers/consumers park on the actor's event loop instead of pinning
+executor threads — many callers can block concurrently on one queue
+actor.
+"""
+from __future__ import annotations
+
+import asyncio
+from queue import Empty, Full  # re-exported, same as the reference
+from typing import Any, List, Optional
+
+import ray_tpu
+
+__all__ = ["Queue", "Empty", "Full"]
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize)
+
+    def qsize(self) -> int:
+        return self.queue.qsize()
+
+    def empty(self) -> bool:
+        return self.queue.empty()
+
+    def full(self) -> bool:
+        return self.queue.full()
+
+    async def put(self, item: Any,
+                  timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            await self.queue.put(item)
+            return
+        try:
+            await asyncio.wait_for(self.queue.put(item), timeout)
+        except asyncio.TimeoutError:
+            raise Full from None
+
+    async def get(self, timeout: Optional[float] = None) -> Any:
+        if timeout is None:
+            return await self.queue.get()
+        try:
+            return await asyncio.wait_for(self.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            raise Empty from None
+
+    def put_nowait(self, item: Any) -> None:
+        self.queue.put_nowait(item)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        # all-or-nothing, like the reference (queue.py:280)
+        if self.maxsize > 0 and \
+                self.queue.qsize() + len(items) > self.maxsize:
+            raise Full(f"batch of {len(items)} does not fit in a queue "
+                       f"holding {self.queue.qsize()}/{self.maxsize}")
+        for item in items:
+            self.queue.put_nowait(item)
+
+    def get_nowait(self) -> Any:
+        return self.queue.get_nowait()
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        if num_items > self.queue.qsize():
+            raise Empty(f"{num_items} requested, "
+                        f"{self.queue.qsize()} available")
+        return [self.queue.get_nowait() for _ in range(num_items)]
+
+
+class Queue:
+    """Actor-backed FIFO shared by any number of tasks/actors.
+
+    `maxsize <= 0` means unbounded. `actor_options` are forwarded to the
+    underlying actor (e.g. placement, name, lifetime)."""
+
+    def __init__(self, maxsize: int = 0,
+                 actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        # max_concurrency bounds how many callers may block on the actor
+        # at once (each blocked put/get holds one concurrency slot while
+        # its coroutine parks on the actor's event loop)
+        opts = {"max_concurrency": 64}
+        opts.update(actor_options or {})
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self.maxsize, self.actor))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def size(self) -> int:
+        return self.qsize()
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            ray_tpu.get(self.actor.put_nowait.remote(item))
+            return
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        ray_tpu.get(self.actor.put.remote(item, timeout))
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            return ray_tpu.get(self.actor.get_nowait.remote())
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        return ray_tpu.get(self.actor.get.remote(timeout))
+
+    async def put_async(self, item: Any, block: bool = True,
+                        timeout: Optional[float] = None) -> None:
+        if not block:
+            await ray_tpu.get_async(self.actor.put_nowait.remote(item))
+            return
+        await ray_tpu.get_async(self.actor.put.remote(item, timeout))
+
+    async def get_async(self, block: bool = True,
+                        timeout: Optional[float] = None) -> Any:
+        if not block:
+            return await ray_tpu.get_async(self.actor.get_nowait.remote())
+        return await ray_tpu.get_async(self.actor.get.remote(timeout))
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)))
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        return ray_tpu.get(self.actor.get_nowait_batch.remote(num_items))
+
+    def shutdown(self, force: bool = False) -> None:
+        """Terminate the backing actor; the queue is unusable after."""
+        if self.actor is not None:
+            ray_tpu.kill(self.actor)
+        self.actor = None
+
+
+def _rebuild_queue(maxsize: int, actor) -> Queue:
+    q = Queue.__new__(Queue)
+    q.maxsize = maxsize
+    q.actor = actor
+    return q

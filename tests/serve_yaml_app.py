@@ -1,0 +1,20 @@
+"""Importable Serve app used by test_serve_yaml.py's declarative-deploy
+tests (the schema's import_path must point at a real module)."""
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __init__(self, bias: int = 0):
+        self.bias = bias
+
+    def __call__(self, request):
+        return {"value": 2 * request.json()["x"] + self.bias}
+
+
+app = Doubler.bind()
+
+
+def build(args):
+    """Builder-function import path: returns a bound app from YAML args."""
+    return Doubler.bind(int(args.get("bias", 0)))

@@ -167,3 +167,26 @@ def test_actor_drilldown_and_serve_view(dashboard):
             break
         time.sleep(0.5)
     assert apps == {}, apps
+
+
+def test_rpc_and_autoscaler_views(dashboard):
+    """/api/rpc serves per-method dispatch stats; /api/autoscaler serves
+    the KV status mirror + live pending demand (empty-but-valid when no
+    autoscaler runs)."""
+    import json as _json
+
+    status, _, body = _get(dashboard.url + "/api/rpc")
+    assert status == 200
+    stats = _json.loads(body)
+    assert isinstance(stats, dict) and stats  # conductor has seen traffic
+    method = next(iter(stats.values()))
+    assert {"count", "mean_queue_ms", "mean_handler_ms"} <= set(method)
+
+    status, _, body = _get(dashboard.url + "/api/autoscaler")
+    assert status == 200
+    a = _json.loads(body)
+    assert "live_demand" in a and isinstance(a["live_demand"], list)
+
+    # the SPA carries the new tabs
+    status, _, html = _get(dashboard.url + "/")
+    assert b"renderRpc" in html and b"renderAutoscaler" in html

@@ -1,0 +1,74 @@
+"""Fork-server worker spawning (reference: raylet WorkerPool prestart,
+worker_pool.h:343 — amortized worker start)."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu._private.worker_spawn import ForkedProc
+
+
+def test_forked_proc_liveness_and_signals():
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    fp = ForkedProc(proc.pid)
+    assert fp.poll() is None
+    fp.terminate()
+    # the real parent (us) reaps; ForkedProc sees the pid vanish
+    proc.wait(timeout=10)
+    deadline = time.monotonic() + 5
+    while fp.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert fp.poll() == 0
+    # signalling a dead pid is a no-op, not an error
+    fp.kill()
+    assert fp.wait(timeout=1) == 0
+
+
+def test_forked_proc_wait_timeout():
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    fp = ForkedProc(proc.pid)
+    with pytest.raises(subprocess.TimeoutExpired):
+        fp.wait(timeout=0.2)
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_cluster_uses_fork_server_and_workers_die_fast(ray_start_regular):
+    """Workers spawned through the template must appear and fully vanish
+    (no zombie window — the template reaps via SIGCHLD) shortly after a
+    cluster-initiated kill."""
+    import ray_tpu
+    from ray_tpu._private import worker as wmod
+
+    @ray_tpu.remote
+    def f():
+        return os.getpid()
+
+    pids = set(ray_tpu.get([f.remote() for _ in range(8)], timeout=60.0))
+    assert pids
+    gw = wmod.global_worker
+    session = gw.session_dir
+    # template process is alive for the session
+    assert os.path.exists(os.path.join(session, "fork_server.sock")) or \
+        os.environ.get("RAY_TPU_NO_FORK_SERVER")
+    pid = next(iter(pids))
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        state = "?"
+        try:
+            state = open(f"/proc/{pid}/status").read().splitlines()[1]
+        except OSError:
+            pass
+        pytest.fail(f"worker {pid} still visible 5s after SIGTERM ({state})")

@@ -1,0 +1,165 @@
+"""GKE/Kubernetes node providers against canned transports — the
+KubeRay-analog provisioning path (reference
+python/ray/autoscaler/_private/kuberay/node_provider.py, tested there
+with mocked k8s API clients)."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.gke import (KubernetesPodProvider,
+                                    TpuQueuedResourceProvider)
+
+
+class FakeK8s:
+    """Core-v1 pods API double: POST creates, DELETE removes, GET lists
+    (labelSelector ignored — the provider filters)."""
+
+    def __init__(self):
+        self.pods = {}
+        self.calls = []
+
+    def __call__(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        if method == "POST":
+            name = body["metadata"]["name"]
+            self.pods[name] = dict(body,
+                                   status={"phase": "Running",
+                                           "podIP": "10.0.0.9"})
+            return self.pods[name]
+        if method == "DELETE":
+            self.pods.pop(url.rsplit("/", 1)[-1], None)
+            return {}
+        return {"items": list(self.pods.values())}
+
+
+@pytest.fixture
+def pod_provider():
+    api = FakeK8s()
+    p = KubernetesPodProvider(
+        namespace="ray", cluster_name="c1",
+        head_address="10.0.0.1:6379",
+        node_configs={"tpu-host": {
+            "image": "gcr.io/p/ray-tpu:latest",
+            "resources": {"google.com/tpu": 8, "cpu": "8"},
+            "node_selector": {"cloud.google.com/gke-tpu-topology": "2x4"},
+            "env": {"EXTRA": "1"},
+        }},
+        http=api)
+    p._api = api
+    return p
+
+
+def test_pod_create_list_terminate(pod_provider):
+    nid = pod_provider.create_node("tpu-host", {"TPU": 8})
+    assert nid.startswith("ray-tpu-c1-tpu-host-")
+    nodes = pod_provider.non_terminated_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["node_id"] == nid
+    assert nodes[0]["resources"] == {"TPU": 8.0}
+    assert nodes[0]["state"] == "Running"
+    assert nodes[0]["ip"] == "10.0.0.9"
+    pod_provider.terminate_node(nid)
+    assert pod_provider.non_terminated_nodes() == []
+
+
+def test_pod_manifest_shape(pod_provider):
+    pod_provider.create_node("tpu-host", {"TPU": 8})
+    method, url, body = pod_provider._api.calls[0]
+    assert method == "POST" and url.endswith("/namespaces/ray/pods")
+    assert body["kind"] == "Pod"
+    labels = body["metadata"]["labels"]
+    assert labels["ray-tpu-cluster"] == "c1"
+    assert labels["ray-tpu-node-type"] == "tpu-host"
+    c = body["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == 8
+    assert "--address" in c["command"]
+    assert "10.0.0.1:6379" in c["command"]
+    # the worker must advertise its chips when it joins
+    res_arg = c["command"][c["command"].index("--resources") + 1]
+    assert json.loads(res_arg) == {"TPU": 8.0}
+    assert body["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"] == "2x4"
+
+
+def test_pod_completed_phases_filtered(pod_provider):
+    nid = pod_provider.create_node("tpu-host", {"TPU": 8})
+    pod_provider._api.pods[nid]["status"]["phase"] = "Succeeded"
+    assert pod_provider.non_terminated_nodes() == []
+
+
+class FakeQrApi:
+    """Cloud TPU queuedResources double."""
+
+    def __init__(self):
+        self.qrs = {}
+        self.calls = []
+
+    def __call__(self, method, url, body=None):
+        self.calls.append((method, url, body))
+        if method == "POST":
+            qr_id = url.rsplit("queuedResourceId=", 1)[-1]
+            self.qrs[qr_id] = dict(
+                body, name=f"{url.split('?')[0]}/{qr_id}",
+                state={"state": "WAITING_FOR_RESOURCES"})
+            return {"name": f"operations/op-{qr_id}"}
+        if method == "DELETE":
+            self.qrs.pop(url.rsplit("/", 1)[-1].split("?")[0], None)
+            return {}
+        if "queuedResources/" in url:
+            return self.qrs.get(url.rsplit("/", 1)[-1], {})
+        return {"queuedResources": list(self.qrs.values())}
+
+
+@pytest.fixture
+def qr_provider():
+    api = FakeQrApi()
+    p = TpuQueuedResourceProvider(
+        project="p", zone="us-central2-b", cluster_name="c1",
+        head_address="10.0.0.1:6379",
+        node_configs={"v5e-8": {
+            "accelerator_type": "v5litepod-8",
+            "runtime_version": "v2-alpha-tpuv5-lite",
+            "spot": True,
+            "valid_until_s": 3600,
+        }},
+        http=api)
+    p._api = api
+    return p
+
+
+def test_qr_create_list_terminate(qr_provider):
+    nid = qr_provider.create_node("v5e-8", {"TPU": 8})
+    nodes = qr_provider.non_terminated_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["node_id"] == nid
+    assert nodes[0]["resources"] == {"TPU": 8.0}
+    assert nodes[0]["state"] == "WAITING_FOR_RESOURCES"
+    qr_provider.terminate_node(nid)
+    assert qr_provider.non_terminated_nodes() == []
+
+
+def test_qr_request_shape(qr_provider):
+    qr_provider.create_node("v5e-8", {"TPU": 8})
+    method, url, body = qr_provider._api.calls[0]
+    assert method == "POST" and "queuedResourceId=" in url
+    assert "spot" in body and "guaranteed" not in body
+    assert body["queueingPolicy"]["validUntilDuration"] == "3600s"
+    node = body["tpu"]["nodeSpec"][0]["node"]
+    assert node["acceleratorType"] == "v5litepod-8"
+    assert node["labels"]["ray-cluster"] == "c1"
+    assert "10.0.0.1:6379" in node["metadata"]["startup-script"]
+
+
+def test_qr_wait_active(qr_provider):
+    nid = qr_provider.create_node("v5e-8", {"TPU": 8})
+    assert qr_provider.wait_active(nid, timeout=0.3, poll_s=0.05) is False
+    qr_provider._api.qrs[nid]["state"]["state"] = "ACTIVE"
+    assert qr_provider.wait_active(nid, timeout=1.0, poll_s=0.05) is True
+
+
+def test_qr_failed_states_filtered(qr_provider):
+    nid = qr_provider.create_node("v5e-8", {"TPU": 8})
+    qr_provider._api.qrs[nid]["state"]["state"] = "FAILED"
+    assert qr_provider.non_terminated_nodes() == []

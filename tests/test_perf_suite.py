@@ -25,3 +25,77 @@ def test_microbench_smoke(tmp_path):
     with open(out) as f:
         data = json.load(f)
     assert data["results"] == sink
+
+
+def test_pipelined_tasks_not_inverted(tmp_path):
+    """Regression guard for the round-4 anomaly: pipelined task
+    throughput (tasks_async) ran 5x BELOW serial round-trips because
+    every task paid lease+return RPCs and parked submit threads woke in
+    herds. With worker-lease reuse (worker.py _lease_recache) pipelined
+    throughput must stay at least comparable to serial — the historic
+    failure mode was a 5x inversion, so the 0.6 floor catches it while
+    tolerating 1-core CI jitter."""
+    import time
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        @ray_tpu.remote
+        def f():
+            return b"ok"
+
+        ray_tpu.get([f.remote() for _ in range(50)])  # warm pool
+        n = 200
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(f.remote())
+        sync_rate = n / (time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        ray_tpu.get([f.remote() for _ in range(n)], timeout=120.0)
+        async_rate = n / (time.perf_counter() - t0)
+    finally:
+        ray_tpu.shutdown()
+    assert async_rate > 0.6 * sync_rate, (
+        f"pipelined inversion returned: async {async_rate:.0f}/s vs "
+        f"sync {sync_rate:.0f}/s")
+
+
+def test_actor_churn_floor():
+    """Regression guard for 4-actors/s churn: with the fork server
+    (fork_server.py) create+call+kill waves must sustain >= 10/s even
+    on a loaded 1-core CI host (measured ~36/s idle)."""
+    import time
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        @ray_tpu.remote
+        class Cell:
+            def __init__(self, v):
+                self.v = v
+
+            def get(self):
+                return self.v
+
+        a = Cell.remote(0)
+        ray_tpu.get(a.get.remote())
+        ray_tpu.kill(a)  # warm (fork server boots on first spawn)
+
+        n, wave, done = 24, 8, 0
+        t0 = time.perf_counter()
+        while done < n:
+            k = min(wave, n - done)
+            actors = [Cell.remote(i) for i in range(k)]
+            got = ray_tpu.get([x.get.remote() for x in actors],
+                              timeout=120.0)
+            assert got == list(range(k))
+            for x in actors:
+                ray_tpu.kill(x)
+            done += k
+        rate = n / (time.perf_counter() - t0)
+    finally:
+        ray_tpu.shutdown()
+    assert rate >= 10.0, f"actor churn regressed to {rate:.1f}/s"

@@ -1,0 +1,98 @@
+"""ray_tpu.util.queue.Queue — surface modeled on the reference's
+python/ray/tests/test_queue.py (FIFO order, maxsize backpressure,
+nowait/batch variants, cross-task sharing)."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_queue_fifo_and_size(ray_start_regular):
+    q = Queue()
+    assert q.empty()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert q.size() == 5
+    assert not q.empty()
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert q.empty()
+    q.shutdown()
+
+
+def test_queue_nowait_and_batch(ray_start_regular):
+    q = Queue(maxsize=3)
+    q.put_nowait(1)
+    q.put_nowait_batch([2, 3])
+    assert q.full()
+    with pytest.raises(Exception):  # Full via RemoteError or direct
+        q.put_nowait(4)
+    with pytest.raises(Exception):
+        q.put_nowait_batch([4, 5])
+    assert q.get_nowait_batch(2) == [1, 2]
+    with pytest.raises(Exception):
+        q.get_nowait_batch(5)
+    assert q.get_nowait() == 3
+    with pytest.raises(Exception):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_blocking_timeouts(ray_start_regular):
+    q = Queue(maxsize=1)
+    q.put("x")
+    t0 = time.monotonic()
+    with pytest.raises(Exception):  # Full after the timeout
+        q.put("y", timeout=0.3)
+    assert time.monotonic() - t0 >= 0.25
+    assert q.get() == "x"
+    with pytest.raises(Exception):  # Empty after the timeout
+        q.get(timeout=0.3)
+    q.shutdown()
+
+
+def test_queue_blocking_put_unblocks_on_get(ray_start_regular):
+    q = Queue(maxsize=1)
+    q.put(1)
+    got = []
+
+    def producer():
+        q.put(2, timeout=30.0)  # blocks until the consumer drains
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.2)
+    got.append(q.get(timeout=10.0))
+    t.join(timeout=30)
+    assert not t.is_alive()
+    got.append(q.get(timeout=10.0))
+    assert got == [1, 2]
+    q.shutdown()
+
+
+def test_queue_shared_across_tasks(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30.0) for _ in range(n)]
+
+    pref = producer.remote(q, 10)
+    cref = consumer.remote(q, 10)
+    assert ray_tpu.get(pref) == 10
+    assert ray_tpu.get(cref) == list(range(10))
+    q.shutdown()
+
+
+def test_queue_exceptions_are_queue_module_types():
+    assert issubclass(Full, Exception)
+    assert issubclass(Empty, Exception)

@@ -156,7 +156,9 @@ def test_rpc_handler_stats(cluster):
     stats = state.rpc_stats()
     assert "lease_worker" in stats, sorted(stats)
     s = stats["lease_worker"]
-    assert s["count"] >= 5
+    # lease reuse pipelines same-shape tasks onto cached leases, so 5
+    # tasks need >= 1 lease RPC, not 5 (worker.py _lease_recache)
+    assert s["count"] >= 1
     assert s["mean_handler_ms"] >= 0.0
     assert s["max_handler_ms"] >= s["mean_handler_ms"] - 1e-9
     assert s["max_queue_ms"] >= 0.0

@@ -314,3 +314,28 @@ def test_tpe_with_asha_bohb_style(cluster):
         metric="score", mode="max", stop={"training_iteration": 8})
     best = grid.get_best_result(metric="score").metrics["score"]
     assert best > -0.1, best
+
+
+def test_hyperband_sync_brackets(cluster):
+    """Synchronous HyperBand (reference schedulers/hyperband.py): every
+    halving decision compares the FULL rung at the pause barrier, so
+    with all trials running concurrently the weakest are stopped at the
+    first milestone and the best reaches max_t."""
+    def fn(config):
+        for i in range(12):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    sched = tune.HyperBandScheduler(metric="score", mode="max", max_t=12,
+                                    grace_period=3, reduction_factor=2)
+    grid = tune.Tuner(
+        fn, param_space={"q": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=4)).fit()
+    iters = {r.metrics["trial_id"]: r.metrics["training_iteration"]
+             for r in grid}
+    best = grid.get_best_result()
+    # best trial survives to the end; at least one is halved out early
+    assert best.metrics["score"] == max(r.metrics["score"] for r in grid)
+    assert best.metrics["training_iteration"] >= 11
+    assert min(iters.values()) < 12, iters

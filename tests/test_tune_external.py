@@ -1,0 +1,95 @@
+"""External searcher adapters — reference tune/search/hyperopt (adapter
+protocol) and tune/search/optuna (ask/tell) equivalents."""
+from __future__ import annotations
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture
+def tune_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+SPACE = {"x": tune.uniform(-2.0, 2.0),
+         "nested": {"k": tune.choice(["a", "b"])}}
+
+
+def _trainable(config):
+    x = config["x"]
+    bonus = 0.5 if config["nested"]["k"] == "b" else 0.0
+    tune.report({"score": -(x - 1.0) ** 2 + bonus})
+
+
+class _FakeOpt:
+    """A deliberately-dumb external optimizer: proposes a fixed ladder of
+    x values and records every tell."""
+
+    def __init__(self):
+        self.ladder = [-2.0, -1.0, 0.0, 1.0, 2.0]
+        self.i = 0
+        self.tells = []
+
+    def ask(self, trial_id):
+        if self.i >= len(self.ladder):
+            return None
+        x = self.ladder[self.i]
+        self.i += 1
+        return {"x": x, "nested/k": "b"}
+
+    def tell(self, trial_id, score, error):
+        self.tells.append((trial_id, score, error))
+
+
+def test_wrap_searcher_drives_trials(tune_cluster):
+    opt = _FakeOpt()
+    searcher = tune.wrap_searcher(
+        SPACE, ask=opt.ask, tell=opt.tell, num_samples=10,
+        metric="score", mode="max")
+    results = tune.run(_trainable, search_alg=searcher, metric="score",
+                       mode="max")
+    df = results.get_dataframe() if hasattr(results, "get_dataframe") \
+        else None
+    best = results.get_best_result(metric="score", mode="max")
+    # the ladder's best point is x=1.0 with k="b" -> score 0.5
+    assert best.metrics["score"] == pytest.approx(0.5)
+    assert best.config["x"] == pytest.approx(1.0)
+    assert best.config["nested"]["k"] == "b"
+    # every completed trial was told back, scores negated for minimize
+    assert len(opt.tells) == 5
+    assert all(not err for _, _, err in opt.tells)
+    assert min(s for _, s, _ in opt.tells) == pytest.approx(-0.5)
+
+
+def test_wrap_searcher_exhausts_budget(tune_cluster):
+    opt = _FakeOpt()
+    searcher = tune.wrap_searcher(SPACE, ask=opt.ask, tell=opt.tell,
+                                  num_samples=3, metric="score", mode="max")
+    results = tune.run(_trainable, search_alg=searcher, metric="score",
+                       mode="max")
+    assert len(opt.tells) == 3  # budget capped below the ladder length
+
+
+def test_optuna_searcher(tune_cluster):
+    pytest.importorskip("optuna")
+    searcher = tune.OptunaSearcher(SPACE, num_samples=8, metric="score",
+                                   mode="max", seed=0)
+    results = tune.run(_trainable, search_alg=searcher, metric="score",
+                       mode="max")
+    best = results.get_best_result(metric="score", mode="max")
+    assert "x" in best.config and best.config["nested"]["k"] in ("a", "b")
+    assert len(searcher._study.trials) == 8
+
+
+def test_optuna_import_error_without_lib():
+    try:
+        import optuna  # noqa: F401
+        pytest.skip("optuna present")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="optuna"):
+        tune.OptunaSearcher(SPACE)
