@@ -145,6 +145,43 @@ class ObjectRef {
   std::shared_ptr<detail::Pin> pin_;
 };
 
+/* Typed actor handle — reference api.h ActorHandle<T>. Methods are v1
+ * symbol names of the same library; Call<R, Arg> decodes the result
+ * object as R. Kill() is explicit (handles are process-local ids, not
+ * refcounted pins). */
+class ActorHandle {
+ public:
+  ActorHandle() = default;
+  ActorHandle(const ray_tpu_api_t* api, std::string id)
+      : api_(api), id_(std::move(id)) {}
+  const std::string& ID() const { return id_; }
+  bool Valid() const { return api_ != nullptr; }
+
+  template <typename R, typename Arg>
+  ObjectRef<R> Call(const char* method, const Arg& arg) const {
+    std::vector<uint8_t> buf = detail::Codec<Arg>::encode(arg);
+    char id[RAY_TPU_OBJECT_ID_BUF] = {0};
+    int64_t rc = api_->call_actor(api_->ctx, id_.c_str(), method,
+                                  buf.data(), buf.size(), id);
+    if (rc != 0) {
+      throw RayError(std::string("actor Call of ") + method + " failed",
+                     rc);
+    }
+    return ObjectRef<R>(api_, id);
+  }
+
+  void Kill() {
+    if (api_ != nullptr) {
+      api_->kill_actor(api_->ctx, id_.c_str());
+      api_ = nullptr;
+    }
+  }
+
+ private:
+  const ray_tpu_api_t* api_ = nullptr;
+  std::string id_;
+};
+
 class Runtime {
  public:
   explicit Runtime(const ray_tpu_api_t* api) : api_(api) {}
@@ -189,6 +226,20 @@ class Runtime {
                                     " failed",
                                 rc);
     return ObjectRef<R>(api_, id);
+  }
+
+  /* Create an actor whose methods are v1 symbols of this library —
+   * reference ray::Actor(...).Remote(). `methods` is comma-separated;
+   * init_symbol may be nullptr. */
+  template <typename Arg>
+  ActorHandle CreateActor(const char* methods, const char* init_symbol,
+                          const Arg& init) {
+    std::vector<uint8_t> buf = detail::Codec<Arg>::encode(init);
+    char id[RAY_TPU_OBJECT_ID_BUF] = {0};
+    int64_t rc = api_->create_actor(api_->ctx, methods, init_symbol,
+                                    buf.data(), buf.size(), id);
+    if (rc != 0) throw RayError("CreateActor failed", rc);
+    return ActorHandle(api_, id);
   }
 
   const ray_tpu_api_t* raw() const { return api_; }

@@ -58,6 +58,29 @@ typedef struct ray_tpu_api {
   int64_t (*release)(void* ctx, const char* object_id);
 
   void (*free_buf)(uint8_t* p);
+
+  /* ---- v2.1 appended entry points (actor surface; reference analog:
+   * ray::Actor(...).Remote() / ActorHandle.Task() in
+   * /root/reference/cpp/include/ray/api.h). Fields are appended so v2
+   * binaries keep working unchanged. Actor-handle ids are PROCESS-LOCAL
+   * like object ids. ---- */
+
+  /* Create a cluster actor whose methods are v1-ABI symbols of the SAME
+   * library (comma-separated in `methods`); `init_symbol` (may be NULL)
+   * runs once at construction with the init payload. Writes the handle
+   * id into id_out (RAY_TPU_OBJECT_ID_BUF bytes). */
+  int64_t (*create_actor)(void* ctx, const char* methods,
+                          const char* init_symbol, const uint8_t* init_arg,
+                          size_t init_len, char* id_out);
+
+  /* Invoke a declared method symbol on the actor; writes the result
+   * object id into id_out (get/release it like any other id). */
+  int64_t (*call_actor)(void* ctx, const char* actor_id,
+                        const char* method, const uint8_t* arg,
+                        size_t arg_len, char* id_out);
+
+  /* Terminate the actor and drop the handle. */
+  int64_t (*kill_actor)(void* ctx, const char* actor_id);
 } ray_tpu_api_t;
 
 #endif  /* RAY_TPU_API_H_ */

@@ -106,17 +106,33 @@ _SUBMIT_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
 _RELEASE_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
                               ctypes.c_char_p)
 _FREE_T = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_uint8))
+# id_out params are c_void_p: a c_char_p arg would reach the callback as
+# an immutable bytes COPY and _write_id would scribble on that copy, not
+# the caller's buffer (same convention as _PUT_T/_SUBMIT_T)
+_CREATE_ACTOR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int64, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_void_p)
+_CALL_ACTOR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int64, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_void_p)
+_KILL_ACTOR_T = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
+                                 ctypes.c_char_p)
 
 
 class _ApiStruct(ctypes.Structure):
     _fields_ = [("ctx", ctypes.c_void_p), ("put", _PUT_T),
                 ("get", _GET_T), ("submit", _SUBMIT_T),
-                ("release", _RELEASE_T), ("free_buf", _FREE_T)]
+                ("release", _RELEASE_T), ("free_buf", _FREE_T),
+                # v2.1 appended fields (ABI-compatible extension)
+                ("create_actor", _CREATE_ACTOR_T),
+                ("call_actor", _CALL_ACTOR_T),
+                ("kill_actor", _KILL_ACTOR_T)]
 
 
 # id -> ObjectRef pins for objects minted through the native API (per
 # worker process; released via api->release or at process exit)
 _API_REFS: Dict[str, Any] = {}
+_API_ACTORS: Dict[str, Any] = {}   # handle id -> ActorHandle (native API)
 _API_STRUCTS: Dict[str, Any] = {}  # lib_path -> (_ApiStruct, callbacks)
 
 
@@ -190,8 +206,54 @@ def _make_api(lib_path: str) -> "_ApiStruct":
     def _free(p):
         libc.free(ctypes.cast(p, ctypes.c_void_p))
 
+    def _create_actor(ctx, methods, init_symbol, init_arg, init_len,
+                      id_out):
+        try:
+            import uuid as _uuid
+
+            syms = [m for m in methods.decode().split(",") if m]
+            init = init_symbol.decode() if init_symbol else None
+            cls = cpp_actor(lib_path, syms, init_symbol=init or None)
+            payload = ctypes.string_at(init_arg, init_len) \
+                if init_len else b""
+            handle = cls.remote(payload)
+            hid = _uuid.uuid4().hex
+            _API_ACTORS[hid] = handle
+            _write_id(id_out, hid)
+            return 0
+        except Exception:  # noqa: BLE001 — code, not unwinding into C
+            return 5
+
+    def _call_actor(ctx, actor_id, method, arg, arg_len, id_out):
+        try:
+            handle = _API_ACTORS.get(actor_id.decode())
+            if handle is None:
+                return 2  # ENOENT
+            m = getattr(handle, method.decode(), None)
+            if m is None:
+                return 22  # EINVAL — undeclared method symbol
+            ref = m.remote(ctypes.string_at(arg, arg_len)
+                           if arg_len else b"")
+            _API_REFS[ref.id] = ref
+            _write_id(id_out, ref.id)
+            return 0
+        except Exception:  # noqa: BLE001
+            return 5
+
+    def _kill_actor(ctx, actor_id):
+        try:
+            handle = _API_ACTORS.pop(actor_id.decode(), None)
+            if handle is None:
+                return 2
+            ray_tpu.kill(handle)
+            return 0
+        except Exception:  # noqa: BLE001
+            return 5
+
     cbs = (_PUT_T(_put), _GET_T(_get), _SUBMIT_T(_submit),
-           _RELEASE_T(_release), _FREE_T(_free))
+           _RELEASE_T(_release), _FREE_T(_free),
+           _CREATE_ACTOR_T(_create_actor), _CALL_ACTOR_T(_call_actor),
+           _KILL_ACTOR_T(_kill_actor))
     api = _ApiStruct(None, *cbs)
     _API_STRUCTS[lib_path] = (api, cbs)  # keep callbacks alive
     return api

@@ -206,8 +206,61 @@ def test_cpp_api_pins_released(cluster, native_api_lib):
 
 CC_TYPED_SRC = r"""
 #include "ray_tpu.hpp"
+#include <atomic>
+#include <cstring>
 
 struct Vec3 { double x, y, z; };
+
+/* v1-ABI actor method symbols (per-worker native state) */
+static std::atomic<long long> g_cell{0};
+
+extern "C" int64_t cell_init(const uint8_t* in, size_t in_len,
+                             uint8_t** out, size_t* out_len) {
+  long long v = 0;
+  if (in_len == sizeof(v)) std::memcpy(&v, in, sizeof(v));
+  g_cell.store(v);
+  RAY_TPU_TASK_RETURN(out, out_len, &v, sizeof(v));
+  return 0;
+}
+
+extern "C" int64_t cell_add(const uint8_t* in, size_t in_len,
+                            uint8_t** out, size_t* out_len) {
+  long long d = 0;
+  if (in_len == sizeof(d)) std::memcpy(&d, in, sizeof(d));
+  long long v = (g_cell += d);
+  RAY_TPU_TASK_RETURN(out, out_len, &v, sizeof(v));
+  return 0;
+}
+
+extern "C" int64_t typed_actor_roundtrip(const ray_tpu_api_t* api,
+                                         const uint8_t* in, size_t in_len,
+                                         uint8_t** out, size_t* out_len) {
+  /* reference ray::Actor(...).Remote() + ActorHandle::Task() shape:
+   * create a stateful native actor, make typed calls, kill it. */
+  (void)in; (void)in_len;
+  ray_tpu::Runtime rt(api);
+  ray_tpu::ActorHandle a;
+  try {
+    a = rt.CreateActor<long long>("cell_add", "cell_init", 5LL);
+  } catch (const ray_tpu::RayError& e) { return 410 + e.code(); }
+  ray_tpu::ObjectRef<long long> r1;
+  try {
+    r1 = a.Call<long long, long long>("cell_add", 3LL);
+  } catch (const ray_tpu::RayError& e) { return 420 + e.code(); }
+  long long v1;
+  try {
+    v1 = rt.Get(r1, 60.0);
+  } catch (const ray_tpu::RayError& e) { return 430 + e.code(); }
+  if (v1 != 8) return 301;
+  long long v2;
+  try {
+    v2 = rt.Get(a.Call<long long, long long>("cell_add", 2LL), 60.0);
+  } catch (const ray_tpu::RayError& e) { return 440 + e.code(); }
+  if (v2 != 10) return 302;
+  a.Kill();
+  RAY_TPU_TASK_RETURN(out, out_len, &v2, sizeof(v2));
+  return 0;
+}
 
 extern "C" int64_t vec_norm2(const ray_tpu_api_t* api,
                              const uint8_t* in, size_t in_len,
@@ -277,6 +330,16 @@ def test_cpp_typed_object_refs(cluster, typed_lib):
     out = ray_tpu.get(f.remote(b""), timeout=60.0)
     (n2,) = struct.unpack("<d", out)
     assert n2 == 169.0
+
+
+def test_cpp_typed_actor(cluster, typed_lib):
+    """Native actor surface through the typed wrappers: CreateActor with
+    an init symbol, stateful typed Calls, Kill (reference api.h
+    ray::Actor/ActorHandle)."""
+    f = cpp_function(typed_lib, "typed_actor_roundtrip", api=True)
+    out = ray_tpu.get(f.remote(b""), timeout=120.0)
+    (v,) = struct.unpack("<q", out)
+    assert v == 10
 
 
 def test_cpp_typed_pins_released(cluster, typed_lib):
