@@ -6,9 +6,9 @@ from .dataset import Dataset, GroupedData  # noqa: F401
 from .datasource import (from_arrow, from_items, from_numpy,  # noqa: F401
                          from_pandas, range, range_tensor, read_binary_files,
                          read_csv, read_images, read_json, read_numpy,
-                         read_avro, read_bigquery, read_mongo,
-                         read_parquet, read_sql, read_text, read_tfrecords,
-                         read_webdataset)
+                         read_avro, read_bigquery, read_databricks_tables,
+                         read_mongo, read_parquet, read_sql, read_text,
+                         read_tfrecords, read_webdataset)
 from .iterator import DataIterator  # noqa: F401
 
 __all__ = [
@@ -18,5 +18,5 @@ __all__ = [
     "from_arrow", "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_numpy", "read_images", "read_sql",
     "read_tfrecords", "read_webdataset", "read_avro", "read_mongo",
-    "read_bigquery",
+    "read_bigquery", "read_databricks_tables",
 ]

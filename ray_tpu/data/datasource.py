@@ -717,3 +717,85 @@ def read_bigquery(project_id: str, dataset: Optional[str] = None,
 
     return _make_read("read_bigquery",
                       [make(i * step, step) for i in _builtins.range(n)])
+
+
+def read_databricks_tables(*, warehouse_id: str,
+                           table: Optional[str] = None,
+                           query: Optional[str] = None,
+                           catalog: Optional[str] = None,
+                           schema: Optional[str] = None,
+                           http: Optional[Callable] = None,
+                           host: Optional[str] = None,
+                           token: Optional[str] = None,
+                           poll_s: float = 1.0,
+                           timeout_s: float = 600.0,
+                           **_kw) -> Dataset:
+    """Databricks SQL warehouse table/query — reference read_api.py
+    read_databricks_tables (:2146; the SQL Statement Execution REST API
+    in both). Credentials come from DATABRICKS_HOST/DATABRICKS_TOKEN
+    (reference convention) unless `host`/`token`/`http` are injected.
+    Each external-link chunk of the finished statement becomes one read
+    task."""
+    import json as _json
+    import time as _time
+    import urllib.request as _url
+
+    if (table is None) == (query is None):
+        raise ValueError("pass exactly one of table= or query=")
+    host = host or os.environ.get("DATABRICKS_HOST", "")
+    token = token or os.environ.get("DATABRICKS_TOKEN", "")
+    if http is None and (not host or not token):
+        raise ValueError("set DATABRICKS_HOST/DATABRICKS_TOKEN or pass "
+                         "host=/token= (or an http= transport)")
+
+    def default_http(method, url, body=None):
+        data = _json.dumps(body).encode() if body is not None else None
+        req = _url.Request(
+            f"https://{host}{url}" if url.startswith("/") else url,
+            data=data, method=method,
+            headers={"Authorization": f"Bearer {token}",
+                     "Content-Type": "application/json"})
+        with _url.urlopen(req, timeout=60) as r:
+            payload = r.read()
+            return _json.loads(payload) if payload else {}
+
+    transport = http or default_http
+    sql = query or f"SELECT * FROM {table}"
+    body = {"warehouse_id": warehouse_id, "statement": sql,
+            "wait_timeout": "10s", "disposition": "EXTERNAL_LINKS",
+            "format": "JSON_ARRAY"}
+    if catalog:
+        body["catalog"] = catalog
+    if schema:
+        body["schema"] = schema
+    resp = transport("POST", "/api/2.0/sql/statements/", body)
+    sid = resp["statement_id"]
+    deadline = _time.monotonic() + timeout_s
+    while resp["status"]["state"] in ("PENDING", "RUNNING"):
+        if _time.monotonic() > deadline:
+            raise TimeoutError(f"statement {sid} still "
+                               f"{resp['status']['state']} after "
+                               f"{timeout_s:.0f}s")
+        _time.sleep(poll_s)
+        resp = transport("GET", f"/api/2.0/sql/statements/{sid}")
+    if resp["status"]["state"] != "SUCCEEDED":
+        raise RuntimeError(
+            f"statement {sid} {resp['status']['state']}: "
+            f"{resp['status'].get('error', {}).get('message', '')}")
+    cols = [c["name"] for c in
+            resp["manifest"]["schema"]["columns"]]
+    chunks = resp["result"].get("external_links", [])
+
+    def make(link):
+        def read():
+            rows = transport("GET", link["external_link"])
+            return pa.table({c: [r[i] for r in rows]
+                             for i, c in enumerate(cols)})
+
+        return read
+
+    if not chunks:  # inline empty result
+        return _make_read("read_databricks_tables",
+                          [lambda: pa.table({c: [] for c in cols})])
+    return _make_read("read_databricks_tables",
+                      [make(ln) for ln in chunks])

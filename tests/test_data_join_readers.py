@@ -330,3 +330,48 @@ def test_read_bigquery_table_and_query():
 
     with pytest.raises(ValueError):
         rd.read_bigquery("proj")
+
+
+# ------------------------------------------------ read_databricks_tables
+
+class _FakeDbx:
+    """SQL Statement Execution API double: POST starts (PENDING), one GET
+    later it SUCCEEDEDs with two external-link chunks."""
+
+    def __init__(self):
+        self.polls = 0
+
+    def __call__(self, method, url, body=None):
+        if method == "POST":
+            return {"statement_id": "st1",
+                    "status": {"state": "PENDING"}}
+        if url.endswith("/st1"):
+            self.polls += 1
+            if self.polls < 2:
+                return {"statement_id": "st1",
+                        "status": {"state": "RUNNING"}}
+            return {
+                "statement_id": "st1",
+                "status": {"state": "SUCCEEDED"},
+                "manifest": {"schema": {"columns": [
+                    {"name": "id"}, {"name": "v"}]}},
+                "result": {"external_links": [
+                    {"external_link": "https://x/chunk0"},
+                    {"external_link": "https://x/chunk1"}]},
+            }
+        if url.endswith("chunk0"):
+            return [[1, "a"], [2, "b"]]
+        return [[3, "c"]]
+
+
+def test_read_databricks_tables():
+    ds = rd.read_databricks_tables(
+        warehouse_id="w1", table="cat.t", http=_FakeDbx(), poll_s=0.01)
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == [1, 2, 3]
+    assert sorted(r["v"] for r in rows) == ["a", "b", "c"]
+
+    with pytest.raises(ValueError):
+        rd.read_databricks_tables(warehouse_id="w1", http=_FakeDbx())
+    with pytest.raises(ValueError, match="DATABRICKS"):
+        rd.read_databricks_tables(warehouse_id="w1", table="t")
