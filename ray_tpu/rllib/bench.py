@@ -45,7 +45,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="")
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (e.g. 'cpu') BEFORE backend "
+                         "init — required on hosts whose default TPU "
+                         "tunnel may be unavailable, where the first "
+                         "jitted op would otherwise hang")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     from . import APPOConfig, IMPALAConfig, PPOConfig
 
