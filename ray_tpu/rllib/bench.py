@@ -56,6 +56,16 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+        # The package __init__ already ran under `python -m`; the update
+        # only helps while no module-level code has touched a backend
+        # yet. If one ever does, fail loudly here instead of silently
+        # hanging on the first jit against an unavailable default tunnel.
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():  # pragma: no cover
+            raise RuntimeError(
+                "--platform came too late: a jax backend initialized "
+                "during import; move the offending module-level jax use")
 
     from . import APPOConfig, IMPALAConfig, PPOConfig
 
@@ -76,14 +86,19 @@ def main(argv=None) -> int:
                                       rollout_fragment_length=64)
                          .debugging(seed=0).build()),
     }
+    import jax
+
+    platform = jax.devices()[0].platform
     results = []
     for name, build in builders.items():
         rec = bench_algo(name, build(), args.steps)
+        rec["platform"] = platform  # cpu stand-ins must say so
         results.append(rec)
         print(json.dumps(rec), flush=True)
     if args.out:
         with open(args.out, "w") as f:
-            json.dump({"results": results}, f, indent=1)
+            json.dump({"platform": platform, "results": results}, f,
+                      indent=1)
     return 0
 
 
