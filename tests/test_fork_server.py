@@ -72,3 +72,37 @@ def test_cluster_uses_fork_server_and_workers_die_fast(ray_start_regular):
         except OSError:
             pass
         pytest.fail(f"worker {pid} still visible 5s after SIGTERM ({state})")
+
+
+def test_cached_lease_survives_worker_crash(ray_start_regular):
+    """A worker can die while its lease sits in the driver's reuse cache
+    (worker.py _lease_recache); the next task must transparently fall
+    back to a fresh lease via the crash-retry path instead of failing."""
+    import ray_tpu
+    from ray_tpu._private import worker as wmod
+
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pid = ray_tpu.get(whoami.remote(), timeout=60.0)
+    gw = wmod.global_worker
+    with gw._lease_cache_lock:
+        cached = [wid for lst in gw._lease_cache.values()
+                  for wid, _, _ in lst]
+    assert cached, "lease was not recached after the task"
+
+    # kill the worker while its lease is cached
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+
+    # next same-shape task pops the dead cached lease, hits
+    # ConnectionLost on push, and retries through a fresh lease
+    pid2 = ray_tpu.get(whoami.remote(), timeout=60.0)
+    assert pid2 != pid
