@@ -30,9 +30,11 @@ supervisor therefore:
   4. runs the MEASURE child with known-good defaults only (flash blocks
      1024/1024, per-chip batch 32, no autotune sweep, no fused-bwd
      probe): the minimal risk path to a number on disk;
-  5. leaves kernel exploration (fused-bwd probe, block autotune) to
-     opt-in children (BENCH_EXPLORE=1) that run only AFTER a headline
-     number exists, each in its own bounded process;
+  5. runs kernel exploration (fused-bwd probe, block autotune) only
+     AFTER the headline number has been PRINTED, each in its own
+     bounded child; an improved record is printed as a later line (the
+     driver takes the last one), so exploration can only improve the
+     result, never lose it. BENCH_EXPLORE=0 disables;
   6. falls back to JAX_PLATFORMS=cpu if the TPU path fails so a parsed
      record is always emitted, with the TPU failure recorded in the
      JSON instead of a raw traceback.
@@ -422,8 +424,15 @@ def _supervise() -> int:
             }))
             return 1
         if (rec is not None and rec.get("implied_mfu")
-                and os.environ.get("BENCH_EXPLORE") == "1"):
-            rec = _explore(rec, tpu_timeout)
+                and os.environ.get("BENCH_EXPLORE", "1") == "1"):
+            # headline first, THEN explore: the driver parses the LAST
+            # complete JSON line, so a killed/timed-out exploration can
+            # only fail to improve the record, never lose it
+            print(json.dumps(rec), flush=True)
+            best = _explore(rec, tpu_timeout)
+            if best is not rec:
+                print(json.dumps(best))
+            return 0
 
     if rec is not None:
         print(json.dumps(rec))
