@@ -1,0 +1,36 @@
+"""Dataset example: streaming transforms, join, groupby, device feed.
+
+    python examples/data_pipeline.py
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def main() -> None:
+    ray_tpu.init(num_cpus=4)
+    try:
+        users = rd.from_items(
+            [{"uid": i, "region": "us" if i % 2 else "eu"}
+             for i in range(100)])
+        events = rd.range(1000, parallelism=8).map(
+            lambda r: {"uid": r["id"] % 100, "value": float(r["id"])})
+
+        joined = events.join(users, on="uid")
+        by_region = joined.groupby("region").mean("value")
+        print(by_region.take_all())
+
+        # stream batches toward a training loop
+        it = joined.select_columns(["value"]).iter_batches(
+            batch_size=128, batch_format="numpy")
+        total = sum(b["value"].sum() for b in it)
+        print(f"sum over stream: {total:.0f}")
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""A Serve application for the declarative deploy example.
+
+    python -m ray_tpu serve run examples/serve_config.yaml
+    curl -X POST localhost:8000/classify -d '{"x": [1.0, 2.0]}'
+"""
+from ray_tpu import serve
+
+
+@serve.deployment
+class Preprocessor:
+    def transform(self, xs):
+        return [float(x) * 2 for x in xs]
+
+
+@serve.deployment
+class Model:
+    def __init__(self, preprocessor, bias: float = 0.0):
+        self.pre = preprocessor
+        self.bias = bias
+
+    def __call__(self, request):
+        xs = self.pre.transform.remote(request.json()["x"]).result()
+        return {"score": sum(xs) + self.bias}
+
+
+app = Model.bind(Preprocessor.bind())
+
+
+def build(args):
+    """Builder entry point: YAML `args` configure the app."""
+    return Model.bind(Preprocessor.bind(), float(args.get("bias", 0.0)))
