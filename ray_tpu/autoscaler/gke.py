@@ -38,6 +38,17 @@ from .gcp import TPU_API, _default_http, _metadata_token, accelerator_chips
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+def _parse_cpu_quantity(quantity: Any) -> float:
+    """k8s CPU quantity -> cores. '500m' is 500 MILLIcpu = 0.5 cores
+    (k8s resource-quantity suffix), '8'/'8.0' are cores."""
+    s = str(quantity).strip()
+    if not s:
+        return 1.0
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)
+
+
 def _incluster_http() -> Callable:
     """k8s REST transport using the pod's mounted service account
     (reference kuberay node_provider.py load_k8s_secrets)."""
@@ -159,9 +170,8 @@ class KubernetesPodProvider(NodeProvider):
                 "node_id": meta.get("name"),
                 "node_type": node_type,
                 "resources": {"TPU": chips} if chips else
-                             {"CPU": float(str(cfg.get("resources", {})
-                                               .get("cpu", 1)).rstrip("m")
-                                           or 1)},
+                             {"CPU": _parse_cpu_quantity(
+                                 cfg.get("resources", {}).get("cpu", 1))},
                 "state": phase,
                 "ip": pod.get("status", {}).get("podIP"),
             })

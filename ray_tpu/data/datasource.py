@@ -701,9 +701,30 @@ def read_bigquery(project_id: str, dataset: Optional[str] = None,
     if not table:
         raise ValueError("dataset must be 'dataset.table'")
     meta = transport("GET", f"{base}/datasets/{ds_id}/tables/{table}")
-    total = int(meta.get("numRows", 0))
+    total = int(meta.get("numRows", 0) or 0)
     schema_fields = meta["schema"]["fields"]
-    n = max(1, min(parallelism, total or 1))
+
+    if total <= 0:
+        # Views and tables with a streaming buffer report no numRows, so
+        # startIndex range splitting would fetch <=1 row. Fall back to a
+        # single task that follows pageToken to exhaustion.
+        def read_paged():
+            rows: list = []
+            token = None
+            while True:
+                url = (f"{base}/datasets/{ds_id}/tables/{table}/data"
+                       f"?maxResults=10000")
+                if token:
+                    url += f"&pageToken={token}"
+                resp = transport("GET", url)
+                rows.extend(resp.get("rows", []))
+                token = resp.get("pageToken")
+                if not token:
+                    return _rows_to_table(schema_fields, rows)
+
+        return _make_read("read_bigquery", [read_paged])
+
+    n = max(1, min(parallelism, total))
     step = -(-max(total, 1) // n)
 
     def make(start: int, count: int):

@@ -11,6 +11,10 @@ control plane.
 
 Public surface:
 - MeshConfig / make_mesh: named-axis mesh construction (dp/fsdp/pp/tp/sp/ep)
+- HybridMeshConfig / make_hybrid_mesh / discover_slice_topology:
+  multi-slice DCN x ICI hybrid meshes (data-like axes across slices over
+  DCN, model axes within a slice on ICI), with RAY_TPU_VIRTUAL_SLICES
+  partitioning the virtual CPU mesh into fake slices for off-silicon tests
 - collective: host-level collective group API mirroring
   ray.util.collective's surface (init_collective_group, allreduce, barrier,
   broadcast, allgather, reducescatter, send, recv)
@@ -28,6 +32,13 @@ from .mesh import (  # noqa: F401
     host_local_array_to_global,
     make_mesh,
     named_sharding,
+    shard_map,
+)
+from .multislice import (  # noqa: F401
+    HybridMeshConfig,
+    SliceTopology,
+    discover_slice_topology,
+    make_hybrid_mesh,
 )
 from .collective import (  # noqa: F401
     CollectiveActorMixin,

@@ -15,10 +15,12 @@ the actor: the KV is already the cluster's rendezvous plane.
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
 import socket
 import time
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _NAMESPACE = "_jax_distributed"
 
@@ -76,19 +78,157 @@ def rendezvous_coordinator(kv_put: Callable, kv_get: Callable,
                        f"within {timeout}s")
 
 
+# ------------------------------------------------------ slice rendezvous
+
+def detect_slice_id() -> Optional[int]:
+    """This process's TPU slice id from the runtime env, or None when no
+    slice identity is advertised (single-slice / non-megascale jobs).
+    RAY_TPU_SLICE_ID is the explicit override; MEGASCALE_SLICE_ID is what
+    the multislice TPU runtime exports on every worker VM."""
+    for var in ("RAY_TPU_SLICE_ID", "MEGASCALE_SLICE_ID"):
+        v = os.environ.get(var)
+        if v is not None and v != "":
+            return int(v)
+    return None
+
+
+def rendezvous_slices(kv_put: Callable, kv_get: Callable, group_key: str,
+                      rank: int, world: int, slice_id: Optional[int],
+                      timeout: float = 120.0
+                      ) -> Optional[Dict[int, List[int]]]:
+    """Each rank publishes its slice id (or a "none" marker) under the
+    group key; rank 0 polls the per-rank keys, assembles the slice map
+    {slice_id: sorted ranks}, and publishes it under one assembled key
+    that the other ranks poll — O(world) conductor RPCs total instead of
+    every rank polling every other rank. Same KV-rendezvous pattern as
+    the coordinator claim above — the conductor KV is the cluster's
+    rendezvous plane.
+
+    Slice identity must be all-or-none across the gang: mixed
+    some-ranks-have-a-slice-id gangs (env leak, heterogeneous hosts)
+    raise ValueError on EVERY rank instead of deadlocking with
+    mismatched process ids. Returns None when no rank has a slice id
+    (single-slice gang, no grouping needed)."""
+    kv_put(f"{group_key}/slice/{rank}".encode(),
+           ("none" if slice_id is None else str(int(slice_id))).encode(),
+           namespace=_NAMESPACE)
+    assembled_key = f"{group_key}/slice_assembled".encode()
+    deadline = time.monotonic() + timeout
+    sleep = 0.01
+
+    if rank != 0:
+        while True:
+            v = kv_get(assembled_key, namespace=_NAMESPACE)
+            if v:
+                rec = json.loads(v.decode())
+                if "__error__" in rec:
+                    raise ValueError(rec["__error__"])
+                if not rec:
+                    return None
+                return {int(s): rs for s, rs in sorted(rec.items())}
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"slice rendezvous for {group_key}: rank 0 did not "
+                    f"publish the assembled slice map within {timeout}s")
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.5)
+
+    got: Dict[int, Optional[int]] = {rank: slice_id}
+    while len(got) < world:
+        for r in range(world):
+            if r in got:
+                continue
+            v = kv_get(f"{group_key}/slice/{r}".encode(),
+                       namespace=_NAMESPACE)
+            if v:
+                s = v.decode()
+                got[r] = None if s == "none" else int(s)
+        if len(got) < world:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"slice rendezvous for {group_key}: only "
+                    f"{len(got)}/{world} ranks published within "
+                    f"{timeout}s")
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.5)
+
+    missing = sorted(r for r, s in got.items() if s is None)
+    if missing:
+        if len(missing) < world:
+            msg = (f"inconsistent slice identity in {group_key}: ranks "
+                   f"{missing} have no slice id while the rest do — "
+                   f"slice identity must be all-or-none across the gang")
+            kv_put(assembled_key, json.dumps({"__error__": msg}).encode(),
+                   namespace=_NAMESPACE)
+            raise ValueError(msg)
+        kv_put(assembled_key, b"{}", namespace=_NAMESPACE)
+        return None
+
+    slice_map: Dict[int, List[int]] = {}
+    for r, s in got.items():
+        slice_map.setdefault(s, []).append(r)
+    slice_map = {s: sorted(rs) for s, rs in sorted(slice_map.items())}
+    kv_put(assembled_key,
+           json.dumps({str(s): rs for s, rs in slice_map.items()}).encode(),
+           namespace=_NAMESPACE)
+    return slice_map
+
+
+def publish_slice_map(kv_put: Callable, group_key: str,
+                      slice_map: Dict[int, List[int]],
+                      process_ids: Dict[int, int], world: int) -> None:
+    """Write the gang's slice map under `{group_key}/slice_map` where
+    `ray_tpu.util.state.slice_topology` reads it (rank 0 only)."""
+    kv_put(f"{group_key}/slice_map".encode(),
+           json.dumps({"slices": {str(s): rs
+                                  for s, rs in slice_map.items()},
+                       "process_ids": {str(r): p
+                                       for r, p in process_ids.items()},
+                       "world": world}).encode(),
+           namespace=_NAMESPACE)
+
+
+def slice_process_ids(slice_map: Dict[int, List[int]]) -> Dict[int, int]:
+    """Slice-major process-id assignment: ranks of the same slice get
+    CONTIGUOUS process ids (what `mesh_utils.create_hybrid_device_mesh`
+    with process-granules and the DCN-outer axis order expect), with
+    rank 0's slice first so rank 0 keeps process id 0 — it hosts the
+    jax.distributed coordinator service."""
+    rank0_slice = next(s for s, rs in slice_map.items() if 0 in rs)
+    order = sorted(slice_map, key=lambda s: (s != rank0_slice, s))
+    pids: Dict[int, int] = {}
+    pid = 0
+    for s in order:
+        for r in sorted(slice_map[s]):
+            pids[r] = pid
+            pid += 1
+    return pids
+
+
 def initialize_jax_distributed(group_key: str, rank: int, world: int,
                                kv_put: Optional[Callable] = None,
                                kv_get: Optional[Callable] = None,
                                timeout: float = 120.0,
-                               host: Optional[str] = None) -> None:
+                               host: Optional[str] = None,
+                               slice_id: Optional[int] = None,
+                               ) -> Optional[Dict[str, object]]:
     """Run the coordinator rendezvous and `jax.distributed.initialize`.
 
     Must be called before any other jax API touches the backend. With
     world == 1 this is a no-op (single-process SPMD needs no service).
     kv_put/kv_get default to the connected cluster's conductor KV.
+
+    With `slice_id` (explicit, or detected from the runtime env by the
+    caller via `detect_slice_id`), ranks first rendezvous their slice
+    membership: process ids are reassigned slice-major so processes of
+    one slice are contiguous in the jax.distributed job, and rank 0
+    publishes the slice map under `{group_key}/slice_map` where the
+    state API (`ray_tpu.util.state.slice_topology`) finds it. Returns
+    the slice info dict ({"slice_id", "slices", "process_ids"}) when a
+    slice rendezvous ran, else None.
     """
     if world <= 1:
-        return
+        return None
     if kv_put is None or kv_get is None:
         from .._private import worker as worker_mod
 
@@ -105,12 +245,34 @@ def initialize_jax_distributed(group_key: str, rank: int, world: int,
             # advertise on the interface that reaches the conductor
             host = _local_ip(w.conductor_address[0])
 
+    process_id = rank
+    slice_info: Optional[Dict[str, object]] = None
+    # Always rendezvous (slice_id may be None): slice identity must be
+    # all-or-none across the gang, and only the rendezvous can tell this
+    # rank whether the OTHERS have one — a mixed gang fails fast with a
+    # clear error on every rank instead of deadlocking on mismatched
+    # process ids.
+    slice_map = rendezvous_slices(kv_put, kv_get, group_key, rank,
+                                  world, slice_id, timeout)
+    if slice_map is not None:
+        pids = slice_process_ids(slice_map)
+        process_id = pids[rank]
+        slice_info = {"slice_id": int(slice_id),
+                      "slices": {int(s): rs
+                                 for s, rs in slice_map.items()},
+                      "process_ids": {int(r): p
+                                      for r, p in pids.items()}}
+        if rank == 0:
+            publish_slice_map(kv_put, group_key, slice_map, pids, world)
+
     coordinator = rendezvous_coordinator(kv_put, kv_get, group_key, rank,
                                          timeout, host=host)
     import jax
 
     jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=world, process_id=rank)
+                               num_processes=world,
+                               process_id=process_id)
+    return slice_info
 
 
 def is_jax_distributed_initialized() -> bool:
@@ -140,6 +302,23 @@ def setup_jax_distributed(timeout: float = 120.0) -> Tuple[int, int]:
     if not is_jax_distributed_initialized():
         group_key = getattr(ctx, "jax_dist_key", None) or \
             f"group/{ctx.experiment_name}"
-        initialize_jax_distributed(group_key, ctx.rank, ctx.world_size,
-                                   timeout=timeout)
+        # slice identity: the runtime env (MEGASCALE_SLICE_ID) is ground
+        # truth when present — gang placement does not guarantee host
+        # order follows physical slice boundaries, so the trainer's
+        # rank-arithmetic assignment (ScalingConfig.num_slices) is only
+        # the fallback for runtimes that advertise no slice identity.
+        detected = detect_slice_id()
+        assigned = getattr(ctx, "slice_id", None)
+        slice_id = detected if detected is not None else assigned
+        if detected is not None and assigned is not None and \
+                detected != assigned:
+            logging.getLogger(__name__).warning(
+                "rank %d: trainer assigned slice %s but the TPU runtime "
+                "reports slice %s; using the runtime's value",
+                ctx.rank, assigned, detected)
+        info = initialize_jax_distributed(group_key, ctx.rank,
+                                          ctx.world_size, timeout=timeout,
+                                          slice_id=slice_id)
+        if info is not None:
+            ctx.slice_map = info["slices"]
     return ctx.rank, ctx.world_size

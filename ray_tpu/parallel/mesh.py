@@ -24,6 +24,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "sp", "ep", "tp")
 
 
+def solve_axis_sizes(vals: Dict[str, int], total: int,
+                     unit: str) -> Dict[str, int]:
+    """Solve the named-axis size map against `total` items: at most one
+    axis may be -1 ("fill with the remainder"), the rest must be
+    positive and their product must divide (fill) or equal (no fill)
+    `total`. Shared by the ICI solve (MeshConfig.sizes, unit="device")
+    and the DCN solve (HybridMeshConfig.dcn_sizes, unit="slice")."""
+    vals = dict(vals)
+    fill = [k for k, v in vals.items() if v == -1]
+    if len(fill) > 1:
+        raise ValueError(f"only one axis may be -1, got {fill}")
+    fixed = 1
+    for k, v in vals.items():
+        if v != -1:
+            if v <= 0:
+                raise ValueError(f"axis {k} must be positive or -1, got {v}")
+            fixed *= v
+    if fill:
+        if total % fixed != 0:
+            raise ValueError(
+                f"{total} {unit}s not divisible by fixed axes "
+                f"product {fixed}")
+        vals[fill[0]] = total // fixed
+    elif fixed != total:
+        raise ValueError(
+            f"mesh axes product {fixed} != {unit} count {total}")
+    return vals
+
+
 @dataclass(frozen=True)
 class MeshConfig:
     """Sizes for each named axis; -1 on exactly one axis means "fill with
@@ -37,27 +66,11 @@ class MeshConfig:
     tp: int = 1
 
     def sizes(self, n_devices: int) -> Dict[str, int]:
-        vals = {f.name: getattr(self, f.name) for f in fields(self)}
-        fill = [k for k, v in vals.items() if v == -1]
-        if len(fill) > 1:
-            raise ValueError(f"only one axis may be -1, got {fill}")
-        fixed = 1
-        for k, v in vals.items():
-            if v != -1:
-                if v <= 0:
-                    raise ValueError(f"axis {k} must be positive or -1, got {v}")
-                fixed *= v
-        if fill:
-            if n_devices % fixed != 0:
-                raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes "
-                    f"product {fixed}")
-            vals[fill[0]] = n_devices // fixed
-        else:
-            if fixed != n_devices:
-                raise ValueError(
-                    f"mesh axes product {fixed} != device count {n_devices}")
-        return {k: vals[k] for k in MESH_AXES}
+        # fields(MeshConfig), not fields(self): subclasses (HybridMeshConfig)
+        # add DCN axes that must not leak into the ICI size solve.
+        vals = {f.name: getattr(self, f.name) for f in fields(MeshConfig)}
+        solved = solve_axis_sizes(vals, n_devices, "device")
+        return {k: solved[k] for k in MESH_AXES}
 
     def build(self, devices: Optional[Sequence[Any]] = None) -> Mesh:
         return make_mesh(self, devices)
@@ -80,12 +93,43 @@ def make_mesh(config: Optional[MeshConfig] = None,
         devices = jax.devices()
     sizes = config.sizes(len(devices))
     shape = tuple(sizes[a] for a in MESH_AXES)
+    return Mesh(ici_device_mesh(shape, devices), MESH_AXES)
+
+
+def ici_device_mesh(shape: Tuple[int, ...],
+                    devices: Sequence[Any]) -> np.ndarray:
+    """Topology-optimized device array for one ICI domain (a slice, or the
+    whole device set when there is only one). Falls back to a plain
+    row-major reshape where mesh_utils has no assignment (virtual CPU
+    devices, odd shapes) — shared by make_mesh and the multislice
+    per-slice builder."""
     try:
-        dev_array = mesh_utils.create_device_mesh(
+        return mesh_utils.create_device_mesh(
             shape, devices=np.asarray(devices, dtype=object).ravel())
     except (ValueError, AssertionError, NotImplementedError):
-        dev_array = np.asarray(devices, dtype=object).reshape(shape)
-    return Mesh(dev_array, MESH_AXES)
+        return np.asarray(devices, dtype=object).reshape(shape)
+
+
+try:  # jax >= 0.6 exports it at top level
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover - jax 0.4/0.5
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_REPLICATION_CHECK_KW = next(
+    (kw for kw in ("check_vma", "check_rep")
+     if kw in __import__("inspect").signature(_shard_map_impl).parameters),
+    None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """Version-portable `shard_map`: jax renamed the replication-check
+    kwarg (check_rep -> check_vma) and moved the function out of
+    experimental; this front door accepts `check_vma` and forwards to
+    whatever the installed jax calls it."""
+    if check_vma is not None and _REPLICATION_CHECK_KW:
+        kw[_REPLICATION_CHECK_KW] = check_vma
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

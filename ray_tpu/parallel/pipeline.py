@@ -26,11 +26,9 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import shard_map
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],

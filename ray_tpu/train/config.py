@@ -23,6 +23,28 @@ class ScalingConfig:
     # the user — train/torch/config.py:64-117). Opt out for gangs doing
     # pure host-side work with no jax in the loop.
     setup_jax_distributed: bool = True
+    # mode="workers" on a multi-slice pod: how many TPU slices the gang
+    # spans. Workers are assigned slice ids contiguously (the trainer's
+    # gang placement packs a slice's hosts together) and the
+    # jax.distributed rendezvous groups process ids slice-major so DCN
+    # axes of a HybridMeshConfig land across slices.
+    num_slices: int = 1
+
+
+def assign_worker_slices(num_workers: int, num_slices: int) -> list:
+    """Contiguous balanced slice assignment for a worker gang: rank
+    order == host order under STRICT_PACK, so contiguous ranks share a
+    slice's hosts. Returns one slice id per rank, or all-None for
+    single-slice gangs (no slice rendezvous needed). Used as the
+    fallback when the TPU runtime advertises no slice identity
+    (parallel.distributed.detect_slice_id)."""
+    if num_slices <= 1:
+        return [None] * num_workers
+    if num_workers % num_slices != 0:
+        raise ValueError(
+            f"num_workers={num_workers} not divisible by "
+            f"num_slices={num_slices}")
+    return [i * num_slices // num_workers for i in range(num_workers)]
 
 
 @dataclass
@@ -37,12 +59,35 @@ class ShardingConfig:
     ep: int = 1
     tp: int = 1
     remat: bool = False  # jax.checkpoint the model forward
+    # DCN (cross-slice) axis sizes for multi-slice pods; the plain axes
+    # above then size the ICI mesh WITHIN one slice. All 1 = single
+    # slice, lowers to a flat MeshConfig exactly as before.
+    dcn_dp: int = 1
+    dcn_fsdp: int = 1
+    dcn_pp: int = 1
+
+    @property
+    def is_hybrid(self) -> bool:
+        return any(v != 1 for v in (self.dcn_dp, self.dcn_fsdp,
+                                    self.dcn_pp))
 
     def mesh_config(self):
+        if self.is_hybrid:
+            from ..parallel.multislice import HybridMeshConfig
+
+            return HybridMeshConfig(
+                dp=self.dp, fsdp=self.fsdp, pp=self.pp, sp=self.sp,
+                ep=self.ep, tp=self.tp, dcn_dp=self.dcn_dp,
+                dcn_fsdp=self.dcn_fsdp, dcn_pp=self.dcn_pp)
         from ..parallel.mesh import MeshConfig
 
         return MeshConfig(dp=self.dp, fsdp=self.fsdp, pp=self.pp,
                           sp=self.sp, ep=self.ep, tp=self.tp)
+
+    def build_mesh(self, devices=None):
+        """Lower to a jax Mesh: hybrid (slice-topology discovery + DCN
+        block assembly) when any dcn_* axis is set, flat otherwise."""
+        return self.mesh_config().build(devices)
 
 
 @dataclass

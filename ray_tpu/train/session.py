@@ -28,6 +28,12 @@ class TrainContext:
     # rendezvous namespace for this gang (unique per fit); consumed by
     # parallel.distributed.setup_jax_distributed
     jax_dist_key: Optional[str] = None
+    # multi-slice identity (ScalingConfig.num_slices > 1): which TPU
+    # slice this rank's host belongs to; slice_map is filled in by
+    # setup_jax_distributed after the slice rendezvous
+    slice_id: Optional[int] = None
+    num_slices: int = 1
+    slice_map: Optional[Dict[int, Any]] = None
     # set by the trainer: called with (metrics, checkpoint)
     _report_fn: Optional[Callable[[Dict[str, Any], Optional[Checkpoint]],
                                   None]] = None
@@ -41,6 +47,9 @@ class TrainContext:
 
     def get_trial_dir(self) -> str:
         return self.trial_dir
+
+    def get_slice_id(self) -> int:
+        return 0 if self.slice_id is None else self.slice_id
 
 
 def _set_session(ctx: Optional[TrainContext]) -> None:

@@ -265,7 +265,9 @@ class JaxTrainer:
             def run(self, fn_bytes: bytes, cfg: Dict[str, Any],
                     trial_dir: str, shards: Dict[str, Any],
                     latest_path: Optional[str],
-                    dist_key: Optional[str] = None) -> List[Any]:
+                    dist_key: Optional[str] = None,
+                    slice_id: Optional[int] = None,
+                    num_slices: int = 1) -> List[Any]:
                 from ray_tpu._private import serialization
                 from ray_tpu.train.session import (TrainContext,
                                                    _set_session, StopTrial)
@@ -283,6 +285,7 @@ class JaxTrainer:
                     latest_checkpoint=(Ckpt(latest_path)
                                        if latest_path else None),
                     jax_dist_key=dist_key,
+                    slice_id=slice_id, num_slices=num_slices,
                     _report_fn=report_fn)
                 _set_session(ctx)
                 try:
@@ -327,12 +330,19 @@ class JaxTrainer:
         if n > 1 and getattr(self.scaling_config,
                              "setup_jax_distributed", True):
             dist_key = f"train-gang/{uuid.uuid4().hex}"
+        # multi-slice gangs: the rendezvous groups process ids
+        # slice-major for hybrid DCN meshes.
+        from .config import assign_worker_slices
+
+        num_slices = max(1, getattr(self.scaling_config, "num_slices", 1))
+        slice_ids = assign_worker_slices(n, num_slices)
         workers = [_TrainWorker.options(placement_group=pg)
                    .remote(rank=i, world=n) for i in range(n)]
         try:
             refs = [w.run.remote(
                 fn_bytes, cfg, storage, self._shard_datasets(i, n),
-                latest.path if latest else None, dist_key)
+                latest.path if latest else None, dist_key,
+                slice_ids[i], num_slices)
                 for i, w in enumerate(workers)]
             all_reports = ray_tpu.get(refs)
         finally:

@@ -337,10 +337,7 @@ def device_allreduce(x, mesh, axis: str = "dp", op: ReduceOp = ReduceOp.SUM):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from ray_tpu.parallel.mesh import shard_map
 
     prims = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
              ReduceOp.MIN: jax.lax.pmin}

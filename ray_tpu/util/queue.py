@@ -15,8 +15,22 @@ from queue import Empty, Full  # re-exported, same as the reference
 from typing import Any, List, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import TaskError
 
 __all__ = ["Queue", "Empty", "Full"]
+
+
+def _queue_error(exc: BaseException) -> Optional[Exception]:
+    """Map an actor-side failure back to the stdlib queue exception the
+    reference raises: actor errors arrive wrapped in TaskError, and the
+    nowait paths raise asyncio.QueueEmpty/QueueFull (which do NOT
+    subclass queue.Empty/Full)."""
+    cause = exc.cause if isinstance(exc, TaskError) else exc
+    if isinstance(cause, (Full, asyncio.QueueFull)):
+        return Full(*getattr(cause, "args", ()))
+    if isinstance(cause, (Empty, asyncio.QueueEmpty)):
+        return Empty(*getattr(cause, "args", ()))
+    return None
 
 
 class _QueueActor:
@@ -93,59 +107,86 @@ class Queue:
     def __reduce__(self):
         return (_rebuild_queue, (self.maxsize, self.actor))
 
+    @staticmethod
+    def _get(ref):
+        """ray_tpu.get with actor-side queue errors mapped back to the
+        stdlib queue.Empty/queue.Full the caller expects."""
+        try:
+            return ray_tpu.get(ref)
+        except TaskError as e:
+            qe = _queue_error(e)
+            if qe is None:
+                raise
+            raise qe from None
+
+    @staticmethod
+    async def _get_async(ref):
+        try:
+            return await ray_tpu.get_async(ref)
+        except TaskError as e:
+            qe = _queue_error(e)
+            if qe is None:
+                raise
+            raise qe from None
+
     def qsize(self) -> int:
-        return ray_tpu.get(self.actor.qsize.remote())
+        return self._get(self.actor.qsize.remote())
 
     def size(self) -> int:
         return self.qsize()
 
     def empty(self) -> bool:
-        return ray_tpu.get(self.actor.empty.remote())
+        return self._get(self.actor.empty.remote())
 
     def full(self) -> bool:
-        return ray_tpu.get(self.actor.full.remote())
+        return self._get(self.actor.full.remote())
 
     def put(self, item: Any, block: bool = True,
             timeout: Optional[float] = None) -> None:
         if not block:
-            ray_tpu.get(self.actor.put_nowait.remote(item))
+            self._get(self.actor.put_nowait.remote(item))
             return
         if timeout is not None and timeout < 0:
             raise ValueError("'timeout' must be a non-negative number")
-        ray_tpu.get(self.actor.put.remote(item, timeout))
+        self._get(self.actor.put.remote(item, timeout))
 
     def get(self, block: bool = True,
             timeout: Optional[float] = None) -> Any:
         if not block:
-            return ray_tpu.get(self.actor.get_nowait.remote())
+            return self._get(self.actor.get_nowait.remote())
         if timeout is not None and timeout < 0:
             raise ValueError("'timeout' must be a non-negative number")
-        return ray_tpu.get(self.actor.get.remote(timeout))
+        return self._get(self.actor.get.remote(timeout))
 
     async def put_async(self, item: Any, block: bool = True,
                         timeout: Optional[float] = None) -> None:
         if not block:
-            await ray_tpu.get_async(self.actor.put_nowait.remote(item))
+            await self._get_async(self.actor.put_nowait.remote(item))
             return
-        await ray_tpu.get_async(self.actor.put.remote(item, timeout))
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        await self._get_async(self.actor.put.remote(item, timeout))
 
     async def get_async(self, block: bool = True,
                         timeout: Optional[float] = None) -> Any:
         if not block:
-            return await ray_tpu.get_async(self.actor.get_nowait.remote())
-        return await ray_tpu.get_async(self.actor.get.remote(timeout))
+            return await self._get_async(
+                self.actor.get_nowait.remote())
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        return await self._get_async(self.actor.get.remote(timeout))
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, block=False)
 
     def put_nowait_batch(self, items: List[Any]) -> None:
-        ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)))
+        self._get(self.actor.put_nowait_batch.remote(list(items)))
 
     def get_nowait(self) -> Any:
         return self.get(block=False)
 
     def get_nowait_batch(self, num_items: int) -> List[Any]:
-        return ray_tpu.get(self.actor.get_nowait_batch.remote(num_items))
+        return self._get(self.actor.get_nowait_batch.remote(num_items))
 
     def shutdown(self, force: bool = False) -> None:
         """Terminate the backing actor; the queue is unusable after."""

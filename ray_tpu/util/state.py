@@ -66,6 +66,40 @@ def list_objects() -> List[Dict[str, Any]]:
     return out
 
 
+def slice_topology(group: Optional[str] = None) -> Dict[str, Any]:
+    """Slice maps of jax.distributed gangs that ran a multi-slice
+    rendezvous (parallel.distributed.initialize_jax_distributed with a
+    slice id): {group_key: {"slices": {slice_id: [ranks]},
+    "process_ids": {rank: process_id}, "world": n}}. Rank 0 of each
+    gang publishes its map into the conductor KV; this reads it back —
+    the state-API analog of `list_placement_groups` for DCN topology."""
+    w = _conductor()
+    suffix = "/slice_map"
+    keys = w.conductor.call("kv_keys", b"", "_jax_distributed",
+                            timeout=10.0)
+    out: Dict[str, Any] = {}
+    for key in keys:
+        name = key.decode() if isinstance(key, bytes) else str(key)
+        if not name.endswith(suffix):
+            continue
+        g = name[:-len(suffix)]
+        if group is not None and g != group:
+            continue
+        raw = w.conductor.call("kv_get", key, "_jax_distributed",
+                               timeout=10.0)
+        if not raw:
+            continue
+        rec = json.loads(raw.decode())
+        out[g] = {
+            "slices": {int(s): rs
+                       for s, rs in rec.get("slices", {}).items()},
+            "process_ids": {int(r): p for r, p
+                            in rec.get("process_ids", {}).items()},
+            "world": rec.get("world"),
+        }
+    return out
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Group task events by name — reference api.py summarize_tasks :1382."""
     groups: Dict[str, Dict[str, Any]] = defaultdict(
@@ -156,14 +190,16 @@ def _render_prometheus(per_worker: Dict[str, Any]) -> str:
                     acc = 0
                     for bound, n in zip(m["boundaries"], buckets):
                         acc += n
+                        le = f'le="{bound}"'
                         lines.append(
                             f"{name}_bucket"
-                            f"{labels(keys, tag_json, worker_id, f'le=\"{bound}\"')}"
+                            f"{labels(keys, tag_json, worker_id, le)}"
                             f" {acc}")
                     acc += buckets[-1]
+                    inf = 'le="+Inf"'
                     lines.append(
                         f"{name}_bucket"
-                        f"{labels(keys, tag_json, worker_id, 'le=\"+Inf\"')}"
+                        f"{labels(keys, tag_json, worker_id, inf)}"
                         f" {acc}")
                     lines.append(f"{name}_sum"
                                  f"{labels(keys, tag_json, worker_id)} "

@@ -24,6 +24,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy tests excluded from the tier-1 "
+        "`-m 'not slow'` smoke run")
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

@@ -74,13 +74,24 @@ def test_cluster_uses_fork_server_and_workers_die_fast(ray_start_regular):
         pytest.fail(f"worker {pid} still visible 5s after SIGTERM ({state})")
 
 
-def test_cached_lease_survives_worker_crash(ray_start_regular):
+def test_cached_lease_survives_worker_crash():
     """A worker can die while its lease sits in the driver's reuse cache
     (worker.py _lease_recache); the next task must transparently fall
-    back to a fresh lease via the crash-retry path instead of failing."""
+    back to a fresh lease via the crash-retry path instead of failing.
+
+    The lease idle TTL is pinned up (default 0.1s) so the reaper cannot
+    win the race against the cached-lease assertion on a loaded host."""
     import ray_tpu
     from ray_tpu._private import worker as wmod
 
+    ray_tpu.init(num_cpus=4, _system_config={"lease_idle_ttl": 5.0})
+    try:
+        _assert_cached_lease_crash_retry(ray_tpu, wmod)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _assert_cached_lease_crash_retry(ray_tpu, wmod):
     @ray_tpu.remote
     def whoami():
         return os.getpid()

@@ -83,6 +83,25 @@ def test_pod_manifest_shape(pod_provider):
         "cloud.google.com/gke-tpu-topology"] == "2x4"
 
 
+def test_pod_millicpu_quantity_parsed(pod_provider):
+    """'500m' is 0.5 cores, not 500 (regression: rstrip('m') inflated
+    millicpu quantities 1000x)."""
+    api = pod_provider._api
+    p = KubernetesPodProvider(
+        namespace="ray", cluster_name="c1",
+        head_address="10.0.0.1:6379",
+        node_configs={
+            "cpu-milli": {"image": "img", "resources": {"cpu": "500m"}},
+            "cpu-cores": {"image": "img", "resources": {"cpu": "8"}},
+        },
+        http=api)
+    n1 = p.create_node("cpu-milli", {"CPU": 0.5})
+    n2 = p.create_node("cpu-cores", {"CPU": 8})
+    nodes = {n["node_id"]: n for n in p.non_terminated_nodes()}
+    assert nodes[n1]["resources"] == {"CPU": 0.5}
+    assert nodes[n2]["resources"] == {"CPU": 8.0}
+
+
 def test_pod_completed_phases_filtered(pod_provider):
     nid = pod_provider.create_node("tpu-host", {"TPU": 8})
     pod_provider._api.pods[nid]["status"]["phase"] = "Succeeded"

@@ -11,11 +11,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.parallel import (MeshConfig, make_mesh, make_pipeline_fn,
-                              infer_fsdp_specs, stack_stage_params)
+                              infer_fsdp_specs, shard_map,
+                              stack_stage_params)
 from ray_tpu.ops import moe_ffn, mha_reference, ulysses_attention
 from ray_tpu.ops.ring_attention import ring_attention
 

@@ -28,15 +28,17 @@ def test_queue_nowait_and_batch(ray_start_regular):
     q.put_nowait(1)
     q.put_nowait_batch([2, 3])
     assert q.full()
-    with pytest.raises(Exception):  # Full via RemoteError or direct
+    # actor-side asyncio.QueueFull/QueueEmpty and remote queue.Full/Empty
+    # all come back as the stdlib queue exceptions (reference parity)
+    with pytest.raises(Full):
         q.put_nowait(4)
-    with pytest.raises(Exception):
+    with pytest.raises(Full):
         q.put_nowait_batch([4, 5])
     assert q.get_nowait_batch(2) == [1, 2]
-    with pytest.raises(Exception):
+    with pytest.raises(Empty):
         q.get_nowait_batch(5)
     assert q.get_nowait() == 3
-    with pytest.raises(Exception):
+    with pytest.raises(Empty):
         q.get_nowait()
     q.shutdown()
 
@@ -45,11 +47,11 @@ def test_queue_blocking_timeouts(ray_start_regular):
     q = Queue(maxsize=1)
     q.put("x")
     t0 = time.monotonic()
-    with pytest.raises(Exception):  # Full after the timeout
+    with pytest.raises(Full):  # Full after the timeout
         q.put("y", timeout=0.3)
     assert time.monotonic() - t0 >= 0.25
     assert q.get() == "x"
-    with pytest.raises(Exception):  # Empty after the timeout
+    with pytest.raises(Empty):  # Empty after the timeout
         q.get(timeout=0.3)
     q.shutdown()
 
