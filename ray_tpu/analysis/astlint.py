@@ -1,0 +1,300 @@
+"""AST lint — the "actor code" half of shardlint.
+
+Two rule families, both pure `ast` walks (no imports of the linted code,
+so broken or dependency-heavy modules still lint):
+
+- blocking-in-async (error): a blocking call — `time.sleep`,
+  sync `ray_tpu.get` / `ray.get`, or `.get()` on a `queue.Queue` bound in
+  the same scope — lexically inside an `async def`. One blocking call
+  freezes the actor's entire event loop: every other coroutine on that
+  replica stalls ("Scaling Deep Learning Training with MPMD Pipeline
+  Parallelism" shows exactly this class of stall deadlocking stage
+  handoffs). Nested sync `def`s are their own execution context and are
+  not flagged.
+- host-sync-in-jit (error for `.item()` / `jax.device_get`, warning for
+  `print`): host synchronization inside a function that is jitted —
+  decorated with `@jax.jit` / `@functools.partial(jax.jit, ...)` or
+  passed to a `jax.jit(...)` call in the same file. `.item()` on a tracer
+  aborts tracing; `print` runs at trace time and shows a tracer, not the
+  value (fix: `jax.debug.print`).
+
+Suppression: append `# shardlint: ok` to the flagged line, or
+`# shardlint: disable=<rule-id>` to suppress one rule on that line.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import ERROR, Finding, WARNING
+
+# Module-attribute calls that block the calling thread.
+_BLOCKING_ATTRS: Dict[Tuple[str, str], str] = {
+    ("time", "sleep"): "await asyncio.sleep(...) instead",
+    ("ray_tpu", "get"): "await on a thread: "
+                        "loop.run_in_executor(None, ray_tpu.get, ref)",
+    ("ray", "get"): "await on a thread: "
+                    "loop.run_in_executor(None, ray.get, ref)",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shardlint:\s*(ok|disable=([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*))")
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line number -> None (suppress all) or set of suppressed rule ids."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) == "ok":
+            out[i] = None
+        else:
+            out[i] = {r.strip() for r in m.group(2).split(",")}
+    return out
+
+
+class _Aliases:
+    """Import alias tracking: maps local names to canonical module names
+    and remembers `from time import sleep`-style direct imports."""
+
+    def __init__(self, tree: ast.AST):
+        self.module_alias: Dict[str, str] = {}
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.module_alias[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = \
+                        (node.module, a.name)
+
+    def resolve_call(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(module, attr) for `mod.attr(...)` and `from mod import attr`
+        call forms; None otherwise."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            mod = self.module_alias.get(f.value.id)
+            if mod:
+                return (mod, f.attr)
+        if isinstance(f, ast.Name) and f.id in self.from_imports:
+            return self.from_imports[f.id]
+        return None
+
+
+def _queue_names(fn: ast.AST, aliases: _Aliases) -> Set[str]:
+    """Names assigned a `queue.Queue(...)` (alias-aware) anywhere in the
+    function — their `.get()` blocks."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # q: queue.Queue = Queue()
+            targets = [node.target]
+        else:
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        resolved = aliases.resolve_call(node.value)
+        if resolved in {("queue", "Queue"), ("queue", "LifoQueue"),
+                        ("queue", "PriorityQueue"),
+                        ("multiprocessing", "Queue")}:
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _iter_scope_calls(fn: ast.AST):
+    """Call nodes lexically in `fn`'s own execution scope: descends
+    expressions and control flow but NOT nested def/async def/lambda
+    (they run in their own context, possibly off-loop)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------------ blocking-in-async
+
+
+def _lint_blocking_in_async(tree: ast.AST, aliases: _Aliases,
+                            path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        queues = _queue_names(fn, aliases)
+        for call in _iter_scope_calls(fn):
+            resolved = aliases.resolve_call(call)
+            if resolved in _BLOCKING_ATTRS:
+                mod, attr = resolved
+                findings.append(Finding(
+                    "blocking-in-async", ERROR,
+                    f"{path}:{call.lineno}",
+                    f"blocking {mod}.{attr}() inside "
+                    f"'async def {fn.name}' stalls the event loop",
+                    _BLOCKING_ATTRS[resolved]))
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                    isinstance(f.value, ast.Name) and f.value.id in queues:
+                findings.append(Finding(
+                    "blocking-in-async", ERROR,
+                    f"{path}:{call.lineno}",
+                    f"blocking {f.value.id}.get() (queue.Queue) inside "
+                    f"'async def {fn.name}' stalls the event loop",
+                    "use asyncio.Queue, or offload with "
+                    "loop.run_in_executor"))
+    return findings
+
+
+# -------------------------------------------------------- host-sync-in-jit
+
+
+def _is_jax_jit(node: ast.AST, aliases: _Aliases) -> bool:
+    """True for expressions denoting jax.jit: `jax.jit`, `jit` imported
+    from jax, or `functools.partial(jax.jit, ...)`."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            aliases.module_alias.get(node.value.id) == "jax" and \
+            node.attr == "jit":
+        return True
+    if isinstance(node, ast.Name) and \
+            aliases.from_imports.get(node.id) == ("jax", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        resolved = aliases.resolve_call(node)
+        if resolved and resolved[1] == "partial" and node.args:
+            return _is_jax_jit(node.args[0], aliases)
+        # jax.jit(...) used directly as a decorator factory
+        return _is_jax_jit(node.func, aliases)
+    return False
+
+
+def _jitted_functions(tree: ast.AST,
+                      aliases: _Aliases) -> List[ast.FunctionDef]:
+    """Defs that are jitted: decorated with jax.jit (possibly through
+    functools.partial) or referenced by name in a jax.jit(<name>, ...)
+    call anywhere in the file. Name matching excludes class-body methods
+    — `jax.jit(step)` refers to a plain function binding, and a
+    same-named method elsewhere in the file must not be falsely flagged
+    (decorated methods are still caught via their decorator)."""
+    jit_called: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func, aliases):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    jit_called.add(arg.id)
+    method_ids = {id(item) for node in ast.walk(tree)
+                  if isinstance(node, ast.ClassDef)
+                  for item in node.body
+                  if isinstance(item, ast.FunctionDef)}
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if (fn.name in jit_called and id(fn) not in method_ids) or \
+                any(_is_jax_jit(d, aliases) for d in fn.decorator_list):
+            out.append(fn)
+    return out
+
+
+def _lint_host_sync_in_jit(tree: ast.AST, aliases: _Aliases,
+                           path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _jitted_functions(tree, aliases):
+        for call in _iter_scope_calls(fn):
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "item" and \
+                    not call.args:
+                findings.append(Finding(
+                    "host-sync-in-jit", ERROR, f"{path}:{call.lineno}",
+                    f".item() inside jitted '{fn.name}' aborts tracing "
+                    "(host sync on a tracer)",
+                    "return the array and call .item() outside the jit"))
+            elif aliases.resolve_call(call) == ("jax", "device_get"):
+                findings.append(Finding(
+                    "host-sync-in-jit", ERROR, f"{path}:{call.lineno}",
+                    f"jax.device_get inside jitted '{fn.name}' forces a "
+                    "host round-trip on a tracer",
+                    "move the transfer outside the jitted function"))
+            elif isinstance(f, ast.Name) and f.id == "print":
+                findings.append(Finding(
+                    "host-sync-in-jit", WARNING, f"{path}:{call.lineno}",
+                    f"print() inside jitted '{fn.name}' runs at trace "
+                    "time and shows a tracer, not values",
+                    "use jax.debug.print(...) for runtime values"))
+    return findings
+
+
+# ---------------------------------------------------------------- drivers
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one Python source string. Returns [] for unparsable files —
+    syntax errors are a different tool's job."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    aliases = _Aliases(tree)
+    findings = _lint_blocking_in_async(tree, aliases, path)
+    findings += _lint_host_sync_in_jit(tree, aliases, path)
+    if not findings:
+        return findings
+    suppressed = _suppressions(source)
+    out = []
+    for f in findings:
+        try:
+            line = int(f.location.rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            line = -1
+        rules = suppressed.get(line, "absent")
+        if rules == "absent" or (rules is not None and
+                                 f.rule not in rules):
+            out.append(f)
+    return out
+
+
+def lint_file(path: str) -> List[Finding]:
+    # errors="replace": a stray non-UTF-8 byte must not abort the whole
+    # lint run (lint_source already treats unparsable sources as [])
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        return lint_source(fh.read(), path)
+
+
+# Directories no linter should crawl: caches, VCS internals, virtualenvs
+# and vendored trees (third-party async internals legitimately block and
+# would flip the exit code for code the user does not own).
+_SKIP_DIRS = frozenset({"__pycache__", "node_modules", "venv", "build",
+                        "dist", "site-packages", "egg-info"})
+
+
+def lint_path(path: str) -> List[Finding]:
+    """Lint a file or every .py file under a directory (skipping hidden
+    directories, virtualenvs, and vendored trees)."""
+    if os.path.isfile(path):
+        return lint_file(path)
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in _SKIP_DIRS and not d.startswith(".")
+                       and not d.endswith(".egg-info")]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, name)))
+    return findings
+
+
+__all__ = ["lint_source", "lint_file", "lint_path"]

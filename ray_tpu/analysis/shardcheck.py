@@ -1,0 +1,221 @@
+"""Static PartitionSpec / mesh validation — the "shard" half of shardlint.
+
+Everything here is deviceless: a `MeshLayout` is just named axis sizes
+plus per-axis DCN factors (from `multislice.dcn_axis_factors`), and the
+arrays are abstract (`jax.ShapeDtypeStruct` / anything with .shape and
+.dtype, e.g. the output of `jax.eval_shape`). That means a pod layout can
+be linted on a laptop before a single chip is reserved.
+
+Rules:
+- unknown-axis        spec names an axis the mesh does not have (error)
+- rank-exceeds-ndim   spec longer than the array's rank (error)
+- non-dividing-dim    axis size does not divide the sharded dim (error)
+- duplicate-axis      one mesh axis on two dims of the same spec (error)
+- replicated-large-param  param above the byte threshold with every
+                      sharding axis of size 1 — a full copy per device
+                      (warning)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import MESH_AXES, MeshConfig
+from ..parallel.multislice import (HybridMeshConfig, SliceTopology,
+                                   dcn_axis_factors)
+from .findings import ERROR, Finding, WARNING
+
+# Default HBM blow-up threshold: a fully-replicated param larger than this
+# many bytes is flagged. 64 MiB ≈ a GPT-2-small embedding in bf16.
+DEFAULT_REPLICATED_THRESHOLD = 64 * 2 ** 20
+
+
+@dataclass(frozen=True)
+class MeshLayout:
+    """Deviceless mesh description: axis name -> size, axis name -> DCN
+    span factor (1 = the axis lives entirely on ICI)."""
+
+    axis_sizes: Dict[str, int]
+    dcn_factors: Dict[str, int] = field(default_factory=dict)
+    name: str = "mesh"
+    # True when the DCN placement was DECLARED (HybridMeshConfig dcn_*)
+    # rather than discovered by stride analysis of a flat mesh — the
+    # collective findings word themselves accordingly.
+    declared_dcn: bool = False
+
+    @staticmethod
+    def from_config(config: MeshConfig, n_devices: int,
+                    num_slices: int = 1, name: str = "") -> "MeshLayout":
+        if isinstance(config, HybridMeshConfig) and num_slices > 1:
+            per_slice = n_devices // num_slices
+            ici = config.sizes(per_slice)
+            dcn = config.dcn_sizes(num_slices)
+            sizes = {a: ici[a] * dcn[a] for a in MESH_AXES}
+        else:
+            sizes = config.sizes(n_devices)
+        return MeshLayout(
+            axis_sizes=sizes,
+            dcn_factors=dcn_axis_factors(config, n_devices, num_slices),
+            name=name or type(config).__name__,
+            declared_dcn=isinstance(config, HybridMeshConfig))
+
+    @staticmethod
+    def from_mesh(mesh: Any,
+                  topology: Optional[SliceTopology] = None,
+                  name: str = "") -> "MeshLayout":
+        """Layout of a built `jax.sharding.Mesh`. With a SliceTopology the
+        DCN factors are EXACT: each device maps to its slice and the span
+        of every axis is counted on the actual device array (works for
+        hybrid block assembly and topology-optimized orders alike)."""
+        sizes = dict(mesh.shape)
+        factors = {a: 1 for a in sizes}
+        if topology is not None and topology.num_slices > 1:
+            slice_of = {d: i for i, s in enumerate(topology.slices)
+                        for d in s}
+            ids = np.vectorize(lambda d: slice_of[d])(
+                np.asarray(mesh.devices, dtype=object))
+            for i, a in enumerate(mesh.axis_names):
+                lines = np.moveaxis(ids, i, -1).reshape(-1, ids.shape[i])
+                factors[a] = max(len(set(line)) for line in lines)
+        return MeshLayout(axis_sizes=sizes, dcn_factors=factors,
+                          name=name or "mesh")
+
+    def axis_size(self, axis: str) -> int:
+        return self.axis_sizes.get(axis, 1)
+
+    def dcn_factor(self, axis: str) -> int:
+        return self.dcn_factors.get(axis, 1)
+
+    def dcn_axes(self) -> List[str]:
+        return [a for a in self.axis_sizes
+                if self.dcn_factors.get(a, 1) > 1]
+
+
+def spec_entries(spec: Any) -> List[Tuple[Any, ...]]:
+    """Normalize a PartitionSpec-like into per-dim tuples of axis names:
+    P('dp', ('fsdp','tp'), None) -> [('dp',), ('fsdp','tp'), ()]."""
+    out: List[Tuple[Any, ...]] = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(entry))
+        else:
+            out.append((entry,))
+    return out
+
+
+def _nbytes(aval: Any) -> int:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = np.dtype(getattr(aval, "dtype", np.float32))
+    return int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape \
+        else dtype.itemsize
+
+
+def check_spec(spec: Any, aval: Any, layout: MeshLayout,
+               where: str = "") -> List[Finding]:
+    """Validate one PartitionSpec against one abstract array."""
+    findings: List[Finding] = []
+    loc = where or "spec"
+    entries = spec_entries(spec)
+    shape = tuple(getattr(aval, "shape", ()) or ())
+
+    if len(entries) > len(shape):
+        findings.append(Finding(
+            "rank-exceeds-ndim", ERROR, loc,
+            f"spec {spec} has {len(entries)} entries for a rank-"
+            f"{len(shape)} array of shape {shape}",
+            "drop the extra entries (trailing dims default to "
+            "replicated)"))
+        entries = entries[:len(shape)]
+
+    seen: Dict[str, int] = {}
+    for dim, axes in enumerate(entries):
+        for ax in axes:
+            if ax not in layout.axis_sizes:
+                findings.append(Finding(
+                    "unknown-axis", ERROR, loc,
+                    f"spec {spec} names axis {ax!r} which is not in the "
+                    f"mesh (axes: {tuple(layout.axis_sizes)})",
+                    f"use one of the canonical MESH_AXES {MESH_AXES}"))
+                continue
+            if ax in seen:
+                findings.append(Finding(
+                    "duplicate-axis", ERROR, loc,
+                    f"spec {spec} uses mesh axis {ax!r} on both dim "
+                    f"{seen[ax]} and dim {dim}",
+                    "an axis may shard at most one dim; compose with a "
+                    "second axis instead"))
+                continue
+            seen[ax] = dim
+        group = int(np.prod([layout.axis_size(a) for a in axes
+                             if a in layout.axis_sizes], dtype=np.int64)) \
+            if axes else 1
+        if group > 1 and shape[dim] % group != 0:
+            findings.append(Finding(
+                "non-dividing-dim", ERROR, loc,
+                f"dim {dim} of shape {shape} is {shape[dim]}, not "
+                f"divisible by the sharding group {axes} of size {group}",
+                "pad the dim to a multiple (cf. GPT2Config."
+                "vocab_pad_multiple) or reshard on a smaller axis"))
+    return findings
+
+
+def _is_replicated(spec: Any, layout: MeshLayout) -> bool:
+    """True when every device holds the full array: all named axes (after
+    dropping unknown ones) have size 1."""
+    for axes in spec_entries(spec):
+        for ax in axes:
+            if layout.axis_size(ax) > 1:
+                return False
+    return True
+
+
+def check_specs(spec_tree: Any, abstract_tree: Any, layout: MeshLayout,
+                replicated_threshold: int = DEFAULT_REPLICATED_THRESHOLD,
+                where: str = "params") -> List[Finding]:
+    """Validate a PartitionSpec pytree against a matching abstract-array
+    pytree (e.g. `gpt2_partition_specs(cfg)` vs `jax.eval_shape` of the
+    init). Adds the replicated-large-param HBM check on top of the
+    per-leaf spec checks."""
+    import jax
+
+    is_spec = _spec_leaf_predicate()
+    spec_leaves = jax.tree_util.tree_flatten_with_path(
+        spec_tree, is_leaf=is_spec)[0]
+    aval_leaves = jax.tree_util.tree_flatten(abstract_tree)[0]
+    if len(spec_leaves) != len(aval_leaves):
+        raise ValueError(
+            f"spec tree has {len(spec_leaves)} leaves but abstract tree "
+            f"has {len(aval_leaves)} — the trees must match")
+
+    findings: List[Finding] = []
+    for (path, spec), aval in zip(spec_leaves, aval_leaves):
+        loc = where + jax.tree_util.keystr(path)
+        leaf_findings = check_spec(spec, aval, layout, where=loc)
+        findings.extend(leaf_findings)
+        if any(f.rule == "unknown-axis" for f in leaf_findings):
+            # the user DID try to shard this leaf — a replication
+            # warning on top of the typo'd-axis error would misdirect
+            continue
+        nbytes = _nbytes(aval)
+        if nbytes >= replicated_threshold and _is_replicated(spec, layout):
+            mib = nbytes / 2 ** 20
+            findings.append(Finding(
+                "replicated-large-param", WARNING, loc,
+                f"{mib:.1f} MiB param is fully replicated — every device "
+                f"holds a complete copy (threshold "
+                f"{replicated_threshold / 2 ** 20:.0f} MiB)",
+                "shard it: infer_fsdp_specs() or a 'tp' dim spec"))
+    return findings
+
+
+def _spec_leaf_predicate():
+    from jax.sharding import PartitionSpec
+    return lambda x: isinstance(x, PartitionSpec)
+
+
+__all__ = ["MeshLayout", "DEFAULT_REPLICATED_THRESHOLD", "check_spec",
+           "check_specs", "spec_entries"]

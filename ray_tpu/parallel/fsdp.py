@@ -39,6 +39,13 @@ def infer_fsdp_specs(params: Any, fsdp_size: int, *,
         spec = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
         if fsdp_size <= 1 or np.size(x) < min_size_to_shard:
             return P(*spec)
+        # a spec may use each mesh axis at most once: if the base spec
+        # already shards some dim on `axis_name` (alone or inside a
+        # tuple), adding it again would be a duplicate-axis error
+        used = {a for s in spec if s is not None
+                for a in (s if isinstance(s, tuple) else (s,))}
+        if axis_name in used:
+            return P(*spec)
         cand = [i for i, (dim, s) in enumerate(zip(shape, spec))
                 if s is None and dim % fsdp_size == 0]
         if not cand:
@@ -55,6 +62,9 @@ def infer_fsdp_specs(params: Any, fsdp_size: int, *,
 def fsdp_shardings(mesh: Mesh, params: Any, **kw) -> Any:
     """NamedSharding tree for `params` on `mesh` (see infer_fsdp_specs)."""
     axis = kw.get("axis_name", "fsdp")
+    from .mesh import validate_axis_names
+
+    validate_axis_names(mesh, P(axis), "fsdp_shardings axis_name")
     specs = infer_fsdp_specs(params, mesh.shape.get(axis, 1), **kw)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda s: isinstance(s, P))

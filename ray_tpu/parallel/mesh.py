@@ -121,11 +121,37 @@ _REPLICATION_CHECK_KW = next(
     None)
 
 
+def validate_axis_names(mesh: Any, specs: Any, what: str = "spec") -> None:
+    """Raise a clear ValueError when a PartitionSpec (or pytree of specs)
+    names an axis the mesh does not have — instead of the opaque deep-XLA
+    failure a bad name produces otherwise. Works for Mesh and
+    AbstractMesh alike (anything with .axis_names)."""
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    if not names:
+        return
+    known = set(names)
+    for spec in jax.tree.leaves(specs,
+                                is_leaf=lambda s: isinstance(s, P)):
+        if not isinstance(spec, P):
+            continue
+        for entry in tuple(spec):
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            for ax in axes:
+                if ax is not None and ax not in known:
+                    raise ValueError(
+                        f"unknown mesh axis {ax!r} in {what} {spec}: "
+                        f"this mesh has axes {names} (canonical "
+                        f"MESH_AXES = {MESH_AXES})")
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
     """Version-portable `shard_map`: jax renamed the replication-check
     kwarg (check_rep -> check_vma) and moved the function out of
     experimental; this front door accepts `check_vma` and forwards to
-    whatever the installed jax calls it."""
+    whatever the installed jax calls it. Spec axis names are validated
+    against the mesh up front (clear ValueError, not a deep-XLA error)."""
+    validate_axis_names(mesh, in_specs, "shard_map in_specs")
+    validate_axis_names(mesh, out_specs, "shard_map out_specs")
     if check_vma is not None and _REPLICATION_CHECK_KW:
         kw[_REPLICATION_CHECK_KW] = check_vma
     return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
@@ -134,8 +160,11 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     """Shorthand: named_sharding(mesh, 'dp', None) ==
-    NamedSharding(mesh, PartitionSpec('dp', None))."""
-    return NamedSharding(mesh, P(*spec))
+    NamedSharding(mesh, PartitionSpec('dp', None)). Axis names are
+    validated against the mesh up front."""
+    pspec = P(*spec)
+    validate_axis_names(mesh, pspec, "named_sharding spec")
+    return NamedSharding(mesh, pspec)
 
 
 def host_local_array_to_global(mesh: Mesh, spec: P, host_arrays):

@@ -211,6 +211,37 @@ def make_hybrid_mesh(config: HybridMeshConfig,
                 MESH_AXES)
 
 
+def dcn_axis_factors(config: MeshConfig, n_devices: int,
+                     num_slices: int) -> Dict[str, int]:
+    """Per-axis DCN span of `config` laid out over `num_slices` equal
+    slices: factor d means a line along that mesh axis touches d distinct
+    slices (d-1 of every d hops ride DCN, not ICI). Hybrid configs get
+    their declared dcn_* sizes; a FLAT MeshConfig stretched across a
+    multi-slice device set gets a stride analysis of the row-major layout
+    — this is how the analyzer catches tp/sp/ep silently spanning DCN.
+    """
+    if num_slices <= 1:
+        return {a: 1 for a in MESH_AXES}
+    if isinstance(config, HybridMeshConfig):
+        return config.dcn_sizes(num_slices)
+    # Exact count on the row-major layout: map every device position to
+    # its (contiguous) slice and count distinct slices along each axis's
+    # lines — no alignment assumptions, so layouts whose lines straddle
+    # a slice boundary (e.g. dp=3 x tp=2 over 2 slices) are caught too.
+    sizes = config.sizes(n_devices)
+    per_slice = n_devices // num_slices
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    slice_ids = (np.arange(n_devices) // per_slice).reshape(shape)
+    factors: Dict[str, int] = {}
+    for i, a in enumerate(MESH_AXES):
+        if shape[i] <= 1:
+            factors[a] = 1
+            continue
+        lines = np.moveaxis(slice_ids, i, -1).reshape(-1, shape[i])
+        factors[a] = int(max(len(set(line)) for line in lines))
+    return factors
+
+
 def _dcn_product(config: HybridMeshConfig) -> int:
     p = 1
     for f in DCN_AXES.values():
@@ -240,6 +271,7 @@ def _assemble_hybrid(topology: SliceTopology,
 __all__ = [
     "DCN_AXES",
     "HybridMeshConfig",
+    "dcn_axis_factors",
     "SliceTopology",
     "VIRTUAL_SLICES_ENV",
     "discover_slice_topology",

@@ -113,6 +113,12 @@ def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
     param_spec: optional PartitionSpec pytree for the NON-stage dims of the
     stacked params (e.g. tp shardings); the leading 'pp' axis is prepended.
     """
+    from .mesh import validate_axis_names
+
+    validate_axis_names(mesh, P(axis_name, tuple(data_axes)),
+                        "pipeline axes")
+    if param_spec is not None:
+        validate_axis_names(mesh, param_spec, "pipeline param_spec")
     pp = mesh.shape[axis_name]
 
     def full_param_spec(stacked_params):

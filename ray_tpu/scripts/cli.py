@@ -235,6 +235,72 @@ def cmd_job(args) -> None:
         print(json.dumps(client.list_jobs(), indent=2, default=str))
 
 
+def cmd_analyze(args) -> None:
+    """`ray_tpu analyze` — shardlint static analysis: AST lint over
+    Python sources (blocking-in-async, host-sync-in-jit) plus, with
+    --layouts, the shard/collective/DCN-cost checks over the built-in
+    dryrun mesh layouts. Fully deviceless: jax is pinned to cpu and no
+    backend device is ever enumerated, so a wedged TPU relay cannot hang
+    the lint."""
+    # Force the cpu platform BEFORE anything imports jax: the layout
+    # checks trace against AbstractMesh and never need silicon. Restored
+    # on exit so programmatic main([...]) callers (and their subprocess
+    # children) are not pinned to cpu afterwards.
+    prev_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        _run_analyze(args)
+    finally:
+        if prev_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_platform
+
+
+def _run_analyze(args) -> None:
+    from ray_tpu import analysis
+
+    findings = []
+    paths = args.paths
+    if not paths:
+        # --layouts is additive ("also analyze ..."): the source lint of
+        # the installed package always runs unless explicit paths narrow
+        # it.
+        import ray_tpu
+
+        paths = [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
+    for p in paths:
+        if not os.path.exists(p):
+            raise SystemExit(f"no such file or directory: {p}")
+        findings.extend(analysis.lint_path(p))
+    if args.layouts:
+        # If jax first loads HERE, it initializes under our forced
+        # JAX_PLATFORMS=cpu — its config value is our pin, not the
+        # caller's, so restore to None (auto-detect), not to `prev`.
+        jax_preloaded = "jax" in sys.modules
+        import jax
+
+        # config (not just env) pin: the axon sitecustomize force-sets
+        # JAX_PLATFORMS, and config wins regardless. Restored so a
+        # programmatic main([...]) caller is not left cpu-pinned.
+        prev = jax.config.jax_platforms if jax_preloaded else None
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            for name, fs in analysis.analyze_builtin_layouts().items():
+                findings.extend(fs)
+        finally:
+            jax.config.update("jax_platforms", prev)
+    if args.json:
+        print(json.dumps([f.to_dict() for f in
+                          analysis.sort_findings(findings)], indent=2))
+    else:
+        print(analysis.format_report(findings))
+    worst = analysis.max_severity(findings)
+    order = list(analysis.SEVERITIES)
+    if findings and order.index(worst) <= order.index(args.fail_on):
+        raise SystemExit(1)
+
+
 def cmd_serve(args) -> None:
     """`serve run|deploy|status|config|shutdown|delete` — reference
     python/ray/serve/scripts.py:147-746 (run/deploy/config/status) over
@@ -345,6 +411,23 @@ def main(argv=None) -> None:
     sp.add_argument("--scale", type=float, default=1.0)
     sp.add_argument("--out", default="")
     sp.set_defaults(fn=cmd_microbench)
+
+    sp = sub.add_parser("analyze",
+                        help="shardlint static analysis: AST lint over "
+                             "sources, --layouts for mesh/DCN checks")
+    sp.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "installed ray_tpu package)")
+    sp.add_argument("--layouts", action="store_true",
+                    help="also analyze the built-in dryrun mesh layouts "
+                         "(sharding specs, collectives over DCN)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    sp.add_argument("--fail-on", choices=["error", "warning", "info"],
+                    default="error",
+                    help="exit 1 when a finding at this severity or "
+                         "worse exists (default: error)")
+    sp.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser("serve", help="Serve applications: run/deploy from "
                                       "YAML config, status, shutdown")

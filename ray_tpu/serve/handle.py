@@ -70,6 +70,22 @@ class DeploymentResponse:
         finally:
             self._mark_done()
 
+    async def result_async(self, timeout_s: Optional[float] = None) -> Any:
+        """Loop-safe result(): the blocking get — and the dead-replica
+        retry inside it, whose re-pick may wait for a replacement
+        replica — runs on the default executor, so an async deployment
+        method can `await resp.result_async()` (or just `await resp`)
+        without stalling its event loop."""
+        import asyncio
+        import functools
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self.result, timeout_s))
+
+    def __await__(self):
+        # `resp = await handle.remote_async(x); y = await resp`
+        return self.result_async().__await__()
+
     def _to_object_ref(self):
         self._mark_done()
         return self._object_ref
@@ -239,26 +255,76 @@ class Router:
                 self._inflight = {tag: self._inflight.get(tag, 0)
                                   for tag, _ in self._replicas}
 
+    _PICK_TIMEOUT_S = 30.0
+
+    def _try_pick(self) -> Optional[Tuple[str, Any]]:
+        """One non-blocking pow-2 choice; None when no replicas are
+        known. On success the replica's in-flight count is already
+        incremented."""
+        with self._lock:
+            if not self._replicas:
+                return None
+            if len(self._replicas) == 1:
+                chosen = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                chosen = a if self._inflight.get(a[0], 0) <= \
+                    self._inflight.get(b[0], 0) else b
+            self._inflight[chosen[0]] = \
+                self._inflight.get(chosen[0], 0) + 1
+            return chosen
+
+    def _no_replica_error(self) -> TimeoutError:
+        return TimeoutError(
+            f"no running replicas for deployment "
+            f"{self._app}#{self._deployment} after "
+            f"{self._PICK_TIMEOUT_S:.0f}s")
+
     def _pick(self) -> Tuple[str, Any]:
-        deadline = time.monotonic() + 30.0
+        deadline = time.monotonic() + self._PICK_TIMEOUT_S
         while True:
             self._refresh()
-            with self._lock:
-                if self._replicas:
-                    if len(self._replicas) == 1:
-                        chosen = self._replicas[0]
-                    else:
-                        a, b = random.sample(self._replicas, 2)
-                        chosen = a if self._inflight.get(a[0], 0) <= \
-                            self._inflight.get(b[0], 0) else b
-                    self._inflight[chosen[0]] = \
-                        self._inflight.get(chosen[0], 0) + 1
-                    return chosen
+            chosen = self._try_pick()
+            if chosen is not None:
+                return chosen
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no running replicas for deployment "
-                    f"{self._app}#{self._deployment} after 30s")
+                raise self._no_replica_error()
+            # The wait below blocks this thread. On an event-loop thread
+            # that would freeze EVERY coroutine on the loop for up to 30s
+            # (shardlint blocking-in-async) — fail fast with the async
+            # alternative instead of silently wedging the replica.
+            try:
+                import asyncio
+
+                asyncio.get_running_loop()
+            except RuntimeError:
+                pass
+            else:
+                raise RuntimeError(
+                    f"no replica of {self._app}#{self._deployment} is "
+                    "available and the blocking wait would stall this "
+                    "thread's running event loop; use `await "
+                    "handle.remote_async(...)` from async code, or "
+                    "offload the call with loop.run_in_executor")
             time.sleep(0.1)
+
+    async def _pick_async(self) -> Tuple[str, Any]:
+        """Async pick: the controller refresh (a blocking RPC) runs on
+        the default executor and the no-replica wait is an
+        `await asyncio.sleep`, so the caller's event loop keeps serving
+        other requests while this one waits for a replica."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + self._PICK_TIMEOUT_S
+        while True:
+            await loop.run_in_executor(None, self._refresh)
+            chosen = self._try_pick()
+            if chosen is not None:
+                return chosen
+            if time.monotonic() > deadline:
+                raise self._no_replica_error()
+            await asyncio.sleep(0.1)
 
     def _complete(self, tag: str):
         with self._lock:
@@ -304,6 +370,32 @@ class Router:
                 last_err = e
                 self._complete(tag)
                 self._refresh(force=True)
+        raise last_err  # type: ignore[misc]
+
+    async def assign_async(self, meta: RequestMetadata, args, kwargs,
+                           retries: int = 2) -> DeploymentResponse:
+        """Async twin of assign() for callers already on an event loop
+        (async deployment methods composing other deployments): picking
+        waits with `await asyncio.sleep` and the submit RPC runs on the
+        default executor, so the loop never blocks."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        self._start_metrics_push()
+        last_err: Optional[Exception] = None
+        for _ in range(retries + 1):
+            tag, handle = await self._pick_async()
+            try:
+                ref = await loop.run_in_executor(
+                    None, lambda: handle.handle_request.remote(
+                        meta.to_dict(), list(args), dict(kwargs)))
+                return DeploymentResponse(ref, self, tag,
+                                          request=(meta, args, kwargs))
+            except Exception as e:  # noqa: BLE001 — dead replica: retry
+                last_err = e
+                self._complete(tag)
+                await loop.run_in_executor(
+                    None, lambda: self._refresh(force=True))
         raise last_err  # type: ignore[misc]
 
     def assign_stream(self, meta: RequestMetadata, args, kwargs,
@@ -386,7 +478,7 @@ class DeploymentHandle:
                                    else self._multiplexed_model_id),
             _stream=self._stream if stream is None else stream)
 
-    def remote(self, *args, **kwargs):
+    def _request(self, args, kwargs):
         meta = RequestMetadata(
             call_method=self._call_method,
             multiplexed_model_id=self._multiplexed_model_id,
@@ -395,9 +487,24 @@ class DeploymentHandle:
                      else a for a in args)
         kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
                       else v) for k, v in kwargs.items()}
+        return meta, args, kwargs
+
+    def remote(self, *args, **kwargs):
+        meta, args, kwargs = self._request(args, kwargs)
         if self._stream:
             return self._router.assign_stream(meta, args, kwargs)
         return self._router.assign(meta, args, kwargs)
+
+    async def remote_async(self, *args, **kwargs) -> DeploymentResponse:
+        """Loop-safe `remote()` for async deployment methods: awaiting it
+        never blocks the event loop, even while waiting for a replica to
+        come up (the sync path refuses to poll-wait on a loop thread)."""
+        meta, args, kwargs = self._request(args, kwargs)
+        if self._stream:
+            raise NotImplementedError(
+                "remote_async does not support stream=True handles yet; "
+                "use options(stream=True).remote() from a worker thread")
+        return await self._router.assign_async(meta, args, kwargs)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

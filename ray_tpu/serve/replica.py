@@ -268,15 +268,20 @@ class ReplicaActor:
         if callable(fn):
             fn(user_config)
 
-    def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
+    async def prepare_for_shutdown(self, timeout_s: float = 5.0) -> bool:
         """Drain in-flight requests — reference replica.py
-        perform_graceful_shutdown."""
+        perform_graceful_shutdown. Async so the drain wait runs on the
+        actor's event loop via `await asyncio.sleep` (shardlint
+        blocking-in-async: a time.sleep poll here pinned one of the
+        replica's request threads for the whole drain window)."""
+        import asyncio
+
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._lock:
                 if self._inflight == 0:
                     break
-            time.sleep(0.05)
+            await asyncio.sleep(0.05)
         # Optional user shutdown hook; __del__ is left to GC so
         # non-idempotent destructors don't run twice.
         fn = getattr(self._callable, "shutdown", None)

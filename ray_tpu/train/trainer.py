@@ -92,7 +92,27 @@ class TrainStep:
 
     def init_state(self, params: Any) -> Dict[str, Any]:
         """Shard params onto the mesh and build optimizer state with
-        matching sharding (optimizer moments inherit the param layout)."""
+        matching sharding (optimizer moments inherit the param layout).
+
+        The param specs are shardlint-validated against the mesh first:
+        spec errors (unknown axis, non-dividing dim, duplicate axis)
+        raise HERE with the offending param named, instead of surfacing
+        as an opaque XLA error minutes into compilation; HBM warnings
+        (large replicated params) go through `warnings.warn`."""
+        from ray_tpu.analysis import (MeshLayout, check_specs, errors,
+                                      format_report)
+
+        findings = check_specs(self.param_specs, params,
+                               MeshLayout.from_mesh(self.mesh))
+        if errors(findings):
+            raise ValueError(
+                "invalid param sharding for this mesh:\n"
+                + format_report(errors(findings)))
+        if findings:
+            import warnings
+
+            warnings.warn("shardlint: " + format_report(findings),
+                          stacklevel=2)
         params = jax.device_put(params, self._shardings(self.param_specs))
         with self.mesh:
             opt_state = jax.jit(
