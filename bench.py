@@ -56,25 +56,13 @@ REF_TOKENS_PER_SEC_PER_CHIP = 140_000.0
 # validity guard.
 INVALID_MEASUREMENT_RC = 3
 
-# bf16 peak FLOP/s per chip by device kind (public spec sheets).
-_CHIP_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-    "TPU v6e": 918e12,
-}
-
-
 def _chip_peak(device) -> float:
-    kind = getattr(device, "device_kind", "") or ""
-    for name, peak in sorted(_CHIP_PEAK_FLOPS.items(),
-                             key=lambda kv: -len(kv[0])):
-        if kind.startswith(name):
-            return peak
-    return 275e12  # unknown TPU: assume v4-class so the guard stays active
+    """bf16 peak FLOP/s per chip — the per-generation table lives in
+    ray_tpu.observability.flops (the flight recorder's MFU denominator);
+    unknown TPUs map to v4-class so the validity guard stays active."""
+    from ray_tpu.observability.flops import device_peak_flops
+
+    return device_peak_flops(device)
 
 
 def _model_flops_per_token(cfg) -> float:
@@ -325,7 +313,11 @@ def main() -> None:
     step = make_step()
     state = step.init_state(jax.tree.map(jnp.copy, params0))
 
-    _, state, metrics = _time_loop(step, state, batch, warmup)
+    # first call timed apart: it is compile + one step, and the compile
+    # share belongs in the record's step_breakdown, not in the average
+    compile_dt, state, metrics = _time_loop(step, state, batch, 1)
+    if warmup > 1:
+        _, state, metrics = _time_loop(step, state, batch, warmup - 1)
 
     dt1, state, _ = _time_loop(step, state, batch, iters)
     dt2, state, _ = _time_loop(step, state, batch, iters)
@@ -367,6 +359,16 @@ def main() -> None:
         "n_chips": n_chips,
         "fused_flash_bwd": fused_bwd,
         "flash_blocks": list(flash_blocks) if flash_blocks else None,
+        # flight-recorder step breakdown (observability.StepTimer
+        # schema) so the BENCH_*.json perf trajectory is self-describing;
+        # data_wait is 0 by construction (the synthetic batch is
+        # device-resident before the loop).
+        "step_breakdown": {
+            "data_wait_ms": 0.0,
+            "compile_ms": round(compile_dt * 1e3, 1),
+            "device_step_ms": round(dt / iters * 1e3, 3),
+            "mfu": round(implied_flops / _chip_peak(devices[0]), 6),
+        },
     }))
 
 

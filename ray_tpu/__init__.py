@@ -120,6 +120,15 @@ def init(address: Optional[str] = None, *,
     w = Worker(mode="driver", conductor_address=conductor_address,
                session_dir=session_dir)
     _worker_mod.global_worker = w
+    # metrics registered before a prior shutdown() stopped the push loop
+    # must resume flowing to THIS cluster's conductor
+    try:
+        from .util.metrics import _registry as _metrics_registry
+
+        if _metrics_registry._metrics:
+            _metrics_registry._ensure_pusher()
+    except Exception:  # noqa: BLE001 — metrics are never init-fatal
+        pass
     atexit.register(shutdown)
     return {"address": conductor_address, "session_dir": session_dir}
 
@@ -145,6 +154,14 @@ def shutdown() -> None:
     global _conductor, _system_config_prior
     w = _worker_mod.global_worker
     if w is not None:
+        # metrics first: the final registry flush needs the conductor
+        # connection the worker shutdown is about to close
+        try:
+            from .util import metrics as _metrics
+
+            _metrics.shutdown()
+        except Exception:  # noqa: BLE001 — never block shutdown
+            pass
         w.shutdown()
         _worker_mod.global_worker = None
     if _conductor is not None:

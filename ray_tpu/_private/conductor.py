@@ -152,6 +152,9 @@ class ConductorHandler:
         self._subs: Dict[str, List[Tuple[str, int]]] = {}  # channel -> addrs
         self._task_events: List[Dict[str, Any]] = []
         self._spans: List[Dict[str, Any]] = []  # tracing span table
+        # flight recorder: run_id -> {"steps": {step -> {rank -> record}},
+        # "updated": ts} ring buffers fed by StepTimer.flush batches
+        self._train_runs: Dict[str, Dict[str, Any]] = {}
         self._session_dir = session_dir
         self._worker_env = dict(worker_env or {})
         self._clients = ClientPool()
@@ -1214,6 +1217,72 @@ class ConductorHandler:
     def get_task_events(self, limit: int = 10_000) -> List[Dict[str, Any]]:
         with self._lock:
             return self._task_events[-limit:]
+
+    # ------------------------------------------------------ flight recorder
+    # Gang-wide step telemetry (ray_tpu.observability): every rank's
+    # StepTimer ships per-step records here; the per-run ring buffer is
+    # the source for straggler detection (util.state.train_progress),
+    # the dashboard /api/train route, and `ray_tpu train-status`.
+
+    _TRAIN_STEPS_KEPT = 1024   # per-run step window
+    _TRAIN_RUNS_KEPT = 16      # oldest runs evicted past this
+
+    def report_train_steps(self, run_id: str, rank: int,
+                           records: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            run = self._train_runs.setdefault(
+                run_id, {"steps": {}, "updated": 0.0})
+            steps = run["steps"]
+            for rec in records:
+                step = int(rec.get("step", 0))
+                steps.setdefault(step, {})[int(rank)] = rec
+            if len(steps) > self._TRAIN_STEPS_KEPT:
+                for s in sorted(steps)[:len(steps)
+                                       - self._TRAIN_STEPS_KEPT]:
+                    del steps[s]
+            run["updated"] = time.time()
+            if len(self._train_runs) > self._TRAIN_RUNS_KEPT:
+                oldest = sorted(self._train_runs,
+                                key=lambda r:
+                                self._train_runs[r]["updated"])
+                for r in oldest[:len(self._train_runs)
+                                - self._TRAIN_RUNS_KEPT]:
+                    del self._train_runs[r]
+
+    def get_train_progress(self) -> Dict[str, Any]:
+        """Per-run gang summaries (per-rank stats, skew, stragglers) —
+        aggregation math lives in ray_tpu.observability.gang. Step
+        records are write-once (inserted/replaced, never mutated), so a
+        two-level shallow copy isolates the summarizer without paying a
+        deep copy of up to 16k records inside the conductor lock."""
+        from ray_tpu.observability import gang
+
+        with self._lock:
+            snapshot = {
+                run_id: {s: dict(by_rank)
+                         for s, by_rank in run["steps"].items()}
+                for run_id, run in self._train_runs.items()}
+        return {run_id: gang.summarize_run(steps)
+                for run_id, steps in snapshot.items()}
+
+    def get_train_steps(self, limit: int = 10_000) -> List[Dict[str, Any]]:
+        """Raw step records, flattened newest-last with run_id attached —
+        the merged-timeline source (observability.timeline). Only a
+        two-level shallow snapshot happens under the lock (records are
+        write-once, see get_train_progress); the flatten/sort over
+        potentially ~1M records runs outside it."""
+        with self._lock:
+            snapshot = {
+                run_id: {s: dict(by_rank)
+                         for s, by_rank in run["steps"].items()}
+                for run_id, run in self._train_runs.items()}
+        out: List[Dict[str, Any]] = []
+        for run_id, steps in snapshot.items():
+            for step in sorted(steps):
+                for rank, rec in sorted(steps[step].items()):
+                    out.append(dict(rec, run_id=run_id, rank=rank))
+        out.sort(key=lambda r: r.get("t_start") or 0.0)
+        return out[-limit:]
 
     # ----------------------------------------------------------- metrics
     # Reference: src/ray/stats/metric_exporter.cc -> metrics agent ->

@@ -34,8 +34,14 @@ def main() -> None:
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
     host, port = conductor.rsplit(":", 1)
-    print(f"[worker {worker_id[:8]}] connecting to conductor {host}:{port}",
-          flush=True)
+    if os.environ.get("RAY_TPU_WORKER_VERBOSE") == "1":
+        # boot diagnostics are opt-in: by default every worker's stdout
+        # is mirrored to the driver (log_to_driver), and one boot line
+        # per spawned process is pure noise interleaved into driver
+        # output — failures surface through register_worker / the
+        # conductor's death tracking, not this print
+        print(f"[worker {worker_id[:8]}] connecting to conductor "
+              f"{host}:{port}", flush=True)
 
     from . import worker as worker_mod
     from .worker import Worker

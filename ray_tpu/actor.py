@@ -4,6 +4,7 @@
 actor's worker with per-caller sequence numbers for ordering."""
 from __future__ import annotations
 
+import os
 import threading
 import uuid
 from typing import Any, Dict, Optional
@@ -65,10 +66,24 @@ class ActorHandle:
         with self._lock:
             seqno = self._seqno
             self._seqno += 1
-        return w.submit_actor_task(
-            self._actor_id, self._address, method, args, kwargs,
-            num_returns, seqno, self._caller_id,
-            max_task_retries=self._max_task_retries)
+
+        def submit():
+            return w.submit_actor_task(
+                self._actor_id, self._address, method, args, kwargs,
+                num_returns, seqno, self._caller_id,
+                max_task_retries=self._max_task_retries)
+
+        # Unified timeline: submission span parents the actor-side
+        # execution span (see remote_function.remote for the rationale).
+        # Qualified with the actor id like task events name actor calls
+        # ("<id8>.<method>") so same-named methods of different actors
+        # stay distinguishable in the merged trace.
+        if os.environ.get("RAY_TPU_TRACING") == "1":
+            from .util import tracing
+
+            with tracing.submit_span(f"{self._actor_id[:8]}.{method}"):
+                return submit()
+        return submit()
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):

@@ -84,25 +84,22 @@ class _ClusterData:
                 for k, v in sorted(groups.items())]
 
     def timeline(self) -> List[Dict[str, Any]]:
+        from ray_tpu.observability.timeline import task_trace_events
+
         events = self.conductor.call("get_task_events", 10_000, timeout=10.0)
-        out = []
-        for ev in events:
-            worker = ev.get("worker")
-            out.append({
-                "name": ev["name"], "cat": "task", "ph": "X",
-                "ts": ev["start"] * 1e6,
-                "dur": max(0.0, ev["end"] - ev["start"]) * 1e6,
-                "pid": ev.get("job_id", "job"),
-                "tid": f"{worker[0]}:{worker[1]}" if worker else "driver",
-                "args": {"task_id": ev["task_id"],
-                         "status": ev.get("status", "FINISHED")}})
-        return out
+        return task_trace_events(events)
 
     def metrics_text(self) -> str:
         from ray_tpu.util.state import _render_prometheus
 
         return _render_prometheus(self.conductor.call("get_metrics",
                                                       timeout=5.0))
+
+    def train_progress(self) -> Dict[str, Any]:
+        """Flight-recorder gang telemetry (per-rank step stats, skew,
+        stragglers) aggregated by the conductor. Int rank keys are fine:
+        json_response's json.dumps coerces them to strings."""
+        return self.conductor.call("get_train_progress", timeout=10.0)
 
     def serve_status(self) -> Dict[str, Any]:
         """Serve apps/deployments/proxies, mirrored into the conductor
@@ -226,6 +223,7 @@ class DashboardServer:
                                lambda: d.simple_args("get_recent_logs", 500)))
         app.router.add_get("/api/metrics", self._metrics)
         app.router.add_get("/api/serve", self._json_route(d.serve_status))
+        app.router.add_get("/api/train", self._json_route(d.train_progress))
         app.router.add_get("/api/autoscaler",
                            self._json_route(d.autoscaler_status))
         app.router.add_get(

@@ -1,0 +1,32 @@
+"""ray_tpu.observability: the flight recorder.
+
+The runtime's four observability primitives — ``util.metrics``
+(conductor-pushed Prometheus registry), ``util.tracing`` (W3C spans +
+chrome/OTLP export), ``util.profiling`` (jax.profiler device traces) and
+the dashboard/timeline CLI — answer "what is the cluster doing?". This
+layer answers the ML questions a TPU runtime must answer natively:
+
+- what is my MFU and tokens/sec?        -> ``flops`` + ``StepTimer``
+- where did the step time go?           -> ``StepTimer`` phase breakdown
+  (data-wait / compile / device-step / checkpoint / report)
+- which host is the straggler?          -> ``gang`` (conductor-aggregated
+  per-rank skew, surfaced via ``util.state.train_progress()``,
+  ``/api/train`` and ``python -m ray_tpu train-status``)
+- how does it all line up in time?      -> ``timeline`` (one merged
+  chrome trace: driver spans, worker task events, step markers)
+"""
+from .flops import (  # noqa: F401
+    NOMINAL_PEAK_FLOPS,
+    PEAK_FLOPS_BF16,
+    attn_flops_per_token,
+    compiled_flops,
+    device_peak_flops,
+    mfu,
+    param_count,
+    params_size,
+    total_peak_flops,
+    train_flops_per_token,
+)
+from .gang import find_stragglers, step_skew, summarize_run  # noqa: F401
+from .step_timer import PHASES, StepTimer, telemetry_enabled  # noqa: F401
+from .timeline import merged_chrome_trace, merged_timeline  # noqa: F401

@@ -1,0 +1,112 @@
+"""One unified timeline: driver/worker spans + conductor task events +
+training step markers merged into a single chrome-trace file, so one
+Perfetto load shows driver, gang, and step structure together
+(``python -m ray_tpu timeline --merged``).
+
+The three sources already exist separately — ``util.state.timeline``
+(task events), ``util.tracing.to_chrome_trace`` (spans), and the flight
+recorder's step records (``report_train_steps``) — this module only
+merges and labels them:
+
+- task events:   pid = job id,            tid = executing worker
+- spans:         pid = recording process, tid = trace id prefix
+- step markers:  pid = "train:<run_id>",  tid = "rank <r>", one X event
+                 per step carrying the phase breakdown in args, plus a
+                 counter event series for tokens/sec and MFU.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def step_trace_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Chrome-trace events for flattened step records (each record
+    carries run_id/rank — see ConductorHandler.get_train_steps)."""
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        t0, t1 = rec.get("t_start"), rec.get("t_end")
+        if t0 is None or t1 is None:
+            continue
+        pid = f"train:{rec.get('run_id', 'default')}"
+        tid = f"rank {rec.get('rank', 0)}"
+        args = {k: round(v, 3) for k, v in rec.items()
+                if k.endswith("_ms") and isinstance(v, (int, float))}
+        for key in ("tokens", "tokens_per_sec", "mfu"):
+            if key in rec:
+                args[key] = rec[key]
+        out.append({
+            "name": f"step {rec.get('step', '?')}", "cat": "train_step",
+            "ph": "X", "ts": t0 * 1e6,
+            "dur": max(0.0, t1 - t0) * 1e6,
+            "pid": pid, "tid": tid, "args": args,
+        })
+        # counter tracks: throughput/MFU trend lines under the steps
+        counters = {}
+        if rec.get("tokens_per_sec") is not None:
+            counters["tokens_per_sec"] = round(rec["tokens_per_sec"], 1)
+        if rec.get("mfu") is not None:
+            counters["mfu_pct"] = round(100.0 * rec["mfu"], 3)
+        if counters:
+            out.append({"name": "throughput", "cat": "train_step",
+                        "ph": "C", "ts": t1 * 1e6, "pid": pid,
+                        "args": counters})
+    return out
+
+
+def task_trace_events(task_events: List[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """Chrome-trace events for conductor task events — the ONE rendering
+    of the task-event schema, shared by the plain `util.state.timeline`
+    export and the merged flight-recorder trace."""
+    out: List[Dict[str, Any]] = []
+    for ev in task_events:
+        worker = ev.get("worker")
+        out.append({
+            "name": ev["name"], "cat": "task", "ph": "X",
+            "ts": ev["start"] * 1e6,
+            "dur": max(0.0, ev["end"] - ev["start"]) * 1e6,
+            "pid": ev.get("job_id", "job"),
+            "tid": f"{worker[0]}:{worker[1]}" if worker else "driver",
+            "args": {"task_id": ev["task_id"],
+                     "status": ev.get("status", "FINISHED")},
+        })
+    return out
+
+
+def merged_chrome_trace(task_events: List[Dict[str, Any]],
+                        spans: List[Dict[str, Any]],
+                        step_records: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Merge the three sources into one sorted event list."""
+    from ray_tpu.util import tracing
+
+    trace = task_trace_events(task_events)
+    trace.extend(tracing.to_chrome_trace(spans))
+    trace.extend(step_trace_events(step_records))
+    trace.sort(key=lambda e: e.get("ts", 0.0))
+    return trace
+
+
+def merged_timeline(filename: Optional[str] = None,
+                    limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Pull all three sources from the live cluster and merge (the
+    ``timeline --merged`` backend). Flushes this process's pending task
+    events and spans first so a short driver's trace is complete."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    w._flush_task_events()  # spans ride the same flush (tracing.drain)
+    events = w.conductor.call("get_task_events", limit, timeout=30.0)
+    spans = w.conductor.call("get_spans", limit, timeout=30.0)
+    try:
+        steps = w.conductor.call("get_train_steps", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-flight-recorder conductor
+        steps = []
+    trace = merged_chrome_trace(events, spans, steps)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -5,6 +5,7 @@ overrides."""
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, Optional
 
 from ._private import worker as worker_mod
@@ -48,17 +49,31 @@ class RemoteFunction:
         if self._fn_bytes is None:
             from ._private import serialization
             self._fn_bytes = serialization.dumps(self._fn)
-        return w.submit_task(
-            self._fn, args, kwargs,
-            fn_bytes=self._fn_bytes,
-            name=o.get("name") or self._fn.__name__,
-            num_returns=int(o.get("num_returns", 1)),
-            resources=resources,
-            max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
-            placement_group_id=pg_id,
-            runtime_env=o.get("runtime_env"),
-            scheduling_strategy=_sched.to_wire(
-                o.get("scheduling_strategy", "DEFAULT")))
+        name = o.get("name") or self._fn.__name__
+
+        def submit():
+            return w.submit_task(
+                self._fn, args, kwargs,
+                fn_bytes=self._fn_bytes,
+                name=name,
+                num_returns=int(o.get("num_returns", 1)),
+                resources=resources,
+                max_retries=o.get("max_retries", DEFAULT_MAX_RETRIES),
+                placement_group_id=pg_id,
+                runtime_env=o.get("runtime_env"),
+                scheduling_strategy=_sched.to_wire(
+                    o.get("scheduling_strategy", "DEFAULT")))
+
+        # Unified timeline: with tracing on, submission gets its own span
+        # so a trace shows submit -> worker execute as parent -> child
+        # (the traceparent captured in the TaskSpec is THIS span's). The
+        # env gate keeps the common tracing-off path import-free.
+        if os.environ.get("RAY_TPU_TRACING") == "1":
+            from .util import tracing
+
+            with tracing.submit_span(name):
+                return submit()
+        return submit()
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node — reference python/ray/dag/function_node.py

@@ -168,9 +168,50 @@ def cmd_timeline(args) -> None:
     _connect(args)
     from ray_tpu.util import state
 
-    n = len(state.timeline(args.output))
-    print(f"wrote {n} events to {args.output} "
+    n = len(state.timeline(args.output, merged=args.merged))
+    what = "merged (tasks+spans+train steps)" if args.merged else "task"
+    print(f"wrote {n} {what} events to {args.output} "
           f"(load in chrome://tracing or Perfetto)")
+
+
+def cmd_train_status(args) -> None:
+    """Flight-recorder view of running/recent training gangs: per-rank
+    step stats, the latest step's time breakdown, skew, stragglers."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    progress = state.train_progress(getattr(args, "run", None))
+    if args.json:
+        print(json.dumps(progress, indent=2, default=str))
+        return
+    if not progress:
+        print("no training telemetry recorded "
+              "(is the gang using ray_tpu.train.report()?)")
+        return
+    for run_id, run in progress.items():
+        print(f"run {run_id}: world={run['world']} "
+              f"last_step={run['last_step']} "
+              f"steps_buffered={run['steps_buffered']}")
+        bd = run.get("last_step_breakdown") or {}
+        if bd:
+            parts = " ".join(f"{k[:-3]}={v:.1f}ms" for k, v in bd.items())
+            print(f"  last step: {parts}")
+        skew = run.get("last_step_skew") or {}
+        if skew:
+            print(f"  skew: min={skew['min_ms']:.1f}ms "
+                  f"median={skew['median_ms']:.1f}ms "
+                  f"p99={skew['p99_ms']:.1f}ms "
+                  f"max/median={skew['max_over_median']:.2f}")
+        for rank, st in sorted(run["per_rank"].items()):
+            extra = ""
+            if st.get("tokens_per_sec"):
+                extra += f" tok/s={st['tokens_per_sec']:.0f}"
+            if st.get("mfu") is not None:
+                extra += f" mfu={100 * st['mfu']:.2f}%"
+            mark = " <- STRAGGLER" if rank in run["stragglers"] else ""
+            print(f"  rank {rank}: steps={st['steps']} "
+                  f"mean={st['mean_ms']:.1f}ms p99={st['p99_ms']:.1f}ms"
+                  f"{extra}{mark}")
 
 
 def cmd_metrics(args) -> None:
@@ -402,8 +443,19 @@ def main(argv=None) -> None:
 
     sp = sub.add_parser("timeline", help="export chrome trace")
     sp.add_argument("--output", default="ray_tpu_timeline.json")
+    sp.add_argument("--merged", action="store_true",
+                    help="one unified trace: task events + tracing spans "
+                         "+ training step markers (flight recorder)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("train-status",
+                        help="gang training telemetry: per-rank step "
+                             "stats, MFU, skew, stragglers")
+    sp.add_argument("--run", help="filter to one run id")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_train_status)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

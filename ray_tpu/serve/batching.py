@@ -13,7 +13,45 @@ import queue
 import threading
 import weakref
 from concurrent.futures import Future
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+# Batch-occupancy telemetry: on TPU the whole point of @serve.batch is
+# keeping the MXU fed, so the flushed batch size (and its fraction of
+# max_batch_size) is the gauge that says whether it is working. One set
+# of metric objects per process; batchers are distinguished by the "fn"
+# label.
+_metrics_cache: Dict[str, Any] = {}
+_metrics_lock = threading.Lock()
+
+
+def _batch_metrics() -> Dict[str, Any]:
+    # double-checked init: unlocked fast path per flush; the lock only
+    # guards first-time registration so concurrent batcher flush threads
+    # cannot register duplicate metric objects
+    if _metrics_cache:
+        return _metrics_cache
+    with _metrics_lock:
+        if not _metrics_cache:
+            _build_metrics()
+    return _metrics_cache
+
+
+def _build_metrics() -> None:
+    from ray_tpu.util.metrics import Gauge, Histogram
+
+    _metrics_cache.update(
+        size=Histogram(
+            "serve_batch_size", "flushed batch sizes",
+            boundaries=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0],
+            tag_keys=("fn",)),
+        occupancy=Gauge(
+            "serve_batch_occupancy",
+            "last flushed batch size / max_batch_size",
+            tag_keys=("fn",)),
+        queue_depth=Gauge(
+            "serve_batch_queue_depth",
+            "items waiting in the batcher queue",
+            tag_keys=("fn",)))
 
 
 class PerInstance:
@@ -90,6 +128,15 @@ class _BatchQueue:
         self_arg = batch[0][0]
         items = [b[1] for b in batch]
         futs = [b[2] for b in batch]
+        try:
+            m = _batch_metrics()
+            tags = {"fn": getattr(self._fn, "__name__", "batch")}
+            m["size"].observe(float(len(items)), tags=tags)
+            m["occupancy"].set(len(items) / max(1, self._max_batch_size),
+                               tags=tags)
+            m["queue_depth"].set(self._queue.qsize(), tags=tags)
+        except Exception:  # noqa: BLE001 — telemetry must not fail a batch
+            pass
         try:
             if self_arg is not None:
                 results = self._fn(self_arg, items)

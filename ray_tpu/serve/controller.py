@@ -39,11 +39,22 @@ class _DeploymentState:
         self.status = "DEPLOYING"
         # handle_id -> (total inflight from that handle, monotonic ts)
         self.handle_metrics: Dict[str, Tuple[float, float]] = {}
+        # replica_tag -> last get_metrics() snapshot (collected on the
+        # health-check cadence; feeds `serve status` and /api/serve)
+        self.replica_metrics: Dict[str, Dict[str, Any]] = {}
 
     def to_status(self) -> Dict[str, Any]:
+        mets = list(self.replica_metrics.values())
         return {"name": self.name, "status": self.status,
                 "target_num_replicas": self.target_num_replicas,
-                "replicas": [tag for tag, _ in self.replicas]}
+                "replicas": [tag for tag, _ in self.replicas],
+                "metrics": {
+                    "inflight": sum(m.get("inflight", 0) for m in mets),
+                    "num_requests": sum(m.get("num_requests", 0)
+                                        for m in mets),
+                    "num_errors": sum(m.get("num_errors", 0)
+                                      for m in mets),
+                    "per_replica": dict(self.replica_metrics)}}
 
 
 class ServeController:
@@ -356,9 +367,17 @@ class ServeController:
             if now - st.last_health_check > st.config.health_check_period_s:
                 st.last_health_check = now
                 healthy = []
+                replica_metrics: Dict[str, Any] = {}
                 for tag, handle in live:
+                    # piggyback data-plane telemetry on the health
+                    # cadence: both calls are submitted BEFORE waiting
+                    # so the pass still costs one round-trip wait per
+                    # replica, not two (latency/TTFT live in Prometheus;
+                    # these counters surface in `serve status`)
                     try:
-                        ray_tpu.get(handle.check_health.remote(),
+                        health_ref = handle.check_health.remote()
+                        metrics_ref = handle.get_metrics.remote()
+                        ray_tpu.get(health_ref,
                                     timeout=st.config.health_check_timeout_s)
                         healthy.append((tag, handle))
                     except Exception:  # noqa: BLE001 — replica is dead
@@ -366,6 +385,19 @@ class ServeController:
                             ray_tpu.kill(handle)
                         except Exception:  # noqa: BLE001
                             pass
+                        continue
+                    try:
+                        replica_metrics[tag] = ray_tpu.get(
+                            metrics_ref,
+                            timeout=st.config.health_check_timeout_s)
+                    except Exception:  # noqa: BLE001 — busy replica:
+                        pass           # keep the stale snapshot
+                with self._lock:
+                    st.replica_metrics = {
+                        t: replica_metrics.get(t, st.replica_metrics.get(t))
+                        for t, _ in healthy
+                        if replica_metrics.get(t)
+                        or st.replica_metrics.get(t)}
                 if len(healthy) != len(live):
                     with self._lock:
                         st.replicas = healthy

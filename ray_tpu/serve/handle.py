@@ -236,6 +236,29 @@ class Router:
         import ray_tpu
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
+    _router_gauge = None
+    _router_gauge_lock = threading.Lock()
+
+    @classmethod
+    def _queue_depth_gauge(cls):
+        """Process-wide router queue-depth gauge (queued+ongoing per
+        handle, the same number the controller autoscales on), exported
+        through the util.metrics Prometheus pipeline. Double-checked:
+        unlocked fast path per push tick; the lock only guards the
+        first registration so racing push loops of two handles cannot
+        register duplicates."""
+        if cls._router_gauge is not None:
+            return cls._router_gauge
+        with cls._router_gauge_lock:
+            if cls._router_gauge is None:
+                from ray_tpu.util.metrics import Gauge
+
+                cls._router_gauge = Gauge(
+                    "serve_router_queue_depth",
+                    "requests queued+ongoing through this handle",
+                    tag_keys=("app", "deployment", "handle"))
+        return cls._router_gauge
+
     def _refresh(self, force: bool = False):
         now = time.monotonic()
         with self._lock:
@@ -347,6 +370,10 @@ class Router:
                 try:
                     with self._lock:
                         total = sum(self._inflight.values())
+                    self._queue_depth_gauge().set(
+                        total, tags={"app": self._app,
+                                     "deployment": self._deployment,
+                                     "handle": self._handle_id})
                     self._controller().record_handle_metrics.remote(
                         self._app, self._deployment, self._handle_id, total)
                 except Exception:  # noqa: BLE001 — controller restarting

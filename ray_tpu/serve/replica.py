@@ -17,6 +17,51 @@ import cloudpickle
 from .context import RequestContext, set_request_context
 from .http_util import Request  # noqa: F401 — re-export for user callables
 
+# Replica-side data-plane telemetry (one set of metric objects per
+# process; replicas are one-per-process so the WorkerId label already
+# distinguishes them). They ride the util.metrics conductor-push
+# pipeline into /api/metrics and `ray_tpu metrics`.
+_metrics_cache: Dict[str, Any] = {}
+_metrics_lock = threading.Lock()
+
+_LATENCY_BOUNDS_MS = [1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                      1000.0, 2500.0, 5000.0, 10000.0]
+
+
+def _replica_metrics() -> Dict[str, Any]:
+    # double-checked init: the unlocked read is the per-request fast
+    # path; the lock only guards first-time registration so two racing
+    # first requests cannot both register metric objects (duplicate
+    # identical-labelset Prometheus series)
+    if _metrics_cache:
+        return _metrics_cache
+    with _metrics_lock:
+        if not _metrics_cache:
+            _build_metrics()
+    return _metrics_cache
+
+
+def _build_metrics() -> None:
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    tags = ("app", "deployment")
+    _metrics_cache.update(
+        latency=Histogram(
+            "serve_request_latency_ms",
+            "end-to-end request latency on the replica",
+            boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+        ttft=Histogram(
+            "serve_ttft_ms",
+            "time to first streamed chunk (streaming requests)",
+            boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+        requests=Counter(
+            "serve_requests_total", "requests handled",
+            tag_keys=tags + ("outcome",)),
+        inflight=Gauge(
+            "serve_replica_inflight",
+            "requests currently executing on this replica",
+            tag_keys=tags + ("replica",)))
+
 
 class HandleMarker:
     """Placeholder for a bound sub-deployment inside serialized init args;
@@ -131,7 +176,9 @@ class ReplicaActor:
         self._inflight = 0
         self._lock = threading.Lock()
         self._num_requests = 0
+        self._num_errors = 0
         self._start_time = time.time()
+        self._tags = {"app": app_name, "deployment": deployment_name}
 
         target = cloudpickle.loads(serialized_callable)
         args, kwargs = cloudpickle.loads(init_args)
@@ -186,16 +233,44 @@ class ReplicaActor:
             from .context import _request_context
             _request_context.reset(token)
 
+    def _track(self, t0: float, outcome: str,
+               ttft_s: Optional[float] = None) -> None:
+        """Record one finished request into the Prometheus pipeline.
+        Runs in the request paths' finally blocks, so it must never
+        raise: a telemetry failure would discard a computed response or
+        shadow the request's real exception."""
+        try:
+            if outcome == "error":
+                with self._lock:
+                    self._num_errors += 1
+            m = _replica_metrics()
+            m["latency"].observe((time.perf_counter() - t0) * 1e3,
+                                 tags=self._tags)
+            if ttft_s is not None:
+                m["ttft"].observe(ttft_s * 1e3, tags=self._tags)
+            m["requests"].inc(1, tags=dict(self._tags, outcome=outcome))
+            m["inflight"].set(self._inflight,
+                              tags=dict(self._tags,
+                                        replica=self.replica_tag))
+        except Exception:  # noqa: BLE001 — telemetry must not fail a
+            pass           # request or mask its real error
+
     def handle_request(self, meta: Dict[str, Any], args: List[Any],
                        kwargs: Dict[str, Any]) -> Any:
+        t0 = time.perf_counter()
+        outcome = "ok"
         with self._lock:
             self._inflight += 1
             self._num_requests += 1
         try:
             return self._invoke(meta, args, kwargs)
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             with self._lock:
                 self._inflight -= 1
+            self._track(t0, outcome)
 
     # every _ACK_EVERY-th chunk is a synchronous call instead of a notify:
     # bounds unacked in-flight data and detects a vanished consumer
@@ -217,6 +292,8 @@ class ReplicaActor:
         from ray_tpu._private import serialization
         from ray_tpu._private.worker import global_worker
 
+        t0 = time.perf_counter()
+        outcome, ttft = "ok", None
         with self._lock:
             self._inflight += 1
             self._num_requests += 1
@@ -230,6 +307,8 @@ class ReplicaActor:
             try:
                 for item in it:
                     payload = serialization.dumps(item)
+                    if ttft is None:  # first token/chunk produced
+                        ttft = time.perf_counter() - t0
                     if (seq + 1) % self._ACK_EVERY == 0:
                         if not client.call("stream_chunk", stream_id, seq,
                                            payload, timeout=60.0):
@@ -242,9 +321,13 @@ class ReplicaActor:
                 if callable(closer):
                     closer()
             return ("gen", seq)
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             with self._lock:
                 self._inflight -= 1
+            self._track(t0, outcome, ttft_s=ttft)
 
     # -- control plane ------------------------------------------------------
     def get_queue_len(self) -> int:
@@ -255,6 +338,7 @@ class ReplicaActor:
             return {"replica_tag": self.replica_tag,
                     "inflight": self._inflight,
                     "num_requests": self._num_requests,
+                    "num_errors": self._num_errors,
                     "uptime_s": time.time() - self._start_time}
 
     def check_health(self) -> bool:

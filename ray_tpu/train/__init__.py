@@ -28,6 +28,7 @@ from .session import (  # noqa: F401
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    get_step_timer,
     report,
 )
 from .trainer import JaxTrainer, Result, TrainStep  # noqa: F401

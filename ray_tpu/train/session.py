@@ -34,10 +34,16 @@ class TrainContext:
     slice_id: Optional[int] = None
     num_slices: int = 1
     slice_map: Optional[Dict[int, Any]] = None
+    # flight-recorder identity of this fit (observability.StepTimer
+    # records ship to the conductor under this key)
+    run_id: str = ""
     # set by the trainer: called with (metrics, checkpoint)
     _report_fn: Optional[Callable[[Dict[str, Any], Optional[Checkpoint]],
                                   None]] = None
     _stop_requested: bool = False
+    # per-rank step clock (observability.step_timer) the trainer creates;
+    # TrainStep and report() feed it, users reach it via get_step_timer()
+    _step_timer: Optional[Any] = None
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -72,12 +78,66 @@ def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
     """Reference session.py:661. Reports metrics (and optionally a
     checkpoint) to the controlling trainer/tuner. Raises StopIteration-like
-    control via the trainer if the trial was stopped (e.g. by a scheduler)."""
+    control via the trainer if the trial was stopped (e.g. by a scheduler).
+
+    report() is also the step boundary for the flight recorder: the
+    session's StepTimer closes the current step here and its breakdown
+    (data_wait/compile/device_step/checkpoint/report ms, tokens/sec, MFU)
+    is merged into the reported metrics, so Result.metrics_history is
+    self-describing. Time spent delivering the report itself (including
+    synchronous checkpoint registration) lands in the NEXT step's
+    "report"/"checkpoint" phase."""
     ctx = get_context()
+    metrics = dict(metrics)
+    timer = ctx._step_timer
+    if timer is not None and timer.enabled:
+        rec = timer.end_step()
+        if rec is not None:
+            for key in ("total_ms", "data_wait_ms", "compile_ms",
+                        "device_step_ms", "checkpoint_ms", "report_ms",
+                        "other_ms", "tokens_per_sec", "mfu"):
+                if key in rec:
+                    metrics.setdefault(
+                        "step_time_ms" if key == "total_ms" else key,
+                        rec[key])
     if ctx._report_fn is not None:
-        ctx._report_fn(dict(metrics), checkpoint)
+        if timer is not None and timer.enabled:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            try:
+                ctx._report_fn(metrics, checkpoint)
+            finally:
+                timer.record(
+                    "checkpoint" if checkpoint is not None else "report",
+                    _time.perf_counter() - t0)
+        else:
+            ctx._report_fn(metrics, checkpoint)
     if ctx._stop_requested:
         raise StopTrial()
+
+
+def get_step_timer():
+    """The active session's flight-recorder StepTimer — use it to
+    attribute data-loading or checkpoint time from inside a train_fn:
+
+        with ray_tpu.train.get_step_timer().phase("data_wait"):
+            batch = next(batches)
+
+    Always returns a timer: outside a session (or with telemetry off) it
+    is a shared disabled instance whose phase() is a no-op."""
+    ctx = _get_session()
+    if ctx is not None and ctx._step_timer is not None:
+        return ctx._step_timer
+    global _disabled_timer
+    if _disabled_timer is None:
+        from ray_tpu.observability.step_timer import StepTimer
+
+        _disabled_timer = StepTimer(enabled=False)
+    return _disabled_timer
+
+
+_disabled_timer = None
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -56,6 +56,17 @@ class Result:
     config: Dict[str, Any] = field(default_factory=dict)
 
 
+def _batch_tokens(batch) -> int:
+    """Tokens per step from a batch pytree: the first leaf with >= 2
+    dims contributes batch x seq (the LM convention throughout
+    ray_tpu.models); 0 when no such leaf exists."""
+    for leaf in jax.tree.leaves(batch):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 2:
+            return int(shape[0]) * int(shape[1])
+    return 0
+
+
 class TrainStep:
     """Jitted SPMD train step over a mesh.
 
@@ -63,15 +74,22 @@ class TrainStep:
     GradientTransformation. param_specs is a PartitionSpec pytree matching
     params (e.g. models.gpt2_partition_specs); data axes default to
     ('dp','fsdp') batch sharding.
+
+    flops_per_token is the analytic MFU fallback (e.g.
+    observability.flops.train_flops_per_token(cfg)) used when the
+    backend cannot report per-execution FLOPs through cost_analysis();
+    when XLA does report them, the exact number wins.
     """
 
     def __init__(self, loss_fn: Callable, optimizer, mesh: Mesh,
-                 param_specs: Any, data_spec: P = P(("dp", "fsdp"))):
+                 param_specs: Any, data_spec: P = P(("dp", "fsdp")),
+                 flops_per_token: Optional[float] = None):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.param_specs = param_specs
         self.data_spec = data_spec
+        self.flops_per_token = flops_per_token
 
         def step(state, batch):
             def loss_of(p):
@@ -89,6 +107,12 @@ class TrainStep:
 
         self._step = step
         self._jitted = None
+        # AOT-compiled executable (jit.lower().compile()): built at first
+        # execution when a flight-recorder session is active, both to
+        # time compilation explicitly and to read XLA's cost_analysis
+        # FLOPs for MFU. Falls back to the plain jit cache on any
+        # backend that rejects the AOT path.
+        self._compiled = None
 
     def init_state(self, params: Any) -> Dict[str, Any]:
         """Shard params onto the mesh and build optimizer state with
@@ -127,7 +151,14 @@ class TrainStep:
             is_leaf=lambda x: isinstance(x, P))
 
     def __call__(self, state, batch):
-        if self._jitted is None:
+        from .session import _get_session
+
+        ctx = _get_session()
+        timer = ctx._step_timer if ctx is not None else None
+        if timer is not None and not timer.enabled:
+            timer = None
+        first = self._jitted is None
+        if first:
             batch_sh = jax.tree.map(
                 lambda _: NamedSharding(self.mesh, self.data_spec), batch)
             self._jitted = jax.jit(self._step, donate_argnums=(0,),
@@ -142,9 +173,72 @@ class TrainStep:
                 return x
             return jax.device_put(x, sharding)
 
+        t0 = time.perf_counter() if timer is not None else 0.0
         batch = jax.tree.map(put, batch)
+        if timer is not None:
+            timer.record("data_wait", time.perf_counter() - t0)
+            if first:
+                t0 = time.perf_counter()
+                self._instrument(timer, state, batch)
+                timer.record("compile", time.perf_counter() - t0)
+            t0 = time.perf_counter()
         with self.mesh:
-            return self._jitted(state, batch)
+            if self._compiled is not None:
+                try:
+                    out = self._compiled(state, batch)
+                except (TypeError, ValueError):
+                    # signature/shape mismatch the AOT executable cannot
+                    # absorb — raised BEFORE execution (buffers not yet
+                    # donated), so retracing via jit is safe. Runtime
+                    # failures (e.g. RESOURCE_EXHAUSTED) propagate: the
+                    # state may already be donated and a retry would
+                    # mask the real error with "Array has been deleted".
+                    self._compiled = None
+                    out = self._jitted(state, batch)
+            else:
+                out = self._jitted(state, batch)
+        if timer is not None:
+            # jax dispatch is async (TPU and CPU): without a sync here
+            # device_step_ms would record ~1ms of dispatch while the
+            # real step time leaked into other_ms and MFU exploded.
+            # The sync is the flight recorder's measurement cost — it
+            # trades host/device overlap for honest per-phase numbers,
+            # and the telemetry-off path stays fully asynchronous.
+            jax.block_until_ready(out)
+            timer.record("device_step", time.perf_counter() - t0)
+        return out
+
+    def _instrument(self, timer, state, batch) -> None:
+        """First-execution flight-recorder hookup: AOT-compile the step
+        (so compile time is attributed explicitly, not smeared into the
+        first device step), read XLA's per-execution FLOPs, and register
+        tokens-per-step + the mesh's aggregate peak FLOPs for MFU."""
+        from ray_tpu.observability import flops as _flops
+
+        try:
+            with self.mesh:
+                self._compiled = self._jitted.lower(state, batch).compile()
+            per_device = _flops.compiled_flops(self._compiled)
+            if per_device:
+                # cost_analysis reports the PER-DEVICE partitioned
+                # program; the MFU denominator aggregates peak over the
+                # whole mesh, so scale the numerator to match (verified:
+                # an 8-way sharded matmul reports 1/8th the flops)
+                timer.set_flops_per_step(
+                    per_device * int(self.mesh.devices.size))
+        except Exception:  # noqa: BLE001 — backend without AOT support
+            self._compiled = None
+        try:
+            timer.set_peak_flops(
+                _flops.total_peak_flops(self.mesh.devices))
+        except Exception:  # noqa: BLE001 — exotic device objects
+            pass
+        tokens = _batch_tokens(batch)
+        if tokens:
+            timer.set_tokens_per_step(tokens)
+            if timer.flops_per_step is None and self.flops_per_token:
+                # analytic 6N fallback: cost_analysis was unavailable
+                timer.set_flops_per_step(self.flops_per_token * tokens)
 
 
 class JaxTrainer:
@@ -230,13 +324,20 @@ class JaxTrainer:
                 else:
                     manager.register(checkpoint, metrics)
 
+        from ray_tpu.observability.step_timer import StepTimer
+
+        run_id = (f"{self.run_config.name or 'default'}"
+                  f"/{uuid.uuid4().hex[:8]}")
+        timer = StepTimer(run_id, rank=0, world_size=1)
         ctx = TrainContext(
             world_size=1, rank=0,
             experiment_name=self.run_config.name or "default",
             trial_dir=storage,
             dataset_shards=self._shard_datasets(0, 1),
             latest_checkpoint=latest,
-            _report_fn=report_fn)
+            run_id=run_id,
+            _report_fn=report_fn,
+            _step_timer=timer)
         cfg = dict(self.train_loop_config)
         cfg["sharding_config"] = self.sharding_config
         _set_session(ctx)
@@ -246,6 +347,7 @@ class JaxTrainer:
             pass
         finally:
             _set_session(None)
+            timer.close()  # flush the tail of the step-record batch
             # drain in-flight async saves before declaring the result —
             # best/latest must reflect every reported checkpoint
             for c in pending_ckpts:
@@ -287,8 +389,10 @@ class JaxTrainer:
                     latest_path: Optional[str],
                     dist_key: Optional[str] = None,
                     slice_id: Optional[int] = None,
-                    num_slices: int = 1) -> List[Any]:
+                    num_slices: int = 1,
+                    run_id: str = "") -> List[Any]:
                 from ray_tpu._private import serialization
+                from ray_tpu.observability.step_timer import StepTimer
                 from ray_tpu.train.session import (TrainContext,
                                                    _set_session, StopTrial)
                 from ray_tpu.train.checkpoint import Checkpoint as Ckpt
@@ -299,6 +403,10 @@ class JaxTrainer:
                 def report_fn(metrics, checkpoint):
                     out.append((metrics, checkpoint))
 
+                # each rank records its own steps; the conductor
+                # aggregates the gang view (straggler detection)
+                timer = StepTimer(run_id, rank=self.rank,
+                                  world_size=self.world)
                 ctx = TrainContext(
                     world_size=self.world, rank=self.rank,
                     trial_dir=trial_dir, dataset_shards=shards,
@@ -306,7 +414,9 @@ class JaxTrainer:
                                        if latest_path else None),
                     jax_dist_key=dist_key,
                     slice_id=slice_id, num_slices=num_slices,
-                    _report_fn=report_fn)
+                    run_id=run_id,
+                    _report_fn=report_fn,
+                    _step_timer=timer)
                 _set_session(ctx)
                 try:
                     if dist_key is not None and self.world > 1:
@@ -322,6 +432,7 @@ class JaxTrainer:
                     pass
                 finally:
                     _set_session(None)
+                    timer.close()  # ship this rank's tail records
                 # In-flight async saves must hit disk before run() returns
                 # (the driver registers these paths and then kills this
                 # worker, its writer thread with it) — and a save that
@@ -356,13 +467,15 @@ class JaxTrainer:
 
         num_slices = max(1, getattr(self.scaling_config, "num_slices", 1))
         slice_ids = assign_worker_slices(n, num_slices)
+        run_id = (f"{self.run_config.name or 'default'}"
+                  f"/{uuid.uuid4().hex[:8]}")
         workers = [_TrainWorker.options(placement_group=pg)
                    .remote(rank=i, world=n) for i in range(n)]
         try:
             refs = [w.run.remote(
                 fn_bytes, cfg, storage, self._shard_datasets(i, n),
                 latest.path if latest else None, dist_key,
-                slice_ids[i], num_slices)
+                slice_ids[i], num_slices, run_id)
                 for i, w in enumerate(workers)]
             all_reports = ray_tpu.get(refs)
         finally:

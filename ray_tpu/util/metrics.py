@@ -6,11 +6,21 @@ process keeps a registry and pushes snapshots to the conductor
 aggregate in Prometheus text exposition format."""
 from __future__ import annotations
 
+import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-_PUSH_INTERVAL_S = 2.0
+_DEFAULT_PUSH_INTERVAL_S = 2.0
+
+
+def _push_interval() -> float:
+    """Registry push cadence; RAY_TPU_METRICS_INTERVAL_S overrides (read
+    per tick so a live process can be retuned)."""
+    try:
+        v = float(os.environ.get("RAY_TPU_METRICS_INTERVAL_S", ""))
+        return v if v > 0 else _DEFAULT_PUSH_INTERVAL_S
+    except ValueError:
+        return _DEFAULT_PUSH_INTERVAL_S
 
 
 class _Registry:
@@ -18,6 +28,7 @@ class _Registry:
         self._metrics: List["Metric"] = []
         self._lock = threading.Lock()
         self._pusher_started = False
+        self._stop_event = threading.Event()
 
     def register(self, m: "Metric") -> None:
         with self._lock:
@@ -33,12 +44,14 @@ class _Registry:
             if self._pusher_started:
                 return
             self._pusher_started = True
+            # restartable: a fresh event per pusher generation, so a
+            # cluster started after shutdown() gets a live push loop
+            self._stop_event = stop = threading.Event()
 
         def push_loop():
             from ray_tpu._private import worker as worker_mod
 
-            while True:
-                time.sleep(_PUSH_INTERVAL_S)
+            while not stop.wait(_push_interval()):
                 w = worker_mod.global_worker
                 if w is None:
                     continue
@@ -58,6 +71,19 @@ class _Registry:
         w = worker_mod.global_worker
         if w is not None:
             w.conductor.notify("report_metrics", w.worker_id, self.snapshot())
+
+    def stop(self) -> None:
+        """Stop the push loop and push one final snapshot — called from
+        ray_tpu.shutdown() so the last interval's increments are not
+        lost (the seed's `while True` daemon just died with the
+        process). register() after stop() restarts the loop."""
+        with self._lock:
+            self._stop_event.set()
+            self._pusher_started = False
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001 — conductor already gone
+            pass
 
 
 _registry = _Registry()
@@ -177,3 +203,8 @@ class Histogram(Metric):
 
 def flush() -> None:
     _registry.flush()
+
+
+def shutdown() -> None:
+    """Stop the push loop + final flush (ray_tpu.shutdown() hook)."""
+    _registry.stop()

@@ -100,6 +100,19 @@ def slice_topology(group: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def train_progress(run: Optional[str] = None) -> Dict[str, Any]:
+    """Gang-wide training telemetry (the flight recorder's state-API
+    surface): {run_id: {world, last_step, per_rank: {rank: {mean_ms,
+    p50_ms, p99_ms, tokens_per_sec, mfu, ...}}, last_step_skew,
+    last_step_breakdown, stragglers}}. Ranks ship per-step records with
+    their metric/span batches; the conductor aggregates (see
+    ray_tpu.observability.gang). `run` filters to one run id."""
+    out = _conductor().conductor.call("get_train_progress", timeout=30.0)
+    if run is not None:
+        out = {k: v for k, v in out.items() if k == run}
+    return out
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Group task events by name — reference api.py summarize_tasks :1382."""
     groups: Dict[str, Dict[str, Any]] = defaultdict(
@@ -120,23 +133,22 @@ def summarize_tasks() -> Dict[str, Any]:
     return dict(groups)
 
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+def timeline(filename: Optional[str] = None,
+             merged: bool = False) -> List[Dict[str, Any]]:
     """Chrome-trace export of task events — reference `ray timeline`
     (scripts.py; ProfileEvents via GcsTaskManager). Load the output in
-    chrome://tracing or Perfetto."""
-    events = list_tasks()
-    trace = []
-    for ev in events:
-        worker = ev.get("worker")
-        tid = f"{worker[0]}:{worker[1]}" if worker else "driver"
-        trace.append({
-            "name": ev["name"], "cat": "task", "ph": "X",
-            "ts": ev["start"] * 1e6,
-            "dur": max(0.0, ev["end"] - ev["start"]) * 1e6,
-            "pid": ev.get("job_id", "job"), "tid": tid,
-            "args": {"task_id": ev["task_id"],
-                     "status": ev.get("status", "FINISHED")},
-        })
+    chrome://tracing or Perfetto.
+
+    merged=True produces the unified flight-recorder timeline instead:
+    task events + tracing spans + training step markers in one trace
+    (`python -m ray_tpu timeline --merged`)."""
+    if merged:
+        from ray_tpu.observability.timeline import merged_timeline
+
+        return merged_timeline(filename)
+    from ray_tpu.observability.timeline import task_trace_events
+
+    trace = task_trace_events(list_tasks())
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

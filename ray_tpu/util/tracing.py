@@ -124,6 +124,20 @@ def span(name: str, traceparent: Optional[str] = None, **attrs):
                 del _finished[:50_000]
 
 
+_NULL_CM = contextlib.nullcontext()
+
+
+def submit_span(name: str):
+    """Span wrapping a task/actor-call submission (`submit:<name>`), or
+    a shared no-op context manager when tracing is off. The single
+    authority for submission-span naming and enablement — used by
+    remote_function.remote() and ActorHandle._invoke so the unified
+    timeline's submit -> execute chain cannot diverge between the two."""
+    if not is_enabled():
+        return _NULL_CM
+    return span(f"submit:{name}")
+
+
 # ------------------------------------------------------------------ export
 
 def drain() -> List[Dict[str, Any]]:
@@ -151,6 +165,17 @@ def to_chrome_trace(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     } for sp in spans]
 
 
+def _otlp_status(status: str) -> Dict[str, Any]:
+    """OTLP status object. Error spans carry the recorded detail (the
+    exception type after "ERROR: ") as status.message — previously the
+    export collapsed every failure to a bare code=2."""
+    if status == "OK":
+        return {"code": 1}
+    detail = status[len("ERROR: "):] if status.startswith("ERROR: ") \
+        else status
+    return {"code": 2, "message": detail}
+
+
 def to_otlp_json(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
     """OTLP/JSON-shaped export for users piping into a collector."""
     return {"resourceSpans": [{
@@ -165,7 +190,7 @@ def to_otlp_json(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
             "name": sp["name"],
             "startTimeUnixNano": int(sp["start"] * 1e9),
             "endTimeUnixNano": int((sp["end"] or sp["start"]) * 1e9),
-            "status": {"code": 1 if sp["status"] == "OK" else 2},
+            "status": _otlp_status(sp["status"]),
             "attributes": [
                 {"key": k, "value": {"stringValue": str(v)}}
                 for k, v in sp["attrs"].items()],

@@ -1,0 +1,134 @@
+"""Prometheus text-exposition correctness (ISSUE-3 satellite): the
+exposition rendered by util.state must satisfy the shapes a strict
+scraper (prometheus_client.parser) requires — validated with
+string-level assertions so no new dependency is added:
+
+- every non-comment line is `name{labels} value` with a parseable float;
+- label values escape backslash, double-quote and newline;
+- one HELP/TYPE header per family, BEFORE its samples, families
+  contiguous;
+- histograms: cumulative buckets, a `+Inf` bucket equal to `_count`,
+  and `_sum`/`_count` series present.
+
+Clusterless on purpose (the tier-1 suite is timeout-bound): the pure
+renderer `state._render_prometheus` is fed this process's live registry
+snapshot — exactly the payload Worker pushes via report_metrics — while
+`tests/test_state.py` covers the conductor round-trip.
+"""
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from ray_tpu.util import metrics, state
+from ray_tpu.util.metrics import _registry
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)$')
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _scrape() -> str:
+    """Render this process's registry exactly as prometheus_metrics()
+    renders the conductor's per-worker snapshots."""
+    return state._render_prometheus({"testworker": _registry.snapshot()})
+
+
+def _parse_labels(blob: str) -> dict:
+    inner = blob[1:-1]
+    out = dict(_LABEL_RE.findall(inner))
+    # the whole blob must be consumed by well-formed k="v" pairs
+    rebuilt = ",".join(f'{k}="{v}"' for k, v in _LABEL_RE.findall(inner))
+    assert rebuilt == inner, f"malformed label blob: {blob!r}"
+    return out
+
+
+def test_exposition_grammar():
+    c = metrics.Counter("expo_requests_total", "help text",
+                        tag_keys=("route",))
+    c.inc(2, tags={"route": "/a"})
+    text = _scrape()
+    assert text.endswith("\n")
+    seen_families = []
+    current = None
+    for line in text.splitlines():
+        assert line.strip() == line and line
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            fam = parts[2]
+            if fam != current:
+                current = fam
+                # families are contiguous: no family header reappears
+                assert fam not in seen_families, f"split family {fam}"
+                seen_families.append(fam)
+            if line.startswith("# TYPE "):
+                assert parts[3] in ("counter", "gauge", "histogram",
+                                    "summary", "untyped")
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        # sample belongs to the current family (histograms suffix the
+        # family name with _bucket/_sum/_count)
+        assert current is not None and m.group("name").startswith(current)
+        float(m.group("value"))  # value parses
+        if m.group("labels"):
+            _parse_labels(m.group("labels"))
+
+
+def test_label_value_escaping():
+    c = metrics.Counter("expo_escapes_total", "desc",
+                        tag_keys=("k",))
+    nasty = 'quote:" backslash:\\ newline:\nend, comma:,'
+    c.inc(1, tags={"k": nasty})
+    text = _scrape()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("expo_escapes_total{"))
+    m = _SAMPLE_RE.match(line)
+    assert m, line  # the escaped newline must NOT split the line
+    labels = _parse_labels(m.group("labels"))
+    unescaped = (labels["k"].replace(r"\n", "\n").replace(r"\"", '"')
+                 .replace("\\\\", "\\"))
+    assert unescaped == nasty
+
+
+def test_histogram_cumulative_with_inf_sum_count():
+    h = metrics.Histogram("expo_latency_s", "lat",
+                          boundaries=[0.01, 0.1, 1.0],
+                          tag_keys=("path",))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0, 7.0):
+        h.observe(v, tags={"path": "/x"})
+    text = _scrape()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("expo_latency_s")]
+    buckets, total, sums = [], None, None
+    for ln in lines:
+        m = _SAMPLE_RE.match(ln)
+        assert m, ln
+        labels = _parse_labels(m.group("labels") or "{}")
+        if m.group("name") == "expo_latency_s_bucket":
+            buckets.append((labels["le"], float(m.group("value"))))
+        elif m.group("name") == "expo_latency_s_count":
+            total = float(m.group("value"))
+        elif m.group("name") == "expo_latency_s_sum":
+            sums = float(m.group("value"))
+    les = [b[0] for b in buckets]
+    assert les == ["0.01", "0.1", "1.0", "+Inf"]
+    counts = [b[1] for b in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert counts == [2.0, 3.0, 4.0, 6.0]
+    assert total == 6.0 and buckets[-1][1] == total
+    assert sums == pytest.approx(0.005 * 2 + 0.05 + 0.5 + 5.0 + 7.0)
+    # TYPE header present and correct
+    assert "# TYPE expo_latency_s histogram" in text
+
+
+def test_help_escaping():
+    metrics.Gauge("expo_multiline_help", "line1\nline2 \\ done").set(1.0)
+    text = _scrape()
+    help_line = next(ln for ln in text.splitlines()
+                     if ln.startswith("# HELP expo_multiline_help"))
+    assert "\n" not in help_line  # real newline would split the comment
+    assert r"\n" in help_line

@@ -73,18 +73,39 @@ def test_exports():
     assert os_spans[0]["status"]["code"] == 1
 
 
+def test_otlp_error_detail_carried():
+    """to_otlp_json must not collapse failures to a bare code=2: the
+    recorded `ERROR: <type>` detail rides as status.message."""
+    tracing.enable()
+    with pytest.raises(KeyError):
+        with tracing.span("fails"):
+            raise KeyError("missing")
+    otlp = tracing.to_otlp_json(tracing.drain())
+    (sp,) = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert sp["status"] == {"code": 2, "message": "KeyError"}
+    # OK spans carry no message
+    with tracing.span("fine"):
+        pass
+    otlp = tracing.to_otlp_json(tracing.drain())
+    (sp,) = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert sp["status"] == {"code": 1}
+
+
 @pytest.fixture
 def traced_cluster(monkeypatch):
     monkeypatch.setenv("RAY_TPU_TRACING", "1")
     tracing._enabled = True
-    ray_tpu.init(num_cpus=2)
+    # log_to_driver off: mirrored worker lines would interleave with the
+    # tier-1 runner's dot-progress lines and corrupt its dot count
+    ray_tpu.init(num_cpus=2, _system_config={"log_to_driver": 0})
     yield
     ray_tpu.shutdown()
 
 
 def test_spans_cross_task_boundary(traced_cluster):
-    """The driver's span parents the worker-side task span; both land in
-    the conductor's span table."""
+    """One trace spans the full chain: driver section -> automatic
+    submit span -> worker-side task span, all in the conductor's span
+    table with correct parentage."""
     @ray_tpu.remote
     def traced_work(x):
         return x + 1
@@ -98,15 +119,19 @@ def test_spans_cross_task_boundary(traced_cluster):
     while time.monotonic() < deadline:
         spans = w.conductor.call("get_spans", timeout=10.0)
         names = {s["name"] for s in spans}
-        if "task:traced_work" in names and "driver-section" in names:
+        if {"task:traced_work", "submit:traced_work",
+                "driver-section"} <= names:
             break
         time.sleep(0.3)
     by_name = {s["name"]: s for s in spans}
     assert "task:traced_work" in by_name, spans
     task_span = by_name["task:traced_work"]
+    submit_span = by_name["submit:traced_work"]
     driver_span = by_name["driver-section"]
     assert task_span["trace_id"] == driver_span["trace_id"]
-    assert task_span["parent_id"] == driver_span["span_id"]
+    assert submit_span["trace_id"] == driver_span["trace_id"]
+    assert task_span["parent_id"] == submit_span["span_id"]
+    assert submit_span["parent_id"] == driver_span["span_id"]
 
 
 def test_spans_cross_actor_boundary(traced_cluster):
@@ -131,3 +156,19 @@ def test_spans_cross_actor_boundary(traced_cluster):
     assert "actor:T.m" in by_name
     assert by_name["actor:T.m"]["trace_id"] == \
         by_name["actor-call-site"]["trace_id"]
+
+
+def test_conductor_span_buffer_capped(tmp_path):
+    """report_spans is bounded the same way report_task_events is: the
+    conductor's span table trims to 100k entries (half dropped at
+    overflow), so a chatty tracer cannot grow head memory without
+    limit. Exercised on a bare handler — no cluster needed."""
+    from ray_tpu._private.conductor import ConductorHandler
+
+    handler = ConductorHandler({"CPU": 1.0}, str(tmp_path))
+    span = {"name": "s", "trace_id": "t", "span_id": "i",
+            "parent_id": None, "start": 0.0, "end": 0.0,
+            "attrs": {}, "status": "OK", "pid": 0}
+    handler.report_spans([dict(span) for _ in range(60_000)])
+    handler.report_spans([dict(span) for _ in range(60_000)])
+    assert len(handler.get_spans(limit=200_000)) <= 100_000
