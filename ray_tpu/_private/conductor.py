@@ -43,6 +43,28 @@ def _worker_start_timeout() -> float:
     return config.worker_start_timeout
 
 
+_RESILIENCE_COUNTER = None
+_TTR_GAUGE = None
+
+
+def _resilience_metrics():
+    """Lazy Prometheus-surface twins of the conductor's resilience
+    counters (created on first event so importing the conductor never
+    spawns a metrics pusher)."""
+    global _RESILIENCE_COUNTER, _TTR_GAUGE
+    if _RESILIENCE_COUNTER is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _RESILIENCE_COUNTER = Counter(
+            "ray_tpu_resilience_events_total",
+            "resilience events by kind (preemption/restart/quarantine/"
+            "grace_checkpoint/chaos/recovery)", tag_keys=("kind",))
+        _TTR_GAUGE = Gauge(
+            "ray_tpu_time_to_recovery_seconds",
+            "first failure -> successful fit, most recent recovery")
+    return _RESILIENCE_COUNTER, _TTR_GAUGE
+
+
 def _chips_needed(resources: Dict[str, float]) -> int:
     """Whole-chip count a lease pins to the worker via TPU_VISIBLE_CHIPS
     (reference accelerators/tpu.py:30). Fractional TPU shares only
@@ -81,6 +103,10 @@ class WorkerRecord:
     # why the worker died, when the runtime knows (e.g. "oom: ..." from
     # the memory monitor) — submitters query this to raise a typed error
     death_cause: Optional[str] = None
+    # set before a deliberate kill (ray_tpu.kill, node deregistration,
+    # gang teardown): the death must not charge the failure-domain
+    # tracker — only UNEXPECTED deaths count toward quarantine
+    expected_death: bool = False
 
 
 @dataclass
@@ -177,6 +203,24 @@ class ConductorHandler:
                           free_chips=list(range(int(resources.get("TPU", 0)))))
         self._nodes[head.node_id] = head
         self._head_node_id = head.node_id
+
+        # Failure-domain quarantine + resilience event log
+        # (ray_tpu.resilience): unexpected worker deaths charge their
+        # host's decayed score; hosts over the threshold are excluded
+        # from lease grants and bundle assignment. The head is exempt
+        # from AUTO-quarantine (it is the control plane's own pool —
+        # excluding it on a single-host runtime would deadlock every
+        # lease), though an operator quarantine_node still pins it.
+        from ray_tpu.resilience.domains import FailureDomainTracker
+        from .config import config as _config
+
+        self._fd_tracker = FailureDomainTracker(
+            threshold=_config.quarantine_threshold,
+            half_life_s=_config.quarantine_halflife_s,
+            exempt=(head.node_id,))
+        self._resilience_events: List[Dict[str, Any]] = []
+        self._resilience_counters: Dict[str, int] = {}
+        self._last_ttr_s: Optional[float] = None
 
         # Durable control-plane tables (reference: GCS Redis-persisted
         # tables, gcs_server.h:103-110 / gcs_table_storage.cc). A snapshot
@@ -290,6 +334,7 @@ class ConductorHandler:
             for w in self._workers.values():
                 if w.node_id == node_id and w.state != "DEAD":
                     w.state = "DEAD"
+                    w.expected_death = True  # host is leaving on purpose
                     self._release_resources(self._lease_release_node(w),
                                             w.resources)
                     w.resources = {}
@@ -482,6 +527,13 @@ class ConductorHandler:
 
     def _acquire_resources(self, node: NodeRecord, req: Dict[str, float]) -> bool:
         for k, v in req.items():
+            if k.startswith("_pg_") and k not in node.available:
+                # bundle pool lives elsewhere: even a ZERO-resource PG
+                # lease (0-CPU actors) must bind to the bundle's node —
+                # gang placement and failure-domain accounting both
+                # depend on the lease landing where the bundle was
+                # reserved
+                return False
             if node.available.get(k, 0.0) + 1e-9 < v:
                 return False
         for k, v in req.items():
@@ -603,6 +655,17 @@ class ConductorHandler:
                 if affinity is not None:
                     pinned = self._affinity_nodes_locked(
                         affinity, resources)
+                if pinned is None:
+                    # failure-domain quarantine + preemption drain: a
+                    # host that keeps killing gangs, or one about to be
+                    # reclaimed, must not receive new leases. When EVERY
+                    # node is excluded the filter yields — a degraded
+                    # grant beats a cluster-wide deadlock. An explicit
+                    # NODE_AFFINITY pin (pinned) beats quarantine.
+                    kept = [n for n in nodes
+                            if not self._fd_tracker.is_excluded(n.node_id)]
+                    if kept:
+                        nodes = kept
                 if pinned is not None:
                     nodes = pinned
                 elif strategy == "SPREAD":
@@ -951,6 +1014,14 @@ class ConductorHandler:
                 rec.restarts_remaining = 0
             worker_id = rec.worker_id
             w = self._workers.get(worker_id) if worker_id else None
+            if w is not None and w.state != "DEAD" and \
+                    not (w.proc is not None and w.proc.poll() is not None):
+                # deliberate kill of a LIVE worker: don't charge the
+                # failure tracker. A worker that already exited on its
+                # own (crash racing this kill — e.g. a gang teardown
+                # sweeping over the rank whose death triggered it) died
+                # organically and must still count toward quarantine.
+                w.expected_death = True
         if w is not None and w.proc is not None:
             try:
                 w.proc.kill()
@@ -1057,6 +1128,12 @@ class ConductorHandler:
         order = [self._head_node_id] + sorted(
             nid for nid, n in self._nodes.items()
             if nid != self._head_node_id and n.alive)
+        # quarantined/draining hosts are excluded from gang formation;
+        # an all-excluded cluster falls back to the full list (liveness)
+        kept = [nid for nid in order
+                if not self._fd_tracker.is_excluded(nid)]
+        if kept:
+            order = kept
         avail = {nid: dict(self._nodes[nid].available) for nid in order}
 
         def fits(nid, b):
@@ -1283,6 +1360,147 @@ class ConductorHandler:
                     out.append(dict(rec, run_id=run_id, rank=rank))
         out.sort(key=lambda r: r.get("t_start") or 0.0)
         return out[-limit:]
+
+    # --------------------------------------------------------- resilience
+    # ray_tpu.resilience: the conductor is the authority for preemption
+    # broadcast, failure-domain quarantine, and the resilience event log
+    # (restart/preemption/quarantine markers for the merged timeline).
+
+    _RESILIENCE_EVENTS_KEPT = 10_000
+
+    def _resilience_record_locked(self, event: Dict[str, Any]) -> None:
+        """Append an event + bump its kind counter. Must hold the lock."""
+        event.setdefault("ts", time.time())
+        self._resilience_events.append(event)
+        if len(self._resilience_events) > self._RESILIENCE_EVENTS_KEPT:
+            del self._resilience_events[
+                :len(self._resilience_events)
+                - self._RESILIENCE_EVENTS_KEPT]
+        kind = str(event.get("kind", "other"))
+        self._resilience_counters[kind] = \
+            self._resilience_counters.get(kind, 0) + 1
+        if kind == "recovery" and event.get("ttr_s") is not None:
+            self._last_ttr_s = float(event["ttr_s"])
+        try:
+            counter, ttr = _resilience_metrics()
+            counter.inc(tags={"kind": kind})
+            if kind == "recovery" and self._last_ttr_s is not None:
+                ttr.set(self._last_ttr_s)
+        except Exception:  # noqa: BLE001 — metrics must never fail an
+            pass           # event report
+
+    def _record_failure(self, node_id: str, kind: str, detail: str = "",
+                        worker_id: Optional[str] = None) -> None:
+        """Charge `node_id`'s failure domain; emits a quarantine event
+        on the not-quarantined -> quarantined transition."""
+        was = self._fd_tracker.is_quarantined(node_id)
+        score = self._fd_tracker.record(node_id, kind, detail=detail)
+        with self._lock:
+            self._resilience_record_locked(
+                {"kind": kind, "node_id": node_id, "detail": detail,
+                 "worker_id": worker_id, "score": round(score, 4)})
+            if not was and self._fd_tracker.is_quarantined(node_id):
+                self._resilience_record_locked(
+                    {"kind": "quarantine", "node_id": node_id,
+                     "detail": f"score {score:.2f} >= threshold "
+                               f"{self._fd_tracker.threshold:g}"})
+
+    def report_preemption(self, node_id: Optional[str] = None,
+                          worker_id: Optional[str] = None,
+                          grace_s: Optional[float] = None,
+                          reason: str = "maintenance") -> Dict[str, Any]:
+        """A host announced it is going away (maintenance event, spot
+        reclaim, SIGTERM). Starts draining the host — no new leases or
+        bundles land on it for the grace window — and broadcasts
+        "checkpoint now, grace N seconds" on the `resilience` pubsub
+        channel, where training sessions pick it up
+        (ray_tpu.train.preemption_requested)."""
+        from .config import config
+
+        grace = config.preempt_grace_s if grace_s is None else \
+            float(grace_s)
+        with self._cv:
+            if node_id is None and worker_id is not None:
+                w = self._workers.get(worker_id)
+                if w is not None:
+                    node_id = w.lease_node_id or w.node_id
+            if node_id is None:
+                node_id = self._head_node_id
+            self._fd_tracker.begin_drain(
+                node_id, time.monotonic() + grace, reason)
+            event = {"kind": "preemption", "ts": time.time(),
+                     "node_id": node_id, "grace_s": grace,
+                     "deadline": time.time() + grace, "reason": reason}
+            self._resilience_record_locked(event)
+            self._notify_all_locked()
+        self.publish("resilience", event)
+        return event
+
+    def report_resilience_event(self, event: Dict[str, Any]) -> None:
+        """Generic event sink for trainers/supervisors/chaos: restart,
+        grace_checkpoint, gang_peer_death, elastic_reform, recovery
+        (with time-to-recovery `ttr_s`), chaos injections."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            self._resilience_record_locked(dict(event))
+
+    def quarantine_node(self, node_id: str, reason: str = "manual") -> None:
+        """Operator pin: exclude a node until clear_quarantine."""
+        self._fd_tracker.quarantine(node_id, reason)
+        with self._cv:
+            self._resilience_record_locked(
+                {"kind": "quarantine", "node_id": node_id,
+                 "detail": reason, "manual": True})
+            self._notify_all_locked()
+
+    def clear_quarantine(self, node_id: str) -> bool:
+        cleared = self._fd_tracker.clear(node_id)
+        with self._cv:
+            if cleared:
+                self._resilience_record_locked(
+                    {"kind": "quarantine_cleared", "node_id": node_id})
+            self._notify_all_locked()
+        return cleared
+
+    def get_resilience_status(self) -> Dict[str, Any]:
+        """State-API/dashboard view: per-domain scores + quarantine/
+        drain flags, excluded hosts, counters, recent events."""
+        status = self._fd_tracker.status()
+        with self._lock:
+            return {
+                "domains": status["domains"],
+                "threshold": status["threshold"],
+                "half_life_s": status["half_life_s"],
+                "excluded": self._fd_tracker.excluded(),
+                "head_node_id": self._head_node_id,
+                "counters": dict(self._resilience_counters),
+                "last_ttr_s": self._last_ttr_s,
+                "recent_events": self._resilience_events[-50:],
+            }
+
+    def get_resilience_events(self, limit: int = 10_000
+                              ) -> List[Dict[str, Any]]:
+        """Raw event log, oldest first — the merged-timeline source."""
+        with self._lock:
+            return self._resilience_events[-limit:]
+
+    def schedulable_resources(self) -> Dict[str, float]:
+        """available_resources minus quarantined/draining hosts — what a
+        gang re-form can actually get (elastic sizing input)."""
+        with self._lock:
+            # copy under the lock: other RPCs insert/pop _pg_ keys in
+            # these dicts, and iterating them unlocked can raise
+            # "dictionary changed size during iteration"
+            nodes = [(n.node_id, dict(n.available))
+                     for n in self._nodes.values() if n.alive]
+        out: Dict[str, float] = {}
+        for node_id, available in nodes:
+            if self._fd_tracker.is_excluded(node_id):
+                continue
+            for k, v in available.items():
+                out[k] = out.get(k, 0) + v
+        return out
 
     # ----------------------------------------------------------- metrics
     # Reference: src/ray/stats/metric_exporter.cc -> metrics agent ->
@@ -1633,6 +1851,14 @@ class ConductorHandler:
             return w.death_cause if w is not None else None
 
     def _on_worker_death(self, w: WorkerRecord) -> None:
+        if not w.expected_death:
+            # unexpected death (crash, OOM, chaos kill, host loss):
+            # charge the host's failure domain and log the event —
+            # this is what eventually quarantines a flaky host
+            self._record_failure(w.lease_node_id or w.node_id,
+                                 "worker_death",
+                                 detail=w.death_cause or "",
+                                 worker_id=w.worker_id)
         restart: List[str] = []
         with self._cv:
             for rec in self._actors.values():
@@ -1745,6 +1971,18 @@ class Conductor:
             os.path.join(self.handler._session_dir, "logs"),
             lambda batch: self.handler.publish("worker_logs", batch),
             node_label="head").start()
+        # head-node preemption watcher: the maintenance-event channel
+        # (RAY_TPU_MAINTENANCE_EVENT file) covers the head host too
+        self._preemption_watcher = None
+        from ray_tpu.resilience.preemption import (ENV_VAR,
+                                                   PreemptionWatcher)
+
+        if os.environ.get(ENV_VAR):
+            h = self.handler
+            self._preemption_watcher = PreemptionWatcher(
+                lambda ev: h.report_preemption(
+                    node_id=h._head_node_id, grace_s=ev.grace_s,
+                    reason=ev.reason)).start()
         return self
 
     @property
@@ -1752,5 +1990,7 @@ class Conductor:
         return self.server.address
 
     def stop(self) -> None:
+        if getattr(self, "_preemption_watcher", None) is not None:
+            self._preemption_watcher.stop()
         self.handler.stop()
         self.server.stop()

@@ -89,6 +89,21 @@ _FLAGS: List[Flag] = [
     Flag("log_to_driver", int, 1,
          "1 = mirror worker stdout/stderr lines to the driver console "
          "via the worker_logs pubsub channel (reference log_monitor.py)"),
+    # --- resilience ----------------------------------------------------
+    Flag("preempt_grace_s", float, 30.0,
+         "default preemption grace window (seconds) when the "
+         "maintenance-event channel does not specify one"),
+    Flag("maintenance_poll_s", float, 1.0,
+         "poll period of the RAY_TPU_MAINTENANCE_EVENT file watcher"),
+    Flag("quarantine_threshold", float, 3.0,
+         "decayed failure score at which a host is quarantined out of "
+         "lease grants and gang formation (ray_tpu.resilience)"),
+    Flag("quarantine_halflife_s", float, 600.0,
+         "half-life (seconds) of a host's failure score decay"),
+    Flag("restart_backoff_base_s", float, 1.0,
+         "base delay of the trainer's exponential restart backoff"),
+    Flag("restart_backoff_max_s", float, 30.0,
+         "cap on the trainer's restart backoff delay"),
     # --- misc ----------------------------------------------------------
     Flag("node_ip", str, "",
          "address other hosts can reach this one on (else inferred from "

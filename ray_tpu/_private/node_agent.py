@@ -137,6 +137,17 @@ class NodeAgent:
                              self.server.address, timeout=10.0)
         self._hb_thread.start()
         self._mem_thread.start()
+        # preemption watcher: the maintenance-event channel
+        # (RAY_TPU_MAINTENANCE_EVENT file) turns an upcoming host
+        # reclaim into a conductor broadcast — "checkpoint now, grace N
+        # seconds" — before the platform starts killing processes
+        self._preemption_watcher = None
+        from ray_tpu.resilience.preemption import (ENV_VAR,
+                                                   PreemptionWatcher)
+
+        if os.environ.get(ENV_VAR):
+            self._preemption_watcher = PreemptionWatcher(
+                self.notify_preemption).start()
         # tail THIS host's worker logs into the worker_logs channel — but
         # only when the head is a different machine: on a shared host the
         # conductor's own tailer already covers the shared session dir
@@ -181,6 +192,16 @@ class NodeAgent:
     def address(self) -> Tuple[str, int]:
         return self.server.address
 
+    def notify_preemption(self, event) -> None:
+        """Report this host's preemption (maintenance event / SIGTERM)
+        to the conductor; it drains the host and broadcasts the
+        checkpoint-now signal to affected gangs."""
+        try:
+            self._conductor.call("report_preemption", self.node_id, None,
+                                 event.grace_s, event.reason, timeout=5.0)
+        except Exception:  # noqa: BLE001 — conductor mid-restart: the
+            pass           # next heartbeat re-establishes contact
+
     def _heartbeat_loop(self) -> None:
         from .config import config
 
@@ -188,6 +209,13 @@ class NodeAgent:
         last_ok = time.monotonic()
         pending_dead: List[str] = []
         while not self._stopped.wait(_heartbeat_period()):
+            # chaos harness: scripted heartbeat delay (the "slow host"
+            # failure mode — exercises the conductor's node timeout)
+            from ray_tpu.resilience.chaos import heartbeat_delay_s
+
+            delay = heartbeat_delay_s()
+            if delay > 0 and self._stopped.wait(delay):
+                break
             with self._causes_lock:
                 causes = dict(self._pending_causes)
             pending_dead.extend(self.handler.reap_dead())
@@ -216,6 +244,8 @@ class NodeAgent:
 
     def stop(self) -> None:
         self._stopped.set()
+        if getattr(self, "_preemption_watcher", None) is not None:
+            self._preemption_watcher.stop()
         self.handler._shutdown_workers()
         try:
             # force: this host is leaving whether or not leases are live;
@@ -251,6 +281,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         resources.update(json.loads(args.resources))
     agent = NodeAgent((host, int(port)), resources,
                       node_id=args.node_id).start()
+    # daemon main only (a library must not hijack signals): SIGTERM —
+    # how platforms reclaim a VM — becomes a preemption broadcast so
+    # gangs on this host checkpoint before the processes die
+    from ray_tpu.resilience.preemption import install_sigterm_notifier
+
+    install_sigterm_notifier(agent.notify_preemption)
     print(f"node agent {agent.node_id[:12]} on {agent.address} "
           f"joined {args.address}", flush=True)
     try:

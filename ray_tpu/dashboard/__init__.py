@@ -16,6 +16,8 @@ Routes:
   /api/metrics           Prometheus exposition (text)
   /api/serve             Serve apps/deployments/proxies (controller's
                          KV-mirrored status)
+  /api/resilience        recovery subsystem: quarantined/draining hosts,
+                         failure scores, restart/preemption counters
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -226,6 +228,9 @@ class DashboardServer:
         app.router.add_get("/api/train", self._json_route(d.train_progress))
         app.router.add_get("/api/autoscaler",
                            self._json_route(d.autoscaler_status))
+        app.router.add_get(
+            "/api/resilience",
+            self._json_route(lambda: d.simple("get_resilience_status")))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

@@ -13,6 +13,9 @@ merges and labels them:
 - step markers:  pid = "train:<run_id>",  tid = "rank <r>", one X event
                  per step carrying the phase breakdown in args, plus a
                  counter event series for tokens/sec and MFU.
+- resilience:    pid = "resilience",      tid = event kind — instant
+                 markers for preemptions, restarts, quarantines, grace
+                 checkpoints, and chaos injections (ray_tpu.resilience).
 """
 from __future__ import annotations
 
@@ -54,6 +57,29 @@ def step_trace_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return out
 
 
+def resilience_trace_events(events: List[Dict[str, Any]]
+                            ) -> List[Dict[str, Any]]:
+    """Instant markers for resilience events (preemption, restart,
+    quarantine, grace checkpoint, chaos injection, recovery) — one
+    global-scope "i" event per entry so failures and recoveries line up
+    against the task/span/step tracks they interrupted."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        where = ev.get("node_id") or ev.get("run_id") or ev.get("name")
+        out.append({
+            "name": f"{kind}:{where}" if where else kind,
+            "cat": "resilience", "ph": "i", "s": "g", "ts": ts * 1e6,
+            "pid": "resilience", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -76,21 +102,25 @@ def task_trace_events(task_events: List[Dict[str, Any]]
 
 def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         spans: List[Dict[str, Any]],
-                        step_records: List[Dict[str, Any]]
+                        step_records: List[Dict[str, Any]],
+                        resilience_events: Optional[
+                            List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
-    """Merge the three sources into one sorted event list."""
+    """Merge the sources into one sorted event list."""
     from ray_tpu.util import tracing
 
     trace = task_trace_events(task_events)
     trace.extend(tracing.to_chrome_trace(spans))
     trace.extend(step_trace_events(step_records))
+    if resilience_events:
+        trace.extend(resilience_trace_events(resilience_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
 
 def merged_timeline(filename: Optional[str] = None,
                     limit: int = 10_000) -> List[Dict[str, Any]]:
-    """Pull all three sources from the live cluster and merge (the
+    """Pull all sources from the live cluster and merge (the
     ``timeline --merged`` backend). Flushes this process's pending task
     events and spans first so a short driver's trace is complete."""
     from ray_tpu._private import worker as worker_mod
@@ -105,7 +135,12 @@ def merged_timeline(filename: Optional[str] = None,
         steps = w.conductor.call("get_train_steps", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-flight-recorder conductor
         steps = []
-    trace = merged_chrome_trace(events, spans, steps)
+    try:
+        resil = w.conductor.call("get_resilience_events", limit,
+                                 timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-resilience conductor
+        resil = []
+    trace = merged_chrome_trace(events, spans, steps, resil)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -1,0 +1,45 @@
+"""ray_tpu.resilience: preemption-aware, failure-domain-aware recovery.
+
+TPU pods make preemption and maintenance routine, and the hardest part
+of the runtime is behaving well under that churn (SURVEY §7). This
+package is the recovery subsystem spanning the node agent, conductor,
+trainer, and observability layers:
+
+- :mod:`preemption` — node-side watcher for the maintenance-event
+  channel (``RAY_TPU_MAINTENANCE_EVENT`` file/env) and SIGTERM; turns a
+  doomed host into a conductor broadcast: "checkpoint now, grace N s".
+- :mod:`domains` — per-host failure history with decay; hosts over the
+  threshold are quarantined out of lease grants, placement-group
+  assignment, and gang re-formation.
+- :mod:`supervisor` — gang supervision for workers-mode training: fast
+  peer-death detection via the conductor's death pubsub,
+  cancel-the-survivors, backoff policy, and elastic re-form onto a
+  smaller ``dcn_dp`` axis when capacity shrank.
+- :mod:`chaos` — deterministic scriptable fault plans (kill rank R at
+  step S, preempt host H with grace G, delay heartbeats, bounce the
+  conductor) so integration tests replay exact failure scenarios.
+
+Surfaces: ``ray_tpu.util.state.resilience_status()``, ``python -m
+ray_tpu resilience-status``, dashboard ``/api/resilience``, restart/
+preemption/quarantine counters, and restart/preemption markers in the
+merged flight-recorder timeline.
+"""
+from .chaos import (  # noqa: F401
+    ChaosAction,
+    ChaosError,
+    ChaosMonkey,
+    ChaosPlan,
+    monkey_from_spec,
+)
+from .domains import FailureDomainTracker  # noqa: F401
+from .preemption import (  # noqa: F401
+    MaintenanceEvent,
+    PreemptionWatcher,
+    install_sigterm_notifier,
+    read_maintenance_event,
+)
+from .supervisor import (  # noqa: F401
+    GangSupervisor,
+    backoff_delay,
+    elastic_reform,
+)

@@ -1,0 +1,250 @@
+"""Chaos harness: deterministic, scriptable fault injection.
+
+The recovery machinery in this package is only trustworthy if exact
+failure scenarios can be replayed in tests — "kill rank 2 at step 5",
+"preempt host H with 3 seconds of grace mid-run", "delay heartbeats by
+500ms". A chaos *plan* is a JSON list of such actions, carried in the
+``RAY_TPU_CHAOS_PLAN`` env var (inline JSON, or ``@/path/plan.json``)
+or handed to the trainer programmatically; a :class:`ChaosMonkey` built
+from the plan is consulted at every training step boundary
+(``ray_tpu.train.report``) and fires each matching action exactly once.
+
+Actions (all fields beyond ``action`` optional unless noted):
+
+- ``{"action": "raise", "rank": R, "at_step": S}`` — raise
+  :class:`ChaosError` inside the training loop (survivable failure; the
+  trainer's retry path catches it).
+- ``{"action": "kill", "rank": R, "at_step": S}`` — hard ``os._exit``
+  of the rank's process (worker death; exercises death-pub detection).
+- ``{"action": "preempt", "node": N, "grace_s": G, "at_step": S}`` —
+  report a preemption for node N (a node id, ``"head"``, or ``"self"``
+  = the firing rank's host) to the conductor, which broadcasts the
+  checkpoint-now signal and starts draining the host.
+- ``{"action": "delay_heartbeats", "ms": M}`` — node agents stretch
+  their heartbeat period by M ms (consulted each beat, not stepwise).
+- ``{"action": "bounce_conductor", "at_step": S}`` — matched by
+  :meth:`ChaosPlan.external_actions`; executed by the test harness
+  (only it owns the conductor's lifecycle), not by the monkey.
+
+``at_step`` compares against the step number being reported (the
+``step`` metric when present, else the session's report count, both
+1-based for the first report). ``attempt`` (default 0) scopes an action
+to one restart generation so a resumed run replaying the same step
+numbers does not re-fire it; ``"attempt": "any"`` fires every time the
+step matches. ``rank`` defaults to 0 for cluster-wide actions
+(``preempt``) and is required for ``raise``/``kill``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_VAR = "RAY_TPU_CHAOS_PLAN"
+
+_IN_PROCESS = ("raise", "kill", "preempt")
+_EXTERNAL = ("bounce_conductor",)
+_PASSIVE = ("delay_heartbeats",)
+
+
+class ChaosError(RuntimeError):
+    """A scripted, survivable failure injected by the chaos harness."""
+
+
+@dataclass
+class ChaosAction:
+    action: str
+    at_step: int = 0
+    rank: Optional[int] = None
+    attempt: Any = 0            # int generation, or "any"
+    node: Optional[str] = None  # preempt: node id | "head" | "self"
+    grace_s: Optional[float] = None
+    ms: float = 0.0             # delay_heartbeats
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosAction":
+        action = str(d.get("action", ""))
+        known = _IN_PROCESS + _EXTERNAL + _PASSIVE
+        if action not in known:
+            raise ValueError(f"unknown chaos action {action!r}; "
+                             f"known: {sorted(known)}")
+        if action in ("raise", "kill") and d.get("rank") is None:
+            raise ValueError(f"chaos action {action!r} requires a rank")
+        return cls(action=action,
+                   at_step=int(d.get("at_step", 0)),
+                   rank=(None if d.get("rank") is None
+                         else int(d["rank"])),
+                   attempt=d.get("attempt", 0),
+                   node=d.get("node"),
+                   grace_s=(None if d.get("grace_s") is None
+                            else float(d["grace_s"])),
+                   ms=float(d.get("ms", 0.0)))
+
+    def matches(self, step: int, rank: int, attempt: int) -> bool:
+        if self.action in _PASSIVE:
+            return False  # consulted out-of-band, not stepwise
+        if self.attempt != "any" and int(self.attempt) != attempt:
+            return False
+        if self.at_step != step:
+            return False
+        want = 0 if self.rank is None else self.rank
+        return want == rank
+
+
+class ChaosPlan:
+    """An ordered list of actions, parsed from JSON."""
+
+    def __init__(self, actions: List[ChaosAction], spec: str = ""):
+        self.actions = list(actions)
+        self.spec = spec
+
+    def __bool__(self) -> bool:
+        return bool(self.actions)
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> "ChaosPlan":
+        """Parse inline JSON or ``@/path/to/plan.json``; None/"" is the
+        empty plan. A malformed plan raises — silently dropping scripted
+        faults would make a chaos test vacuously green."""
+        if not spec:
+            return cls([], "")
+        text = spec
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                text = f.read()
+        data = json.loads(text)
+        if isinstance(data, dict):
+            data = data.get("actions", [])
+        return cls([ChaosAction.from_dict(d) for d in data], spec)
+
+    @classmethod
+    def from_env(cls) -> "ChaosPlan":
+        return cls.from_spec(os.environ.get(ENV_VAR))
+
+    def heartbeat_delay_s(self) -> float:
+        """Extra node-agent heartbeat delay scripted by the plan."""
+        return sum(a.ms for a in self.actions
+                   if a.action == "delay_heartbeats") / 1000.0
+
+    def external_actions(self, step: int, attempt: int = 0
+                         ) -> List[ChaosAction]:
+        """Actions the harness itself must execute at this step (e.g.
+        bounce_conductor) — the monkey cannot, it lives inside the run."""
+        return [a for a in self.actions
+                if a.action in _EXTERNAL
+                and a.matches(step, a.rank or 0, attempt)]
+
+
+_HB_DELAY_CACHE: Optional[tuple] = None  # (env spec, parsed delay)
+
+
+def heartbeat_delay_s() -> float:
+    """Env-plan heartbeat stretch, for the node agent's beat loop.
+    Cached per env value (the agent consults this every beat — no
+    point re-parsing an @file plan each second); parse failures count
+    as no delay here — the agent must keep heartbeating no matter what
+    is in the env."""
+    global _HB_DELAY_CACHE
+    spec = os.environ.get(ENV_VAR)
+    if _HB_DELAY_CACHE is not None and _HB_DELAY_CACHE[0] == spec:
+        return _HB_DELAY_CACHE[1]
+    try:
+        delay = ChaosPlan.from_spec(spec).heartbeat_delay_s()
+    except Exception:  # noqa: BLE001
+        delay = 0.0
+    _HB_DELAY_CACHE = (spec, delay)
+    return delay
+
+
+class ChaosMonkey:
+    """Per-process executor of a plan's in-process actions.
+
+    Created by the trainer for each fit attempt and consulted from
+    ``ray_tpu.train.report`` at every step boundary. Each action fires
+    at most once per monkey; the ``attempt`` field on actions provides
+    cross-restart determinism (a restarted run is a new monkey with a
+    new attempt number).
+    """
+
+    def __init__(self, plan: ChaosPlan, rank: int = 0, attempt: int = 0,
+                 conductor_call: Optional[Callable[..., Any]] = None):
+        self.plan = plan
+        self.rank = int(rank)
+        self.attempt = int(attempt)
+        self._conductor_call = conductor_call
+        self._fired: set = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- firing
+
+    def on_step(self, step: int) -> None:
+        """Fire every in-process action matching (step, rank, attempt).
+        May raise ChaosError or terminate the process — by design."""
+        for idx, a in enumerate(self.plan.actions):
+            if a.action not in _IN_PROCESS:
+                continue
+            with self._lock:
+                if idx in self._fired:
+                    continue
+                if not a.matches(step, self.rank, self.attempt):
+                    continue
+                self._fired.add(idx)
+            self._execute(a, step)
+
+    def _execute(self, a: ChaosAction, step: int) -> None:
+        self._report_event(a, step)
+        if a.action == "raise":
+            raise ChaosError(
+                f"chaos: injected failure at rank {self.rank} "
+                f"step {step} (attempt {self.attempt})")
+        if a.action == "kill":
+            os._exit(137)
+        if a.action == "preempt":
+            self._preempt(a)
+
+    def _call(self, method: str, *args, **kwargs) -> Any:
+        if self._conductor_call is not None:
+            return self._conductor_call(method, *args, **kwargs)
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        if w is None:
+            return None
+        return w.conductor.call(method, *args, timeout=10.0, **kwargs)
+
+    def _preempt(self, a: ChaosAction) -> None:
+        node_id, worker_id = a.node, None
+        if a.node in (None, "self"):
+            node_id = None
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            worker_id = w.worker_id if w is not None else None
+        elif a.node == "head":
+            node_id = None  # conductor defaults to its head node
+        try:
+            self._call("report_preemption", node_id, worker_id,
+                       a.grace_s, "chaos")
+        except Exception:  # noqa: BLE001 — conductor mid-bounce: the
+            pass           # preempt injection is lost, the plan is not
+
+    def _report_event(self, a: ChaosAction, step: int) -> None:
+        try:
+            self._call("report_resilience_event", {
+                "kind": "chaos", "action": a.action, "rank": self.rank,
+                "step": step, "attempt": self.attempt, "node": a.node})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+
+def monkey_from_spec(spec: Optional[str], rank: int = 0,
+                     attempt: int = 0) -> Optional[ChaosMonkey]:
+    """Build a monkey when `spec` (or, if None, the env) carries a
+    plan; None when there is no chaos configured."""
+    plan = (ChaosPlan.from_env() if spec is None
+            else ChaosPlan.from_spec(spec))
+    if not plan:
+        return None
+    return ChaosMonkey(plan, rank=rank, attempt=attempt)

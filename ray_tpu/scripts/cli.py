@@ -214,6 +214,51 @@ def cmd_train_status(args) -> None:
                   f"{extra}{mark}")
 
 
+def cmd_resilience_status(args) -> None:
+    """Recovery-subsystem view: quarantined/draining hosts with their
+    decayed failure scores, event counters, and recent events."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    st = state.resilience_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    excluded = set(st.get("excluded") or [])
+    print(f"quarantine threshold: {st['threshold']:g} "
+          f"(half-life {st['half_life_s']:g}s)")
+    domains = st.get("domains") or {}
+    if not domains:
+        print("no failure history recorded")
+    for node_id, d in sorted(domains.items()):
+        flags = []
+        if d.get("quarantined"):
+            flags.append("QUARANTINED" + (" (manual)" if d.get("manual")
+                                          else ""))
+        if d.get("draining"):
+            flags.append(f"DRAINING {d['drain_remaining_s']:.0f}s left "
+                         f"({d.get('drain_reason')})")
+        if d.get("exempt"):
+            flags.append("exempt")
+        mark = " <- EXCLUDED" if node_id in excluded else ""
+        print(f"  {node_id[:16]}: score={d['score']:.2f} "
+              f"failures={d['failures']}"
+              + (f" last={d['last_kind']}" if d.get("last_kind") else "")
+              + (f" [{', '.join(flags)}]" if flags else "") + mark)
+    counters = st.get("counters") or {}
+    if counters:
+        print("counters: " + " ".join(f"{k}={v}" for k, v
+                                      in sorted(counters.items())))
+    if st.get("last_ttr_s") is not None:
+        print(f"last time-to-recovery: {st['last_ttr_s']:.2f}s")
+    for ev in (st.get("recent_events") or [])[-args.events:]:
+        when = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("kind", "ts") and v is not None}
+        print(f"  [{when}] {ev.get('kind')} "
+              + " ".join(f"{k}={v}" for k, v in extra.items()))
+
+
 def cmd_metrics(args) -> None:
     _connect(args)
     from ray_tpu.util import state
@@ -456,6 +501,16 @@ def main(argv=None) -> None:
     sp.add_argument("--json", action="store_true")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_train_status)
+
+    sp = sub.add_parser("resilience-status",
+                        help="recovery subsystem: quarantined/draining "
+                             "hosts, failure scores, restart/preemption "
+                             "counters, recent events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=10,
+                    help="recent events to print (default 10)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_resilience_status)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

@@ -29,6 +29,7 @@ from .session import (  # noqa: F401
     get_context,
     get_dataset_shard,
     get_step_timer,
+    preemption_requested,
     report,
 )
 from .trainer import JaxTrainer, Result, TrainStep  # noqa: F401

@@ -29,6 +29,12 @@ class ScalingConfig:
     # jax.distributed rendezvous groups process ids slice-major so DCN
     # axes of a HybridMeshConfig land across slices.
     num_slices: int = 1
+    # elastic floor (ray_tpu.resilience): when a restart finds less
+    # schedulable capacity than num_workers (host quarantined / slice
+    # preempted), the gang re-forms at the largest feasible size >= this
+    # — multi-slice gangs shrink by whole slices and a ShardingConfig
+    # whose dcn_dp equals num_slices follows. None = never shrink.
+    min_workers: Optional[int] = None
 
 
 def assign_worker_slices(num_workers: int, num_slices: int) -> list:

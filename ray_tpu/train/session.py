@@ -37,6 +37,9 @@ class TrainContext:
     # flight-recorder identity of this fit (observability.StepTimer
     # records ship to the conductor under this key)
     run_id: str = ""
+    # restart generation (0 = first attempt); the trainer's retry loop
+    # bumps it and the chaos harness scopes scripted faults to it
+    attempt: int = 0
     # set by the trainer: called with (metrics, checkpoint)
     _report_fn: Optional[Callable[[Dict[str, Any], Optional[Checkpoint]],
                                   None]] = None
@@ -44,6 +47,14 @@ class TrainContext:
     # per-rank step clock (observability.step_timer) the trainer creates;
     # TrainStep and report() feed it, users reach it via get_step_timer()
     _step_timer: Optional[Any] = None
+    # the active preemption notice (conductor `resilience` pubsub): a
+    # host this run touches announced it is going away — checkpoint now
+    _preemption: Optional[Dict[str, Any]] = None
+    _grace_acked: bool = False
+    # resilience.chaos.ChaosMonkey for this attempt (scripted faults
+    # fire at the report() step boundary); None = no chaos configured
+    _chaos: Optional[Any] = None
+    _report_count: int = 0
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -89,6 +100,14 @@ def report(metrics: Dict[str, Any],
     "report"/"checkpoint" phase."""
     ctx = get_context()
     metrics = dict(metrics)
+    ctx._report_count += 1
+    step = ctx._report_count
+    v = metrics.get("step")
+    if v is not None:
+        try:
+            step = int(v)  # python/numpy/jax scalars alike
+        except (TypeError, ValueError):
+            step = ctx._report_count
     timer = ctx._step_timer
     if timer is not None and timer.enabled:
         rec = timer.end_step()
@@ -113,6 +132,21 @@ def report(metrics: Dict[str, Any],
                     _time.perf_counter() - t0)
         else:
             ctx._report_fn(metrics, checkpoint)
+    if checkpoint is not None and ctx._preemption is not None \
+            and not ctx._grace_acked:
+        # the step-fresh checkpoint the preemption broadcast asked for
+        # is now registered: mark the grace flow complete (observable
+        # in resilience_status / the merged timeline)
+        ctx._grace_acked = True
+        _report_resilience_event({
+            "kind": "grace_checkpoint", "run_id": ctx.run_id,
+            "rank": ctx.rank, "step": step,
+            "node_id": ctx._preemption.get("node_id")})
+    if ctx._chaos is not None:
+        # scripted faults fire AFTER the report is delivered, so "kill
+        # rank R at step S" leaves step S's metrics/checkpoint as the
+        # deterministic resume point
+        ctx._chaos.on_step(step)
     if ctx._stop_requested:
         raise StopTrial()
 
@@ -138,6 +172,37 @@ def get_step_timer():
 
 
 _disabled_timer = None
+
+
+def preemption_requested() -> Optional[Dict[str, Any]]:
+    """Inside a train_fn: the active preemption notice, or None.
+
+    When a host this run touches announces a maintenance event /
+    preemption, the conductor broadcasts "checkpoint now, grace N
+    seconds" and this returns the notice::
+
+        {"node_id": ..., "grace_s": 30.0, "deadline": <unix ts>,
+         "reason": "maintenance"}
+
+    React by reporting a checkpoint promptly — the restarted run then
+    resumes from a step-fresh checkpoint instead of the last periodic
+    one. Outside a session this returns None."""
+    ctx = _get_session()
+    return ctx._preemption if ctx is not None else None
+
+
+def _report_resilience_event(event: Dict[str, Any]) -> None:
+    """Best-effort event to the conductor's resilience log (driver or
+    worker process; silently a no-op without a cluster)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_resilience_event", event)
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -25,6 +25,7 @@ insert psum/reduce-scatter — the step the reference delegates to torch DDP
 """
 from __future__ import annotations
 
+import logging
 import os
 import time
 import traceback
@@ -39,7 +40,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import (CheckpointConfig, FailureConfig, RunConfig,
                      ScalingConfig, ShardingConfig)
-from .session import StopTrial, TrainContext, _set_session
+from .session import (StopTrial, TrainContext, _report_resilience_event,
+                      _set_session)
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -54,6 +58,79 @@ class Result:
     # the trial's hyperparameter config (reference Result.config —
     # populated by Tune, empty for plain Trainer fits)
     config: Dict[str, Any] = field(default_factory=dict)
+
+
+def _subscribe_preemption(ctx: TrainContext):
+    """Route the conductor's `resilience` pubsub into the session so
+    `ray_tpu.train.preemption_requested()` sees the notice. Returns an
+    unsubscribe token (None without a cluster)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        return None
+
+    def on_msg(msg, _ctx=ctx):
+        if isinstance(msg, dict) and msg.get("kind") == "preemption":
+            _ctx._preemption = msg
+
+    w.subscribe_channel("resilience", on_msg)
+    return (w, on_msg)
+
+
+def _unsubscribe_preemption(token) -> None:
+    if token is None:
+        return
+    try:
+        token[0].unsubscribe_channel("resilience", token[1])
+    except Exception:  # noqa: BLE001 — worker already torn down
+        pass
+
+
+def _persist_checkpoint(ck: Checkpoint, trial_dir: str, rank: int,
+                        seq: int, attempt: int = 0) -> Checkpoint:
+    """Move a reported checkpoint into `{trial_dir}/pending` NOW, on
+    the worker, at report time — not when the gang run returns. A gang
+    that dies mid-training (preemption, chaos kill) must leave its
+    step-fresh checkpoints on shared storage for the restart to resume
+    from; a checkpoint sitting in the dead worker's tempdir is lost.
+
+    Names sort attempt-major: `seq` (the per-run report count) resets
+    to 0 on every restart, so without the attempt prefix a long first
+    attempt would out-sort a short second one and
+    `_newest_pending_checkpoint` would resume attempt 3 from attempt
+    1's stale state."""
+    import shutil
+
+    pending = os.path.join(trial_dir, "pending")
+    os.makedirs(pending, exist_ok=True)
+    dst = os.path.join(pending, f"{attempt:04d}-{seq:06d}-rank{rank}")
+    if os.path.abspath(ck.path) == dst:
+        return ck
+    if os.path.exists(dst):
+        shutil.rmtree(dst)
+    try:
+        os.replace(ck.path, dst)
+    except OSError:  # cross-filesystem tempdir
+        shutil.copytree(ck.path, dst)
+        shutil.rmtree(ck.path, ignore_errors=True)
+    ck.path = dst
+    return ck
+
+
+def _newest_pending_checkpoint(storage: str) -> Optional[Checkpoint]:
+    """Latest worker-persisted checkpoint under `{storage}/pending`
+    (names sort as {attempt:04d}-{seq:06d}-rank{r}, newest last)."""
+    pending = os.path.join(storage, "pending")
+    try:
+        names = sorted(os.listdir(pending))
+    except OSError:
+        return None
+    for name in reversed(names):
+        path = os.path.join(pending, name)
+        if os.path.isdir(path):
+            return Checkpoint(path)
+    return None
 
 
 def _batch_tokens(batch) -> int:
@@ -276,28 +353,112 @@ class JaxTrainer:
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
         latest = self.resume_from_checkpoint
+        first_failure_ts: Optional[float] = None
+        # chaos plans ride the env on the driver; workers get the spec
+        # forwarded explicitly (their spawn env predates the plan)
+        chaos_spec = os.environ.get("RAY_TPU_CHAOS_PLAN")
         while True:
             try:
                 if self.mode == "workers" and \
                         self.scaling_config.num_workers > 1:
-                    result = self._fit_workers(manager, latest, storage)
+                    result = self._fit_workers(manager, latest, storage,
+                                               attempt, chaos_spec)
                 else:
-                    result = self._fit_spmd(manager, latest, storage)
+                    result = self._fit_spmd(manager, latest, storage,
+                                            attempt, chaos_spec)
                 result.path = storage
+                if attempt and first_failure_ts is not None:
+                    # time-to-recovery: first failure -> successful fit
+                    _report_resilience_event({
+                        "kind": "recovery",
+                        "name": self.run_config.name or "default",
+                        "attempts": attempt,
+                        "ttr_s": round(time.time() - first_failure_ts, 3)})
                 return result
-            except BaseException as e:  # noqa: BLE001
+            except (KeyboardInterrupt, SystemExit):
+                # deliberate stops are not failures: Ctrl-C must kill
+                # the run, not trigger a checkpoint-restart
+                raise
+            except Exception as e:  # noqa: BLE001
                 attempt += 1
-                latest = manager.latest_checkpoint or latest
+                if first_failure_ts is None:
+                    first_failure_ts = time.time()
+                # elastic story = checkpoint-restart (SURVEY.md §7): the
+                # newest registered checkpoint wins; a gang that died
+                # mid-run leaves worker-persisted checkpoints in
+                # pending/ (the preemption grace flow lands there)
+                latest = (manager.latest_checkpoint
+                          or _newest_pending_checkpoint(storage) or latest)
                 if max_failures >= 0 and attempt > max_failures:
                     return Result(error=e, checkpoint=latest, path=storage,
                                   metrics={})
-                # elastic story = checkpoint-restart (SURVEY.md §7):
-                # re-run train_fn from the newest checkpoint.
+                from ray_tpu.resilience import backoff_delay
+
+                delay = backoff_delay(attempt)
+                logger.warning(
+                    "train attempt %d failed with %s: %s — restarting "
+                    "from %s in %.2fs", attempt, type(e).__name__, e,
+                    latest.path if latest else "scratch", delay)
+                _report_resilience_event({
+                    "kind": "restart",
+                    "name": self.run_config.name or "default",
+                    "attempt": attempt,
+                    "cause": f"{type(e).__name__}: {e}"[:500],
+                    "backoff_s": round(delay, 3),
+                    "resume_from": latest.path if latest else None})
+                time.sleep(delay)
+                self._maybe_elastic_reform()
+
+    def _maybe_elastic_reform(self) -> None:
+        """Before a workers-mode restart: if schedulable capacity shrank
+        below the gang (dead host quarantined, slice preempted) and the
+        user set ScalingConfig.min_workers, re-form smaller — shrinking
+        whole slices and the dcn_dp axis with them."""
+        if self.mode != "workers" or \
+                self.scaling_config.min_workers is None:
+            return
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        if w is None:
+            return
+        try:
+            avail = w.conductor.call("schedulable_resources", timeout=10.0)
+        except Exception:  # noqa: BLE001 — older/mid-restart conductor
+            return
+        per_worker = dict(self.scaling_config.resources_per_worker
+                          or {"CPU": 1.0})
+        per_worker.setdefault("CPU", 1.0)
+        cap = min((int(avail.get(k, 0.0) // v)
+                   for k, v in per_worker.items() if v > 0), default=0)
+        from ray_tpu.resilience import elastic_reform
+
+        reformed = elastic_reform(self.scaling_config,
+                                  self.sharding_config, cap)
+        if reformed is None:
+            return
+        old_n = self.scaling_config.num_workers
+        old_slices = self.scaling_config.num_slices
+        self.scaling_config, self.sharding_config = reformed
+        logger.warning(
+            "elastic re-form: capacity shrank to %d worker slot(s); "
+            "gang %d workers/%d slices -> %d workers/%d slices",
+            cap, old_n, old_slices, self.scaling_config.num_workers,
+            self.scaling_config.num_slices)
+        _report_resilience_event({
+            "kind": "elastic_reform",
+            "name": self.run_config.name or "default",
+            "from_workers": old_n, "to_workers":
+                self.scaling_config.num_workers,
+            "from_slices": old_slices,
+            "to_slices": self.scaling_config.num_slices})
 
     # ----------------------------------------------------------- spmd mode
 
     def _fit_spmd(self, manager: CheckpointManager,
-                  latest: Optional[Checkpoint], storage: str) -> Result:
+                  latest: Optional[Checkpoint], storage: str,
+                  attempt: int = 0,
+                  chaos_spec: Optional[str] = None) -> Result:
         history: List[Dict[str, Any]] = []
         last_metrics: Dict[str, Any] = {}
         pending_ckpts: List[Any] = []
@@ -329,6 +490,8 @@ class JaxTrainer:
         run_id = (f"{self.run_config.name or 'default'}"
                   f"/{uuid.uuid4().hex[:8]}")
         timer = StepTimer(run_id, rank=0, world_size=1)
+        from ray_tpu.resilience.chaos import monkey_from_spec
+
         ctx = TrainContext(
             world_size=1, rank=0,
             experiment_name=self.run_config.name or "default",
@@ -336,10 +499,14 @@ class JaxTrainer:
             dataset_shards=self._shard_datasets(0, 1),
             latest_checkpoint=latest,
             run_id=run_id,
+            attempt=attempt,
             _report_fn=report_fn,
-            _step_timer=timer)
+            _step_timer=timer,
+            _chaos=(monkey_from_spec(chaos_spec, rank=0, attempt=attempt)
+                    if chaos_spec else None))
         cfg = dict(self.train_loop_config)
         cfg["sharding_config"] = self.sharding_config
+        preempt_sub = _subscribe_preemption(ctx)
         _set_session(ctx)
         try:
             self.train_fn(cfg)
@@ -347,6 +514,7 @@ class JaxTrainer:
             pass
         finally:
             _set_session(None)
+            _unsubscribe_preemption(preempt_sub)
             timer.close()  # flush the tail of the step-record batch
             # drain in-flight async saves before declaring the result —
             # best/latest must reflect every reported checkpoint
@@ -363,7 +531,9 @@ class JaxTrainer:
     # --------------------------------------------------------- worker mode
 
     def _fit_workers(self, manager: CheckpointManager,
-                     latest: Optional[Checkpoint], storage: str) -> Result:
+                     latest: Optional[Checkpoint], storage: str,
+                     attempt: int = 0,
+                     chaos_spec: Optional[str] = None) -> Result:
         import ray_tpu
 
         n = self.scaling_config.num_workers
@@ -390,17 +560,31 @@ class JaxTrainer:
                     dist_key: Optional[str] = None,
                     slice_id: Optional[int] = None,
                     num_slices: int = 1,
-                    run_id: str = "") -> List[Any]:
+                    run_id: str = "",
+                    attempt: int = 0,
+                    chaos_spec: Optional[str] = None) -> List[Any]:
                 from ray_tpu._private import serialization
                 from ray_tpu.observability.step_timer import StepTimer
+                from ray_tpu.resilience.chaos import monkey_from_spec
                 from ray_tpu.train.session import (TrainContext,
                                                    _set_session, StopTrial)
                 from ray_tpu.train.checkpoint import Checkpoint as Ckpt
+                from ray_tpu.train.trainer import (_persist_checkpoint,
+                                                   _subscribe_preemption,
+                                                   _unsubscribe_preemption)
 
                 fn = serialization.loads(fn_bytes)
                 out: List[Any] = []
 
                 def report_fn(metrics, checkpoint):
+                    if checkpoint is not None and \
+                            not hasattr(checkpoint, "future"):
+                        # durable at REPORT time: a gang killed
+                        # mid-training must leave its step-fresh
+                        # checkpoints behind for the restart
+                        checkpoint = _persist_checkpoint(
+                            checkpoint, trial_dir, self.rank, len(out),
+                            attempt)
                     out.append((metrics, checkpoint))
 
                 # each rank records its own steps; the conductor
@@ -415,8 +599,13 @@ class JaxTrainer:
                     jax_dist_key=dist_key,
                     slice_id=slice_id, num_slices=num_slices,
                     run_id=run_id,
+                    attempt=attempt,
                     _report_fn=report_fn,
-                    _step_timer=timer)
+                    _step_timer=timer,
+                    _chaos=(monkey_from_spec(chaos_spec, rank=self.rank,
+                                             attempt=attempt)
+                            if chaos_spec else None))
+                preempt_sub = _subscribe_preemption(ctx)
                 _set_session(ctx)
                 try:
                     if dist_key is not None and self.world > 1:
@@ -432,6 +621,7 @@ class JaxTrainer:
                     pass
                 finally:
                     _set_session(None)
+                    _unsubscribe_preemption(preempt_sub)
                     timer.close()  # ship this rank's tail records
                 # In-flight async saves must hit disk before run() returns
                 # (the driver registers these paths and then kills this
@@ -469,15 +659,31 @@ class JaxTrainer:
         slice_ids = assign_worker_slices(n, num_slices)
         run_id = (f"{self.run_config.name or 'default'}"
                   f"/{uuid.uuid4().hex[:8]}")
-        workers = [_TrainWorker.options(placement_group=pg)
+        # lease the bundle's actual resources (not the 0-CPU actor
+        # default): the gang then occupies its reserved capacity and
+        # each rank's lease is charged to the host its bundle lives on
+        # (failure-domain accounting under ray_tpu.resilience)
+        rpw = dict(self.scaling_config.resources_per_worker
+                   or {"CPU": 1.0})
+        opts: Dict[str, Any] = {"placement_group": pg,
+                                "num_cpus": rpw.pop("CPU", 1.0)}
+        if rpw:
+            opts["resources"] = rpw
+        workers = [_TrainWorker.options(**opts)
                    .remote(rank=i, world=n) for i in range(n)]
+        from ray_tpu.resilience import GangSupervisor
+
         try:
             refs = [w.run.remote(
                 fn_bytes, cfg, storage, self._shard_datasets(i, n),
                 latest.path if latest else None, dist_key,
-                slice_ids[i], num_slices, run_id)
+                slice_ids[i], num_slices, run_id, attempt, chaos_spec)
                 for i, w in enumerate(workers)]
-            all_reports = ray_tpu.get(refs)
+            # gang supervision: one dead rank -> cancel the survivors
+            # (their collectives can never complete) so this get fails
+            # fast and the fit-level retry restarts from checkpoint
+            with GangSupervisor(workers, run_id=run_id):
+                all_reports = ray_tpu.get(refs)
         finally:
             for w in workers:
                 try:

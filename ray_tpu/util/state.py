@@ -113,6 +113,17 @@ def train_progress(run: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def resilience_status() -> Dict[str, Any]:
+    """Recovery-subsystem view (ray_tpu.resilience): per-host failure
+    scores with quarantine/drain flags, the excluded host list, event
+    counters (preemption/restart/quarantine/grace_checkpoint/...),
+    last time-to-recovery, and the most recent events. The CLI analog
+    is `python -m ray_tpu resilience-status`; the dashboard serves it
+    at /api/resilience."""
+    return _conductor().conductor.call("get_resilience_status",
+                                       timeout=10.0)
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Group task events by name — reference api.py summarize_tasks :1382."""
     groups: Dict[str, Dict[str, Any]] = defaultdict(

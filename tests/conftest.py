@@ -28,6 +28,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy tests excluded from the tier-1 "
         "`-m 'not slow'` smoke run")
+    config.addinivalue_line(
+        "markers", "chaos: scripted fault-injection scenarios "
+        "(ray_tpu.resilience.chaos); the tier-1-safe smoke subset runs "
+        "on a virtual cluster, heavier replays are also marked slow — "
+        "select with `-m chaos`")
 
 
 @pytest.fixture
