@@ -162,11 +162,33 @@ def shutdown() -> None:
             _metrics.shutdown()
         except Exception:  # noqa: BLE001 — never block shutdown
             pass
+        try:
+            # cached weight publishers hold chunk refs against this
+            # worker's store; drop them with the cluster they fed.
+            # sys.modules check: never IMPORT the fabric (and jax with
+            # it) just to shut down a process that never published.
+            import sys as _sys
+
+            pub_mod = _sys.modules.get("ray_tpu.weights.publisher")
+            if pub_mod is not None:
+                pub_mod._reset_publishers()
+        except Exception:  # noqa: BLE001 — never block shutdown
+            pass
         w.shutdown()
         _worker_mod.global_worker = None
     if _conductor is not None:
         _conductor.stop()
         _conductor = None
+    # SIGKILL'ed workers (chaos tests, OOM kills) cannot unlink their shm
+    # arena segments; left behind they hold tmpfs RAM across runs. The
+    # conductor's stop() sweeps its own session — this covers connects
+    # to remote clusters and anything that died since.
+    try:
+        from ._private.object_store import cleanup_leaked_segments
+
+        cleanup_leaked_segments()
+    except Exception:  # noqa: BLE001 — never block shutdown
+        pass
     if _system_config_prior is not None:
         # this cluster's _system_config env exports must not leak into
         # the next cluster started in this process

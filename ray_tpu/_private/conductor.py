@@ -222,6 +222,18 @@ class ConductorHandler:
         self._resilience_counters: Dict[str, int] = {}
         self._last_ttr_s: Optional[float] = None
 
+        # Live weight fabric (ray_tpu.weights): versioned manifests of
+        # sharded in-memory weight publications. Chunks stay in their
+        # producers' object stores (ownership model — no bytes here);
+        # the registry holds only metadata and is the single commit
+        # authority: a version becomes visible to subscribers atomically
+        # when its LAST host fragment lands.
+        # committed: name -> {version -> manifest}; pending: (name,
+        # version) -> in-flight publish (reaped after weights_publish_ttl_s)
+        self._weights_committed: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        self._weights_pending: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._weight_events: List[Dict[str, Any]] = []
+
         # Durable control-plane tables (reference: GCS Redis-persisted
         # tables, gcs_server.h:103-110 / gcs_table_storage.cc). A snapshot
         # in the session dir lets a restarted conductor recover KV, named
@@ -1502,6 +1514,302 @@ class ConductorHandler:
                 out[k] = out.get(k, 0) + v
         return out
 
+    # ------------------------------------------------------ weight fabric
+    # ray_tpu.weights: the conductor is the version registry. Producers
+    # publish their LOCAL shards into their own object stores and send
+    # only a metadata fragment here; the version commits atomically when
+    # every host's fragment is in. Keep-last-K GC and partial-publish
+    # reaping notify producers over the `weights` pubsub channel so they
+    # can free the dropped chunks they own.
+
+    _WEIGHT_EVENTS_KEPT = 10_000
+
+    def _weight_event_locked(self, event: Dict[str, Any]) -> None:
+        event.setdefault("ts", time.time())
+        self._weight_events.append(event)
+        if len(self._weight_events) > self._WEIGHT_EVENTS_KEPT:
+            del self._weight_events[
+                :len(self._weight_events) - self._WEIGHT_EVENTS_KEPT]
+
+    def report_weight_event(self, event: Dict[str, Any]) -> None:
+        """Client-side markers (fetch, swap) for the merged timeline —
+        publish/gc/reap events are recorded by the registry itself."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            self._weight_event_locked(dict(event))
+
+    def get_weight_events(self, limit: int = 10_000) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._weight_events[-limit:]
+
+    def weights_publish_fragment(self, name: str, version: int, host: int,
+                                 num_hosts: int, fragment: Dict[str, Any],
+                                 run_id: str = "",
+                                 step: Optional[int] = None
+                                 ) -> Dict[str, Any]:
+        """One host's share of a publish: per-leaf shard metadata (the
+        chunk ObjectIDs live in that host's store). The version flips
+        committed — and becomes fetchable — only when all `num_hosts`
+        fragments are in; until then it is invisible to subscribers and
+        a died-mid-publish producer leaves only a reapable pending
+        entry, never a torn manifest."""
+        version = int(version)
+        publish_msg = None
+        gc_msgs: List[Dict[str, Any]] = []
+        with self._cv:
+            by_ver = self._weights_committed.setdefault(name, {})
+            if version in by_ver:
+                return {"error": f"version {version} of {name!r} is "
+                                 "already committed"}
+            key = (name, version)
+            pend = self._weights_pending.get(key)
+            if pend is not None and int(num_hosts) != pend["num_hosts"]:
+                # a gang RESIZED between attempts (elastic re-form after
+                # a crash that left this version partially published):
+                # the stale pending entry can never complete under the
+                # old num_hosts, and erroring here would crash-loop the
+                # recovered gang until the TTL reaper ran — supersede
+                # it, telling the old fragments' owners to free EXACTLY
+                # those chunks (by object id: the new gang's in-flight
+                # chunks share the version number and must survive)
+                gc_msgs.append({
+                    "kind": "reaped", "name": name,
+                    "versions": [version],
+                    "object_ids": self._weights_object_ids(
+                        f["leaves"] for f in
+                        pend["fragments"].values())})
+                self._weight_event_locked(
+                    {"kind": "reap", "name": name, "version": version,
+                     "detail": f"superseded: num_hosts "
+                               f"{pend['num_hosts']} -> {num_hosts}"})
+                pend = None
+            if pend is None:
+                pend = self._weights_pending[key] = {
+                    "fragments": {}, "num_hosts": int(num_hosts),
+                    "run_id": run_id, "step": step,
+                    "started": time.monotonic()}
+            prev_frag = pend["fragments"].get(int(host))
+            if prev_frag is not None:
+                # fragment RESEND (publisher retry after an ambiguous
+                # RPC timeout): the replaced fragment's chunks are
+                # referenced by nothing from here on — reap-notice them
+                # or the producer pins a full stale shard copy forever
+                gc_msgs.append({
+                    "kind": "reaped", "name": name,
+                    "versions": [version],
+                    "object_ids": self._weights_object_ids(
+                        [prev_frag["leaves"]])})
+            pend["fragments"][int(host)] = fragment
+            self._dirty = True  # registry is a durable table: producers'
+            # chunk refs depend on gc/reap notices that only a registry
+            # remembering the version can ever send (conductor bounce)
+            committed = len(pend["fragments"]) == pend["num_hosts"]
+            if committed:
+                del self._weights_pending[key]
+                manifest = self._weights_commit_locked(name, version, pend)
+                publish_msg = {"kind": "published", "name": name,
+                               "version": version, "step": step,
+                               "run_id": run_id,
+                               "total_bytes": manifest["total_bytes"]}
+                # EXTEND: a supersede notice queued above must still go
+                # out when the superseding fragment commits immediately
+                gc_msgs.extend(self._weights_gc_locked(name, None))
+            self._notify_all_locked()
+        if publish_msg is not None:
+            self.publish("weights", publish_msg)
+        for msg in gc_msgs:
+            self.publish("weights", msg)
+        return {"committed": committed, "version": version}
+
+    @staticmethod
+    def _weights_object_ids(leaves_by_frag) -> List[str]:
+        """Chunk object ids referenced by fragments or manifest leaves.
+        gc/reap notices name EXPLICIT object ids so a publisher only
+        ever frees the chunks the registry actually dropped — a
+        version-scoped notice would also hit a NEW publish in flight
+        under the same version number (gang resize supersede)."""
+        out: List[str] = []
+        for leaves in leaves_by_frag:
+            for leaf in (leaves.values() if isinstance(leaves, dict)
+                         else leaves):
+                for sh in leaf.get("shards", ()):
+                    out.append(sh["object_id"])
+        return out
+
+    @staticmethod
+    def _weights_recency(manifest: Dict[str, Any]) -> Tuple[float, int]:
+        """Ordering key for GC and 'latest': commit recency, version as
+        tiebreak. By COMMIT TIME, not version number — a gang restarted
+        from an older checkpoint legitimately republishes lower version
+        numbers, and those are the weights subscribers should follow
+        (max-version ordering would instantly GC the rollback's publish
+        while 'latest' kept pointing at the dead attempt's weights)."""
+        return (float(manifest.get("ts", 0.0)),
+                int(manifest.get("version", 0)))
+
+    def _weights_latest_locked(self, name: str) -> Optional[int]:
+        by_ver = self._weights_committed.get(name, {})
+        if not by_ver:
+            return None
+        return max(by_ver.values(), key=self._weights_recency)["version"]
+
+    def weights_latest_version(self, name: str) -> Optional[int]:
+        """O(1)-payload poll target for subscribers — the full manifest
+        (per-chunk tables + treedef bytes) must not ship on every
+        staleness check."""
+        with self._lock:
+            return self._weights_latest_locked(name)
+
+    def weights_has_version(self, name: str, version: int) -> bool:
+        """O(1) committed-version probe (publishers pre-check replayed
+        steps before paying the local shard copy into the store)."""
+        with self._lock:
+            return int(version) in self._weights_committed.get(name, {})
+
+    def _weights_commit_locked(self, name: str, version: int,
+                               pend: Dict[str, Any]) -> Dict[str, Any]:
+        """Merge host fragments into the version manifest. Must hold the
+        lock; records the publish event."""
+        frags = pend["fragments"]
+        n_leaves = max(int(f.get("n_leaves", 0)) for f in frags.values())
+        leaves: List[Dict[str, Any]] = []
+        total = 0
+        n_chunks = 0
+        for i in range(n_leaves):
+            metas = [f["leaves"].get(str(i)) for _, f in sorted(
+                frags.items())]
+            meta = next(m for m in metas if m is not None)
+            shards = [s for m in metas if m is not None
+                      for s in m["shards"]]
+            total += sum(int(s["nbytes"]) for s in shards)
+            n_chunks += len(shards)
+            leaves.append({"shape": meta["shape"], "dtype": meta["dtype"],
+                           "shards": shards})
+        treedef = next((f["treedef"] for _, f in sorted(frags.items())
+                        if f.get("treedef") is not None), None)
+        manifest = {"name": name, "version": version,
+                    "step": pend.get("step"), "run_id": pend.get("run_id"),
+                    "ts": time.time(), "num_hosts": pend["num_hosts"],
+                    "n_leaves": n_leaves, "n_chunks": n_chunks,
+                    "total_bytes": total, "leaves": leaves,
+                    "treedef": treedef}
+        self._weights_committed[name][version] = manifest
+        self._weight_event_locked(
+            {"kind": "publish", "name": name, "version": version,
+             "step": pend.get("step"), "run_id": pend.get("run_id"),
+             "num_hosts": pend["num_hosts"], "bytes": total})
+        return manifest
+
+    def _weights_gc_locked(self, name: str,
+                           keep: Optional[int]) -> List[Dict[str, Any]]:
+        """Drop committed versions beyond keep-last-K (config
+        weights_keep when `keep` is None). Returns the pubsub messages
+        telling producers which versions' chunks to free — publish them
+        AFTER releasing the lock."""
+        from .config import config
+
+        keep = config.weights_keep if keep is None else int(keep)
+        by_ver = self._weights_committed.get(name, {})
+        order = sorted(by_ver,
+                       key=lambda v: self._weights_recency(by_ver[v]))
+        drop = order[:-keep] if keep > 0 else order
+        msgs = []
+        for v in drop:
+            manifest = by_ver.pop(v)
+            self._dirty = True
+            self._weight_event_locked(
+                {"kind": "gc", "name": name, "version": v})
+            msgs.append({"kind": "gc", "name": name, "versions": [v],
+                         "object_ids": self._weights_object_ids(
+                             [manifest["leaves"]])})
+        return msgs
+
+    def weights_gc(self, name: str, keep: Optional[int] = None) -> int:
+        """Operator GC (`ray_tpu weights gc`): keep only the newest
+        `keep` versions of `name`. Returns the number dropped. Only an
+        EXPLICIT keep=0 drops everything; a negative keep (operator
+        typo) is rejected rather than read as drop-all."""
+        if keep is not None and int(keep) < 0:
+            raise ValueError(f"keep must be >= 0, got {keep}")
+        with self._cv:
+            msgs = self._weights_gc_locked(name, keep)
+            self._notify_all_locked()
+        for msg in msgs:
+            self.publish("weights", msg)
+        return len(msgs)
+
+    def weights_reap(self, max_age_s: Optional[float] = None) -> int:
+        """Drop pending publishes older than `max_age_s` (config
+        weights_publish_ttl_s default) — a producer chaos-killed
+        mid-publish must never leave a forever-pending entry, and its
+        surviving peers' orphan chunks must be freed. Runs from the
+        monitor loop; tests call it with 0 for determinism."""
+        from .config import config
+
+        ttl = config.weights_publish_ttl_s if max_age_s is None \
+            else float(max_age_s)
+        now = time.monotonic()
+        msgs = []
+        with self._cv:
+            for key in [k for k, p in self._weights_pending.items()
+                        if now - p["started"] >= ttl]:
+                name, version = key
+                pend = self._weights_pending.pop(key)
+                self._dirty = True
+                self._weight_event_locked(
+                    {"kind": "reap", "name": name, "version": version})
+                msgs.append({"kind": "reaped", "name": name,
+                             "versions": [version],
+                             "object_ids": self._weights_object_ids(
+                                 f["leaves"] for f in
+                                 pend["fragments"].values())})
+            if msgs:
+                self._notify_all_locked()
+        for msg in msgs:
+            self.publish("weights", msg)
+        return len(msgs)
+
+    def weights_get_manifest(self, name: str,
+                             version: Optional[int] = None
+                             ) -> Optional[Dict[str, Any]]:
+        """The full manifest of `version` (latest committed when None),
+        or None when nothing is committed / the version was GC'd."""
+        with self._lock:
+            by_ver = self._weights_committed.get(name, {})
+            if not by_ver:
+                return None
+            v = self._weights_latest_locked(name) if version is None \
+                else int(version)
+            return by_ver.get(v)
+
+    def get_weight_versions(self) -> Dict[str, Any]:
+        """Registry state for util.state.weight_versions(), the
+        `ray_tpu weights` CLI, and the dashboard's /api/weights — one
+        summary per name, manifests without the per-shard chunk lists."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for name, by_ver in self._weights_committed.items():
+                if not by_ver:
+                    continue
+                out[name] = {
+                    "latest": self._weights_latest_locked(name),
+                    "versions": [
+                        {k: m[k] for k in ("version", "step", "run_id",
+                                           "ts", "num_hosts", "n_leaves",
+                                           "n_chunks", "total_bytes")}
+                        for m in sorted(
+                            by_ver.values(),
+                            key=self._weights_recency)],
+                }
+            pending = [{"name": n, "version": v,
+                        "hosts_committed":
+                            sorted(p["fragments"]),
+                        "num_hosts": p["num_hosts"],
+                        "age_s": round(time.monotonic() - p["started"], 3)}
+                       for (n, v), p in self._weights_pending.items()]
+            return {"names": out, "pending": pending}
+
     # ----------------------------------------------------------- metrics
     # Reference: src/ray/stats/metric_exporter.cc -> metrics agent ->
     # Prometheus; here workers push their registry snapshots and the
@@ -1668,6 +1976,21 @@ class ConductorHandler:
                 "actors": list(self._actors.values()),
                 "pgs": list(self._pgs.values()),
                 "jobs": jobs,
+                # weight registry (metadata only — chunks live in their
+                # producers' stores and survive a conductor bounce; a
+                # forgotten registry could never send the gc/reap
+                # notices producers' chunk lifetimes depend on)
+                "weights": {
+                    "committed": {n: dict(bv) for n, bv in
+                                  self._weights_committed.items()},
+                    "pending": [
+                        {"name": n, "version": v,
+                         "num_hosts": p["num_hosts"],
+                         "run_id": p.get("run_id", ""),
+                         "step": p.get("step"),
+                         "fragments": dict(p["fragments"])}
+                        for (n, v), p in self._weights_pending.items()],
+                },
                 # a restarted conductor mints a fresh head node id: PG
                 # bundle assignments pointing at THIS id must be remapped
                 "head_node_id": self._head_node_id,
@@ -1742,6 +2065,18 @@ class ConductorHandler:
                                  restored_at=now)
                 self._workers[w.worker_id] = w
                 self._acquire_resources(head, held)
+        wstate = state.get("weights") or {}
+        self._weights_committed = {
+            n: {int(v): m for v, m in bv.items()}
+            for n, bv in (wstate.get("committed") or {}).items()}
+        for p in wstate.get("pending") or []:
+            # fresh TTL clock: `started` is monotonic and does not
+            # survive a restart; the reaper ages them out from now
+            self._weights_pending[(p["name"], int(p["version"]))] = {
+                "fragments": dict(p["fragments"]),
+                "num_hosts": int(p["num_hosts"]),
+                "run_id": p.get("run_id", ""), "step": p.get("step"),
+                "started": now}
         for jid, meta in state.get("jobs", {}).items():
             meta = dict(meta, proc=None)
             if meta.get("status") == "RUNNING":
@@ -1765,6 +2100,12 @@ class ConductorHandler:
         while not self._stopped:
             time.sleep(0.2)
             self._flush_state()
+            try:
+                # partial weight publishes (producer died mid-publish)
+                # age out of the registry here
+                self.weights_reap()
+            except Exception:  # noqa: BLE001 — monitor must not die
+                pass
             refresh_ms = config.memory_monitor_refresh_ms
             if refresh_ms > 0 and \
                     time.monotonic() - last_mem_check >= refresh_ms / 1000.0:

@@ -104,6 +104,13 @@ _FLAGS: List[Flag] = [
          "base delay of the trainer's exponential restart backoff"),
     Flag("restart_backoff_max_s", float, 30.0,
          "cap on the trainer's restart backoff delay"),
+    # --- weight fabric -------------------------------------------------
+    Flag("weights_keep", int, 3,
+         "committed weight versions the registry keeps per name; older "
+         "manifests are dropped and their chunks reaped (ray_tpu.weights)"),
+    Flag("weights_publish_ttl_s", float, 120.0,
+         "age at which a partially-committed weight publish (a producer "
+         "died mid-publish) is reaped from the registry"),
     # --- misc ----------------------------------------------------------
     Flag("node_ip", str, "",
          "address other hosts can reach this one on (else inferred from "

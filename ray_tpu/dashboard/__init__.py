@@ -18,6 +18,8 @@ Routes:
                          KV-mirrored status)
   /api/resilience        recovery subsystem: quarantined/draining hosts,
                          failure scores, restart/preemption counters
+  /api/weights           live weight fabric: committed/pending versions
+                         per weight-set name (ray_tpu.weights registry)
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -231,6 +233,9 @@ class DashboardServer:
         app.router.add_get(
             "/api/resilience",
             self._json_route(lambda: d.simple("get_resilience_status")))
+        app.router.add_get(
+            "/api/weights",
+            self._json_route(lambda: d.simple("get_weight_versions")))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

@@ -84,12 +84,20 @@ class ContinuousBatchingEngine:
     """Greedy continuous-batching decode over `max_batch` slots."""
 
     def __init__(self, params: Any, config: Any, *,
-                 max_batch: int = 8, idle_sleep_s: float = 0.002):
+                 max_batch: int = 8, idle_sleep_s: float = 0.002,
+                 params_version: Optional[int] = None):
         # config: any family _model_fns knows (LlamaConfig, GPT2Config)
         self.params = params
         self.config = config
         self.max_batch = max_batch
         self.idle_sleep_s = idle_sleep_s
+        # live-weight hot swap (ray_tpu.weights): a queued (params,
+        # version) is applied by the decode loop BETWEEN ticks — the
+        # params pytree is a plain jit argument, so swapping it never
+        # invalidates compiled programs or in-flight slots' KV caches
+        self.params_version = params_version
+        self._pending_swap: Optional[tuple] = None
+        self.swap_count = 0
         self._cache = _model_fns(config)[1](config, max_batch)
         self._tokens = np.zeros(max_batch, np.int32)
         self._pos = np.zeros(max_batch, np.int32)
@@ -133,9 +141,44 @@ class ContinuousBatchingEngine:
         return list(self.stream(prompt_tokens, max_new_tokens, eos_token,
                                 timeout_s))
 
+    def update_params(self, params: Any,
+                      version: Optional[int] = None) -> threading.Event:
+        """Queue a live weight swap; the decode loop applies it between
+        ticks (never mid-tick), so in-flight requests keep their KV
+        caches and keep decoding — under the new weights from the next
+        tick on — with no restart and no drop. Returns an Event set once
+        the swap has been applied. Two swaps queued between the same two
+        ticks coalesce: the newer wins, both events fire."""
+        ev = threading.Event()
+        with self._lock:
+            prev = self._pending_swap
+            self._pending_swap = (params, version,
+                                  (prev[2] + [ev]) if prev else [ev])
+        if self._stopped.is_set() and not self._thread.is_alive():
+            # decode loop confirmed exited (not merely stop-requested —
+            # the loop may still be inside its final tick): apply
+            # synchronously so a caller's wait() never strands on a
+            # stopped engine, without ever swapping mid-tick
+            self._apply_pending_swap()
+        return ev
+
+    def _apply_pending_swap(self) -> None:
+        """Decode-loop only, between ticks."""
+        with self._lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        params, version, events = pending
+        self.params = params
+        self.params_version = version
+        self.swap_count += 1
+        for ev in events:
+            ev.set()
+
     def stop(self) -> None:
         self._stopped.set()
         self._thread.join(timeout=10.0)
+        self._apply_pending_swap()  # fire waiters a dead loop would strand
 
     @property
     def active_slots(self) -> int:
@@ -177,6 +220,7 @@ class ContinuousBatchingEngine:
 
     def _loop(self) -> None:
         while not self._stopped.is_set():
+            self._apply_pending_swap()
             self._admit()
             if all(r is None for r in self._slot_req):
                 self._stopped.wait(self.idle_sleep_s)

@@ -16,6 +16,11 @@ merges and labels them:
 - resilience:    pid = "resilience",      tid = event kind — instant
                  markers for preemptions, restarts, quarantines, grace
                  checkpoints, and chaos injections (ray_tpu.resilience).
+- weights:       pid = "weights",         tid = event kind — instant
+                 markers for weight publishes, fetches, hot swaps, GC
+                 and reaps (ray_tpu.weights), so a serving replica's
+                 swap lines up against the training steps that
+                 produced the version.
 """
 from __future__ import annotations
 
@@ -80,6 +85,30 @@ def resilience_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def weight_trace_events(events: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Instant markers for weight-fabric events (publish, fetch, swap,
+    gc, reap) — mirrors the resilience track under pid "weights"."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        name = ev.get("name")
+        ver = ev.get("version")
+        label = f"{kind}:{name}" if name else kind
+        if ver is not None:
+            label += f"@v{ver}"
+        out.append({
+            "name": label, "cat": "weights", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "weights", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -104,6 +133,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         spans: List[Dict[str, Any]],
                         step_records: List[Dict[str, Any]],
                         resilience_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        weight_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -114,6 +145,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
     trace.extend(step_trace_events(step_records))
     if resilience_events:
         trace.extend(resilience_trace_events(resilience_events))
+    if weight_events:
+        trace.extend(weight_trace_events(weight_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -140,7 +173,11 @@ def merged_timeline(filename: Optional[str] = None,
                                  timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-resilience conductor
         resil = []
-    trace = merged_chrome_trace(events, spans, steps, resil)
+    try:
+        wev = w.conductor.call("get_weight_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-weights conductor
+        wev = []
+    trace = merged_chrome_trace(events, spans, steps, resil, wev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -259,6 +259,56 @@ def cmd_resilience_status(args) -> None:
               + " ".join(f"{k}={v}" for k, v in extra.items()))
 
 
+def cmd_weights(args) -> None:
+    """`ray_tpu weights list|inspect|gc` — the live weight fabric's
+    registry view (ray_tpu.weights): committed versions per name with
+    sizes and host counts, one version's full manifest (minus chunk
+    payloads), or an operator keep-last-K GC."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    w = worker_mod.global_worker
+    if args.weights_cmd == "list":
+        listing = state.weight_versions(getattr(args, "name", None))
+        if args.json:
+            print(json.dumps(listing, indent=2, default=str))
+            return
+        names = listing.get("names") or {}
+        if not names and not listing.get("pending"):
+            print("no weight versions published")
+        for name, rec in sorted(names.items()):
+            print(f"{name}: latest=v{rec['latest']} "
+                  f"({len(rec['versions'])} kept)")
+            for v in rec["versions"]:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(v.get("ts", 0)))
+                print(f"  v{v['version']}: step={v.get('step')} "
+                      f"bytes={v['total_bytes']} hosts={v['num_hosts']} "
+                      f"leaves={v['n_leaves']} chunks={v['n_chunks']} "
+                      f"[{when}]"
+                      + (f" run={v['run_id']}" if v.get("run_id") else ""))
+        for p in listing.get("pending") or []:
+            print(f"  PENDING {p['name']} v{p['version']}: "
+                  f"{len(p['hosts_committed'])}/{p['num_hosts']} hosts, "
+                  f"age {p['age_s']:.1f}s")
+    elif args.weights_cmd == "inspect":
+        m = w.conductor.call("weights_get_manifest", args.name,
+                             args.version, timeout=10.0)
+        if m is None:
+            raise SystemExit(
+                f"no committed version "
+                f"{'(latest)' if args.version is None else args.version} "
+                f"of {args.name!r}")
+        m = dict(m)
+        m.pop("treedef", None)  # pickled bytes, not printable
+        print(json.dumps(m, indent=2, default=str))
+    elif args.weights_cmd == "gc":
+        dropped = w.conductor.call("weights_gc", args.name, args.keep,
+                                   timeout=10.0)
+        print(f"dropped {dropped} version(s) of {args.name!r}")
+
+
 def cmd_metrics(args) -> None:
     _connect(args)
     from ray_tpu.util import state
@@ -511,6 +561,28 @@ def main(argv=None) -> None:
                     help="recent events to print (default 10)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_resilience_status)
+
+    sp = sub.add_parser("weights",
+                        help="live weight fabric: published versions, "
+                             "manifests, keep-last-K GC")
+    # --address lives on the LEAF parsers only: a mid-level flag would
+    # be clobbered by the leaf's default (None) and silently ignored
+    wsub = sp.add_subparsers(dest="weights_cmd", required=True)
+    ws = wsub.add_parser("list", help="versions per weight-set name")
+    ws.add_argument("--name", help="filter to one weight set")
+    ws.add_argument("--json", action="store_true")
+    ws.add_argument("--address")
+    ws = wsub.add_parser("inspect",
+                         help="one version's manifest (metadata only)")
+    ws.add_argument("name")
+    ws.add_argument("--version", type=int,
+                    help="default: latest committed")
+    ws.add_argument("--address")
+    ws = wsub.add_parser("gc", help="keep only the newest K versions")
+    ws.add_argument("name")
+    ws.add_argument("--keep", type=int, required=True)
+    ws.add_argument("--address")
+    sp.set_defaults(fn=cmd_weights)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

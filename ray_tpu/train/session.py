@@ -86,7 +86,9 @@ def get_context() -> TrainContext:
 
 
 def report(metrics: Dict[str, Any],
-           checkpoint: Optional[Checkpoint] = None) -> None:
+           checkpoint: Optional[Checkpoint] = None, *,
+           publish_weights: Any = None,
+           weights_name: Optional[str] = None) -> None:
     """Reference session.py:661. Reports metrics (and optionally a
     checkpoint) to the controlling trainer/tuner. Raises StopIteration-like
     control via the trainer if the trial was stopped (e.g. by a scheduler).
@@ -97,15 +99,26 @@ def report(metrics: Dict[str, Any],
     is merged into the reported metrics, so Result.metrics_history is
     self-describing. Time spent delivering the report itself (including
     synchronous checkpoint registration) lands in the NEXT step's
-    "report"/"checkpoint" phase."""
+    "report"/"checkpoint" phase.
+
+    ``publish_weights=params`` publishes this host's LOCAL shards of the
+    pytree into the live weight fabric (ray_tpu.weights) as version
+    `step` under ``weights_name`` (default: the experiment name) —
+    serving replicas subscribed to that name hot-swap to it between
+    decode ticks. Equivalent to ``weights.publish(params, step=step)``
+    from inside the train_fn. Without a ``step`` metric the registry
+    assigns latest+1 (single-host only — a multi-host gang must report
+    a step so every host names the same version)."""
     ctx = get_context()
     metrics = dict(metrics)
     ctx._report_count += 1
     step = ctx._report_count
+    explicit_step = False
     v = metrics.get("step")
     if v is not None:
         try:
             step = int(v)  # python/numpy/jax scalars alike
+            explicit_step = True
         except (TypeError, ValueError):
             step = ctx._report_count
     timer = ctx._step_timer
@@ -119,6 +132,29 @@ def report(metrics: Dict[str, Any],
                     metrics.setdefault(
                         "step_time_ms" if key == "total_ms" else key,
                         rec[key])
+    if publish_weights is not None:
+        from ray_tpu import weights as _weights
+
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            # version = the user's step metric when given (stable across
+            # restarts); otherwise registry-assigned latest+1 — the
+            # per-attempt report COUNT must not name versions, it resets
+            # to 1 on every restart and would collide with (or sort
+            # below) the previous attempt's publications
+            _weights.publish(publish_weights,
+                             name=weights_name or ctx.experiment_name,
+                             step=step if explicit_step else None,
+                             run_id=ctx.run_id)
+        except ValueError as e:
+            if "already committed" not in str(e):
+                raise
+            # a restarted attempt replaying an already-published step:
+            # idempotent no-op, never a reason to kill the gang
+        if timer is not None and timer.enabled:
+            timer.record("report", _time.perf_counter() - t0)
     if ctx._report_fn is not None:
         if timer is not None and timer.enabled:
             import time as _time
@@ -134,14 +170,52 @@ def report(metrics: Dict[str, Any],
             ctx._report_fn(metrics, checkpoint)
     if checkpoint is not None and ctx._preemption is not None \
             and not ctx._grace_acked:
-        # the step-fresh checkpoint the preemption broadcast asked for
-        # is now registered: mark the grace flow complete (observable
-        # in resilience_status / the merged timeline)
-        ctx._grace_acked = True
-        _report_resilience_event({
-            "kind": "grace_checkpoint", "run_id": ctx.run_id,
-            "rank": ctx.rank, "step": step,
-            "node_id": ctx._preemption.get("node_id")})
+        # The grace flow: the preemption broadcast asked for a
+        # step-fresh checkpoint NOW. An async save must actually be ON
+        # DISK before we ack — expedite every in-flight writer and
+        # block on this one's commit (the host may die right after the
+        # grace window; a checkpoint still in the writer queue when it
+        # does is no checkpoint at all).
+        committed = True
+        if hasattr(checkpoint, "future"):
+            import time as _time
+
+            from .async_checkpoint import expedite_all
+
+            expedite_all()
+            # bounded by the broadcast's own deadline: a wedged writer
+            # must not pin the worker in report() past the grace window
+            # it was trying to beat (then the gang would die mid-wait
+            # with nothing committed AND nothing else attempted)
+            deadline = ctx._preemption.get("deadline")
+            budget = (max(1.0, float(deadline) - _time.time())
+                      if deadline is not None
+                      else float(ctx._preemption.get("grace_s") or 30.0))
+            try:
+                checkpoint.future.result(timeout=budget)
+            except Exception:  # noqa: BLE001 — torn or still-writing
+                committed = False  # save: don't ack; a later report
+                #                    may still land one
+            else:
+                if ctx.world_size > 1 and ctx.trial_dir:
+                    # workers mode persists async saves into
+                    # {trial_dir}/pending from a commit hook — and hook
+                    # failures are swallowed by design. A path still in
+                    # the worker tempdir means the checkpoint dies with
+                    # this host: acking it would record a grace
+                    # checkpoint the restart cannot find.
+                    import os as _os
+
+                    pending_root = _os.path.abspath(_os.path.join(
+                        ctx.trial_dir, "pending")) + _os.sep
+                    committed = _os.path.abspath(
+                        checkpoint.path).startswith(pending_root)
+        if committed:
+            ctx._grace_acked = True
+            _report_resilience_event({
+                "kind": "grace_checkpoint", "run_id": ctx.run_id,
+                "rank": ctx.rank, "step": step,
+                "node_id": ctx._preemption.get("node_id")})
     if ctx._chaos is not None:
         # scripted faults fire AFTER the report is delivered, so "kill
         # rank R at step S" leaves step S's metrics/checkpoint as the

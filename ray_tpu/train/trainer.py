@@ -73,6 +73,12 @@ def _subscribe_preemption(ctx: TrainContext):
     def on_msg(msg, _ctx=ctx):
         if isinstance(msg, dict) and msg.get("kind") == "preemption":
             _ctx._preemption = msg
+            # commit in-flight async saves promptly: the grace
+            # checkpoint must land on disk inside the grace window, not
+            # at gang completion (async_checkpoint grace flow)
+            from .async_checkpoint import expedite_all
+
+            expedite_all()
 
     w.subscribe_channel("resilience", on_msg)
     return (w, on_msg)
@@ -577,14 +583,24 @@ class JaxTrainer:
                 out: List[Any] = []
 
                 def report_fn(metrics, checkpoint):
-                    if checkpoint is not None and \
-                            not hasattr(checkpoint, "future"):
-                        # durable at REPORT time: a gang killed
-                        # mid-training must leave its step-fresh
-                        # checkpoints behind for the restart
-                        checkpoint = _persist_checkpoint(
-                            checkpoint, trial_dir, self.rank, len(out),
-                            attempt)
+                    if checkpoint is not None:
+                        # durable at REPORT (or, async, COMMIT) time: a
+                        # gang killed mid-training must leave its
+                        # step-fresh checkpoints behind for the restart.
+                        # Async saves persist from the writer thread's
+                        # commit hook — strictly before wait() returns,
+                        # so the grace flow's report-side wait implies
+                        # the checkpoint is already in pending/.
+                        if hasattr(checkpoint, "add_commit_hook"):
+                            seq = len(out)
+                            checkpoint.add_commit_hook(
+                                lambda c, _seq=seq: _persist_checkpoint(
+                                    c, trial_dir, self.rank, _seq,
+                                    attempt))
+                        else:
+                            checkpoint = _persist_checkpoint(
+                                checkpoint, trial_dir, self.rank,
+                                len(out), attempt)
                     out.append((metrics, checkpoint))
 
                 # each rank records its own steps; the conductor
@@ -629,6 +645,10 @@ class JaxTrainer:
                 # FAILED must come back as path=None, not as a torn
                 # directory the driver would register as a checkpoint.
                 resolved: List[Any] = []
+                import os as _os
+
+                pending_root = _os.path.abspath(
+                    _os.path.join(trial_dir, "pending")) + _os.sep
                 for metrics, ck in out:
                     path = None
                     if ck is not None:
@@ -638,6 +658,16 @@ class JaxTrainer:
                                 ck.wait()
                             except Exception:  # noqa: BLE001 — torn
                                 ok = False
+                            else:
+                                # commit hooks swallow their own errors
+                                # (a bad hook must not fail the save):
+                                # a path still in the worker tempdir
+                                # means the persist-to-pending/ hook
+                                # FAILED — that checkpoint dies with
+                                # this worker and must not be reported
+                                # as durable
+                                ok = _os.path.abspath(ck.path).startswith(
+                                    pending_root)
                         path = ck.path if ok else None
                     resolved.append((metrics, path))
                 return resolved

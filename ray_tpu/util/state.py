@@ -113,6 +113,22 @@ def train_progress(run: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def weight_versions(name: Optional[str] = None) -> Dict[str, Any]:
+    """Live weight fabric registry state (ray_tpu.weights): per name the
+    latest committed version and the kept manifests' summaries
+    (version, step, run_id, bytes, host/leaf/chunk counts), plus any
+    in-flight (pending) publishes. The CLI analog is
+    `python -m ray_tpu weights list`; the dashboard serves it at
+    /api/weights. `name` filters to one weight set."""
+    out = _conductor().conductor.call("get_weight_versions", timeout=10.0)
+    if name is not None:
+        out = {"names": {k: v for k, v in out.get("names", {}).items()
+                         if k == name},
+               "pending": [p for p in out.get("pending", [])
+                           if p.get("name") == name]}
+    return out
+
+
 def resilience_status() -> Dict[str, Any]:
     """Recovery-subsystem view (ray_tpu.resilience): per-host failure
     scores with quarantine/drain flags, the excluded host list, event

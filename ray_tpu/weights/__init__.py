@@ -1,0 +1,33 @@
+"""ray_tpu.weights — the live weight fabric.
+
+Versioned, sharded, in-memory train→serve weight publication:
+
+- **Producer** (:class:`WeightPublisher` / :func:`publish`): each host
+  publishes only its LOCAL shards as chunks in its own object store
+  (shm for same-host readers, chunked RPC for remote) plus a
+  metadata-only fragment to the conductor's version registry. No
+  single-host gather, ever.
+- **Registry** (conductor-side): commits a version atomically when the
+  last host's fragment lands, keeps the newest K versions
+  (``weights_keep``), reaps publishes torn by a producer death
+  (``weights_publish_ttl_s``), and notifies producers to free dropped
+  chunks over the ``weights`` pubsub channel.
+- **Consumer** (:class:`WeightSubscriber`): reshard-on-fetch — each
+  device materializes only the slices its target sharding needs, the
+  same ``restore(like=)`` contract as async checkpointing, so a
+  dp/fsdp training layout feeds a tp serving layout with no
+  intermediate full array on any host.
+- **Serving** (:class:`WeightSync`): subscribes a continuous-batching
+  engine and hot-swaps params BETWEEN decode ticks; in-flight requests
+  keep their KV caches and finish. Staleness is a Prometheus gauge.
+
+Surfaces: ``util.state.weight_versions()``, ``ray_tpu weights``
+(list/inspect/gc), dashboard ``/api/weights``, publish/fetch/swap
+markers in the merged timeline.
+"""
+from .publisher import WeightPublisher, publish  # noqa: F401
+from .subscriber import FetchStats, WeightSubscriber  # noqa: F401
+from .sync import WeightSync  # noqa: F401
+
+__all__ = ["WeightPublisher", "WeightSubscriber", "WeightSync",
+           "FetchStats", "publish"]

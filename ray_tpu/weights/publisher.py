@@ -1,0 +1,260 @@
+"""Producer side of the live weight fabric.
+
+Each host of a training gang publishes ONLY its local shards — there is
+never a single-host gather, for any leaf, anywhere in the fabric. Every
+addressable replica-0 shard of every jax.Array leaf goes into THIS
+process's object store as its own chunk (the shm path serves same-host
+readers zero-copy; remote readers stream it through the existing 64MB
+chunked fetch), and a metadata-only fragment rides one RPC to the
+conductor's version registry. The registry commits the version
+atomically when the LAST host's fragment lands — subscribers can never
+observe a torn publish.
+
+Ownership model consequence (deliberate, matching the object plane): the
+chunks live exactly as long as the publishing process. Publish from a
+process that outlives consumption (the spmd driver, a parameter-server
+actor, a long-lived gang) — not from a worker that exits right after.
+
+GC: the registry's keep-last-K (and partial-publish reaping) notifies
+producers on the `weights` pubsub channel; the publisher drops its
+ObjectRefs for dropped versions and the refcount layer frees the store
+entries.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.train.async_checkpoint import _leaf_snapshots
+
+from ._common import require_worker
+from .metrics import weight_metrics
+
+
+def _worker():
+    return require_worker("publishing weights")
+
+
+class WeightPublisher:
+    """Publishes versions of one named weight set from this process.
+
+    host_rank/num_hosts default to the jax distributed identity, so a
+    multi-host gang where every host constructs a publisher and calls
+    :meth:`publish` with the same step commits one joint version made of
+    every host's local shards.
+    """
+
+    def __init__(self, name: str = "default", *,
+                 host_rank: Optional[int] = None,
+                 num_hosts: Optional[int] = None):
+        import jax
+
+        self.name = name
+        self.host_rank = (jax.process_index() if host_rank is None
+                          else int(host_rank))
+        self.num_hosts = (jax.process_count() if num_hosts is None
+                          else int(num_hosts))
+        self._worker = _worker()
+        # version -> chunk refs: holding the refs IS what keeps the
+        # chunks alive (refcount ownership); dropped on gc/reap notice
+        self._refs: Dict[int, List[Any]] = {}
+        self._lock = threading.Lock()
+        self._worker.subscribe_channel("weights", self._on_weights_msg)
+
+    # ------------------------------------------------------------- publish
+
+    def publish(self, tree: Any, *, step: Optional[int] = None,
+                version: Optional[int] = None, run_id: str = "") -> int:
+        """Publish this host's local shards of `tree` as `version`
+        (defaults to `step`, else registry-latest + 1 — multi-host gangs
+        must pass an explicit step so every host names the same
+        version). Returns the version id; the version is fetchable once
+        every host committed."""
+        import jax
+
+        t0 = time.perf_counter()
+        if version is None:
+            if step is None:
+                if self.num_hosts > 1:
+                    # registry-assigned numbering is a per-host race in
+                    # a gang: two hosts in different rounds could name
+                    # the same version and the registry would commit a
+                    # manifest MIXING rounds across hosts
+                    raise ValueError(
+                        "multi-host publishes need an explicit step= "
+                        "(every host must name the same version)")
+                version = self._next_version()
+            else:
+                version = step
+        version = int(version)
+        # best-effort pre-check: a restarted attempt replaying
+        # already-published steps must not pay a full local-shard copy
+        # into the store only to have the registry reject it (the
+        # registry's own check remains authoritative under races)
+        try:
+            exists = self._worker.conductor.call(
+                "weights_has_version", self.name, version, timeout=10.0)
+        except Exception:  # noqa: BLE001 — probe only
+            exists = False
+        if exists:
+            raise ValueError(
+                f"weight publish rejected: version {version} of "
+                f"{self.name!r} is already committed")
+        leaves, treedef = jax.tree.flatten(tree)
+        frag_leaves: Dict[str, Any] = {}
+        refs: List[Any] = []
+        w = self._worker
+        for i, leaf in enumerate(leaves):
+            meta, shards = _leaf_snapshots(leaf)
+            entries = []
+            for index, host_arr in shards:
+                arr = np.asarray(host_arr)
+                if arr.ndim and not arr.flags.c_contiguous:
+                    # NB: ascontiguousarray would promote 0-d to 1-d
+                    arr = np.ascontiguousarray(arr)
+                ref = w.put(arr)
+                refs.append(ref)
+                entries.append({"index": [list(t) for t in index],
+                                "object_id": ref.id,
+                                "locator": list(w.address),
+                                "nbytes": int(arr.nbytes)})
+            frag_leaves[str(i)] = {**meta, "shards": entries}
+        fragment: Dict[str, Any] = {"leaves": frag_leaves,
+                                    "n_leaves": len(leaves)}
+        if self.host_rank == 0:
+            fragment["treedef"] = pickle.dumps(treedef, protocol=5)
+        with self._lock:
+            self._refs.setdefault(version, []).extend(refs)
+        try:
+            res = w.conductor.call(
+                "weights_publish_fragment", self.name, version,
+                self.host_rank, self.num_hosts, fragment, run_id, step,
+                timeout=60.0)
+        except Exception:
+            # Transport failure is ambiguous: the fragment may have
+            # landed before the timeout. Probe the registry — if the
+            # version is pending or committed there, the chunks are (or
+            # will be) referenced and gc/reap notices will release
+            # them; only a fragment that verifiably never landed has
+            # refs nothing will ever reap, which must be dropped here
+            # or every failed publish leaks a full local-shard copy.
+            if not self._fragment_landed(version):
+                self._drop_call_refs(version, refs)
+            raise
+        if res.get("error"):
+            self._drop_call_refs(version, refs)
+            raise ValueError(f"weight publish rejected: {res['error']}")
+        m = weight_metrics()
+        m["publish_ms"].observe((time.perf_counter() - t0) * 1e3,
+                                tags={"name": self.name})
+        m["published"].inc(1, tags={"name": self.name})
+        return version
+
+    def _fragment_landed(self, version: int) -> bool:
+        """Did the registry record `version` (pending or committed)?
+        Unreachable registry reads as True: keeping refs until close()
+        (a bounded leak) beats freeing chunks a committed manifest may
+        reference."""
+        try:
+            listing = self._worker.conductor.call("get_weight_versions",
+                                                  timeout=10.0)
+        except Exception:  # noqa: BLE001 — conductor unreachable
+            return True
+        rec = (listing.get("names") or {}).get(self.name)
+        if rec and any(v["version"] == version for v in rec["versions"]):
+            return True
+        return any(p.get("name") == self.name
+                   and p.get("version") == version
+                   for p in listing.get("pending") or ())
+
+    def _drop_call_refs(self, version: int, refs: List[Any]) -> None:
+        """Drop ONLY this call's refs: a duplicate-version publish must
+        not free the chunks of the already-committed version sharing
+        the number."""
+        with self._lock:
+            held = self._refs.get(version)
+            if held is None:
+                return
+            mine = {r.id for r in refs}
+            held[:] = [r for r in held if r.id not in mine]
+            if not held:
+                del self._refs[version]
+
+    def _next_version(self) -> int:
+        listing = self._worker.conductor.call("get_weight_versions",
+                                              timeout=30.0)
+        rec = (listing.get("names") or {}).get(self.name)
+        return (int(rec["latest"]) + 1) if rec else 1
+
+    # ----------------------------------------------------------------- gc
+
+    def _on_weights_msg(self, msg: Any) -> None:
+        """Registry notices: drop refs for GC'd/reaped chunks so the
+        refcount layer frees this process's store entries. Notices name
+        EXPLICIT object ids — dropping by version number alone would
+        also free a NEW publish in flight under a reused version number
+        (the gang-resize supersede case)."""
+        if not isinstance(msg, dict) or msg.get("name") != self.name:
+            return
+        if msg.get("kind") not in ("gc", "reaped"):
+            return
+        ids = set(msg.get("object_ids") or ())
+        with self._lock:
+            if ids:
+                for v in list(self._refs):
+                    held = self._refs[v]
+                    held[:] = [r for r in held if r.id not in ids]
+                    if not held:
+                        del self._refs[v]
+            else:
+                # id-less notice (older conductor): version-scoped drop
+                for v in msg.get("versions") or ():
+                    self._refs.pop(int(v), None)
+
+    def held_versions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._refs)
+
+    def close(self) -> None:
+        """Drop every held version's chunks and the pubsub callback."""
+        try:
+            self._worker.unsubscribe_channel("weights",
+                                             self._on_weights_msg)
+        except Exception:  # noqa: BLE001 — worker already torn down
+            pass
+        with self._lock:
+            self._refs.clear()
+
+
+# Module-level publishers, one per name: refs must outlive publish() —
+# they ARE the chunks' lifetime — so `weights.publish(...)` keeps its
+# publisher (and the refs it holds) alive in the process.
+_publishers: Dict[str, WeightPublisher] = {}
+_publishers_lock = threading.Lock()
+
+
+def publish(tree: Any, *, name: str = "default",
+            step: Optional[int] = None, version: Optional[int] = None,
+            run_id: str = "") -> int:
+    """Publish from a per-name process-cached :class:`WeightPublisher`
+    (`ray_tpu.train.report(..., publish_weights=...)` lands here)."""
+    cur = _worker()
+    with _publishers_lock:
+        pub = _publishers.get(name)
+        if pub is None or pub._worker is not cur:
+            # a publisher from a previous init/shutdown cycle holds a
+            # dead worker (and chunks that died with it) — replace it
+            pub = _publishers[name] = WeightPublisher(name)
+    return pub.publish(tree, step=step, version=version, run_id=run_id)
+
+
+def _reset_publishers() -> None:
+    """Test/shutdown hook: drop cached publishers (and their chunks)."""
+    with _publishers_lock:
+        for pub in _publishers.values():
+            pub.close()
+        _publishers.clear()

@@ -1,0 +1,225 @@
+"""Consumer side of the live weight fabric: reshard-on-fetch.
+
+A fetch pulls ONLY the chunks the target sharding needs and assembles
+each device's shard with ``jax.make_array_from_callback`` — the exact
+``restore(like=)`` contract (the assembly IS
+``async_checkpoint._LeafReader`` with a chunk-fetching loader), so a
+dp/fsdp training layout feeds a tp inference layout with no intermediate
+full array on any host.
+
+Per-fetch accounting (:class:`FetchStats`) records bytes pulled over the
+object plane and the largest single slice any read materialized — the
+e2e acceptance asserts from these that no process ever assembled a full
+unsharded copy of a sharded leaf.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private.object_store import ObjectRef
+from ray_tpu.train.async_checkpoint import _LeafReader, materialize_like
+
+from ._common import require_worker
+from .metrics import weight_metrics
+
+
+def _worker():
+    return require_worker("fetching weights")
+
+
+@dataclass
+class FetchStats:
+    """Accounting for one fetch() call."""
+
+    version: int = 0
+    chunks_fetched: int = 0        # pulled over the object plane
+    chunks_local: int = 0          # already in this process's store
+    fetched_bytes: int = 0         # remote bytes only
+    max_read_bytes: int = 0        # largest single assembled slice
+    # per-leaf: (largest single read, full leaf nbytes) — the
+    # no-full-copy assertion compares these for sharded leaves
+    leaf_read_bytes: List[Any] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+
+class _ChunkFetcher:
+    """Per-fetch chunk cache: each needed chunk crosses the object plane
+    at most once per fetch, with remote-vs-local accounting."""
+
+    def __init__(self, worker, stats: FetchStats):
+        self._worker = worker
+        self._stats = stats
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def __call__(self, shard: Dict[str, Any]) -> np.ndarray:
+        oid = shard["object_id"]
+        arr = self._cache.get(oid)
+        if arr is not None:
+            return arr
+        was_local = self._worker.store.contains(oid)
+        ref = ObjectRef(oid, locator=tuple(shard["locator"]),
+                        owner=tuple(shard["locator"]))
+        arr = np.asarray(self._worker.get(ref, timeout=60.0))
+        if was_local:
+            self._stats.chunks_local += 1
+        else:
+            self._stats.chunks_fetched += 1
+            self._stats.fetched_bytes += int(shard["nbytes"])
+        self._cache[oid] = arr
+        return arr
+
+
+class _AccountingReader(_LeafReader):
+    """_LeafReader that records the size of every assembled slice."""
+
+    def __init__(self, shape, dtype, shards, loader, stats: FetchStats,
+                 leaf_index: int):
+        super().__init__(None, shape, dtype, shards, loader=loader)
+        self._stats = stats
+        self._leaf_index = leaf_index
+
+    def read(self, index):
+        out = super().read(index)
+        nbytes = int(out.nbytes)
+        self._stats.max_read_bytes = max(self._stats.max_read_bytes,
+                                         nbytes)
+        rec = self._stats.leaf_read_bytes[self._leaf_index]
+        rec["max_read_bytes"] = max(rec["max_read_bytes"], nbytes)
+        return out
+
+
+class WeightSubscriber:
+    """Fetches versions of one named weight set into this process.
+
+    Rides the `weights` pubsub channel for publish notifications (with a
+    registry poll as the fallback path); :meth:`fetch` pulls a version
+    under a target sharding template.
+    """
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._worker = _worker()
+        self._cv = threading.Condition()
+        self.last_stats: Optional[FetchStats] = None
+        self._worker.subscribe_channel("weights", self._on_weights_msg)
+
+    def _on_weights_msg(self, msg: Any) -> None:
+        """Pure wakeup: waiters re-poll the registry, which stays the
+        single source of truth for what is actually committed."""
+        if not isinstance(msg, dict) or msg.get("name") != self.name:
+            return
+        if msg.get("kind") == "published":
+            with self._cv:
+                self._cv.notify_all()
+
+    # ------------------------------------------------------------ queries
+
+    def latest_version(self) -> Optional[int]:
+        """Latest committed version in the registry, or None. An O(1)
+        RPC — polled at staleness-check cadence by every replica, so it
+        must not ship the manifest's chunk tables each time."""
+        v = self._worker.conductor.call("weights_latest_version",
+                                        self.name, timeout=30.0)
+        return None if v is None else int(v)
+
+    def wait_for_version(self, min_version: int,
+                         timeout: float = 30.0) -> int:
+        """Block until a version >= min_version is committed; returns
+        the latest version. Pubsub-driven with a bounded registry poll
+        as the safety net (a conductor restart drops subscriptions)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            latest = self.latest_version()
+            if latest is not None and latest >= min_version:
+                return latest
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no version >= {min_version} of {self.name!r} "
+                    f"within {timeout}s (latest: {latest})")
+            with self._cv:
+                self._cv.wait(min(remaining, 0.5))
+
+    # -------------------------------------------------------------- fetch
+
+    def fetch(self, *, version: Optional[int] = None,
+              like: Any = None) -> Any:
+        """Materialize `version` (latest when None).
+
+        ``like=template``: template-leaf shardings drive the assembly —
+        each device reads only its own slice, fetching only the chunks
+        that slice intersects (reshard-on-fetch). ``like=None`` returns
+        plain numpy leaves via the producer's treedef (debug/CLI path —
+        this one DOES assemble full arrays; serving should always pass
+        a template)."""
+        stats = FetchStats()
+        t0 = time.perf_counter()
+        manifest = self._worker.conductor.call(
+            "weights_get_manifest", self.name, version, timeout=30.0)
+        if manifest is None:
+            raise KeyError(
+                f"no committed version {'(latest)' if version is None else version} "
+                f"of weights {self.name!r} in the registry")
+        stats.version = int(manifest["version"])
+        fetcher = _ChunkFetcher(self._worker, stats)
+        readers: List[_AccountingReader] = []
+        for i, leaf in enumerate(manifest["leaves"]):
+            shape = tuple(leaf["shape"])
+            dtype = np.dtype(leaf["dtype"])
+            full = int(np.prod(shape)) * dtype.itemsize if shape \
+                else dtype.itemsize
+            stats.leaf_read_bytes.append(
+                {"leaf": i, "max_read_bytes": 0, "full_nbytes": full})
+            readers.append(_AccountingReader(
+                shape, dtype, leaf["shards"], fetcher, stats, i))
+        if like is None:
+            if manifest.get("treedef") is None:
+                raise ValueError(
+                    f"version {stats.version} of {self.name!r} carries "
+                    "no treedef (host-0 fragment missing it); pass "
+                    "like= to fetch")
+            treedef = pickle.loads(manifest["treedef"])
+            leaves = [r.read(tuple(slice(0, d) for d in r.shape))
+                      for r in readers]
+            import jax
+
+            out = jax.tree.unflatten(treedef, leaves)
+        else:
+            import jax
+
+            _, treedef = jax.tree.flatten(like)
+            if treedef.num_leaves != len(readers):
+                raise ValueError(
+                    f"template has {treedef.num_leaves} leaves but "
+                    f"version {stats.version} of {self.name!r} was "
+                    f"published with {len(readers)}")
+            out = materialize_like(readers, treedef, like)
+        stats.elapsed_s = time.perf_counter() - t0
+        self.last_stats = stats
+        m = weight_metrics()
+        m["fetches"].inc(1, tags={"name": self.name})
+        if stats.fetched_bytes:
+            m["fetched_bytes"].inc(stats.fetched_bytes,
+                                   tags={"name": self.name})
+        try:
+            self._worker.conductor.notify("report_weight_event", {
+                "kind": "fetch", "name": self.name,
+                "version": stats.version,
+                "fetched_bytes": stats.fetched_bytes,
+                "chunks": stats.chunks_fetched + stats.chunks_local})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        return out
+
+    def close(self) -> None:
+        try:
+            self._worker.unsubscribe_channel("weights",
+                                             self._on_weights_msg)
+        except Exception:  # noqa: BLE001 — worker already torn down
+            pass

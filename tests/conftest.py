@@ -33,6 +33,25 @@ def pytest_configure(config):
         "(ray_tpu.resilience.chaos); the tier-1-safe smoke subset runs "
         "on a virtual cluster, heavier replays are also marked slow — "
         "select with `-m chaos`")
+    config.addinivalue_line(
+        "markers", "weights: live weight fabric scenarios "
+        "(ray_tpu.weights); the tier-1-safe smoke subset runs on a "
+        "virtual cluster with log_to_driver=0 — select with "
+        "`-m weights`")
+
+
+def _sweep_leaked_shm():
+    """Chaos/kill tests SIGKILL workers, which cannot unlink their shm
+    arena segments; sweep after every cluster so a leak in one test
+    cannot degrade (or fail) the rest of the tier-1 run. Redundant with
+    ray_tpu.shutdown()'s own sweep on the happy path — this one also
+    runs when shutdown() raised before reaching its sweep."""
+    from ray_tpu._private.object_store import cleanup_leaked_segments
+
+    try:
+        cleanup_leaked_segments()
+    except Exception:  # noqa: BLE001 — sweep is best-effort
+        pass
 
 
 @pytest.fixture
@@ -42,6 +61,7 @@ def ray_start_regular():
     info = ray_tpu.init(num_cpus=4)
     yield info
     ray_tpu.shutdown()
+    _sweep_leaked_shm()
 
 
 @pytest.fixture(scope="module")
@@ -52,6 +72,7 @@ def ray_start_shared():
     info = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     yield info
     ray_tpu.shutdown()
+    _sweep_leaked_shm()
 
 
 @pytest.fixture

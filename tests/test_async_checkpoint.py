@@ -74,6 +74,90 @@ def test_restore_onto_different_mesh_bit_exact(tmp_path):
     assert int(restored["step"]) == 7
 
 
+# Round-trip property over unequal source/target mesh shapes: the
+# weights fabric (ray_tpu.weights) reuses this exact reshard-on-fetch
+# path, so its contract is pinned here before anything depends on it.
+# Shape (16, 8) divides by every axis product below.
+RESHARD_MESHES = [
+    ([("dp", 2), ("fsdp", 4)], [("dp", 8)]),
+    ([("dp", 8)], [("dp", 2), ("fsdp", 4)]),
+    ([("dp", 2), ("fsdp", 4)], [("dp", 4), ("fsdp", 2)]),
+    ([("dp", 4), ("fsdp", 2)], [("dp", 2), ("fsdp", 2)]),  # fewer devices
+    ([("dp", 2), ("fsdp", 2)], [("dp", 8)]),               # more devices
+]
+
+
+def _axis_specs(axes):
+    """A spec set exercising row-, column-, mixed- and un-sharded leaves
+    for whatever axis names the mesh has."""
+    names = [a for a, _ in axes]
+    first = names[0]
+    rest = tuple(names[1:]) or None
+    return {
+        "w_rows": ((16, 8), P(tuple(names), None)),
+        "w_cols": ((16, 8), P(None, tuple(names))),
+        "w_mixed": ((16, 8), P(first, rest)),
+        "w_rep": ((16, 8), P(None, None)),
+    }
+
+
+@pytest.mark.parametrize("src_axes,dst_axes", RESHARD_MESHES)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_restore_reshard_roundtrip_property(tmp_path, src_axes, dst_axes,
+                                            seed):
+    """For every (source mesh, target mesh) pair and every sharding
+    style, save-then-restore(like=) is bit-exact and lands the
+    template's sharding."""
+    mesh_src = _mesh(src_axes)
+    state = _sharded_state(mesh_src, _axis_specs(src_axes), seed=seed)
+    d = str(tmp_path / "ck")
+    ac.async_save(d, state).wait()
+
+    mesh_dst = _mesh(dst_axes)
+    like = {
+        k: jax.device_put(np.zeros(shape, np.float32),
+                          NamedSharding(mesh_dst, spec))
+        for k, (shape, spec) in _axis_specs(dst_axes).items()}
+    like["step"] = jnp.int32(0)
+    restored = ac.restore(d, like=like)
+    for k in _axis_specs(src_axes):
+        np.testing.assert_array_equal(np.asarray(restored[k]),
+                                      np.asarray(state[k]))
+        assert restored[k].sharding == like[k].sharding
+    assert int(restored["step"]) == 7
+
+
+def test_restore_like_dtype_cast_template(tmp_path):
+    """A template whose dtype differs from the stored one casts on
+    device (the serving layout may run bf16 off an fp32 training
+    checkpoint) — sharding still comes from the template."""
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    state = _sharded_state(mesh, SPECS, seed=9)
+    d = str(tmp_path / "ck")
+    ac.async_save(d, state).wait()
+
+    mesh_b = _mesh([("dp", 8)])
+    like = {
+        "w_fsdp": jax.device_put(np.zeros((16, 8), jnp.bfloat16),
+                                 NamedSharding(mesh_b, P("dp", None))),
+        "w_tp": jax.device_put(np.zeros((8, 16), np.float32),
+                               NamedSharding(mesh_b, P(None, "dp"))),
+        "w_rep": jax.device_put(np.zeros((4, 4), np.float16),
+                                NamedSharding(mesh_b, P(None, None))),
+        "step": jnp.int32(0),
+    }
+    restored = ac.restore(d, like=like)
+    assert restored["w_fsdp"].dtype == jnp.bfloat16
+    assert restored["w_rep"].dtype == np.float16
+    assert restored["w_tp"].dtype == np.float32  # same dtype: no cast
+    for k in SPECS:
+        np.testing.assert_array_equal(
+            np.asarray(restored[k], dtype=np.float32),
+            np.asarray(np.asarray(state[k]).astype(like[k].dtype),
+                       dtype=np.float32))
+        assert restored[k].sharding == like[k].sharding
+
+
 def test_save_returns_before_write_completes(tmp_path):
     """report/save must not block on disk I/O (async done-criterion)."""
     mesh = _mesh([("dp", 8)])

@@ -409,6 +409,104 @@ def test_resume_matches_uninterrupted_run(tmp_path, monkeypatch):
                                                    rel=1e-12)
 
 
+def _async_grace_train_fn(cfg):
+    """_sgd_train_fn with the grace checkpoint taken through an
+    AsyncCheckpointer whose artificial write delay far exceeds the test
+    budget — only the preemption-driven expedite path can commit it in
+    time."""
+    import tempfile
+    import time as _t
+
+    import numpy as _np
+
+    from ray_tpu.train import (get_checkpoint, get_context,
+                               preemption_requested, report)
+    from ray_tpu.train import async_checkpoint as _ac
+
+    ctx = get_context()
+    ckpter = _ac.AsyncCheckpointer()
+    ckpter._test_write_delay = float(cfg.get("write_delay", 0.0))
+    step, w = 0, _np.full(4, 5.0)
+    ck = get_checkpoint()
+    if ck is not None:
+        st = _ac.restore(ck.path)
+        step, w = int(st["step"]), _np.asarray(st["w"])
+    graced = False
+    while step < int(cfg["n_steps"]):
+        step += 1
+        loss = float((w ** 2).sum())
+        w = w - 0.2 * w
+        ckpt = None
+        if preemption_requested() is not None and not graced:
+            graced = True
+            d = tempfile.mkdtemp(prefix="agrace_")
+            ckpt = ckpter.save(d, {"step": _np.int64(step), "w": w})
+        report({"step": step, "loss": loss,
+                "world": ctx.get_world_size()}, checkpoint=ckpt)
+        if cfg.get("step_sleep"):
+            _t.sleep(float(cfg["step_sleep"]))
+
+
+@pytest.mark.chaos
+def test_async_grace_checkpoint_commits_within_window(tmp_path,
+                                                      monkeypatch):
+    """Async-checkpoint grace flow (ISSUE-5 satellite): an in-flight
+    AsyncCheckpointer save at preemption time is expedited and committed
+    promptly — persisted into pending/ from the commit hook BEFORE the
+    chaos kill lands — so the restart resumes from the grace checkpoint
+    instead of scratch. The 60s artificial write delay guards both
+    halves: without expedite the fit would block out the assert budget,
+    without commit-time persistence the resume would start at step 1."""
+    from ray_tpu.train import (FailureConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+    from ray_tpu.util import state
+
+    ray_tpu.init(num_cpus=4, _system_config={
+        "log_to_driver": 0,
+        "restart_backoff_base_s": 0.1,
+        "restart_backoff_max_s": 0.2,
+    })
+    try:
+        # generous step spacing: the preemption broadcast rides pubsub
+        # and must land on the workers BEFORE the kill step even on a
+        # loaded machine — too-tight spacing flakes into
+        # resume-from-scratch
+        monkeypatch.setenv("RAY_TPU_CHAOS_PLAN", json.dumps([
+            {"action": "preempt", "node": "head", "grace_s": 15.0,
+             "at_step": 2},
+            {"action": "kill", "rank": 1, "at_step": 6},
+        ]))
+        t0 = time.monotonic()
+        result = JaxTrainer(
+            _async_grace_train_fn,
+            train_loop_config={"n_steps": N_STEPS, "step_sleep": 0.15,
+                               "write_delay": 60.0},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         setup_jax_distributed=False),
+            run_config=RunConfig(name="async-grace",
+                                 storage_path=str(tmp_path),
+                                 failure_config=FailureConfig(
+                                     max_failures=2)),
+            mode="workers").fit()
+        elapsed = time.monotonic() - t0
+        assert result.error is None
+        # expedite really cut the 60s write delay short
+        assert elapsed < 45.0, elapsed
+        # the restart resumed from the grace checkpoint (taken at the
+        # step after the preemption broadcast), never from scratch
+        expected = _expected_losses(N_STEPS)
+        assert result.metrics["step"] == N_STEPS
+        for m in result.metrics_history:
+            assert m["loss"] == pytest.approx(expected[m["step"] - 1],
+                                              rel=1e-12)
+        first_resumed = result.metrics_history[0]["step"]
+        assert 3 < first_resumed <= 7, first_resumed
+        st = state.resilience_status()
+        assert st["counters"].get("grace_checkpoint", 0) >= 1
+    finally:
+        ray_tpu.shutdown()
+
+
 # ------------------------------ end-to-end chaos scenario (tier-1 accept)
 
 @pytest.fixture

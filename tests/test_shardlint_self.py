@@ -31,7 +31,7 @@ def test_package_lint_covers_the_whole_tree():
         if any(n.endswith(".py") for n in filenames):
             seen.add(os.path.relpath(dirpath, PACKAGE_ROOT).split(
                 os.sep)[0])
-    assert {"serve", "parallel", "train", "resilience"} <= seen
+    assert {"serve", "parallel", "train", "resilience", "weights"} <= seen
 
 
 def test_driver_entry_is_clean_too():

@@ -1,0 +1,591 @@
+"""Live weight fabric (ray_tpu.weights, ISSUE-5 acceptance surface):
+versioned train→serve weight publication with reshard-on-fetch and
+between-tick hot swap.
+
+The `weights` marker tags the fabric scenarios; everything here is the
+tier-1-safe smoke subset (virtual 8-device CPU cluster, log_to_driver=0
+per the established fixture pattern)."""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu import weights as wts
+
+
+def _mesh(axes):
+    devs = np.array(jax.devices()[:int(np.prod([n for _, n in axes]))])
+    return Mesh(devs.reshape([n for _, n in axes]), [a for a, _ in axes])
+
+
+def _put(mesh, spec, arr):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+@pytest.fixture
+def weights_cluster():
+    ray_tpu.init(num_cpus=4, _system_config={
+        "log_to_driver": 0,
+        "weights_keep": 2,
+    })
+    yield ray_tpu._private.worker.global_worker
+    ray_tpu.shutdown()
+
+
+def _tree(mesh, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w_big": _put(mesh, P(("dp", "fsdp"), None),
+                      (rng.standard_normal((64, 16)) * scale).astype(
+                          np.float32)),
+        "w_col": _put(mesh, P(None, ("dp", "fsdp")),
+                      rng.standard_normal((4, 32)).astype(np.float32)),
+        "bias": _put(mesh, P(None),
+                     rng.standard_normal(16).astype(np.float32)),
+        "step": jnp.int32(7),
+    }
+
+
+# --------------------------------------------------- publish / fetch core
+
+@pytest.mark.weights
+def test_publish_fetch_reshard_roundtrip(weights_cluster):
+    """dp/fsdp-published weights fetched under a tp layout: values are
+    bit-equal, shardings are the TEMPLATE's, and no read ever assembled
+    a full copy of a sharded leaf (the no-single-host-gather invariant,
+    consumer side)."""
+    mesh_train = _mesh([("dp", 2), ("fsdp", 4)])
+    state = _tree(mesh_train, seed=3)
+    version = wts.publish(state, name="roundtrip", step=11)
+    assert version == 11
+
+    # producer side of the invariant: every shard of a sharded leaf is
+    # a strict subset of the leaf — nothing gathered before publish
+    w = weights_cluster
+    manifest = w.conductor.call("weights_get_manifest", "roundtrip", None,
+                                timeout=10.0)
+    assert manifest["version"] == 11 and manifest["num_hosts"] == 1
+    by_bytes = {tuple(lf["shape"]): lf for lf in manifest["leaves"]}
+    big = by_bytes[(64, 16)]
+    assert len(big["shards"]) == 8
+    full_nbytes = 64 * 16 * 4
+    for sh in big["shards"]:
+        assert sh["nbytes"] == full_nbytes // 8 < full_nbytes
+
+    mesh_tp = _mesh([("tp", 8)])
+    like = {
+        "w_big": _put(mesh_tp, P(None, "tp"),
+                      np.zeros((64, 16), np.float32)),
+        "w_col": _put(mesh_tp, P(None, "tp"),
+                      np.zeros((4, 32), np.float32)),
+        "bias": _put(mesh_tp, P(None), np.zeros(16, np.float32)),
+        "step": jnp.int32(0),
+    }
+    sub = wts.WeightSubscriber("roundtrip")
+    fetched = sub.fetch(like=like)
+    for k in ("w_big", "w_col", "bias"):
+        np.testing.assert_array_equal(np.asarray(fetched[k]),
+                                      np.asarray(state[k]))
+        assert fetched[k].sharding == like[k].sharding
+    assert int(fetched["step"]) == 7
+    stats = sub.last_stats
+    assert stats.version == 11
+    # consumer side of the invariant: the largest single assembled slice
+    # of the big sharded leaf is its per-device share, never the whole
+    for rec in stats.leaf_read_bytes:
+        if rec["full_nbytes"] == full_nbytes:
+            assert 0 < rec["max_read_bytes"] <= full_nbytes // 8
+    sub.close()
+
+
+@pytest.mark.weights
+def test_fetch_without_template_returns_numpy(weights_cluster):
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    state = _tree(mesh, seed=5)
+    wts.publish(state, name="plain", step=1)
+    sub = wts.WeightSubscriber("plain")
+    out = sub.fetch()
+    np.testing.assert_array_equal(out["w_big"], np.asarray(state["w_big"]))
+    assert isinstance(out["w_big"], np.ndarray)
+    sub.close()
+
+
+@pytest.mark.weights
+def test_multi_host_fragments_merge(weights_cluster, monkeypatch):
+    """Two per-host publishers (each contributing only its own half of
+    the rows) commit ONE joint version; the consumer assembles across
+    both hosts' chunks. The version is invisible until the LAST
+    fragment lands (atomic commit)."""
+    from ray_tpu.weights import publisher as pub_mod
+
+    mesh = _mesh([("dp", 8)])
+    full = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+    arr = _put(mesh, P("dp", None), full)
+    real = pub_mod._leaf_snapshots
+
+    def half(lo, hi):
+        def snap(leaf):
+            meta, shards = real(leaf)
+            if getattr(leaf, "ndim", 0):
+                shards = [(idx, a) for idx, a in shards
+                          if lo <= idx[0][0] < hi]
+            return meta, shards
+        return snap
+
+    host0 = wts.WeightPublisher("joint", host_rank=0, num_hosts=2)
+    host1 = wts.WeightPublisher("joint", host_rank=1, num_hosts=2)
+    sub = wts.WeightSubscriber("joint")
+    monkeypatch.setattr(pub_mod, "_leaf_snapshots", half(0, 32))
+    host0.publish({"w": arr}, step=1)
+    # only one of two hosts committed: nothing visible yet
+    assert sub.latest_version() is None
+    listing = weights_cluster.conductor.call("get_weight_versions",
+                                             timeout=10.0)
+    assert [p["version"] for p in listing["pending"]] == [1]
+    monkeypatch.setattr(pub_mod, "_leaf_snapshots", half(32, 64))
+    host1.publish({"w": arr}, step=1)
+    assert sub.wait_for_version(1, timeout=10.0) == 1
+    like = {"w": _put(mesh, P(None, "dp"), np.zeros((64, 8), np.float32))}
+    out = sub.fetch(like=like)
+    np.testing.assert_array_equal(np.asarray(out["w"]), full)
+    for p in (host0, host1):
+        p.close()
+    sub.close()
+
+
+# ------------------------------------------------------- GC and reaping
+
+@pytest.mark.weights
+def test_version_gc_keeps_exactly_k(weights_cluster):
+    """weights_keep=2 (fixture): the registry keeps exactly the two
+    newest manifests and the producers' chunks for dropped versions are
+    freed (gc notice over the weights pubsub)."""
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    pub = wts.WeightPublisher("gc-test")
+    for step in range(1, 5):
+        pub.publish(_tree(mesh, seed=step), step=step)
+    w = weights_cluster
+    listing = w.conductor.call("get_weight_versions", timeout=10.0)
+    rec = listing["names"]["gc-test"]
+    assert rec["latest"] == 4
+    assert [v["version"] for v in rec["versions"]] == [3, 4]
+    assert w.conductor.call("weights_get_manifest", "gc-test", 1,
+                            timeout=10.0) is None
+    # the publisher dropped its refs for v1/v2 (pubsub gc notice)
+    deadline = time.monotonic() + 10.0
+    while pub.held_versions() != [3, 4]:
+        assert time.monotonic() < deadline, pub.held_versions()
+        time.sleep(0.05)
+    # a subscriber asking for a GC'd version gets a clean error
+    sub = wts.WeightSubscriber("gc-test")
+    with pytest.raises(KeyError):
+        sub.fetch(version=1, like=None)
+    # operator GC down to one version
+    assert w.conductor.call("weights_gc", "gc-test", 1, timeout=10.0) == 1
+    listing = w.conductor.call("get_weight_versions", timeout=10.0)
+    assert [v["version"] for v in
+            listing["names"]["gc-test"]["versions"]] == [4]
+    pub.close()
+    sub.close()
+
+
+@pytest.mark.weights
+def test_interrupted_publish_never_visible_and_reaped(weights_cluster):
+    """Chaos-kill on the producer mid-publish: an actor puts its chunks
+    and commits host 0's fragment of a 2-host publish, then dies. The
+    partial version must never become visible and must be reaped."""
+    w = weights_cluster
+
+    @ray_tpu.remote
+    class HalfProducer:
+        def publish_fragment(self):
+            import numpy as np
+
+            from ray_tpu import weights as wts
+
+            pub = wts.WeightPublisher("torn", host_rank=0, num_hosts=2)
+            # plain numpy leaf: process 0 contributes it whole
+            pub.publish({"w": np.ones((8, 8), np.float32)}, step=1)
+            self._pub = pub  # keep refs alive until the kill
+            return True
+
+    prod = HalfProducer.remote()
+    assert ray_tpu.get(prod.publish_fragment.remote(), timeout=60.0)
+    sub = wts.WeightSubscriber("torn")
+    assert sub.latest_version() is None
+    ray_tpu.kill(prod)  # the chaos: producer dies before host 1 commits
+    assert w.conductor.call("weights_reap", 0.0, timeout=10.0) == 1
+    listing = w.conductor.call("get_weight_versions", timeout=10.0)
+    assert "torn" not in listing["names"]
+    assert listing["pending"] == []
+    kinds = [e["kind"] for e in w.conductor.call("get_weight_events",
+                                                 100, timeout=10.0)
+             if e.get("name") == "torn"]
+    assert "reap" in kinds and "publish" not in kinds
+    # the name is reusable after the reap
+    mesh = _mesh([("dp", 8)])
+    wts.publish({"w": _put(mesh, P("dp", None),
+                           np.zeros((8, 8), np.float32))},
+                name="torn", step=2)
+    assert sub.wait_for_version(2, timeout=10.0) == 2
+    sub.close()
+
+
+@pytest.mark.weights
+def test_gang_resize_supersedes_stale_pending(weights_cluster):
+    """A crash mid-publish leaves a pending entry with the OLD gang
+    size; the re-formed (resized) gang replaying the same step must
+    supersede it — not crash-loop on a num_hosts mismatch — and the
+    supersede reap must free exactly the old fragments' chunks, never
+    the new publisher's in-flight chunks under the same version."""
+    mesh = _mesh([("dp", 8)])
+    a1 = _put(mesh, P("dp", None),
+              np.arange(64, dtype=np.float32).reshape(8, 8))
+    a2 = _put(mesh, P("dp", None),
+              np.arange(64, dtype=np.float32).reshape(8, 8) * 2)
+    old = wts.WeightPublisher("resize", host_rank=0, num_hosts=2)
+    old.publish({"w": a1}, step=1)  # gang dies before host 1 commits
+    assert old.held_versions() == [1]
+    # elastic re-form to a single host; the restart replays step 1
+    new = wts.WeightPublisher("resize", host_rank=0, num_hosts=1)
+    assert new.publish({"w": a2}, step=1) == 1
+    sub = wts.WeightSubscriber("resize")
+    out = sub.fetch()
+    np.testing.assert_array_equal(out["w"], np.asarray(a2))
+    # the supersede notice freed the OLD gang's orphan fragments...
+    deadline = time.monotonic() + 10.0
+    while old.held_versions():
+        assert time.monotonic() < deadline, old.held_versions()
+        time.sleep(0.05)
+    # ...but not the committed publish sharing the version number
+    assert new.held_versions() == [1]
+    np.testing.assert_array_equal(sub.fetch()["w"], np.asarray(a2))
+    for p in (old, new):
+        p.close()
+    sub.close()
+
+
+@pytest.mark.weights
+def test_rollback_republish_served_not_gcd(weights_cluster):
+    """A gang restarted from an older checkpoint republishes LOWER
+    version numbers. The registry orders by commit recency: the
+    rollback's publish becomes `latest` (subscribers follow the live
+    trainer) and GC drops the oldest-committed version, never the one
+    just published."""
+    mesh = _mesh([("dp", 8)])
+
+    def tree(x):
+        return {"w": _put(mesh, P("dp", None),
+                          np.full((8, 8), x, np.float32))}
+
+    pub = wts.WeightPublisher("rollback")
+    pub.publish(tree(5.0), step=5)
+    pub.publish(tree(6.0), step=6)
+    # ... crash, restart from the step-1 checkpoint, retrain to step 2
+    pub.publish(tree(2.0), step=2)
+    w = weights_cluster
+    assert w.conductor.call("weights_latest_version", "rollback",
+                            timeout=10.0) == 2
+    rec = w.conductor.call("get_weight_versions",
+                           timeout=10.0)["names"]["rollback"]
+    assert rec["latest"] == 2
+    # keep-2 by commit recency: v5 (oldest committed) dropped, v6+v2 kept
+    assert sorted(v["version"] for v in rec["versions"]) == [2, 6]
+    sub = wts.WeightSubscriber("rollback")
+    out = sub.fetch()  # latest == the rollback's weights
+    np.testing.assert_array_equal(out["w"], np.full((8, 8), 2.0,
+                                                    np.float32))
+    sub.close()
+    pub.close()
+
+
+@pytest.mark.weights
+def test_duplicate_version_rejected(weights_cluster):
+    mesh = _mesh([("dp", 8)])
+    tree = {"w": _put(mesh, P("dp", None), np.ones((8, 8), np.float32))}
+    wts.publish(tree, name="dup", step=1)
+    with pytest.raises(ValueError, match="already committed"):
+        wts.publish(tree, name="dup", step=1)
+    # the rejection dropped only the DUPLICATE's refs: the committed
+    # version's chunks must still be alive and fetchable
+    sub = wts.WeightSubscriber("dup")
+    out = sub.fetch(version=1, like=None)
+    np.testing.assert_array_equal(out["w"], np.ones((8, 8), np.float32))
+    sub.close()
+    # unversioned publish picks latest+1
+    assert wts.publish(tree, name="dup") == 2
+
+
+@pytest.mark.weights
+def test_report_publish_versions_survive_restart(weights_cluster,
+                                                 tmp_path):
+    """Version defaulting across trainer attempts: without a 'step'
+    metric the registry assigns latest+1 (the per-attempt report count
+    must not name versions — it resets on restart); with an explicit
+    step, a restarted attempt replaying an already-published step is an
+    idempotent no-op, never a gang-killing error."""
+    from ray_tpu.train import JaxTrainer, RunConfig, report
+
+    mesh = _mesh([("dp", 8)])
+    tree = {"w": _put(mesh, P("dp", None), np.ones((8, 8), np.float32))}
+
+    def no_step_fn(_):
+        report({"loss": 1.0}, publish_weights=tree, weights_name="mono")
+
+    rc = RunConfig(name="mono-run", storage_path=str(tmp_path))
+    JaxTrainer(no_step_fn, run_config=rc).fit()
+    JaxTrainer(no_step_fn, run_config=rc).fit()  # "restarted" attempt
+    w = weights_cluster
+    listing = w.conductor.call("get_weight_versions", timeout=10.0)
+    assert listing["names"]["mono"]["latest"] == 2
+
+    def replay_fn(_):
+        # explicit step already committed: must not raise
+        report({"step": 2}, publish_weights=tree, weights_name="mono")
+        report({"step": 3}, publish_weights=tree, weights_name="mono")
+
+    result = JaxTrainer(replay_fn, run_config=rc).fit()
+    assert result.error is None
+    listing = w.conductor.call("get_weight_versions", timeout=10.0)
+    assert listing["names"]["mono"]["latest"] == 3
+
+
+# ------------------------------------------------------- engine hot swap
+
+@pytest.mark.weights
+def test_engine_hot_swap_between_ticks():
+    """update_params applies between decode ticks: the in-flight request
+    completes without error, and post-swap generations are bit-identical
+    to a fresh engine started from the same weights."""
+    import concurrent.futures as cf
+
+    from ray_tpu.models.engine import ContinuousBatchingEngine
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+    params_a = gpt2_init(cfg, jax.random.PRNGKey(0))
+    params_b = jax.tree.map(lambda x: x * 1.25, params_a)
+
+    eng = ContinuousBatchingEngine(params_a, cfg, max_batch=2,
+                                   params_version=1)
+    fresh = ContinuousBatchingEngine(params_b, cfg, max_batch=2,
+                                     params_version=2)
+    try:
+        with cf.ThreadPoolExecutor(1) as pool:
+            long_fut = pool.submit(eng.generate, [1, 2, 3], 60)
+            time.sleep(0.15)  # the request is mid-decode now
+            applied = eng.update_params(params_b, version=2)
+            assert applied.wait(timeout=30.0)
+            long_toks = long_fut.result(timeout=120)
+        assert len(long_toks) == 60  # completed, no drop, no error
+        assert eng.params_version == 2 and eng.swap_count == 1
+        for prompt in ([5, 6], [9, 9, 9, 9]):
+            assert eng.generate(prompt, 8) == fresh.generate(prompt, 8)
+    finally:
+        eng.stop()
+        fresh.stop()
+    # a swap queued AFTER stop() must not strand its waiter: the dead
+    # decode loop can never apply it, so it applies synchronously
+    late = eng.update_params(params_a, version=3)
+    assert late.wait(timeout=5.0)
+    assert eng.params_version == 3
+
+
+# ------------------------------------------------- e2e train -> serve
+
+@pytest.mark.weights
+def test_train_publish_serve_hotswap_e2e(weights_cluster, tmp_path,
+                                         monkeypatch):
+    """ISSUE-5 acceptance: a training gang publishes at step N under a
+    train layout (row-sharded over dp x fsdp); a serve replica running
+    the continuous-batching engine hot-swaps to it between decode ticks
+    under an inference layout (column-sharded over tp); post-swap
+    generations are bit-identical to a fresh engine from the same
+    weights; an in-flight request started pre-swap completes; no process
+    assembled a full copy of a sharded leaf; and every surface
+    (weight_versions / CLI / dashboard / staleness gauge / timeline /
+    Prometheus) agrees on the registry state."""
+    from ray_tpu import serve
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_init
+    from ray_tpu.train import JaxTrainer, RunConfig, report
+    from ray_tpu.util import state
+
+    monkeypatch.setenv("RAY_TPU_METRICS_INTERVAL_S", "0.2")
+    w = weights_cluster
+    cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+
+    def train_specs(tree, axes):
+        return jax.tree.map(
+            lambda x: P(axes, None) if getattr(x, "ndim", 0) == 2
+            else P(), tree)
+
+    def shard(tree, mesh, axes):
+        specs = train_specs(tree, axes)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            tree, specs, is_leaf=lambda x: not isinstance(x, (dict, list)))
+
+    def train_fn(tcfg):
+        mesh = _mesh([("dp", 2), ("fsdp", 4)])
+        params = gpt2_init(cfg, jax.random.PRNGKey(42))
+        params = shard(params, mesh, ("dp", "fsdp"))
+        report({"step": 1}, publish_weights=params, weights_name="lm")
+
+    JaxTrainer(train_fn,
+               run_config=RunConfig(name="lm-train",
+                                    storage_path=str(tmp_path))).fit()
+    assert state.weight_versions("lm")["names"]["lm"]["latest"] == 1
+
+    serve.start()
+    try:
+        @serve.deployment
+        class LM:
+            def __init__(self):
+                from ray_tpu import weights as wts_mod
+                from ray_tpu.models.engine import \
+                    ContinuousBatchingEngine
+
+                mesh = _mesh([("tp", 8)])
+                template = gpt2_init(cfg, jax.random.PRNGKey(0))
+                template = jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(
+                            mesh,
+                            P(None, "tp") if getattr(x, "ndim", 0) == 2
+                            else P())),
+                    template,
+                    is_leaf=lambda x: not isinstance(x, (dict, list)))
+                self.template = template
+                self.sub = wts_mod.WeightSubscriber("lm")
+                params = self.sub.fetch(version=1, like=template)
+                self.engine = ContinuousBatchingEngine(
+                    params, cfg, max_batch=4, params_version=1)
+                self.sync = wts_mod.WeightSync(
+                    self.engine, "lm", template=template,
+                    consumer="replica-0", subscriber=self.sub)
+
+            def generate(self, prompt, n):
+                return self.engine.generate(list(prompt), int(n))
+
+            def fresh_generate(self, prompt, n):
+                """Fresh engine from the latest version's weights, same
+                process/devices/shardings — the bit-identity oracle."""
+                from ray_tpu import weights as wts_mod
+                from ray_tpu.models.engine import \
+                    ContinuousBatchingEngine
+
+                sub = wts_mod.WeightSubscriber("lm")
+                params = sub.fetch(like=self.template)
+                eng = ContinuousBatchingEngine(params, cfg, max_batch=2)
+                try:
+                    return eng.generate(list(prompt), int(n))
+                finally:
+                    eng.stop()
+                    sub.close()
+
+            def status(self):
+                return self.sync.status()
+
+        h = serve.run(LM.bind(), name="lm-app", route_prefix="/lm")
+        pre_swap = h.generate.remote([1, 2, 3], 8).result(timeout_s=120)
+        assert len(pre_swap) == 8
+
+        # v2 from the trainer layout while a long request is IN FLIGHT
+        long_resp = h.generate.remote([7, 8], 90)
+        time.sleep(0.1)
+        mesh_train = _mesh([("dp", 2), ("fsdp", 4)])
+        params2 = gpt2_init(cfg, jax.random.PRNGKey(42))
+        params2 = shard(jax.tree.map(lambda x: x * 1.1, params2),
+                        mesh_train, ("dp", "fsdp"))
+        assert wts.publish(params2, name="lm", step=2) == 2
+
+        deadline = time.monotonic() + 60.0
+        while True:
+            st = h.status.remote().result(timeout_s=60)
+            if st["serving_version"] == 2:
+                break
+            assert time.monotonic() < deadline, st
+            time.sleep(0.1)
+        # the pre-swap in-flight request completed without error
+        long_toks = long_resp.result(timeout_s=120)
+        assert len(long_toks) == 90
+        assert st["swap_count"] >= 1
+
+        # post-swap generations == fresh engine from the same weights
+        post = h.generate.remote([4, 5, 6], 10).result(timeout_s=120)
+        fresh = h.fresh_generate.remote([4, 5, 6], 10).result(timeout_s=120)
+        assert post == fresh
+
+        # fetched-bytes accounting: the replica never assembled a full
+        # copy of any sharded (2D, column-split 8-way) leaf
+        assert st["fetched_bytes"] > 0
+        big = [r for r in st["leaf_read_bytes"]
+               if r["full_nbytes"] > 10_000]
+        assert big, st["leaf_read_bytes"]
+        for rec in big:
+            assert rec["max_read_bytes"] <= rec["full_nbytes"] // 8
+
+        # every surface agrees on the registry state
+        listing = state.weight_versions()
+        assert listing["names"]["lm"]["latest"] == 2
+        assert st["latest_version"] == 2
+        assert st["staleness_versions"] == 0
+
+        from ray_tpu.scripts import cli
+        import io
+        import contextlib
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli.main(["weights", "list", "--json",
+                      "--address", "ignored:0"])
+        assert json.loads(buf.getvalue())["names"]["lm"]["latest"] == 2
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli.main(["weights", "inspect", "lm",
+                      "--address", "ignored:0"])
+        assert json.loads(buf.getvalue())["version"] == 2
+
+        import urllib.request
+
+        from ray_tpu.dashboard import DashboardServer
+
+        dash = DashboardServer(w.conductor_address, port=0).start()
+        try:
+            with urllib.request.urlopen(dash.url + "/api/weights",
+                                        timeout=10.0) as r:
+                payload = json.loads(r.read())
+            assert payload["names"]["lm"]["latest"] == 2
+        finally:
+            dash.stop()
+
+        # merged timeline carries publish/fetch/swap markers
+        trace = state.timeline(str(tmp_path / "merged.json"), merged=True)
+        kinds = {e["tid"] for e in trace if e.get("cat") == "weights"}
+        assert {"publish", "fetch", "swap"} <= kinds, kinds
+
+        # Prometheus: driver-side publish metrics now; replica-side
+        # staleness gauge rides the 0.2s push loop
+        from ray_tpu.util import metrics as metrics_mod
+
+        metrics_mod.flush()
+        deadline = time.monotonic() + 15.0
+        while True:
+            text = state.prometheus_metrics()
+            if ("ray_tpu_weights_publish_ms" in text
+                    and "ray_tpu_weights_staleness_versions" in text
+                    and "ray_tpu_weights_fetched_bytes_total" in text):
+                break
+            assert time.monotonic() < deadline, text[-2000:]
+            time.sleep(0.2)
+        assert 'name="lm"' in text
+    finally:
+        serve.shutdown()
