@@ -234,6 +234,12 @@ class ConductorHandler:
         self._weights_pending: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._weight_events: List[Dict[str, Any]] = []
 
+        # Paged KV prefix cache (models/kvcache.py): serving engines
+        # push per-engine stat snapshots + prefix-hit/evict markers;
+        # the conductor only aggregates (no KV bytes ever land here).
+        self._kvcache_stats: Dict[str, Dict[str, Any]] = {}
+        self._kvcache_events: List[Dict[str, Any]] = []
+
         # Durable control-plane tables (reference: GCS Redis-persisted
         # tables, gcs_server.h:103-110 / gcs_table_storage.cc). A snapshot
         # in the session dir lets a restarted conductor recover KV, named
@@ -1542,6 +1548,65 @@ class ConductorHandler:
     def get_weight_events(self, limit: int = 10_000) -> List[Dict[str, Any]]:
         with self._lock:
             return self._weight_events[-limit:]
+
+    # ------------------------------------------------- paged KV cache
+    # Serving engines (models/engine.py) push their prefix-cache stat
+    # snapshots and instant markers here; util.state.kv_cache_stats(),
+    # `ray_tpu kvcache`, and the dashboard /api/kvcache all read the
+    # same aggregate so every surface reports one set of numbers.
+
+    _KVCACHE_EVENTS_KEPT = 10_000
+    _KVCACHE_TOTAL_KEYS = (
+        "lookups", "hits", "partial_hits", "misses", "reused_tokens",
+        "prefilled_tokens", "spliced_tokens", "inserted_blocks",
+        "evictions", "cow_copies", "invalidations", "admitted",
+        "prefill_calls")
+
+    def report_kvcache_stats(self, worker_id: str, engine_id: str,
+                             stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        key = f"{str(worker_id)[:12]}:{engine_id}"
+        with self._lock:
+            self._kvcache_stats[key] = dict(
+                stats, worker_id=worker_id, engine_id=engine_id,
+                ts=time.time())
+
+    def get_kvcache_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            engines = {k: dict(v) for k, v in self._kvcache_stats.items()}
+        totals: Dict[str, Any] = {k: 0 for k in self._KVCACHE_TOTAL_KEYS}
+        for st in engines.values():
+            for k in self._KVCACHE_TOTAL_KEYS:
+                v = st.get(k)
+                if isinstance(v, (int, float)):
+                    totals[k] += v
+        looked = totals["lookups"]
+        totals["hit_rate"] = ((totals["hits"] + totals["partial_hits"])
+                              / looked if looked else 0.0)
+        seen = totals["reused_tokens"] + totals["prefilled_tokens"]
+        totals["token_reuse_rate"] = (totals["reused_tokens"] / seen
+                                      if seen else 0.0)
+        return {"engines": engines, "totals": totals}
+
+    def report_kvcache_event(self, event: Dict[str, Any]) -> None:
+        """Prefix-hit / evict / invalidate instant markers for the
+        merged timeline (observability.timeline)."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._kvcache_events.append(event)
+            if len(self._kvcache_events) > self._KVCACHE_EVENTS_KEPT:
+                del self._kvcache_events[
+                    :len(self._kvcache_events)
+                    - self._KVCACHE_EVENTS_KEPT]
+
+    def get_kvcache_events(self, limit: int = 10_000
+                           ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._kvcache_events[-limit:]
 
     def weights_publish_fragment(self, name: str, version: int, host: int,
                                  num_hosts: int, fragment: Dict[str, Any],

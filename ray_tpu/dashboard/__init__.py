@@ -20,6 +20,9 @@ Routes:
                          failure scores, restart/preemption counters
   /api/weights           live weight fabric: committed/pending versions
                          per weight-set name (ray_tpu.weights registry)
+  /api/kvcache           paged KV prefix cache: per-engine stats +
+                         totals (hit rates, pool utilization) and
+                         recent prefix-hit/evict events
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -126,6 +129,17 @@ class _ClusterData:
         except Exception:  # noqa: BLE001 — older conductor
             status["live_demand"] = []
         return status
+
+    def kvcache(self) -> Dict[str, Any]:
+        """Paged-KV prefix cache: engine stats + the recent event tail
+        (one payload so the SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_kvcache_stats", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_kvcache_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
 
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
@@ -236,6 +250,7 @@ class DashboardServer:
         app.router.add_get(
             "/api/weights",
             self._json_route(lambda: d.simple("get_weight_versions")))
+        app.router.add_get("/api/kvcache", self._json_route(d.kvcache))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

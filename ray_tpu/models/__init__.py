@@ -12,8 +12,9 @@ from .gpt2 import (  # noqa: F401
     gpt2_loss,
     gpt2_partition_specs,
 )
-from .engine import ContinuousBatchingEngine  # noqa: F401
+from .engine import ContinuousBatchingEngine, TokenStream  # noqa: F401
 from .generate import generate, stream_generate  # noqa: F401
+from .kvcache import PagedKVCache  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig,
     init_kv_cache,

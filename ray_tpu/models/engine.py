@@ -9,22 +9,38 @@ runs ONE jitted ragged-batch step (the model family's per-slot decode —
 llama_decode / gpt2_decode — with per-slot positions and masking,
 static shapes throughout, so XLA compiles exactly one program no
 matter how requests interleave). New requests prefill into a
-free slot (one jitted prefill per distinct prompt length — exact
-lengths, so cache rows beyond a slot's own depth are never attended)
-and JOIN the running batch between ticks; finished sequences (EOS or
-their token budget) free their slot between ticks. Slots the engine
-isn't using decode garbage that nothing reads — the cost of static
-shapes, paid once, instead of a recompile per batch composition.
+free slot (one jitted prefill per distinct (cached-prefix, suffix)
+length pair — exact lengths, so cache rows beyond a slot's own depth
+are never attended) and JOIN the running batch between ticks; finished
+sequences (EOS or their token budget) free their slot between ticks.
+Slots the engine isn't using decode garbage that nothing reads — the
+cost of static shapes, paid once, instead of a recompile per batch
+composition.
+
+Prefill rides the paged KV prefix cache (models/kvcache.py): admission
+looks up the longest cached block-aligned prefix of the prompt, gathers
+those blocks from the pool, and prefills ONLY the suffix; the filled
+prompt region is then SPLICED into the slot's rows of the decode slab —
+an O(prompt_len) in-place update, not the O(max_batch x max_len)
+full-cache copy the old `_adopt_slot` paid per admission. Admissions
+between ticks are capped at ``RAY_TPU_MAX_PREFILLS_PER_TICK`` (default
+1) so a burst of arrivals cannot head-of-line-block every in-flight
+decode for the whole drain.
 
 Per-request token queues make it the natural producer for Serve's
 streaming path; `ContinuousBatchingEngine` is thread-safe for
-concurrent submit/iterate from replica request threads.
+concurrent submit/iterate from replica request threads. The streamed
+iterator exposes ``cache_outcome`` (hit|partial|miss) so the replica's
+TTFT histogram can label prefix-cache wins.
 """
 from __future__ import annotations
 
 import functools
+import itertools
+import os
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
 import jax
@@ -32,29 +48,51 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generate import _model_fns
+from .kvcache import PagedKVCache
+
 _DONE = object()
+_ENGINE_SEQ = itertools.count()
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
-def _prefill_one(params, prompt, config, cache1):
-    """Prefill a single sequence into its own B=1 cache; returns the
-    last-position logits and the filled cache. One compile per distinct
-    prompt length (exact lengths: a padded prefill would leave pad
-    entries inside the attended window)."""
+def _prefill_paged(params, suffix, config, prefix_k, prefix_v):
+    """Prefill a single sequence's SUFFIX on top of a cached prefix
+    ([L, c, H, hd]; c=0 is the full-prefill program). The window is the
+    full max_seq_len slab — the same reduction shapes as generate()'s
+    prefill, so cached and uncached paths stay bit-identical — and the
+    returned cache is the stacked [L, S, H, hd] single-sequence fill.
+    One compile per distinct (cached, suffix) length pair."""
     fwd = _model_fns(config)[0]
-    logits, cache1 = fwd(params, prompt, config, cache1, 0)
-    return logits[:, -1], cache1
+    c = prefix_k.shape[1]
+    layers = prefix_k.shape[0]
+    base_k = jnp.zeros((layers, config.max_seq_len) + prefix_k.shape[2:],
+                       prefix_k.dtype)
+    base_v = jnp.zeros_like(base_k)
+    if c:
+        base_k = base_k.at[:, :c].set(prefix_k)
+        base_v = base_v.at[:, :c].set(prefix_v)
+    cache = [{"k": base_k[layer][None], "v": base_v[layer][None]}
+             for layer in range(layers)]
+    logits, cache = fwd(params, suffix, config, cache, c)
+    ck = jnp.stack([blk["k"][0] for blk in cache])
+    cv = jnp.stack([blk["v"][0] for blk in cache])
+    return logits[:, -1], ck, cv
 
 
-@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
-def _adopt_slot(cache, cache1, slot, config):
-    """Copy a prefilled single-sequence cache into batch slot `slot`."""
+@functools.partial(jax.jit, static_argnums=(4, 5),
+                   donate_argnums=(0,))
+def _splice_slot(cache, ck, cv, slot, config, plen):
+    """Write a prefilled sequence's [0, plen) rows into batch slot
+    `slot` of the decode slab — with the slab donated this lowers to an
+    in-place O(plen) row update per layer, never a full-cache copy."""
     del config
     out = []
-    for blk, one in zip(cache, cache1):
+    for layer, blk in enumerate(cache):
         out.append({
-            "k": blk["k"].at[slot].set(one["k"][0]),
-            "v": blk["v"].at[slot].set(one["v"][0]),
+            "k": jax.lax.dynamic_update_slice(
+                blk["k"], ck[layer, :plen][None], (slot, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                blk["v"], cv[layer, :plen][None], (slot, 0, 0, 0)),
         })
     return out
 
@@ -78,6 +116,37 @@ class _Request:
         self.out: "queue.Queue" = queue.Queue()
         self.produced = 0
         self.slot: Optional[int] = None
+        self.cache_outcome: Optional[str] = None  # hit|partial|miss
+        self.reused_tokens = 0
+        self.block_table: List[int] = []
+
+
+class TokenStream:
+    """Iterator over one request's tokens with the prefix-cache outcome
+    attached (``cache_outcome``: hit|partial|miss, None until the
+    request is admitted — always set before the first token arrives).
+    Serve's streaming replica reads it to label the TTFT histogram."""
+
+    def __init__(self, req: _Request, timeout_s: float):
+        self._req = req
+        self._timeout_s = timeout_s
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> int:
+        tok = self._req.out.get(timeout=self._timeout_s)
+        if tok is _DONE:
+            raise StopIteration
+        return int(tok)
+
+    @property
+    def cache_outcome(self) -> Optional[str]:
+        return self._req.cache_outcome
+
+    @property
+    def reused_tokens(self) -> int:
+        return self._req.reused_tokens
 
 
 class ContinuousBatchingEngine:
@@ -85,12 +154,17 @@ class ContinuousBatchingEngine:
 
     def __init__(self, params: Any, config: Any, *,
                  max_batch: int = 8, idle_sleep_s: float = 0.002,
-                 params_version: Optional[int] = None):
+                 params_version: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 kv_block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 max_prefills_per_tick: Optional[int] = None):
         # config: any family _model_fns knows (LlamaConfig, GPT2Config)
         self.params = params
         self.config = config
         self.max_batch = max_batch
         self.idle_sleep_s = idle_sleep_s
+        self.engine_id = f"cb-{os.getpid()}-{next(_ENGINE_SEQ)}"
         # live-weight hot swap (ray_tpu.weights): a queued (params,
         # version) is applied by the decode loop BETWEEN ticks — the
         # params pytree is a plain jit argument, so swapping it never
@@ -99,6 +173,35 @@ class ContinuousBatchingEngine:
         self._pending_swap: Optional[tuple] = None
         self.swap_count = 0
         self._cache = _model_fns(config)[1](config, max_batch)
+        # paged KV prefix cache (models/kvcache.py); RAY_TPU_KV_* env
+        # knobs supply defaults, constructor args win
+        if prefix_cache is None:
+            prefix_cache = os.environ.get("RAY_TPU_KV_CACHE", "1") != "0"
+        if max_prefills_per_tick is None:
+            max_prefills_per_tick = int(os.environ.get(
+                "RAY_TPU_MAX_PREFILLS_PER_TICK", "1"))
+        self.max_prefills_per_tick = max(1, int(max_prefills_per_tick))
+        block_size = int(kv_block_size
+                         or os.environ.get("RAY_TPU_KV_BLOCK_SIZE", "16"))
+        pool_blocks = int(kv_pool_blocks
+                          or int(os.environ.get("RAY_TPU_KV_POOL_BLOCKS",
+                                                "0"))
+                          or max_batch * (-(-config.max_seq_len
+                                            // block_size)))
+        self.kv_cache: Optional[PagedKVCache] = (
+            PagedKVCache(config, block_size=block_size,
+                         num_blocks=pool_blocks)
+            if prefix_cache else None)
+        shape = self._cache[0]["k"].shape  # [maxB, S, H, hd]
+        self._empty_prefix = jnp.zeros(
+            (len(self._cache), 0) + shape[2:], self._cache[0]["k"].dtype)
+        # admission accounting (kv_stats / acceptance surface)
+        self.prefill_calls = 0
+        self.prefilled_tokens = 0
+        self.spliced_tokens = 0
+        self.admitted = 0
+        self.max_admitted_per_tick = 0
+        self._last_stats_push = 0.0
         self._tokens = np.zeros(max_batch, np.int32)
         self._pos = np.zeros(max_batch, np.int32)
         self._slot_req: List[Optional[_Request]] = [None] * max_batch
@@ -127,13 +230,11 @@ class ContinuousBatchingEngine:
     def stream(self, prompt_tokens, max_new_tokens: int,
                eos_token: Optional[int] = None,
                timeout_s: float = 120.0) -> Iterator[int]:
-        """Submit and yield tokens as the shared loop produces them."""
+        """Submit and yield tokens as the shared loop produces them.
+        Returns a TokenStream whose ``cache_outcome`` labels the
+        admission's prefix-cache result."""
         req = self.submit(prompt_tokens, max_new_tokens, eos_token)
-        while True:
-            tok = req.out.get(timeout=timeout_s)
-            if tok is _DONE:
-                return
-            yield int(tok)
+        return TokenStream(req, timeout_s)
 
     def generate(self, prompt_tokens, max_new_tokens: int,
                  eos_token: Optional[int] = None,
@@ -172,6 +273,12 @@ class ContinuousBatchingEngine:
         self.params = params
         self.params_version = version
         self.swap_count += 1
+        # every cached block's KV was computed under the old weights:
+        # drop the prefix index so no post-swap admission matches it
+        # (in-flight slots decode off their own slab copy, unaffected)
+        if self.kv_cache is not None:
+            self.kv_cache.invalidate()
+            self.publish_kv_telemetry(force=True)
         for ev in events:
             ev.set()
 
@@ -179,33 +286,120 @@ class ContinuousBatchingEngine:
         self._stopped.set()
         self._thread.join(timeout=10.0)
         self._apply_pending_swap()  # fire waiters a dead loop would strand
+        self.publish_kv_telemetry(force=True)
 
     @property
     def active_slots(self) -> int:
         with self._lock:
             return self.max_batch - len(self._free)
 
+    # ------------------------------------------------------- telemetry
+    def kv_stats(self) -> Dict[str, Any]:
+        """Prefix-cache + admission counters — the snapshot pushed to
+        the conductor for util.state.kv_cache_stats(), the CLI, and the
+        dashboard (all surfaces report THIS dict's numbers)."""
+        s: Dict[str, Any] = (self.kv_cache.stats() if self.kv_cache
+                             else {"enabled": False})
+        try:
+            programs = _prefill_paged._cache_size()
+        except Exception:  # noqa: BLE001 — older jax without _cache_size
+            programs = -1
+        s.update(
+            engine_id=self.engine_id,
+            max_batch=self.max_batch,
+            max_prefills_per_tick=self.max_prefills_per_tick,
+            admitted=self.admitted,
+            max_admitted_per_tick=self.max_admitted_per_tick,
+            prefill_calls=self.prefill_calls,
+            prefill_programs=programs,
+            spliced_tokens=self.spliced_tokens,
+        )
+        if self.kv_cache is None:
+            # uncached engines still account their prefill work
+            s.setdefault("prefilled_tokens", self.prefilled_tokens)
+            s.setdefault("reused_tokens", 0)
+        return s
+
+    def publish_kv_telemetry(self, force: bool = False) -> None:
+        """Best-effort push of kv_stats + pending timeline events to the
+        conductor (no-op without a live cluster); throttled unless
+        forced."""
+        now = time.monotonic()
+        if not force and now - self._last_stats_push < 0.5:
+            return
+        self._last_stats_push = now
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker
+        if w is None:
+            if self.kv_cache is not None:
+                self.kv_cache.drain_events()  # keep the buffer bounded
+            return
+        try:
+            w.conductor.notify("report_kvcache_stats", w.worker_id,
+                               self.engine_id, self.kv_stats())
+            if self.kv_cache is not None:
+                for ev in self.kv_cache.drain_events():
+                    ev.setdefault("engine", self.engine_id)
+                    w.conductor.notify("report_kvcache_event", ev)
+        except Exception:  # noqa: BLE001 — cluster shutting down
+            pass
+
     # ------------------------------------------------------------ loop
     def _admit(self) -> None:
-        while self._free:
+        admitted = 0
+        while self._free and admitted < self.max_prefills_per_tick:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
-                return
-            with self._lock:
-                slot = self._free.pop()
-            cache1 = _model_fns(self.config)[1](self.config, 1)
-            last_logits, cache1 = _prefill_one(self.params, req.prompt,
-                                               self.config, cache1)
-            self._cache = _adopt_slot(self._cache, cache1, slot,
-                                      self.config)
-            first = int(np.argmax(
-                np.asarray(last_logits[0, :self.config.vocab_size])))
-            req.slot = slot
-            self._slot_req[slot] = req
-            self._tokens[slot] = first
-            self._pos[slot] = req.prompt.shape[1]
-            self._emit(req, first)
+                break
+            self._admit_one(req)
+            admitted += 1
+        if admitted:
+            self.max_admitted_per_tick = max(self.max_admitted_per_tick,
+                                             admitted)
+            self.publish_kv_telemetry()
+
+    def _admit_one(self, req: _Request) -> None:
+        with self._lock:
+            slot = self._free.pop()
+        plen = req.prompt.shape[1]
+        prompt_np = req.prompt[0]
+        match = None
+        if self.kv_cache is not None:
+            match = self.kv_cache.lookup(prompt_np, max_tokens=plen - 1)
+            req.cache_outcome = match.outcome
+            req.reused_tokens = match.tokens
+            prefix_k, prefix_v = self.kv_cache.gather(match)
+        else:
+            prefix_k = prefix_v = self._empty_prefix
+        cached = int(prefix_k.shape[1])
+        suffix = req.prompt[:, cached:]
+        last_logits, ck, cv = _prefill_paged(self.params, suffix,
+                                             self.config, prefix_k,
+                                             prefix_v)
+        self.prefill_calls += 1
+        self.prefilled_tokens += suffix.shape[1]
+        if self.kv_cache is not None:
+            self.kv_cache.note_prefilled(suffix.shape[1])
+            req.block_table = self.kv_cache.commit(prompt_np, ck, cv,
+                                                   match)
+            if match.tokens:
+                self.kv_cache.record_event({
+                    "kind": "prefix_hit", "outcome": match.outcome,
+                    "reused_tokens": match.tokens,
+                    "prompt_tokens": plen, "rid": req.rid})
+        self._cache = _splice_slot(self._cache, ck, cv, np.int32(slot),
+                                   self.config, plen)
+        self.spliced_tokens += plen
+        self.admitted += 1
+        first = int(np.argmax(
+            np.asarray(last_logits[0, :self.config.vocab_size])))
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._tokens[slot] = first
+        self._pos[slot] = plen
+        self._emit(req, first)
 
     def _emit(self, req: _Request, tok: int) -> None:
         req.out.put(tok)
@@ -215,6 +409,9 @@ class ContinuousBatchingEngine:
             req.out.put(_DONE)
             slot = req.slot
             self._slot_req[slot] = None
+            if self.kv_cache is not None and req.block_table:
+                self.kv_cache.release(req.block_table)
+                req.block_table = []
             with self._lock:
                 self._free.append(slot)
 

@@ -1,0 +1,562 @@
+"""Paged KV cache with hash-chained prefix reuse — the serving-envelope
+lever the ROADMAP names first: a fleet of requests sharing a long system
+prompt must not re-prefill it per request (vLLM's PagedAttention prefix
+cache, rebuilt for this engine's fixed-slab TPU decode design).
+
+Layout: one device pool per engine, K and V each
+``[layers, num_blocks, block_size, kv_heads, head_dim]`` in the model's
+cache dtype. Blocks are the unit of sharing:
+
+- **hash-chained index** — block ``i`` of a prompt is keyed by
+  ``H(chain_digest(blocks < i), tokens_i)``, so a lookup walks the
+  longest cached block-aligned prefix without comparing whole prompts
+  (token tuples are still verified on match — a digest collision must
+  never serve wrong KV). A partial tail block (prompt ends mid-block)
+  is indexed separately under its parent digest + exact token tuple.
+- **refcounts** — every admitted request pins the blocks backing its
+  matched prefix for its lifetime; pinned blocks are never evicted or
+  mutated. Refcount-0 blocks STAY cached (that is the cache) and are
+  only reclaimed by LRU eviction under pool pressure, leaves first so a
+  chain interior never orphans reachable descendants.
+- **copy-on-write** — extending a cached partial block (request B's
+  prompt continues where request A's ended mid-block) copies the shared
+  block into a fresh one and writes the new tokens into the copy; the
+  original stays indexed for future short matches.
+- **graceful exhaustion** — when the pool has no free or evictable
+  block, commit simply stops caching that prompt's remaining blocks;
+  prefill correctness never depends on pool capacity.
+
+Correctness invariant (asserted in tier-1 on CPU): engine outputs with
+the cache enabled are bit-identical to the uncached engine. It holds
+because cached prefix KV is byte-for-byte what a full prefill would
+recompute (same absolute RoPE positions, same window length, and masked
+softmax contributes exact zeros for unwritten rows), and because a
+weight swap invalidates the whole index — stale-generation KV is never
+matched again (in-flight slots keep decoding off their own slab copy).
+
+Surfaces (the full treatment every subsystem gets):
+``util.state.kv_cache_stats()``, ``ray_tpu kvcache``, dashboard
+``/api/kvcache``, lazy-init Prometheus counters/gauges (no pusher on
+import), and prefix-hit / evict instant markers in the merged timeline.
+Knobs: ``RAY_TPU_KV_CACHE`` (enable, default 1),
+``RAY_TPU_KV_BLOCK_SIZE`` (default 16), ``RAY_TPU_KV_POOL_BLOCKS``
+(default: one decode slab's worth, ``max_batch * ceil(S/block)``).
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ROOT_DIGEST = b"ray_tpu-kv-root"
+_EVENTS_KEPT = 512
+
+
+def _chain(digest: bytes, tokens: Tuple[int, ...]) -> bytes:
+    h = hashlib.blake2b(digest, digest_size=16)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
+
+
+# --------------------------------------------------------- device ops
+# All pool mutation is jitted with the pool donated, so XLA updates the
+# arrays in place: a block write touches O(block) bytes, never O(pool).
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _write_block(pool_k, pool_v, bid, blk_k, blk_v):
+    """pool [L,N,bs,H,hd] <- blk [L,bs,H,hd] at block row `bid`."""
+    return (jax.lax.dynamic_update_slice(
+                pool_k, blk_k[:, None], (0, bid, 0, 0, 0)),
+            jax.lax.dynamic_update_slice(
+                pool_v, blk_v[:, None], (0, bid, 0, 0, 0)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cow_extend_block(pool_k, pool_v, dst, src, blk_k, blk_v, filled_old):
+    """Copy-on-write: rows ``< filled_old`` come from the SHARED block
+    `src` (the copy), rows ``>= filled_old`` from the freshly prefilled
+    `blk` (the write); the merge lands in `dst`."""
+    sizes = (pool_k.shape[0], 1) + pool_k.shape[2:]
+    old_k = jax.lax.dynamic_slice(pool_k, (0, src, 0, 0, 0), sizes)[:, 0]
+    old_v = jax.lax.dynamic_slice(pool_v, (0, src, 0, 0, 0), sizes)[:, 0]
+    row = jnp.arange(pool_k.shape[2])[None, :, None, None]
+    merged_k = jnp.where(row < filled_old, old_k, blk_k)
+    merged_v = jnp.where(row < filled_old, old_v, blk_v)
+    return (jax.lax.dynamic_update_slice(
+                pool_k, merged_k[:, None], (0, dst, 0, 0, 0)),
+            jax.lax.dynamic_update_slice(
+                pool_v, merged_v[:, None], (0, dst, 0, 0, 0)))
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _gather_prefix(pool_k, pool_v, bids, ntok):
+    """Assemble a matched prefix: block rows `bids` concatenated along
+    the token axis, truncated to the matched token count (the tail
+    block may be partial)."""
+    k = jnp.take(pool_k, bids, axis=1)      # [L, n, bs, H, hd]
+    v = jnp.take(pool_v, bids, axis=1)
+    ll, n, bs = k.shape[0], k.shape[1], k.shape[2]
+    k = k.reshape((ll, n * bs) + k.shape[3:])[:, :ntok]
+    v = v.reshape((ll, n * bs) + v.shape[3:])[:, :ntok]
+    return k, v
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _extract_block(ck, cv, start, block_size):
+    """One block's rows ``[start, start+block_size)`` out of a filled
+    single-sequence cache ``[L, S, H, hd]`` (start traced: one compiled
+    program serves every block offset)."""
+    sizes = (ck.shape[0], block_size) + ck.shape[2:]
+    return (jax.lax.dynamic_slice(ck, (0, start, 0, 0), sizes),
+            jax.lax.dynamic_slice(cv, (0, start, 0, 0), sizes))
+
+
+# ----------------------------------------------------- prometheus (lazy)
+# Created on first pool construction, never at import: importing
+# ray_tpu.models must not spawn a metrics pusher (weights/metrics.py
+# pattern — rebound ONCE to a complete dict).
+
+_metrics: Optional[Dict[str, Any]] = None
+_metrics_lock = threading.Lock()
+
+
+def kvcache_metrics() -> Dict[str, Any]:
+    global _metrics
+    m = _metrics
+    if m is not None:
+        return m
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _metrics = dict(
+                lookups=Counter(
+                    "ray_tpu_kvcache_lookups_total",
+                    "prefix-cache lookups at admission",
+                    tag_keys=("outcome",)),
+                reused_tokens=Counter(
+                    "ray_tpu_kvcache_reused_tokens_total",
+                    "prompt tokens served from cached KV blocks "
+                    "(prefill skipped)"),
+                prefilled_tokens=Counter(
+                    "ray_tpu_kvcache_prefilled_tokens_total",
+                    "prompt tokens actually prefilled (suffix after the "
+                    "cached prefix)"),
+                evictions=Counter(
+                    "ray_tpu_kvcache_evictions_total",
+                    "refcount-0 blocks LRU-evicted under pool pressure"),
+                cow_copies=Counter(
+                    "ray_tpu_kvcache_cow_copies_total",
+                    "copy-on-write block copies (shared partial block "
+                    "extended)"),
+                utilization=Gauge(
+                    "ray_tpu_kvcache_pool_utilization",
+                    "fraction of pool blocks holding cached or pinned "
+                    "KV"))
+    return _metrics
+
+
+class PrefixMatch:
+    """Result of a lookup: the pinned block table backing the longest
+    cached prefix, and how many prompt tokens it covers."""
+
+    __slots__ = ("bids", "tokens", "full_blocks", "partial_bid",
+                 "partial_len", "outcome")
+
+    def __init__(self, bids: List[int], tokens: int, full_blocks: int,
+                 partial_bid: Optional[int], partial_len: int,
+                 outcome: str):
+        self.bids = bids
+        self.tokens = tokens
+        self.full_blocks = full_blocks
+        self.partial_bid = partial_bid
+        self.partial_len = partial_len
+        self.outcome = outcome
+
+
+class _Block:
+    __slots__ = ("bid", "tokens", "filled", "ref", "last_used",
+                 "children", "index_key", "parent_bid")
+
+    def __init__(self, bid: int):
+        self.bid = bid
+        self.tokens: Tuple[int, ...] = ()
+        self.filled = 0
+        self.ref = 0
+        self.last_used = 0
+        self.children = 0
+        # ("full", digest) | ("partial", parent_digest, tokens) | None
+        # (None = orphaned by invalidate(): unreachable, freed on the
+        # last release)
+        self.index_key: Optional[tuple] = None
+        self.parent_bid: Optional[int] = None
+
+
+class PagedKVCache:
+    """Block-pool KV allocator + prefix index for one engine.
+
+    Thread-safe; in practice only the engine's decode thread mutates it
+    while stats/snapshot readers come from anywhere."""
+
+    def __init__(self, config: Any, *, block_size: int, num_blocks: int):
+        from .generate import _model_fns
+
+        if block_size < 1 or num_blocks < 1:
+            raise ValueError("block_size and num_blocks must be >= 1")
+        probe = _model_fns(config)[1](config, 1, max_len=block_size)
+        _, _, heads, head_dim = probe[0]["k"].shape
+        self.layers = len(probe)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = probe[0]["k"].dtype
+        shape = (self.layers, self.num_blocks, self.block_size, heads,
+                 head_dim)
+        self._pool_k = jnp.zeros(shape, self.dtype)
+        self._pool_v = jnp.zeros(shape, self.dtype)
+        self._empty_k = jnp.zeros((self.layers, 0, heads, head_dim),
+                                  self.dtype)
+        self._lock = threading.Lock()
+        self._blocks: Dict[int, _Block] = {}
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._full_index: Dict[bytes, int] = {}
+        self._partial_index: Dict[bytes,
+                                  Dict[Tuple[int, ...], int]] = {}
+        self._tick = itertools.count(1)
+        self._events: List[Dict[str, Any]] = []
+        self._stats: Dict[str, int] = {
+            k: 0 for k in ("lookups", "hits", "partial_hits", "misses",
+                           "reused_tokens", "prefilled_tokens",
+                           "inserted_blocks", "evictions", "cow_copies",
+                           "invalidations")}
+        kvcache_metrics()  # lazy registration, before the first event
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, tokens: np.ndarray, max_tokens: int) -> PrefixMatch:
+        """Longest cached block-aligned (+ partial tail) prefix of
+        `tokens`, capped at `max_tokens` so the caller always has a
+        suffix left to prefill (the last prompt position's logits feed
+        the first sampled token). Matched blocks are PINNED — pair every
+        lookup with a release() of the returned/committed table."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        with self._lock:
+            digest = _ROOT_DIGEST
+            bids: List[int] = []
+            matched = 0
+            while matched + bs <= max_tokens:
+                blk = tuple(int(t) for t in tokens[matched:matched + bs])
+                nxt = _chain(digest, blk)
+                bid = self._full_index.get(nxt)
+                if bid is None or self._blocks[bid].tokens != blk:
+                    break
+                bids.append(bid)
+                digest = nxt
+                matched += bs
+            full_blocks = len(bids)
+            partial_bid: Optional[int] = None
+            partial_len = 0
+            for ptoks, bid in self._partial_index.get(digest, {}).items():
+                k = len(ptoks)
+                if (k > partial_len and matched + k <= max_tokens
+                        and tuple(int(t) for t in
+                                  tokens[matched:matched + k]) == ptoks):
+                    partial_bid, partial_len = bid, k
+            if partial_bid is not None:
+                bids.append(partial_bid)
+                matched += partial_len
+            now = next(self._tick)
+            for bid in bids:
+                b = self._blocks[bid]
+                b.ref += 1
+                b.last_used = now
+            plen = len(tokens)
+            if matched and plen - matched <= bs:
+                outcome = "hit"
+                self._stats["hits"] += 1
+            elif matched:
+                outcome = "partial"
+                self._stats["partial_hits"] += 1
+            else:
+                outcome = "miss"
+                self._stats["misses"] += 1
+            self._stats["lookups"] += 1
+            self._stats["reused_tokens"] += matched
+        m = kvcache_metrics()
+        m["lookups"].inc(tags={"outcome": outcome})
+        if matched:
+            m["reused_tokens"].inc(matched)
+        return PrefixMatch(bids, matched, full_blocks, partial_bid,
+                           partial_len, outcome)
+
+    def gather(self, match: PrefixMatch):
+        """Device prefix ``([L, tokens, H, hd] k, same v)`` for a match
+        (empty arrays for a miss — the uncached-prefill program shape)."""
+        if match.tokens == 0:
+            return self._empty_k, self._empty_k
+        bids = jnp.asarray(match.bids, jnp.int32)
+        return _gather_prefix(self._pool_k, self._pool_v, bids,
+                              match.tokens)
+
+    # ------------------------------------------------------------ commit
+
+    def note_prefilled(self, n_tokens: int) -> None:
+        with self._lock:
+            self._stats["prefilled_tokens"] += int(n_tokens)
+        kvcache_metrics()["prefilled_tokens"].inc(int(n_tokens))
+
+    def commit(self, tokens: np.ndarray, ck, cv,
+               match: PrefixMatch) -> List[int]:
+        """Insert the prompt's uncached blocks from its freshly filled
+        single-sequence cache ``ck/cv [L, S, H, hd]`` and return the
+        request's pinned block table (matched + inserted). Stops quietly
+        when the pool is exhausted — caching is best-effort, the slot's
+        own slab copy is already correct."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        plen = len(tokens)
+        n_full, tail = divmod(plen, bs)
+        with self._lock:
+            table = list(match.bids)
+            digest = _ROOT_DIGEST
+            now = next(self._tick)
+            parent: Optional[int] = None
+            exhausted = False
+            for i in range(n_full):
+                blk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+                nxt = _chain(digest, blk)
+                if i < match.full_blocks:
+                    parent, digest = match.bids[i], nxt
+                    continue
+                existing = self._full_index.get(nxt)
+                if (existing is not None
+                        and self._blocks[existing].tokens == blk):
+                    b = self._blocks[existing]
+                    b.ref += 1
+                    b.last_used = now
+                    table.append(existing)
+                    parent, digest = existing, nxt
+                    continue
+                bid = self._alloc_locked()
+                if bid is None:
+                    exhausted = True
+                    break
+                bk, bv = _extract_block(ck, cv, np.int32(i * bs), bs)
+                if (i == match.full_blocks
+                        and match.partial_bid is not None):
+                    # the matched SHARED partial block sits at this
+                    # position and this prompt widens it to a full
+                    # block: copy-on-write (the original stays indexed
+                    # for future shorter matches)
+                    self._pool_k, self._pool_v = _cow_extend_block(
+                        self._pool_k, self._pool_v, np.int32(bid),
+                        np.int32(match.partial_bid), bk, bv,
+                        np.int32(match.partial_len))
+                    self._stats["cow_copies"] += 1
+                    kvcache_metrics()["cow_copies"].inc()
+                else:
+                    self._pool_k, self._pool_v = _write_block(
+                        self._pool_k, self._pool_v, np.int32(bid), bk,
+                        bv)
+                self._insert_locked(bid, ("full", nxt), blk, bs, parent,
+                                    now)
+                table.append(bid)
+                parent, digest = bid, nxt
+            if tail and not exhausted:
+                self._commit_tail_locked(tokens, ck, cv, match, digest,
+                                         parent, n_full, tail, table,
+                                         now)
+            util = 1.0 - len(self._free) / self.num_blocks
+        kvcache_metrics()["utilization"].set(util)
+        return table
+
+    def _commit_tail_locked(self, tokens, ck, cv, match, digest, parent,
+                            n_full, tail, table, now) -> None:
+        bs = self.block_size
+        if (n_full + 1) * bs > ck.shape[1]:
+            # the tail block's nominal extent crosses the cache window
+            # (block_size not dividing max_seq_len, prompt near max):
+            # dynamic_slice would clamp the start and cache shifted
+            # rows — skip caching this tail, correctness first
+            return
+        tail_toks = tuple(int(t) for t in tokens[n_full * bs:])
+        # the matched partial is the TAIL's predecessor only when it sat
+        # at the final block position (otherwise it was widened to a
+        # full block by the loop above)
+        tail_partial = (match.partial_bid
+                        if match.full_blocks == n_full else None)
+        if tail_partial is not None and match.partial_len == tail:
+            return  # the matched partial already covers the whole tail
+        by_tok = self._partial_index.get(digest, {})
+        existing = by_tok.get(tail_toks)
+        if existing is not None:
+            b = self._blocks[existing]
+            b.ref += 1
+            b.last_used = now
+            table.append(existing)
+            return
+        bid = self._alloc_locked()
+        if bid is None:
+            return
+        bk, bv = _extract_block(ck, cv, np.int32(n_full * bs), bs)
+        if tail_partial is not None:
+            # extending a SHARED cached block: copy-on-write — the old
+            # entry stays indexed for future shorter matches
+            self._pool_k, self._pool_v = _cow_extend_block(
+                self._pool_k, self._pool_v, np.int32(bid),
+                np.int32(tail_partial), bk, bv,
+                np.int32(match.partial_len))
+            self._stats["cow_copies"] += 1
+            kvcache_metrics()["cow_copies"].inc()
+        else:
+            self._pool_k, self._pool_v = _write_block(
+                self._pool_k, self._pool_v, np.int32(bid), bk, bv)
+        self._insert_locked(bid, ("partial", digest, tail_toks),
+                            tail_toks, tail, parent, now)
+        table.append(bid)
+
+    def _insert_locked(self, bid: int, index_key: tuple,
+                       blk_tokens: Tuple[int, ...], filled: int,
+                       parent: Optional[int], now: int) -> None:
+        b = _Block(bid)
+        b.tokens = blk_tokens
+        b.filled = filled
+        b.ref = 1  # the committing request's pin
+        b.last_used = now
+        b.index_key = index_key
+        b.parent_bid = parent
+        self._blocks[bid] = b
+        if index_key[0] == "full":
+            self._full_index[index_key[1]] = bid
+        else:
+            self._partial_index.setdefault(index_key[1],
+                                           {})[index_key[2]] = bid
+        if parent is not None and parent in self._blocks:
+            self._blocks[parent].children += 1
+        self._stats["inserted_blocks"] += 1
+
+    # -------------------------------------------------- alloc / evict
+
+    def _alloc_locked(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim: Optional[_Block] = None
+        for b in self._blocks.values():
+            # evictable: unpinned leaf (children of refcount-0 interiors
+            # are themselves refcount-0, so leaves always drain first)
+            if b.ref == 0 and b.children == 0 and b.index_key is not None:
+                if victim is None or b.last_used < victim.last_used:
+                    victim = b
+        if victim is None:
+            return None
+        self._evict_locked(victim)
+        return victim.bid
+
+    def _evict_locked(self, b: _Block) -> None:
+        self._drop_index_locked(b)
+        if b.parent_bid is not None and b.parent_bid in self._blocks:
+            self._blocks[b.parent_bid].children -= 1
+        del self._blocks[b.bid]
+        self._stats["evictions"] += 1
+        kvcache_metrics()["evictions"].inc()
+        self._event_locked({"kind": "evict", "bid": b.bid,
+                            "block_tokens": b.filled})
+
+    def _drop_index_locked(self, b: _Block) -> None:
+        key = b.index_key
+        if key is None:
+            return
+        if key[0] == "full":
+            self._full_index.pop(key[1], None)
+        else:
+            by_tok = self._partial_index.get(key[1])
+            if by_tok is not None:
+                by_tok.pop(key[2], None)
+                if not by_tok:
+                    del self._partial_index[key[1]]
+        b.index_key = None
+
+    # ---------------------------------------------------- release / gc
+
+    def release(self, table: List[int]) -> None:
+        """Drop a finished request's pins. Refcount-0 blocks remain
+        cached (LRU-evictable); orphans (invalidated while pinned) are
+        freed outright."""
+        with self._lock:
+            for bid in table:
+                b = self._blocks.get(bid)
+                if b is None:
+                    continue
+                b.ref = max(0, b.ref - 1)
+                if b.ref == 0 and b.index_key is None:
+                    if b.parent_bid is not None \
+                            and b.parent_bid in self._blocks:
+                        self._blocks[b.parent_bid].children -= 1
+                    del self._blocks[b.bid]
+                    self._free.append(b.bid)
+            util = 1.0 - len(self._free) / self.num_blocks
+        kvcache_metrics()["utilization"].set(util)
+
+    def invalidate(self) -> None:
+        """Weight swap: every cached block's KV was computed under the
+        OLD params — drop the whole index so no future lookup matches
+        it. In-flight slots keep their pinned (now orphaned) blocks for
+        refcount accounting only; they decode off their own slab."""
+        with self._lock:
+            for b in list(self._blocks.values()):
+                self._drop_index_locked(b)
+                if b.ref == 0:
+                    del self._blocks[b.bid]
+                    self._free.append(b.bid)
+            for b in self._blocks.values():
+                b.children = 0
+            self._stats["invalidations"] += 1
+            self._event_locked({"kind": "invalidate"})
+            util = 1.0 - len(self._free) / self.num_blocks
+        kvcache_metrics()["utilization"].set(util)
+
+    # -------------------------------------------------- stats / events
+
+    def _event_locked(self, ev: Dict[str, Any]) -> None:
+        ev.setdefault("ts", time.time())
+        self._events.append(ev)
+        if len(self._events) > _EVENTS_KEPT:
+            del self._events[:len(self._events) - _EVENTS_KEPT]
+
+    def record_event(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            self._event_locked(dict(ev))
+
+    def drain_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._events = self._events, []
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            s: Dict[str, Any] = dict(self._stats)
+            cached = sum(1 for b in self._blocks.values()
+                         if b.index_key is not None)
+            pinned = sum(1 for b in self._blocks.values() if b.ref > 0)
+            s.update(
+                enabled=True,
+                block_size=self.block_size,
+                num_blocks=self.num_blocks,
+                free_blocks=len(self._free),
+                cached_blocks=cached,
+                pinned_blocks=pinned,
+                pool_utilization=1.0 - len(self._free) / self.num_blocks,
+            )
+        looked = s["lookups"]
+        s["hit_rate"] = ((s["hits"] + s["partial_hits"]) / looked
+                         if looked else 0.0)
+        seen = s["reused_tokens"] + s["prefilled_tokens"]
+        s["token_reuse_rate"] = s["reused_tokens"] / seen if seen else 0.0
+        return s

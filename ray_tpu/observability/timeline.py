@@ -21,6 +21,11 @@ merges and labels them:
                  and reaps (ray_tpu.weights), so a serving replica's
                  swap lines up against the training steps that
                  produced the version.
+- kvcache:       pid = "kvcache",         tid = event kind — instant
+                 markers for paged-KV prefix hits, evictions, and
+                 swap invalidations (models/kvcache.py), so serving
+                 cache behavior lines up against request traffic and
+                 weight swaps.
 """
 from __future__ import annotations
 
@@ -109,6 +114,30 @@ def weight_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def kvcache_trace_events(events: List[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    """Instant markers for paged-KV cache events (prefix_hit, evict,
+    invalidate) — mirrors the weights track under pid "kvcache"."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        if ev.get("outcome"):
+            label += f":{ev['outcome']}"
+        if ev.get("reused_tokens") is not None:
+            label += f" +{ev['reused_tokens']}tok"
+        out.append({
+            "name": label, "cat": "kvcache", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "kvcache", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -135,6 +164,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         resilience_events: Optional[
                             List[Dict[str, Any]]] = None,
                         weight_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        kvcache_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -147,6 +178,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(resilience_trace_events(resilience_events))
     if weight_events:
         trace.extend(weight_trace_events(weight_events))
+    if kvcache_events:
+        trace.extend(kvcache_trace_events(kvcache_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -177,7 +210,11 @@ def merged_timeline(filename: Optional[str] = None,
         wev = w.conductor.call("get_weight_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-weights conductor
         wev = []
-    trace = merged_chrome_trace(events, spans, steps, resil, wev)
+    try:
+        kvev = w.conductor.call("get_kvcache_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-kvcache conductor
+        kvev = []
+    trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

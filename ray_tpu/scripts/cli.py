@@ -309,6 +309,61 @@ def cmd_weights(args) -> None:
         print(f"dropped {dropped} version(s) of {args.name!r}")
 
 
+def cmd_kvcache(args) -> None:
+    """`ray_tpu kvcache` — paged-KV prefix-cache view (models/kvcache):
+    per-engine hit/miss/eviction counters and pool utilization plus the
+    cluster totals every other surface (state API, /api/kvcache,
+    Prometheus, timeline markers) reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.kv_cache_stats(getattr(args, "engine", None))
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    engines = st.get("engines") or {}
+    totals = st.get("totals") or {}
+    if not engines:
+        print("no kv-cache telemetry recorded (is a "
+              "ContinuousBatchingEngine with the prefix cache enabled "
+              "running?)")
+        return
+    print(f"totals: lookups={totals.get('lookups', 0)} "
+          f"hit_rate={totals.get('hit_rate', 0.0):.2%} "
+          f"token_reuse={totals.get('token_reuse_rate', 0.0):.2%} "
+          f"evictions={totals.get('evictions', 0)} "
+          f"cow={totals.get('cow_copies', 0)}")
+    for key, s in sorted(engines.items()):
+        if not s.get("enabled", False):
+            print(f"  {key}: prefix cache DISABLED "
+                  f"(admitted={s.get('admitted', 0)})")
+            continue
+        print(f"  {key}: hits={s.get('hits', 0)} "
+              f"partial={s.get('partial_hits', 0)} "
+              f"misses={s.get('misses', 0)} "
+              f"reused_tok={s.get('reused_tokens', 0)} "
+              f"prefilled_tok={s.get('prefilled_tokens', 0)} "
+              f"pool={s.get('pool_utilization', 0.0):.0%} "
+              f"({s.get('cached_blocks', 0)} cached / "
+              f"{s.get('pinned_blocks', 0)} pinned / "
+              f"{s.get('num_blocks', 0)} blocks) "
+              f"evictions={s.get('evictions', 0)} "
+              f"cow={s.get('cow_copies', 0)} "
+              f"invalidations={s.get('invalidations', 0)}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_kvcache_events", args.events,
+                                  timeout=10.0)
+        for ev in events[-args.events:]:
+            when = time.strftime("%H:%M:%S",
+                                 time.localtime(ev.get("ts", 0)))
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("kind", "ts") and v is not None}
+            print(f"  [{when}] {ev.get('kind')} "
+                  + " ".join(f"{k}={v}" for k, v in extra.items()))
+
+
 def cmd_metrics(args) -> None:
     _connect(args)
     from ray_tpu.util import state
@@ -583,6 +638,17 @@ def main(argv=None) -> None:
     ws.add_argument("--keep", type=int, required=True)
     ws.add_argument("--address")
     sp.set_defaults(fn=cmd_weights)
+
+    sp = sub.add_parser("kvcache",
+                        help="paged KV prefix cache: per-engine "
+                             "hit/miss/eviction stats, pool "
+                             "utilization, recent events")
+    sp.add_argument("--engine", help="filter to one engine id")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N cache events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_kvcache)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

@@ -50,10 +50,13 @@ def _build_metrics() -> None:
             "serve_request_latency_ms",
             "end-to-end request latency on the replica",
             boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+        # `cache` labels prefix-cache wins (hit|partial|miss, "" for
+        # streams that did not come from the batching engine) so the
+        # paged KV cache shows up in the existing latency pipeline
         ttft=Histogram(
             "serve_ttft_ms",
             "time to first streamed chunk (streaming requests)",
-            boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+            boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags + ("cache",)),
         requests=Counter(
             "serve_requests_total", "requests handled",
             tag_keys=tags + ("outcome",)),
@@ -234,7 +237,8 @@ class ReplicaActor:
             _request_context.reset(token)
 
     def _track(self, t0: float, outcome: str,
-               ttft_s: Optional[float] = None) -> None:
+               ttft_s: Optional[float] = None,
+               cache_label: Optional[str] = None) -> None:
         """Record one finished request into the Prometheus pipeline.
         Runs in the request paths' finally blocks, so it must never
         raise: a telemetry failure would discard a computed response or
@@ -247,7 +251,9 @@ class ReplicaActor:
             m["latency"].observe((time.perf_counter() - t0) * 1e3,
                                  tags=self._tags)
             if ttft_s is not None:
-                m["ttft"].observe(ttft_s * 1e3, tags=self._tags)
+                m["ttft"].observe(ttft_s * 1e3,
+                                  tags=dict(self._tags,
+                                            cache=cache_label or ""))
             m["requests"].inc(1, tags=dict(self._tags, outcome=outcome))
             m["inflight"].set(self._inflight,
                               tags=dict(self._tags,
@@ -293,7 +299,7 @@ class ReplicaActor:
         from ray_tpu._private.worker import global_worker
 
         t0 = time.perf_counter()
-        outcome, ttft = "ok", None
+        outcome, ttft, cache_label = "ok", None, None
         with self._lock:
             self._inflight += 1
             self._num_requests += 1
@@ -309,6 +315,10 @@ class ReplicaActor:
                     payload = serialization.dumps(item)
                     if ttft is None:  # first token/chunk produced
                         ttft = time.perf_counter() - t0
+                        # batching-engine streams label their TTFT with
+                        # the admission's prefix-cache outcome
+                        # (engine.TokenStream.cache_outcome)
+                        cache_label = getattr(it, "cache_outcome", None)
                     if (seq + 1) % self._ACK_EVERY == 0:
                         if not client.call("stream_chunk", stream_id, seq,
                                            payload, timeout=60.0):
@@ -327,7 +337,7 @@ class ReplicaActor:
         finally:
             with self._lock:
                 self._inflight -= 1
-            self._track(t0, outcome, ttft_s=ttft)
+            self._track(t0, outcome, ttft_s=ttft, cache_label=cache_label)
 
     # -- control plane ------------------------------------------------------
     def get_queue_len(self) -> int:

@@ -129,6 +129,21 @@ def weight_versions(name: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def kv_cache_stats(engine: Optional[str] = None) -> Dict[str, Any]:
+    """Paged-KV prefix-cache view (models/kvcache.py): per-engine stat
+    snapshots (hits/misses/evictions, pool utilization, reused vs
+    prefilled tokens) plus cluster totals with hit/token-reuse rates.
+    The CLI analog is `python -m ray_tpu kvcache`; the dashboard serves
+    it at /api/kvcache. `engine` filters to one engine id."""
+    out = _conductor().conductor.call("get_kvcache_stats", timeout=10.0)
+    if engine is not None:
+        out = {"engines": {k: v for k, v in out.get("engines",
+                                                    {}).items()
+                           if v.get("engine_id") == engine},
+               "totals": out.get("totals", {})}
+    return out
+
+
 def resilience_status() -> Dict[str, Any]:
     """Recovery-subsystem view (ray_tpu.resilience): per-host failure
     scores with quarantine/drain flags, the excluded host list, event

@@ -38,6 +38,11 @@ def pytest_configure(config):
         "(ray_tpu.weights); the tier-1-safe smoke subset runs on a "
         "virtual cluster with log_to_driver=0 — select with "
         "`-m weights`")
+    config.addinivalue_line(
+        "markers", "kvcache: paged KV prefix-cache scenarios "
+        "(ray_tpu.models.kvcache + the batching engine); everything is "
+        "tier-1-safe on CPU, the e2e surface check runs on a virtual "
+        "cluster with log_to_driver=0 — select with `-m kvcache`")
 
 
 def _sweep_leaked_shm():

@@ -1,0 +1,379 @@
+"""Paged KV cache with prefix reuse (ISSUE-6 acceptance surface):
+block-pool allocator semantics (refcounts, COW, LRU eviction), engine
+bit-identity cached-vs-uncached (incl. weight swap invalidation),
+prefill-work proportionality to the hit rate, and the one-set-of-numbers
+consistency check across state API / CLI / dashboard / Prometheus /
+timeline.
+
+The `kvcache` marker tags the scenarios; everything here is tier-1-safe
+on CPU — the e2e surface check runs on a virtual cluster with
+log_to_driver=0 per the established fixture pattern."""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import engine as engine_mod
+from ray_tpu.models.engine import ContinuousBatchingEngine
+from ray_tpu.models.generate import generate
+from ray_tpu.models.kvcache import PagedKVCache
+from ray_tpu.models.llama import LlamaConfig, llama_init
+
+pytestmark = pytest.mark.kvcache
+
+CFG = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+BS = 4  # test block size: small enough to exercise chains + tails
+
+
+@pytest.fixture(scope="module")
+def model():
+    return llama_init(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("kv_block_size", BS)
+    kw.setdefault("kv_pool_blocks", 32)
+    return ContinuousBatchingEngine(model, CFG, **kw)
+
+
+def _reference(model, prompt, n):
+    return np.asarray(generate(model, CFG, jnp.asarray([prompt],
+                                                       jnp.int32),
+                               max_new_tokens=n))[0].tolist()
+
+
+def _fake_kv(seed: int) -> tuple:
+    """A deterministic single-sequence cache fill [L, S, H, hd] for
+    allocator-level tests (the allocator never inspects KV values)."""
+    rng = np.random.default_rng(seed)
+    shape = (CFG.num_layers, CFG.max_seq_len, CFG.num_kv_heads,
+             CFG.head_dim)
+    return (jnp.asarray(rng.standard_normal(shape), jnp.float32),
+            jnp.asarray(rng.standard_normal(shape), jnp.float32))
+
+
+# ------------------------------------------------------- allocator unit
+
+def test_allocator_refcount_sharing_and_gather():
+    pool = PagedKVCache(CFG, block_size=BS, num_blocks=8)
+    tokens = np.arange(1, 9, dtype=np.int32)          # 2 full blocks
+    ck, cv = _fake_kv(0)
+    miss = pool.lookup(tokens, max_tokens=7)
+    assert miss.outcome == "miss" and miss.tokens == 0
+    table = pool.commit(tokens, ck, cv, miss)
+    assert len(table) == 2
+    st = pool.stats()
+    assert st["inserted_blocks"] == 2 and st["pinned_blocks"] == 2
+
+    # a second identical prompt shares block 0 (block 1 ends at token 8
+    # > max_tokens=7, so the suffix stays prefillable)
+    m2 = pool.lookup(tokens, max_tokens=7)
+    assert m2.tokens == BS and m2.outcome == "hit"
+    pk, pv = pool.gather(m2)
+    np.testing.assert_array_equal(np.asarray(pk), np.asarray(ck)[:, :BS])
+    np.testing.assert_array_equal(np.asarray(pv), np.asarray(cv)[:, :BS])
+
+    pool.release(table)
+    pool.release(m2.bids)
+    st = pool.stats()
+    # releases drop pins, NOT cache entries
+    assert st["pinned_blocks"] == 0 and st["cached_blocks"] == 2
+    assert pool.lookup(tokens, max_tokens=7).tokens == BS
+
+
+def test_allocator_eviction_spares_referenced_blocks():
+    pool = PagedKVCache(CFG, block_size=BS, num_blocks=2)
+    ck, cv = _fake_kv(1)
+    tok_a = np.arange(10, 14, dtype=np.int32)
+    tok_b = np.arange(20, 24, dtype=np.int32)
+    tok_c = np.arange(30, 34, dtype=np.int32)
+    table_a = pool.commit(tok_a, ck, cv, pool.lookup(tok_a, 3))
+    table_b = pool.commit(tok_b, ck, cv, pool.lookup(tok_b, 3))
+    assert len(table_a) == len(table_b) == 1
+    pool.release(table_b)  # B unpinned; A stays pinned
+
+    table_c = pool.commit(tok_c, ck, cv, pool.lookup(tok_c, 3))
+    assert len(table_c) == 1        # allocated by evicting B (LRU ref-0)
+    st = pool.stats()
+    assert st["evictions"] == 1
+    # the pinned block was never reclaimed; the unpinned one was
+    assert pool.lookup(np.concatenate([tok_a, tok_a]), 7).tokens == BS
+    assert pool.lookup(np.concatenate([tok_b, tok_b]), 7).tokens == 0
+
+    # pool exhausted with everything pinned: commit degrades to no-op
+    tok_d = np.arange(40, 44, dtype=np.int32)
+    table_d = pool.commit(tok_d, ck, cv, pool.lookup(tok_d, 3))
+    assert table_d == [] and pool.stats()["evictions"] == 1
+
+
+def test_allocator_cow_divergence_after_shared_prefix():
+    pool = PagedKVCache(CFG, block_size=BS, num_blocks=8)
+    base = np.arange(1, 7, dtype=np.int32)             # 6: full + tail 2
+    ck_a, cv_a = _fake_kv(2)
+    table_a = pool.commit(base, ck_a, cv_a, pool.lookup(base, 5))
+    assert len(table_a) == 2                           # b0 full, b1 tail
+    assert pool.stats()["cow_copies"] == 0
+
+    # B shares the 6-token prefix then diverges; its fill agrees with
+    # A's on the shared region (bit-identity invariant of prefill)
+    ext = np.concatenate([base, np.arange(50, 54, dtype=np.int32)])
+    ck_b = jnp.asarray(np.where(
+        (np.arange(CFG.max_seq_len) < 6)[None, :, None, None],
+        np.asarray(ck_a), np.asarray(_fake_kv(3)[0])), jnp.float32)
+    cv_b = ck_b + 1.0
+    m_b = pool.lookup(ext, max_tokens=9)
+    assert m_b.tokens == 6 and m_b.partial_bid is not None
+    table_b = pool.commit(ext, ck_b, cv_b, m_b)
+    st = pool.stats()
+    # the shared partial was widened via copy-on-write, not mutated
+    assert st["cow_copies"] == 1
+    # ...so A's 6-token prefix entry still matches for a third prompt
+    third = np.concatenate([base, np.arange(70, 74, dtype=np.int32)])
+    assert pool.lookup(third, max_tokens=9).tokens == 6
+    # and B's widened chain serves B-shaped prompts with B's contents
+    m_b2 = pool.lookup(ext, max_tokens=8)
+    assert m_b2.tokens == 8
+    pk, _pv = pool.gather(m_b2)
+    np.testing.assert_array_equal(np.asarray(pk),
+                                  np.asarray(ck_b)[:, :8])
+
+
+def test_allocator_skips_tail_crossing_cache_window():
+    """block_size not dividing max_seq_len: a tail block whose nominal
+    extent crosses the cache window must not be cached (dynamic_slice
+    would clamp the start and store shifted rows)."""
+    pool = PagedKVCache(CFG, block_size=24, num_blocks=8)   # S=128
+    tokens = np.arange(1, 123, dtype=np.int32)   # 5 full blocks + 2
+    ck, cv = _fake_kv(4)
+    table = pool.commit(tokens, ck, cv, pool.lookup(tokens, 121))
+    assert len(table) == 5                       # tail (extent 144) skipped
+    m = pool.lookup(tokens, max_tokens=121)
+    assert m.tokens == 120 and m.partial_bid is None
+    pool.release(table)
+    pool.release(m.bids)
+
+
+# ------------------------------------------------ engine bit-identity
+
+def test_cached_engine_bit_identical_to_uncached(model):
+    cached = _engine(model)
+    uncached = _engine(model, prefix_cache=False)
+    base = [1, 2, 3, 4, 5, 6, 7, 8]                   # block-aligned
+    prompts = [base, base, base + [9, 10, 11],
+               base[:6] + [7, 7], [5, 5, 5]]
+    try:
+        for p in prompts:
+            got = cached.generate(p, 6)
+            assert got == uncached.generate(p, 6), p
+            assert got == _reference(model, p, 6), p
+        st = cached.kv_stats()
+        assert st["hits"] >= 1 and st["reused_tokens"] > 0
+        assert uncached.kv_stats()["enabled"] is False
+    finally:
+        cached.stop()
+        uncached.stop()
+
+
+def test_weight_swap_invalidates_prefix_cache(model):
+    params_b = jax.tree.map(lambda x: x * 1.25, model)
+    eng = _engine(model)
+    fresh_b = _engine(params_b, prefix_cache=False)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    try:
+        eng.generate(prompt, 4)                   # caches prefix under A
+        applied = eng.update_params(params_b, version=2)
+        assert applied.wait(timeout=30.0)
+        # same prompt post-swap: a stale-prefix match would serve
+        # params-A KV and diverge from the uncached params-B engine
+        assert eng.generate(prompt, 4) == fresh_b.generate(prompt, 4)
+        st = eng.kv_stats()
+        assert st["invalidations"] == 1
+    finally:
+        eng.stop()
+        fresh_b.stop()
+
+
+# ------------------------------------- prefill-work proportionality
+
+def test_prefix_reuse_drops_prefill_work_without_full_copy(model):
+    progs_before = engine_mod._prefill_paged._cache_size()
+    eng = _engine(model)
+    shared = [11, 12, 13, 14, 15, 16, 17, 18]         # 2 aligned blocks
+    prompts = [shared + [30 + i] for i in range(4)]
+    try:
+        for p in prompts:
+            assert eng.generate(p, 3) == _reference(model, p, 3)
+        st = eng.kv_stats()
+    finally:
+        eng.stop()
+    # request 1 prefills all 9 tokens; 2..4 only the 1-token suffix
+    assert st["misses"] == 1 and st["hits"] == 3
+    assert st["prefilled_tokens"] == 9 + 3 * 1
+    assert st["reused_tokens"] == 3 * 8
+    # splice writes O(prompt) rows per admission — the old _adopt_slot
+    # full-slab copy (max_batch x max_seq_len) is gone entirely
+    assert st["spliced_tokens"] == 4 * 9
+    assert not hasattr(engine_mod, "_adopt_slot")
+    # one compiled program per distinct (cached, suffix) shape: the
+    # 9-token miss prefill + the 1-on-8 suffix prefill
+    progs_after = engine_mod._prefill_paged._cache_size()
+    assert progs_after - progs_before <= 2
+
+
+def test_pool_exhaustion_falls_back_to_full_prefill(model):
+    eng = _engine(model, kv_pool_blocks=2)
+    try:
+        for i in range(5):
+            p = [60 + 10 * i + j for j in range(8)]   # all-distinct
+            assert eng.generate(p, 3) == _reference(model, p, 3), p
+        st = eng.kv_stats()
+        assert st["pinned_blocks"] == 0               # all released
+        assert st["num_blocks"] == 2
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------- admission cap
+
+def test_admission_cap_bounds_prefill_bursts(model, monkeypatch):
+    import concurrent.futures as cf
+
+    eng = _engine(model)
+    try:
+        assert eng.max_prefills_per_tick == 1         # default
+        prompts = [[i + 1, i + 2] for i in range(6)]
+        with cf.ThreadPoolExecutor(6) as pool:
+            futs = [pool.submit(eng.generate, p, 4) for p in prompts]
+            got = [f.result(timeout=120) for f in futs]
+        for p, g in zip(prompts, got):
+            assert g == _reference(model, p, 4), p
+        assert eng.max_admitted_per_tick <= 1
+    finally:
+        eng.stop()
+    monkeypatch.setenv("RAY_TPU_MAX_PREFILLS_PER_TICK", "3")
+    eng = _engine(model)
+    try:
+        assert eng.max_prefills_per_tick == 3
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------ serve TTFT label
+
+def test_stream_exposes_cache_outcome_for_ttft_label(model):
+    eng = _engine(model)
+    try:
+        p = [41, 42, 43, 44, 45, 46, 47, 48]
+        s1 = eng.stream(p, 3)
+        assert list(s1) and s1.cache_outcome == "miss"
+        s2 = eng.stream(p, 3)
+        assert list(s2) and s2.cache_outcome == "hit"
+        # plen-1 cap: the second block ends exactly at the prompt end,
+        # so one block (4 tokens) is reusable and the suffix prefills
+        assert s2.reused_tokens == 4
+    finally:
+        eng.stop()
+    from ray_tpu.serve.replica import _replica_metrics
+
+    assert "cache" in _replica_metrics()["ttft"]._tag_keys
+
+
+# ----------------------------------------------- e2e surface check
+
+@pytest.fixture
+def kvcache_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, _system_config={"log_to_driver": 0})
+    yield ray_tpu._private.worker.global_worker
+    ray_tpu.shutdown()
+
+
+def test_all_surfaces_report_consistent_numbers(kvcache_cluster, capsys):
+    """kv_cache_stats() / CLI / /api/kvcache / Prometheus / timeline
+    markers all report the SAME hit/miss/eviction numbers for one
+    engine's workload."""
+    import urllib.request
+
+    from ray_tpu.dashboard import DashboardServer
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import metrics as metrics_mod
+    from ray_tpu.util import state
+
+    w = kvcache_cluster
+    model = llama_init(CFG, jax.random.PRNGKey(0))
+    eng = _engine(model)
+    try:
+        shared = [21, 22, 23, 24, 25, 26, 27, 28]
+        for i in range(3):
+            eng.generate(shared + [90 + i], 3)
+        eng.publish_kv_telemetry(force=True)
+        local = eng.kv_stats()
+    finally:
+        eng.stop()
+    metrics_mod.flush()
+
+    # state API (the stats push is a fire-and-forget notify: poll until
+    # the FINAL snapshot — lookups settled — lands at the conductor)
+    import time as time_mod
+
+    key = f"{w.worker_id[:12]}:{eng.engine_id}"
+    deadline = time_mod.monotonic() + 10.0
+    while True:
+        st = state.kv_cache_stats()
+        mine = st["engines"].get(key)
+        if mine is not None and mine.get("lookups") == local["lookups"]:
+            break
+        assert time_mod.monotonic() < deadline, st
+        time_mod.sleep(0.1)
+    for key in ("lookups", "hits", "partial_hits", "misses",
+                "reused_tokens", "prefilled_tokens", "evictions"):
+        assert mine[key] == local[key], key
+    assert st["totals"]["hits"] == local["hits"]
+
+    # CLI (same conductor snapshot)
+    host, port = w.conductor_address
+    cli.main(["kvcache", "--json", "--address", f"{host}:{port}"])
+    cli_out = json.loads(capsys.readouterr().out)
+    assert cli_out["totals"]["hits"] == local["hits"]
+    assert cli_out["totals"]["misses"] == local["misses"]
+
+    # dashboard /api/kvcache
+    srv = DashboardServer(w.conductor_address, port=0).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/api/kvcache",
+                                    timeout=10.0) as r:
+            dash = json.loads(r.read())
+    finally:
+        srv.stop()
+    assert dash["totals"]["hits"] == local["hits"]
+    assert dash["totals"]["reused_tokens"] == local["reused_tokens"]
+    hit_events = [e for e in dash["events"]
+                  if e.get("kind") == "prefix_hit"
+                  and e.get("engine") == eng.engine_id]
+    assert len(hit_events) == local["hits"] + local["partial_hits"]
+
+    # Prometheus exposition: the kvcache families exist and the
+    # process-global counters cover at least this engine's work
+    prom = state.prometheus_metrics()
+    assert "ray_tpu_kvcache_lookups_total" in prom
+    assert "ray_tpu_kvcache_pool_utilization" in prom
+    lookup_total = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in prom.splitlines()
+        if line.startswith("ray_tpu_kvcache_lookups_total{"))
+    assert lookup_total >= local["lookups"]
+
+    # merged timeline: one instant marker per prefix hit
+    trace = state.timeline(merged=True)
+    markers = [e for e in trace if e.get("cat") == "kvcache"
+               and e.get("args", {}).get("engine") == eng.engine_id
+               and e.get("tid") == "prefix_hit"]
+    assert len(markers) == local["hits"] + local["partial_hits"]
+    assert all(m["ph"] == "i" and m["pid"] == "kvcache" for m in markers)

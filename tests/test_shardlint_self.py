@@ -31,7 +31,17 @@ def test_package_lint_covers_the_whole_tree():
         if any(n.endswith(".py") for n in filenames):
             seen.add(os.path.relpath(dirpath, PACKAGE_ROOT).split(
                 os.sep)[0])
-    assert {"serve", "parallel", "train", "resilience", "weights"} <= seen
+    assert {"serve", "parallel", "train", "resilience", "weights",
+            "models"} <= seen
+
+
+def test_kvcache_module_is_lint_covered():
+    """The paged KV cache (models/kvcache.py) is inside the self-lint
+    set: the walk parses it and it carries zero error findings of its
+    own (a rename/move would silently drop it from coverage)."""
+    path = os.path.join(PACKAGE_ROOT, "models", "kvcache.py")
+    assert os.path.exists(path)
+    assert errors(lint_path(path)) == []
 
 
 def test_driver_entry_is_clean_too():
