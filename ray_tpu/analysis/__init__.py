@@ -41,6 +41,12 @@ from .findings import (  # noqa: F401
     sort_findings,
 )
 from .astlint import lint_file, lint_path, lint_source  # noqa: F401
+from .pipelines import (  # noqa: F401
+    BUBBLE_WARN_FRACTION,
+    PIPELINE_SCHEDULES,
+    check_pipeline_schedule,
+    estimate_bubble_fraction,
+)
 
 # name -> submodule for the jax-dependent surface, resolved on demand.
 _LAZY = {

@@ -33,6 +33,9 @@ RULES: Dict[str, str] = {
         "large param fully replicated on every device (HBM blow-up)",
     "collective-over-dcn":
         "bandwidth-heavy collective spans a slow DCN axis",
+    "pipeline-bubble":
+        "pipeline schedule's analytic bubble fraction (S-1)/(M+S-1); "
+        "warning past 20%",
     "blocking-in-async":
         "blocking call (time.sleep / ray_tpu.get / Queue.get) inside "
         "an async def",

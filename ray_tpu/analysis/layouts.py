@@ -113,24 +113,32 @@ def _pipeline_findings(config: MeshConfig, n_devices: int,
                        num_slices: int, pp: int, data_parallel: int,
                        name: str) -> List[Finding]:
     """Trace the GPipe pipeline over an abstract mesh and lint its
-    collectives (ppermute ring + final-stage psum over 'pp')."""
+    collectives (ppermute ring + final-stage psum over 'pp') plus the
+    schedule's analytic bubble estimate (rule pipeline-bubble). The
+    microbatch count follows the M = 4*S sizing rule, so the builtin
+    layouts' own estimates stay at INFO."""
     import jax.numpy as jnp
 
     from ..parallel.pipeline import make_pipeline_fn
+    from .pipelines import check_pipeline_schedule
 
+    m = 4 * pp
+    findings = check_pipeline_schedule(pp, m, "gpipe",
+                                       where=f"{name}/schedule")
     layout = MeshLayout.from_config(config, n_devices, num_slices,
                                     name=name)
     mesh = abstract_mesh(layout)
     if mesh is None:  # jax without AbstractMesh: nothing to trace
-        return [Finding(
+        return findings + [Finding(
             "collective-over-dcn", INFO, f"{name}/collectives",
             "collective scan skipped: this jax has no AbstractMesh")]
-    d, batch = 16, 8 * data_parallel
+    d, batch = 16, data_parallel * m
     pipe = make_pipeline_fn(
-        lambda p, h: jnp.tanh(h @ p[0] + p[1]), mesh, num_microbatches=4)
+        lambda p, h: jnp.tanh(h @ p[0] + p[1]), mesh, num_microbatches=m)
     params = (_sds((pp, d, d)), _sds((pp, d)))
     uses = scan_collectives(pipe, params, _sds((batch, d)))
-    return check_collectives(layout, uses, where=f"{name}/collectives")
+    return findings + check_collectives(layout, uses,
+                                        where=f"{name}/collectives")
 
 
 def analyze_dcn_pp_fsdp(n_devices: int = 8, **_) -> List[Finding]:

@@ -23,6 +23,9 @@ Routes:
   /api/kvcache           paged KV prefix cache: per-engine stats +
                          totals (hit rates, pool utilization) and
                          recent prefix-hit/evict events
+  /api/pipeline          MPMD pipelines: stage registry + per-stage
+                         bubble fraction / channel bytes and recent
+                         pipeline events (ray_tpu.mpmd)
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -141,6 +144,17 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def pipeline(self) -> Dict[str, Any]:
+        """MPMD pipeline registry + the recent event tail (one payload
+        so the SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_pipeline_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_pipeline_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
         the actors-table drill-down."""
@@ -251,6 +265,7 @@ class DashboardServer:
             "/api/weights",
             self._json_route(lambda: d.simple("get_weight_versions")))
         app.router.add_get("/api/kvcache", self._json_route(d.kvcache))
+        app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

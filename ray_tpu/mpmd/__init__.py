@@ -1,0 +1,37 @@
+"""ray_tpu.mpmd — multi-program (MPMD) pipeline parallelism across
+slices.
+
+Where ``parallel.pipeline`` runs a GPipe schedule *inside one jit
+program* over the SPMD ``pp`` mesh axis (every stage shares one compiled
+program and one failure domain), this package runs each stage as its OWN
+program on its own slice-gang (arXiv 2412.14374): the
+:class:`PipelineConductor` forms one stage-gang per slice through the
+conductor-KV rendezvous, every stage compiles its own forward/backward
+independently, and microbatch activations/gradients stream
+point-to-point between adjacent stages over the object plane's chunked
+transfer (``util.chunks`` — the weight fabric's no-gather path).
+``schedule`` drives the ticks: 1F1B (warm-up, steady 1F/1B alternation,
+cool-down) by default, GPipe fill-drain as the fallback.
+
+Unlocks what single-program pipelining cannot express: models larger
+than one slice's program, independent per-stage compilation, and
+heterogeneous stages.
+
+Surfaces (the full convention): ``util.state.pipeline_status()``,
+``ray_tpu pipeline`` CLI, dashboard ``/api/pipeline``, Prometheus
+``ray_tpu_pipeline_bubble_fraction`` /
+``ray_tpu_pipeline_activations_bytes_total``, per-stage ``bubble_wait``
+in the flight recorder, and a ``pipeline`` lane of instant markers in
+the merged timeline.
+"""
+from .channels import ActivationChannel, ChannelStats  # noqa: F401
+from .conductor import PipelineConductor  # noqa: F401
+from .schedule import (  # noqa: F401
+    SCHEDULES,
+    Tick,
+    bubble_fraction,
+    gpipe_schedule,
+    one_f_one_b_schedule,
+    stage_schedule,
+)
+from .trainer import PipelineTrainer  # noqa: F401

@@ -1,0 +1,281 @@
+"""PipelineConductor: forms the stage-gangs and supervises the run.
+
+One stage per slice: each stage of the pipeline is its own gang (one
+actor process per stage today; ``hosts_per_stage != 1`` — multi-host
+stage-gangs with jax.distributed inside one stage — is the
+ROADMAP-named follow-up and refused loudly) assigned a slice identity,
+so a stage shares a failure domain with nothing but itself. Formation reuses the
+conductor-KV rendezvous machinery the SPMD gangs use —
+``pipeline_register_stage`` commits the pipeline "formed" atomically
+when the LAST stage registers, exactly like the weight registry's
+fragment commit — and the run rides the resilience layer's
+``GangSupervisor``: one dead stage kills the survivors (their channel
+recvs can never complete) so the driver's ``get`` fails fast instead of
+waiting out a channel timeout.
+
+Each stage compiles its own program (``StageProgram``) in its own
+process; the conductor never sees a trace of any stage's computation —
+only registry metadata, channel descriptors, and telemetry.
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_tpu.util.runtime import require_worker
+
+from .schedule import SCHEDULES, bubble_fraction
+from .stage import StageActor
+
+
+def _detect_num_slices(default: int) -> int:
+    """Slice count for stage placement: the virtual-slice override
+    (off-silicon dev/test path, parallel.multislice) wins; otherwise
+    assume one slice per stage."""
+    from ray_tpu.parallel.multislice import VIRTUAL_SLICES_ENV
+
+    v = os.environ.get(VIRTUAL_SLICES_ENV)
+    if v:
+        try:
+            return max(1, int(v))
+        except ValueError:
+            pass
+    return default
+
+
+class PipelineConductor:
+    """Forms and drives one named MPMD pipeline.
+
+    stage_fns[i](params_i, x) -> y is stage i's forward;
+    stage_params[i] its initial params. The last stage owns
+    loss_fn(y_last, target) -> scalar. `optimizer` (an optax
+    GradientTransformation) is instantiated independently per stage.
+    """
+
+    def __init__(self, name: str,
+                 stage_fns: Sequence[Callable],
+                 stage_params: Sequence[Any],
+                 optimizer,
+                 loss_fn: Callable, *,
+                 num_microbatches: int,
+                 schedule: str = "1f1b",
+                 hosts_per_stage: int = 1,
+                 resources_per_stage: Optional[Dict[str, float]] = None,
+                 run_id: str = ""):
+        if len(stage_fns) != len(stage_params):
+            raise ValueError(
+                f"{len(stage_fns)} stage fns but "
+                f"{len(stage_params)} stage param trees")
+        if len(stage_fns) < 2:
+            raise ValueError("an MPMD pipeline needs >= 2 stages; use "
+                             "JaxTrainer/TrainStep for a single program")
+        if schedule not in SCHEDULES:
+            raise ValueError(f"unknown schedule {schedule!r}; "
+                             f"one of {sorted(SCHEDULES)}")
+        if int(hosts_per_stage) != 1:
+            # multi-host stage-gangs (jax.distributed inside one stage)
+            # are the ROADMAP-named follow-up; refusing beats silently
+            # spawning a single-process stage for an 8-host request
+            raise NotImplementedError(
+                f"hosts_per_stage={hosts_per_stage}: stage-gangs run "
+                "one host per stage today (multi-host stage-gangs are "
+                "a ROADMAP follow-up)")
+        self.name = name
+        self.stage_fns = list(stage_fns)
+        self.stage_params = list(stage_params)
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.num_stages = len(stage_fns)
+        self.num_microbatches = int(num_microbatches)
+        self.schedule = schedule
+        self.hosts_per_stage = int(hosts_per_stage)
+        self.resources_per_stage = dict(resources_per_stage
+                                        or {"CPU": 1.0})
+        self.run_id = run_id or f"mpmd/{name}/{uuid.uuid4().hex[:8]}"
+        self.bubble_estimate = bubble_fraction(
+            schedule, self.num_stages, self.num_microbatches)
+        self._worker = require_worker("forming a pipeline")
+        self._actors: List[Any] = []
+        self._pg = None
+
+    # ----------------------------------------------------------- formation
+
+    def form(self, timeout: float = 120.0) -> Dict[str, Any]:
+        """Open the registry entry, spawn one stage-gang per slice, and
+        block until every stage registered (the atomic "formed" commit).
+        Lints the schedule first — a >20% analytic bubble is a warning
+        naming the M >= 4*S rule, same policy as TrainStep's spec lint."""
+        import warnings
+
+        from ray_tpu.analysis import check_pipeline_schedule, errors, \
+            format_report
+
+        findings = check_pipeline_schedule(
+            self.num_stages, self.num_microbatches, self.schedule,
+            where=f"pipeline/{self.name}")
+        if errors(findings):  # defensive: the rule never errors today
+            raise ValueError(format_report(findings))
+        if findings and any(f.severity == "warning" for f in findings):
+            warnings.warn("shardlint: " + format_report(findings),
+                          stacklevel=2)
+
+        w = self._worker
+        res = w.conductor.call(
+            "pipeline_open", self.name,
+            {"num_stages": self.num_stages,
+             "schedule": self.schedule,
+             "num_microbatches": self.num_microbatches,
+             "bubble_estimate": self.bubble_estimate,
+             "run_id": self.run_id}, timeout=30.0)
+        if isinstance(res, dict) and res.get("error"):
+            raise RuntimeError(f"pipeline_open rejected: {res['error']}")
+
+        try:
+            return self._form_gangs(timeout)
+        except BaseException:
+            # any formation failure (remote setup raised, registration
+            # rejected, poll timeout) must not leak live stage actors,
+            # the placement group, or a forever-"forming" registry
+            # entry — GangSupervisor only covers actor DEATH
+            self.close()
+            raise
+
+    def _form_gangs(self, timeout: float) -> Dict[str, Any]:
+        import ray_tpu
+        from ray_tpu.util.placement_group import placement_group
+
+        w = self._worker
+        num_slices = _detect_num_slices(self.num_stages)
+        remote_cls = ray_tpu.remote(StageActor)
+        opts = {"num_cpus": self.resources_per_stage.get("CPU", 1.0)}
+        extra = {k: v for k, v in self.resources_per_stage.items()
+                 if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
+        # one bundle per stage, SPREAD: stages land on distinct hosts
+        # whenever capacity allows, so a stage really does share a
+        # failure domain with nothing but itself (soft on a dev box,
+        # where one node hosts every bundle)
+        self._pg = placement_group(
+            [dict(self.resources_per_stage)
+             for _ in range(self.num_stages)], strategy="SPREAD")
+        self._pg.wait()
+        opts["placement_group"] = self._pg
+        self._actors = [
+            remote_cls.options(**opts).remote(
+                self.name, s, self.num_stages,
+                schedule=self.schedule,
+                num_microbatches=self.num_microbatches,
+                slice_id=s % num_slices, run_id=self.run_id)
+            for s in range(self.num_stages)]
+        setup_refs = [
+            a.setup.remote(
+                self.stage_fns[s], self.stage_params[s], self.optimizer,
+                self.loss_fn if s == self.num_stages - 1 else None)
+            for s, a in enumerate(self._actors)]
+        from ray_tpu.resilience import GangSupervisor
+
+        with GangSupervisor(self._actors, run_id=self.run_id):
+            registrations = ray_tpu.get(setup_refs)
+        rejected = [r for r in registrations
+                    if isinstance(r, dict) and r.get("error")]
+        if rejected:
+            # a rejected registration (wrong generation, closed
+            # pipeline) would otherwise burn the whole formation
+            # timeout before surfacing as a generic TimeoutError
+            raise RuntimeError(
+                f"pipeline {self.name!r} stage registration rejected: "
+                f"{rejected[0]['error']}")
+        # the LAST registration flips formed=True atomically; poll only
+        # as the safety net for out-of-order notify delivery
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = w.conductor.call("pipeline_get", self.name,
+                                   timeout=10.0)
+            if rec and rec.get("formed"):
+                return rec
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pipeline {self.name!r} did not form within "
+                    f"{timeout}s: "
+                    f"{len((rec or {}).get('stages') or {})}/"
+                    f"{self.num_stages} stages registered")
+            time.sleep(0.05)
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, num_steps: int, data_fn: Callable[[int], Any],
+            recv_timeout: float = 60.0) -> Dict[str, Any]:
+        """Drive `num_steps` pipeline steps across all stage-gangs under
+        gang supervision. Returns {"losses": [...], "stages": [summary
+        per stage]}; losses come from the last stage."""
+        import ray_tpu
+        from ray_tpu.resilience import GangSupervisor
+
+        if not self._actors:
+            self.form()
+        refs = [a.run_steps.remote(num_steps, data_fn,
+                                   recv_timeout=recv_timeout)
+                for a in self._actors]
+        try:
+            with GangSupervisor(self._actors, run_id=self.run_id):
+                summaries = ray_tpu.get(refs)
+        except Exception as e:
+            # the supervisor already killed the survivors (their
+            # channel recvs could never complete); mark the pipeline
+            # lane so the timeline shows WHY the run stopped
+            try:
+                self._worker.conductor.notify("report_pipeline_event", {
+                    "kind": "stage_death", "pipeline": self.name,
+                    "detail": f"{type(e).__name__}: {e}"[:500]})
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+            raise
+        return {"losses": summaries[-1].get("losses", []),
+                "stages": summaries}
+
+    def stage_params_snapshot(self) -> List[Any]:
+        """Host copies of every stage's current params (test/debug)."""
+        import ray_tpu
+
+        return ray_tpu.get([a.get_params.remote()
+                            for a in self._actors])
+
+    # --------------------------------------------------------------- close
+
+    def close(self) -> None:
+        """Kill the stage-gangs, release their placement group, and
+        close the registry entry."""
+        import ray_tpu
+
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+        self._actors = []
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import \
+                remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001 — conductor mid-shutdown
+                pass
+            self._pg = None
+        try:
+            self._worker.conductor.call("pipeline_close", self.name,
+                                        timeout=10.0)
+        except Exception:  # noqa: BLE001 — conductor mid-shutdown
+            pass
+
+    def __enter__(self) -> "PipelineConductor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = ["PipelineConductor"]

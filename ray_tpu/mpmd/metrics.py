@@ -1,0 +1,44 @@
+"""Prometheus surface of the MPMD pipeline subsystem — lazily created
+so importing ray_tpu.mpmd never spawns a metrics pusher (the weights /
+kvcache pattern). Both ride the util.metrics conductor-push pipeline
+into /api/metrics and `ray_tpu metrics`:
+
+- ray_tpu_pipeline_bubble_fraction        per-stage idle fraction of the
+                                          last pipeline step (bubble_wait
+                                          over step wall time)
+- ray_tpu_pipeline_activations_bytes_total  microbatch tensor bytes moved
+                                          through the activation/gradient
+                                          channels, by direction
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+# Rebound ONCE, to a fully-built dict: the unlocked fast path can only
+# ever observe None or the complete registry, never a partial one.
+_metrics: Optional[Dict[str, Any]] = None
+_lock = threading.Lock()
+
+
+def pipeline_metrics() -> Dict[str, Any]:
+    global _metrics
+    m = _metrics
+    if m is not None:
+        return m
+    with _lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _metrics = dict(
+                bubble_fraction=Gauge(
+                    "ray_tpu_pipeline_bubble_fraction",
+                    "per-stage pipeline bubble: bubble_wait over step "
+                    "wall time for the most recent step",
+                    tag_keys=("pipeline", "stage")),
+                activations_bytes=Counter(
+                    "ray_tpu_pipeline_activations_bytes_total",
+                    "microbatch activation/gradient bytes through the "
+                    "MPMD channels (chunked object-plane transfer)",
+                    tag_keys=("pipeline", "stage", "direction")))
+    return _metrics

@@ -1,0 +1,414 @@
+"""One MPMD pipeline stage: its own separately-compiled program on its
+own slice-gang.
+
+``StageProgram`` is the per-stage ``TrainStep`` analog: it owns the
+stage's params + optimizer state and jit-compiles the stage's OWN
+forward, backward (vjp recompute), and — on the last stage — fused
+loss-and-grad programs, independently of every other stage. That
+independence is the point of MPMD (arXiv 2412.14374): no stage ever
+traces another stage's computation, so per-stage compile is O(stage) and
+stages may be heterogeneous.
+
+``run_stage`` executes the stage's schedule ticks against the
+activation/gradient channels, accumulating gradients per microbatch and
+applying one optimizer update per pipeline step — blocked-on-channel
+time is accounted to the flight recorder's ``bubble_wait`` phase, so
+the merged timeline shows each stage's bubble directly.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .channels import ActivationChannel
+from .metrics import pipeline_metrics
+from .schedule import FORWARD, stage_schedule
+
+
+class StageProgram:
+    """Compiled programs + state for one stage.
+
+    apply_fn(params, x) -> y is this stage's forward. The LAST stage
+    additionally owns loss_fn(y, target) -> scalar and compiles one
+    fused loss-and-grad program instead of a separate forward/backward
+    pair (in 1F1B its backward follows its forward immediately)."""
+
+    def __init__(self, apply_fn: Callable, params: Any, optimizer,
+                 *, loss_fn: Optional[Callable] = None,
+                 is_last: bool = False,
+                 needs_input_grad: bool = True,
+                 num_microbatches: int = 1):
+        import jax
+
+        self.apply_fn = apply_fn
+        self.params = params
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.is_last = bool(is_last)
+        self.needs_input_grad = bool(needs_input_grad)
+        self.num_microbatches = int(num_microbatches)
+        if self.is_last and loss_fn is None:
+            raise ValueError("the last stage needs a loss_fn")
+        self.opt_state = optimizer.init(params)
+        self._saved: Dict[int, Any] = {}  # mb -> forward input
+        self._grad_acc: Any = None
+
+        self._fwd = jax.jit(apply_fn)
+
+        if self.needs_input_grad:
+            def bwd(params, x, dy):
+                _y, vjp = jax.vjp(apply_fn, params, x)
+                return vjp(dy)
+        else:
+            # stage 0 has no upstream: dropping dL/dx INSIDE the jit
+            # lets XLA dead-code-eliminate the whole input-grad chain
+            def bwd(params, x, dy):
+                _y, vjp = jax.vjp(apply_fn, params, x)
+                gp, _gx = vjp(dy)
+                return gp, None
+
+        self._bwd = jax.jit(bwd)
+
+        if self.is_last:
+            def loss_and_grad(params, x, target):
+                def of(p, xx):
+                    return loss_fn(apply_fn(p, xx), target)
+
+                (loss, gx_fn) = jax.value_and_grad(of, argnums=(0, 1))(
+                    params, x)
+                return loss, gx_fn
+
+            self._last = jax.jit(loss_and_grad)
+
+        # gradient-accumulation sum and the per-step update, jitted so
+        # the whole stage step stays on-device
+        def add(acc, g):
+            return jax.tree.map(lambda a, b: a + b, acc, g)
+
+        self._add = jax.jit(add)
+
+        def update(params, opt_state, acc):
+            import optax
+
+            grads = jax.tree.map(
+                lambda g: g / float(self.num_microbatches), acc)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._update = jax.jit(update)
+
+    # ------------------------------------------------------------- ticks
+
+    def forward(self, mb: int, x: Any) -> Any:
+        """Run this stage's forward on microbatch `mb`, saving the
+        input for the backward pass. Last-stage forwards only save (its
+        loss-and-grad program recomputes the forward with the target in
+        hand)."""
+        self._saved[mb] = x
+        if self.is_last:
+            return None
+        return self._fwd(self.params, x)
+
+    def backward(self, mb: int, dy: Any = None,
+                 target: Any = None) -> Any:
+        """Run the backward for microbatch `mb`. Mid/first stages take
+        the downstream gradient `dy`; the last stage takes `target` and
+        returns (loss, upstream_grad); others return upstream_grad.
+        Accumulates this stage's param grads."""
+        x = self._saved.pop(mb)
+        if self.is_last:
+            loss, (gp, gx) = self._last(self.params, x, target)
+        else:
+            loss = None
+            gp, gx = self._bwd(self.params, x, dy)
+        self._grad_acc = gp if self._grad_acc is None \
+            else self._add(self._grad_acc, gp)
+        return (loss, gx) if self.is_last else gx
+
+    def apply_update(self) -> None:
+        """One optimizer step from the accumulated microbatch grads."""
+        if self._grad_acc is None:
+            raise RuntimeError("apply_update before any backward")
+        self.params, self.opt_state = self._update(
+            self.params, self.opt_state, self._grad_acc)
+        self._grad_acc = None
+
+    def reset_step_state(self) -> None:
+        """Drop partial per-step state (saved activations, accumulated
+        grads). Called at run start so a retry on a still-live actor —
+        after an aborted run raised mid-step — can never average a dead
+        step's partial gradient sums into its first update."""
+        self._saved.clear()
+        self._grad_acc = None
+
+    @property
+    def live_activations(self) -> int:
+        return len(self._saved)
+
+
+def run_stage(program: StageProgram, *, name: str, stage: int,
+              num_stages: int, schedule: str, num_microbatches: int,
+              num_steps: int, data_fn: Callable[[int], Any],
+              timer=None, recv_timeout: float = 60.0,
+              run_id: str = "",
+              poll_interval: float = 0.25) -> Dict[str, Any]:
+    """Drive one stage through `num_steps` pipeline steps.
+
+    data_fn(step) -> (x, target): the deterministic per-step batch
+    source every stage shares (stage 0 consumes x, the last stage
+    consumes target; mid stages call it for neither). Returns the stage
+    summary (losses on the last stage, channel stats, bubble fraction).
+    """
+    import contextlib
+
+    import jax
+    import numpy as np
+
+    def bubble_cm():
+        return (timer.phase("bubble_wait") if timer is not None
+                else contextlib.nullcontext())
+
+    s, last = int(stage), int(stage) == int(num_stages) - 1
+    program.reset_step_state()  # a retried run must start clean
+    ticks = stage_schedule(schedule, s, num_stages, num_microbatches)
+    in_ch = out_ch = gin_ch = gout_ch = None
+    if s > 0:
+        in_ch = ActivationChannel(name, s - 1, s, stage=s,
+                                  run_id=run_id,
+                                  poll_interval=poll_interval)
+        gout_ch = ActivationChannel(name, s, s - 1, stage=s,
+                                    run_id=run_id,
+                                    poll_interval=poll_interval)
+    if not last:
+        out_ch = ActivationChannel(name, s, s + 1, stage=s,
+                                   run_id=run_id,
+                                   poll_interval=poll_interval)
+        gin_ch = ActivationChannel(name, s + 1, s, stage=s,
+                                   run_id=run_id,
+                                   poll_interval=poll_interval)
+
+    losses: List[float] = []
+    bubble_fracs: List[float] = []
+    # first execution of each jitted program traces+compiles and is
+    # attributed to the compile phase; every later call (including the
+    # rest of step 0's microbatches) is device_step
+    compiled = {"fwd": False, "bwd": False}
+
+    def compute_phase(kind: str) -> str:
+        if compiled[kind]:
+            return "device_step"
+        compiled[kind] = True
+        return "compile"
+
+    t_run0 = time.perf_counter()
+    try:
+        for step in range(int(num_steps)):
+            t_step0 = time.perf_counter()
+            bubble_s = 0.0
+            micro_x = micro_t = None
+            if s == 0 or last:
+                x_full, t_full = data_fn(step)
+                if s == 0:
+                    micro_x = _split_microbatches(x_full,
+                                                  num_microbatches)
+                if last:
+                    micro_t = _split_microbatches(t_full,
+                                                  num_microbatches)
+            step_losses: List[Any] = []
+            for tick in ticks:
+                if tick.op == FORWARD:
+                    if s == 0:
+                        x = jax.tree.map(lambda a: a[tick.mb], micro_x)
+                    else:
+                        t0 = time.perf_counter()
+                        with bubble_cm():
+                            x = in_ch.recv(step, tick.mb, "act",
+                                           timeout=recv_timeout)
+                        bubble_s += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    y = program.forward(tick.mb, x)
+                    if timer is not None:
+                        # last-stage forwards only save (no program
+                        # ran), so they never consume the compile slot
+                        timer.record(
+                            compute_phase("fwd") if not last
+                            else "device_step",
+                            time.perf_counter() - t0)
+                    if out_ch is not None:
+                        out_ch.send(step, tick.mb, "act", y)
+                else:
+                    if last:
+                        tgt = jax.tree.map(lambda a: a[tick.mb], micro_t)
+                        t0 = time.perf_counter()
+                        loss, gx = program.backward(tick.mb,
+                                                    target=tgt)
+                        if timer is not None:
+                            timer.record(compute_phase("bwd"),
+                                         time.perf_counter() - t0)
+                        step_losses.append(loss)
+                    else:
+                        t0 = time.perf_counter()
+                        with bubble_cm():
+                            dy = gin_ch.recv(step, tick.mb, "grad",
+                                             timeout=recv_timeout)
+                        bubble_s += time.perf_counter() - t0
+                        t0 = time.perf_counter()
+                        gx = program.backward(tick.mb, dy=dy)
+                        if timer is not None:
+                            timer.record(compute_phase("bwd"),
+                                         time.perf_counter() - t0)
+                    if gout_ch is not None:
+                        gout_ch.send(step, tick.mb, "grad", gx)
+            t0 = time.perf_counter()
+            program.apply_update()
+            if timer is not None:
+                timer.record("device_step", time.perf_counter() - t0)
+            step_s = time.perf_counter() - t_step0
+            frac = min(1.0, bubble_s / step_s) if step_s > 0 else 0.0
+            bubble_fracs.append(frac)
+            pipeline_metrics()["bubble_fraction"].set(
+                frac, tags={"pipeline": name, "stage": str(s)})
+            if last and step_losses:
+                losses.append(float(np.mean(
+                    [float(v) for v in step_losses])))
+            if timer is not None:
+                timer.end_step()
+        # success path: wait for the neighbors to TAKE the final
+        # step's payloads before close() drops the chunk refs (the
+        # refs are the chunks' lifetime — closing right after the last
+        # send would race the store free against the last fetch)
+        for ch in (out_ch, gout_ch):
+            if ch is not None:
+                ch.drain(timeout=max(10.0, recv_timeout / 2))
+    finally:
+        for ch in (in_ch, out_ch, gin_ch, gout_ch):
+            if ch is not None:
+                ch.close()
+    chans = [c for c in (in_ch, out_ch, gin_ch, gout_ch)
+             if c is not None]
+    summary: Dict[str, Any] = {
+        "stage": s,
+        "run_id": run_id,  # generation fencing at report time
+        "steps": int(num_steps),
+        "ticks_per_step": len(ticks),
+        "losses": losses,
+        "bubble_fraction": (sum(bubble_fracs) / len(bubble_fracs)
+                            if bubble_fracs else 0.0),
+        "last_bubble_fraction": (bubble_fracs[-1] if bubble_fracs
+                                 else 0.0),
+        "sent_bytes": sum(c.stats.sent_bytes for c in chans),
+        "recv_bytes": sum(c.stats.recv_bytes for c in chans),
+        "sent_msgs": sum(c.stats.sent_msgs for c in chans),
+        "recv_msgs": sum(c.stats.recv_msgs for c in chans),
+        "channel_wait_s": sum(c.stats.wait_s for c in chans),
+        "elapsed_s": time.perf_counter() - t_run0,
+    }
+    return summary
+
+
+def _split_microbatches(batch: Any, m: int) -> Any:
+    """Reshape every leaf [B, ...] -> [m, B/m, ...]; validates
+    divisibility with the batch named."""
+    import jax
+    import numpy as np
+
+    def split(a):
+        a = np.asarray(a)
+        if a.shape[0] % m != 0:
+            raise ValueError(
+                f"batch {a.shape[0]} not divisible by "
+                f"num_microbatches {m}")
+        return a.reshape(m, a.shape[0] // m, *a.shape[1:])
+
+    return jax.tree.map(split, batch)
+
+
+class StageActor:
+    """The stage-gang member actor (wrapped with ray_tpu.remote by the
+    PipelineConductor). One actor per stage host; rank 0 of each stage
+    registers the stage with the conductor's pipeline registry."""
+
+    def __init__(self, name: str, stage: int, num_stages: int, *,
+                 schedule: str, num_microbatches: int,
+                 slice_id: Optional[int] = None, run_id: str = ""):
+        self.name = name
+        self.stage = int(stage)
+        self.num_stages = int(num_stages)
+        self.schedule = schedule
+        self.num_microbatches = int(num_microbatches)
+        self.slice_id = self.stage if slice_id is None else int(slice_id)
+        self.run_id = run_id or f"mpmd/{name}"
+        self._program: Optional[StageProgram] = None
+
+    def setup(self, apply_fn: Callable, init_params: Any, optimizer,
+              loss_fn: Optional[Callable] = None) -> Dict[str, Any]:
+        """Build this stage's own program (independent compile) and
+        register the stage-gang with the conductor. Returns the
+        registration result ({"formed": bool, ...})."""
+        import os
+
+        from ray_tpu._private import worker as worker_mod
+
+        self._program = StageProgram(
+            apply_fn, init_params, optimizer, loss_fn=loss_fn,
+            is_last=self.stage == self.num_stages - 1,
+            needs_input_grad=self.stage > 0,
+            num_microbatches=self.num_microbatches)
+        w = worker_mod.global_worker
+        info = {"worker_id": getattr(w, "worker_id", None),
+                "slice_id": self.slice_id,
+                "run_id": self.run_id,
+                "pid": os.getpid()}
+        return w.conductor.call("pipeline_register_stage", self.name,
+                                self.stage, info, timeout=30.0)
+
+    def run_steps(self, num_steps: int, data_fn: Callable[[int], Any],
+                  recv_timeout: float = 60.0) -> Dict[str, Any]:
+        """Execute `num_steps` pipeline steps of this stage's schedule
+        and report the stage summary to every surface (registry stats,
+        step telemetry, Prometheus, timeline marker)."""
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.observability.step_timer import StepTimer
+        from ray_tpu.util import metrics as metrics_mod
+
+        if self._program is None:
+            raise RuntimeError("setup() must run before run_steps()")
+        timer = StepTimer(self.run_id, rank=self.stage,
+                          world_size=self.num_stages)
+        try:
+            summary = run_stage(
+                self._program, name=self.name, stage=self.stage,
+                num_stages=self.num_stages, schedule=self.schedule,
+                num_microbatches=self.num_microbatches,
+                num_steps=num_steps, data_fn=data_fn, timer=timer,
+                recv_timeout=recv_timeout, run_id=self.run_id)
+        finally:
+            timer.close()
+        w = worker_mod.global_worker
+        # the registry copy must stay O(1) per stage: the full per-step
+        # loss list rides the run_steps return value to the driver, not
+        # every status payload — only the final loss goes to the record
+        reg_stats = {k: v for k, v in summary.items() if k != "losses"}
+        if summary.get("losses"):
+            reg_stats["last_loss"] = summary["losses"][-1]
+        try:
+            w.conductor.call("report_pipeline_stats", self.name,
+                             self.stage, reg_stats, timeout=10.0)
+            w.conductor.notify("report_pipeline_event", {
+                "kind": "stage_report", "pipeline": self.name,
+                "stage": self.stage, "steps": summary["steps"],
+                "bubble_fraction": round(summary["bubble_fraction"], 6),
+                "sent_bytes": summary["sent_bytes"],
+                "recv_bytes": summary["recv_bytes"]})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        metrics_mod.flush()
+        return summary
+
+    def get_params(self) -> Any:
+        """This stage's current params (host copies) — test/debug."""
+        import jax
+        import numpy as np
+
+        return jax.tree.map(np.asarray, self._program.params)

@@ -137,7 +137,8 @@ def summarize_run(steps: Dict[int, Dict[int, Dict[str, Any]]],
         lead = by_rank[min(by_rank)]
         out["last_step_breakdown"] = {
             key: lead[key] for key in
-            ("data_wait_ms", "compile_ms", "device_step_ms",
-             "checkpoint_ms", "report_ms", "other_ms", "total_ms")
+            ("data_wait_ms", "bubble_wait_ms", "compile_ms",
+             "device_step_ms", "checkpoint_ms", "report_ms", "other_ms",
+             "total_ms")
             if key in lead}
     return out

@@ -31,7 +31,11 @@ from typing import Any, Dict, List, Optional
 # zero of them) without monkeypatching the global time module.
 _now = time.perf_counter
 
-PHASES = ("data_wait", "compile", "device_step", "checkpoint", "report")
+# bubble_wait: blocked on a pipeline channel waiting for an upstream
+# stage's activation / downstream stage's gradient (ray_tpu.mpmd) — the
+# per-stage pipeline bubble, distinct from data_wait (input pipeline).
+PHASES = ("data_wait", "bubble_wait", "compile", "device_step",
+          "checkpoint", "report")
 
 _FLUSH_EVERY = 16          # records per conductor batch
 _FLUSH_INTERVAL_S = 2.0    # matches the metric/span flush cadence

@@ -26,6 +26,11 @@ merges and labels them:
                  swap invalidations (models/kvcache.py), so serving
                  cache behavior lines up against request traffic and
                  weight swaps.
+- pipeline:      pid = "pipeline",        tid = "stage <s>" (or event
+                 kind) — one lane per MPMD stage-gang (ray_tpu.mpmd):
+                 formation, per-stage run reports (bubble fraction,
+                 channel bytes), stage deaths — beside the per-stage
+                 train-step markers whose args carry bubble_wait_ms.
 """
 from __future__ import annotations
 
@@ -138,6 +143,33 @@ def kvcache_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def pipeline_trace_events(events: List[Dict[str, Any]]
+                          ) -> List[Dict[str, Any]]:
+    """Instant markers for MPMD pipeline events (open, stage_registered,
+    formed, stage_report, stage_death, closed) — one lane per stage
+    under pid "pipeline" so each stage-gang's lifecycle reads as its own
+    track."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        name = ev.get("pipeline")
+        stage = ev.get("stage")
+        label = f"{kind}:{name}" if name else kind
+        if stage is not None:
+            label += f"/stage{stage}"
+        out.append({
+            "name": label, "cat": "pipeline", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "pipeline",
+            "tid": f"stage {stage}" if stage is not None else kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -166,6 +198,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         weight_events: Optional[
                             List[Dict[str, Any]]] = None,
                         kvcache_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        pipeline_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -180,6 +214,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(weight_trace_events(weight_events))
     if kvcache_events:
         trace.extend(kvcache_trace_events(kvcache_events))
+    if pipeline_events:
+        trace.extend(pipeline_trace_events(pipeline_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -214,7 +250,13 @@ def merged_timeline(filename: Optional[str] = None,
         kvev = w.conductor.call("get_kvcache_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-kvcache conductor
         kvev = []
-    trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev)
+    try:
+        pev = w.conductor.call("get_pipeline_events", limit,
+                               timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-mpmd conductor
+        pev = []
+    trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
+                                pev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -136,6 +136,27 @@ def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
                 f"stacked_params leading (stage) axis must be "
                 f"mesh.shape['{axis_name}']={pp}, got {sorted(leading, key=str)}"
                 " — did you forget stack_stage_params()?")
+        # Validate num_microbatches against the GLOBAL batch HERE, at
+        # call time: pipeline_apply's own check only fires inside
+        # shard_map, where it surfaces as an opaque trace-depth error
+        # naming neither the global batch nor the mesh axes.
+        data_sizes = {a: mesh.shape[a] for a in data_axes}
+        data_shards = int(np.prod(list(data_sizes.values())))
+        global_batch = int(x.shape[0]) if np.ndim(x) else 0
+        if global_batch % data_shards != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by the "
+                f"data-axis product {data_shards} (mesh axes "
+                f"{data_sizes})")
+        local_batch = global_batch // data_shards
+        if local_batch % num_microbatches != 0:
+            raise ValueError(
+                f"num_microbatches={num_microbatches} does not divide "
+                f"the per-shard batch {local_batch} (global batch "
+                f"{global_batch} over data axes {data_sizes}); choose "
+                f"num_microbatches dividing {local_batch}, e.g. by "
+                f"sizing the global batch as a multiple of "
+                f"{data_shards * num_microbatches}")
         pspec = full_param_spec(stacked_params)
         xspec = P(data_axes)
 

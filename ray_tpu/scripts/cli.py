@@ -214,6 +214,17 @@ def cmd_train_status(args) -> None:
                   f"{extra}{mark}")
 
 
+def _print_event_tail(events, n: int) -> None:
+    """Shared `[HH:MM:SS] kind k=v ...` tail rendering for the event
+    logs (resilience / kvcache / pipeline)."""
+    for ev in events[-n:]:
+        when = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("kind", "ts") and v is not None}
+        print(f"  [{when}] {ev.get('kind')} "
+              + " ".join(f"{k}={v}" for k, v in extra.items()))
+
+
 def cmd_resilience_status(args) -> None:
     """Recovery-subsystem view: quarantined/draining hosts with their
     decayed failure scores, event counters, and recent events."""
@@ -251,12 +262,7 @@ def cmd_resilience_status(args) -> None:
                                       in sorted(counters.items())))
     if st.get("last_ttr_s") is not None:
         print(f"last time-to-recovery: {st['last_ttr_s']:.2f}s")
-    for ev in (st.get("recent_events") or [])[-args.events:]:
-        when = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
-        extra = {k: v for k, v in ev.items()
-                 if k not in ("kind", "ts") and v is not None}
-        print(f"  [{when}] {ev.get('kind')} "
-              + " ".join(f"{k}={v}" for k, v in extra.items()))
+    _print_event_tail(st.get("recent_events") or [], args.events)
 
 
 def cmd_weights(args) -> None:
@@ -355,13 +361,63 @@ def cmd_kvcache(args) -> None:
         w = worker_mod.global_worker
         events = w.conductor.call("get_kvcache_events", args.events,
                                   timeout=10.0)
-        for ev in events[-args.events:]:
-            when = time.strftime("%H:%M:%S",
-                                 time.localtime(ev.get("ts", 0)))
-            extra = {k: v for k, v in ev.items()
-                     if k not in ("kind", "ts") and v is not None}
-            print(f"  [{when}] {ev.get('kind')} "
-                  + " ".join(f"{k}={v}" for k, v in extra.items()))
+        _print_event_tail(events, args.events)
+
+
+def cmd_pipeline(args) -> None:
+    """`ray_tpu pipeline` — MPMD pipeline view (ray_tpu.mpmd): per-
+    pipeline stage registry + per-stage run stats (bubble fraction,
+    channel bytes) plus the cluster totals every other surface (state
+    API, /api/pipeline, Prometheus, timeline markers) reports from the
+    same registry."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.pipeline_status(getattr(args, "name", None))
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    pipelines = st.get("pipelines") or {}
+    if not pipelines:
+        print("no MPMD pipelines registered (is a PipelineConductor/"
+              "PipelineTrainer running?)")
+        return
+    for name, rec in sorted(pipelines.items()):
+        status = "closed" if rec.get("closed") else (
+            "formed" if rec.get("formed") else
+            f"forming {len(rec.get('stages') or {})}/"
+            f"{rec['num_stages']}")
+        est = rec.get("bubble_estimate")
+        print(f"{name}: stages={rec['num_stages']} "
+              f"schedule={rec.get('schedule')} "
+              f"microbatches={rec.get('num_microbatches')} [{status}]"
+              + (f" est_bubble={est:.1%}" if est is not None else ""))
+        totals = rec.get("totals") or {}
+        if totals.get("steps"):
+            mean = totals.get("bubble_fraction_mean")
+            print(f"  totals: steps={totals['steps']} "
+                  f"activation_bytes={totals['activation_bytes']}"
+                  + (f" bubble_mean={mean:.1%}"
+                     if mean is not None else ""))
+        stages = rec.get("stages") or {}
+        stats = rec.get("stats") or {}
+        for s in sorted(stages, key=int):
+            reg = stages[s]
+            st_s = stats.get(s) or stats.get(str(s)) or {}
+            line = (f"  stage {s}: slice={reg.get('slice_id')} "
+                    f"worker={str(reg.get('worker_id'))[:12]}")
+            if st_s:
+                line += (f" steps={st_s.get('steps')} "
+                         f"bubble={st_s.get('bubble_fraction', 0.0):.1%}"
+                         f" sent={st_s.get('sent_bytes', 0)}B "
+                         f"recv={st_s.get('recv_bytes', 0)}B")
+            print(line)
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_pipeline_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
 
 
 def cmd_metrics(args) -> None:
@@ -649,6 +705,17 @@ def main(argv=None) -> None:
                     help="also print the last N cache events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_kvcache)
+
+    sp = sub.add_parser("pipeline",
+                        help="MPMD pipelines: stage registry, per-stage "
+                             "bubble fraction and channel bytes, "
+                             "recent events")
+    sp.add_argument("--name", help="filter to one pipeline")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N pipeline events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_pipeline)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

@@ -33,3 +33,15 @@ from .session import (  # noqa: F401
     report,
 )
 from .trainer import JaxTrainer, Result, TrainStep  # noqa: F401
+
+
+def __getattr__(name):
+    # PipelineTrainer lives in ray_tpu.mpmd (the MPMD subsystem) but is
+    # part of the train surface; resolved lazily to keep
+    # `import ray_tpu.train` free of the mpmd/channel machinery.
+    if name == "PipelineTrainer":
+        from ray_tpu.mpmd import PipelineTrainer
+
+        return PipelineTrainer
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -35,6 +35,11 @@ class ScalingConfig:
     # — multi-slice gangs shrink by whole slices and a ShardingConfig
     # whose dcn_dp equals num_slices follows. None = never shrink.
     min_workers: Optional[int] = None
+    # MPMD pipeline parallelism (ray_tpu.mpmd.PipelineTrainer): how many
+    # separately-compiled pipeline stages the job runs, one stage-gang
+    # per slice. 1 = no MPMD pipeline (single-program SPMD; the `pp`
+    # mesh axis remains the in-program GPipe alternative).
+    num_stages: int = 1
 
 
 def assign_worker_slices(num_workers: int, num_slices: int) -> list:

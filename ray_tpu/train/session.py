@@ -125,9 +125,10 @@ def report(metrics: Dict[str, Any],
     if timer is not None and timer.enabled:
         rec = timer.end_step()
         if rec is not None:
-            for key in ("total_ms", "data_wait_ms", "compile_ms",
-                        "device_step_ms", "checkpoint_ms", "report_ms",
-                        "other_ms", "tokens_per_sec", "mfu"):
+            for key in ("total_ms", "data_wait_ms", "bubble_wait_ms",
+                        "compile_ms", "device_step_ms", "checkpoint_ms",
+                        "report_ms", "other_ms", "tokens_per_sec",
+                        "mfu"):
                 if key in rec:
                     metrics.setdefault(
                         "step_time_ms" if key == "total_ms" else key,

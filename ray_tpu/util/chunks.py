@@ -1,0 +1,134 @@
+"""Shared chunked object-plane transfer: ONE implementation of the
+"host array -> owned chunk -> point-to-point fetch" path used by every
+subsystem that ships tensors between processes without a gather.
+
+Producers put each host array into THEIR OWN object store as a chunk
+(the shm path serves same-host readers zero-copy; remote readers stream
+it through the worker's 64MB-ranged `fetch_object_range` pulls) and pass
+around only a metadata entry naming the chunk. Consumers rebuild an
+``ObjectRef`` from the entry and pull the bytes point-to-point from the
+owner — the conductor only ever sees metadata, never payload.
+
+Extracted from ``weights/publisher.py`` / ``weights/subscriber.py`` so
+the live weight fabric and the MPMD activation channels
+(``ray_tpu.mpmd.channels``) share one implementation — including the
+``ascontiguousarray`` guard (it would promote 0-d arrays to 1-d, so
+0-d leaves skip it) — with one set of tests (``tests/test_mpmd.py``).
+
+Ownership model (deliberate, matching the object plane): the returned
+``ObjectRef``s ARE the chunks' lifetime. Callers must hold them until
+every consumer has fetched; dropping the last ref frees the store entry.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu._private.object_store import ObjectRef
+
+
+def ensure_chunkable(host_arr: Any) -> np.ndarray:
+    """`host_arr` as a C-contiguous ndarray ready for the store.
+
+    NB: ``np.ascontiguousarray`` would promote a 0-d array to 1-d, so
+    0-d arrays pass through as-is (they are trivially contiguous)."""
+    arr = np.asarray(host_arr)
+    if arr.ndim and not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    return arr
+
+
+def put_chunk(worker, host_arr: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Put one host array into `worker`'s own store. Returns
+    ``(ref, entry)`` — hold `ref` for the chunk's lifetime; `entry` is
+    the metadata a consumer needs to fetch it point-to-point (plus the
+    array's shape/dtype, so tree descriptors need no second
+    conversion pass)."""
+    arr = ensure_chunkable(host_arr)
+    ref = worker.put(arr)
+    entry = {"object_id": ref.id,
+             "locator": list(worker.address),
+             "nbytes": int(arr.nbytes),
+             "shape": list(arr.shape),
+             "dtype": str(arr.dtype)}
+    return ref, entry
+
+
+class ChunkFetcher:
+    """Chunk puller with a per-instance cache: each needed chunk crosses
+    the object plane at most once per fetcher, with remote-vs-local
+    accounting (``chunks_local`` / ``chunks_fetched`` /
+    ``fetched_bytes``). Callable with a chunk entry dict."""
+
+    def __init__(self, worker, timeout: float = 60.0,
+                 on_read: Optional[Callable[[int, bool], None]] = None):
+        self._worker = worker
+        self._timeout = timeout
+        self._on_read = on_read
+        self._cache: Dict[str, np.ndarray] = {}
+        self.chunks_local = 0
+        self.chunks_fetched = 0
+        self.fetched_bytes = 0
+
+    def __call__(self, entry: Dict[str, Any]) -> np.ndarray:
+        oid = entry["object_id"]
+        arr = self._cache.get(oid)
+        if arr is not None:
+            return arr
+        was_local = self._worker.store.contains(oid)
+        ref = ObjectRef(oid, locator=tuple(entry["locator"]),
+                        owner=tuple(entry["locator"]))
+        arr = np.asarray(self._worker.get(ref, timeout=self._timeout))
+        nbytes = int(entry.get("nbytes", arr.nbytes))
+        if was_local:
+            self.chunks_local += 1
+        else:
+            self.chunks_fetched += 1
+            self.fetched_bytes += nbytes
+        if self._on_read is not None:
+            self._on_read(nbytes, was_local)
+        self._cache[oid] = arr
+        return arr
+
+
+# ---------------------------------------------------------- pytree payloads
+
+def put_tree(worker, tree: Any) -> Tuple[List[Any], Dict[str, Any]]:
+    """Chunk every leaf of a pytree into `worker`'s store. Returns
+    ``(refs, descriptor)``: hold `refs` until consumers fetched; the
+    descriptor (leaf entries + pickled treedef) is metadata-only and
+    safe to route through the conductor."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    refs: List[Any] = []
+    entries: List[Dict[str, Any]] = []
+    total = 0
+    for leaf in leaves:
+        ref, entry = put_chunk(worker, leaf)
+        refs.append(ref)
+        entries.append(entry)
+        total += entry["nbytes"]
+    descriptor = {"leaves": entries,
+                  "treedef": pickle.dumps(treedef, protocol=5),
+                  "total_bytes": total}
+    return refs, descriptor
+
+
+def fetch_tree(worker, descriptor: Dict[str, Any],
+               fetcher: Optional[ChunkFetcher] = None) -> Any:
+    """Materialize a ``put_tree`` descriptor: pull each leaf chunk
+    point-to-point from its owner and unflatten."""
+    import jax
+
+    if fetcher is None:
+        fetcher = ChunkFetcher(worker)
+    leaves = [fetcher(entry) for entry in descriptor["leaves"]]
+    treedef = pickle.loads(descriptor["treedef"])
+    return jax.tree.unflatten(treedef, leaves)
+
+
+__all__ = ["ChunkFetcher", "ensure_chunkable", "fetch_tree", "put_chunk",
+           "put_tree"]

@@ -1,0 +1,27 @@
+"""Small runtime guards shared across subsystems."""
+from __future__ import annotations
+
+
+def require_worker(what: str):
+    """The connected global worker, or a clear error naming the
+    operation that needed it. One implementation for every subsystem
+    that fails without a cluster (weights, mpmd channels, ...)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError(
+            f"ray_tpu.init() must be called before {what}")
+    return w
+
+
+def pipeline_run_token(run_id: str) -> str:
+    """One path-safe key segment for an MPMD pipeline generation ("/"
+    is the channel-key separator). The ONE encoding both sides of the
+    generation fence use: mpmd.channels builds keys with it and the
+    conductor's pipeline_channel_put parses them against it — a
+    divergence would reject every send as a wrong-generation key."""
+    return (run_id or "default").replace("/", ":")
+
+
+__all__ = ["pipeline_run_token", "require_worker"]

@@ -144,6 +144,22 @@ def kv_cache_stats(engine: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def pipeline_status(name: Optional[str] = None) -> Dict[str, Any]:
+    """MPMD pipeline view (ray_tpu.mpmd): per-pipeline stage registry
+    (formed flag, per-stage slice/worker identity), per-stage run stats
+    (steps, bubble_fraction, channel bytes), cross-stage totals, and the
+    channel-mailbox depth. The CLI analog is `python -m ray_tpu
+    pipeline`; the dashboard serves it at /api/pipeline. `name` filters
+    to one pipeline."""
+    out = _conductor().conductor.call("get_pipeline_status", timeout=10.0)
+    if name is not None:
+        out = {"pipelines": {k: v for k, v
+                             in out.get("pipelines", {}).items()
+                             if k == name},
+               "mailbox_depth": out.get("mailbox_depth")}
+    return out
+
+
 def resilience_status() -> Dict[str, Any]:
     """Recovery-subsystem view (ray_tpu.resilience): per-host failure
     scores with quarantine/drain flags, the excluded host list, event

@@ -1,14 +1,4 @@
 """Shared internals of the weight fabric."""
 from __future__ import annotations
 
-
-def require_worker(what: str):
-    """The connected global worker, or a clear error naming the weight-
-    fabric operation that needed it."""
-    from ray_tpu._private import worker as worker_mod
-
-    w = worker_mod.global_worker
-    if w is None:
-        raise RuntimeError(
-            f"ray_tpu.init() must be called before {what}")
-    return w
+from ray_tpu.util.runtime import require_worker  # noqa: F401

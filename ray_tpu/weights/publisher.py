@@ -27,9 +27,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-import numpy as np
-
 from ray_tpu.train.async_checkpoint import _leaf_snapshots
+from ray_tpu.util import chunks
 
 from ._common import require_worker
 from .metrics import weight_metrics
@@ -112,16 +111,13 @@ class WeightPublisher:
             meta, shards = _leaf_snapshots(leaf)
             entries = []
             for index, host_arr in shards:
-                arr = np.asarray(host_arr)
-                if arr.ndim and not arr.flags.c_contiguous:
-                    # NB: ascontiguousarray would promote 0-d to 1-d
-                    arr = np.ascontiguousarray(arr)
-                ref = w.put(arr)
+                # shared chunked-transfer path (util.chunks): the put
+                # side of the fabric's 64MB-chunked no-gather transfer,
+                # incl. the ascontiguousarray 0-d promotion guard
+                ref, entry = chunks.put_chunk(w, host_arr)
                 refs.append(ref)
-                entries.append({"index": [list(t) for t in index],
-                                "object_id": ref.id,
-                                "locator": list(w.address),
-                                "nbytes": int(arr.nbytes)})
+                entries.append(dict(entry,
+                                    index=[list(t) for t in index]))
             frag_leaves[str(i)] = {**meta, "shards": entries}
         fragment: Dict[str, Any] = {"leaves": frag_leaves,
                                     "n_leaves": len(leaves)}

@@ -22,8 +22,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ray_tpu._private.object_store import ObjectRef
 from ray_tpu.train.async_checkpoint import _LeafReader, materialize_like
+from ray_tpu.util import chunks
 
 from ._common import require_worker
 from .metrics import weight_metrics
@@ -48,31 +48,21 @@ class FetchStats:
     elapsed_s: float = 0.0
 
 
-class _ChunkFetcher:
-    """Per-fetch chunk cache: each needed chunk crosses the object plane
-    at most once per fetch, with remote-vs-local accounting."""
+class _ChunkFetcher(chunks.ChunkFetcher):
+    """Shared chunked-transfer fetcher (util.chunks) feeding this
+    fetch's :class:`FetchStats` — each needed chunk crosses the object
+    plane at most once per fetch, with remote-vs-local accounting."""
 
     def __init__(self, worker, stats: FetchStats):
-        self._worker = worker
-        self._stats = stats
-        self._cache: Dict[str, np.ndarray] = {}
+        def on_read(nbytes: int, was_local: bool,
+                    _stats=stats) -> None:
+            if was_local:
+                _stats.chunks_local += 1
+            else:
+                _stats.chunks_fetched += 1
+                _stats.fetched_bytes += nbytes
 
-    def __call__(self, shard: Dict[str, Any]) -> np.ndarray:
-        oid = shard["object_id"]
-        arr = self._cache.get(oid)
-        if arr is not None:
-            return arr
-        was_local = self._worker.store.contains(oid)
-        ref = ObjectRef(oid, locator=tuple(shard["locator"]),
-                        owner=tuple(shard["locator"]))
-        arr = np.asarray(self._worker.get(ref, timeout=60.0))
-        if was_local:
-            self._stats.chunks_local += 1
-        else:
-            self._stats.chunks_fetched += 1
-            self._stats.fetched_bytes += int(shard["nbytes"])
-        self._cache[oid] = arr
-        return arr
+        super().__init__(worker, timeout=60.0, on_read=on_read)
 
 
 class _AccountingReader(_LeafReader):

@@ -43,6 +43,11 @@ def pytest_configure(config):
         "(ray_tpu.models.kvcache + the batching engine); everything is "
         "tier-1-safe on CPU, the e2e surface check runs on a virtual "
         "cluster with log_to_driver=0 — select with `-m kvcache`")
+    config.addinivalue_line(
+        "markers", "mpmd: MPMD pipeline-parallelism scenarios "
+        "(ray_tpu.mpmd: stage-gangs, 1F1B schedule, activation "
+        "channels); the tier-1-safe smoke subset runs on a virtual "
+        "cluster with log_to_driver=0 — select with `-m mpmd`")
 
 
 def _sweep_leaked_shm():

@@ -1,0 +1,632 @@
+"""MPMD pipeline parallelism (ray_tpu.mpmd, ISSUE-7 acceptance
+surface): stage-gangs, the 1F1B/GPipe schedules, activation channels
+over the shared chunked object-plane transfer (util.chunks), and the
+full surface convention (state API / CLI / dashboard / Prometheus /
+timeline markers).
+
+The `mpmd` marker tags the subsystem's scenarios; everything here is
+the tier-1-safe smoke subset (virtual 8-device CPU cluster,
+log_to_driver=0 per the established fixture pattern)."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import ray_tpu
+from ray_tpu.mpmd import schedule as sched
+
+
+# ------------------------------------------------- schedule unit tests
+
+
+def _ops(ticks):
+    return [str(t) for t in ticks]
+
+
+@pytest.mark.mpmd
+def test_1f1b_tick_order():
+    """Canonical non-interleaved 1F1B, S=2 M=4: stage 0 warms up with
+    one forward then alternates; the last stage alternates from the
+    first microbatch (no warm-up)."""
+    s0 = sched.one_f_one_b_schedule(0, 2, 4)
+    s1 = sched.one_f_one_b_schedule(1, 2, 4)
+    assert _ops(s0) == ["F0", "F1", "B0", "F2", "B1", "F3", "B2", "B3"]
+    assert _ops(s1) == ["F0", "B0", "F1", "B1", "F2", "B2", "F3", "B3"]
+
+
+@pytest.mark.mpmd
+def test_gpipe_tick_order():
+    ticks = sched.gpipe_schedule(0, 3, 3)
+    assert _ops(ticks) == ["F0", "F1", "F2", "B0", "B1", "B2"]
+
+
+@pytest.mark.mpmd
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("s,m", [(2, 4), (3, 7), (4, 16), (5, 1)])
+def test_schedules_complete_and_deadlock_free(schedule, s, m):
+    """Every (op, mb) appears exactly once per stage and the global
+    tick order is executable under channel semantics."""
+    schedules = {st: sched.stage_schedule(schedule, st, s, m)
+                 for st in range(s)}
+    for ticks in schedules.values():
+        assert sorted((t.op, t.mb) for t in ticks) == sorted(
+            [("F", i) for i in range(m)] + [("B", i) for i in range(m)])
+    sched.validate_dependencies(schedules, s, m)
+
+
+@pytest.mark.mpmd
+def test_1f1b_bounds_live_activations():
+    """The memory argument for 1F1B: peak saved activations is O(S)
+    (<= S - stage), while GPipe's is O(M)."""
+    s, m = 4, 16
+    for stage in range(s):
+        assert sched.max_live_activations("1f1b", stage, s, m) \
+            <= s - stage
+        assert sched.max_live_activations("gpipe", stage, s, m) == m
+
+
+@pytest.mark.mpmd
+def test_bubble_fraction_formula():
+    assert sched.bubble_fraction("gpipe", 4, 16) == pytest.approx(3 / 19)
+    assert sched.bubble_fraction("1f1b", 2, 4) == pytest.approx(1 / 5)
+    with pytest.raises(ValueError):
+        sched.bubble_fraction("zigzag", 2, 4)
+
+
+# --------------------------------------------- shardlint bubble estimate
+
+
+@pytest.mark.mpmd
+def test_shardlint_bubble_info_and_warning():
+    """The pipeline-bubble rule: INFO with the (S-1)/(M+S-1) estimate,
+    WARNING past 20% with the M >= 4*S fix hint naming the rule from
+    parallel/pipeline.py's docstring."""
+    from ray_tpu.analysis import RULES, check_pipeline_schedule
+
+    assert "pipeline-bubble" in RULES
+    ok = check_pipeline_schedule(4, 16, "gpipe", where="l/schedule")
+    assert len(ok) == 1 and ok[0].severity == "info"
+    assert "15.8%" in ok[0].message and "S=4" in ok[0].message
+
+    bad = check_pipeline_schedule(4, 4, "1f1b")
+    assert len(bad) == 1 and bad[0].severity == "warning"
+    assert "M >= 4*S" in bad[0].fix_hint
+    assert "M >= 16" in bad[0].fix_hint
+
+
+@pytest.mark.mpmd
+def test_builtin_pipeline_layouts_report_bubble(monkeypatch):
+    """The dryrun pipeline layouts now carry a schedule bubble estimate
+    (still INFO — they follow the M = 4*S sizing rule)."""
+    monkeypatch.setenv("RAY_TPU_VIRTUAL_SLICES", "2")
+    from ray_tpu.analysis.layouts import analyze_dp_pp
+
+    findings = analyze_dp_pp(8)
+    bubble = [f for f in findings if f.rule == "pipeline-bubble"]
+    assert len(bubble) == 1 and bubble[0].severity == "info"
+
+
+@pytest.mark.mpmd
+def test_make_pipeline_fn_validates_microbatches(cpu_mesh8):
+    """The divisibility check fires at call time with the global batch
+    and mesh axes named — not as a trace-depth error inside
+    shard_map."""
+    from ray_tpu.parallel import (MeshConfig, make_mesh,
+                                  make_pipeline_fn, stack_stage_params)
+
+    mesh = make_mesh(MeshConfig(dp=2, pp=4), devices=cpu_mesh8)
+    stages = [(jnp.zeros((8, 8)), jnp.zeros((8,))) for _ in range(4)]
+    stacked = stack_stage_params(stages)
+    pipe = make_pipeline_fn(
+        lambda p, x: jnp.tanh(x @ p[0] + p[1]), mesh,
+        num_microbatches=3)
+    x = jnp.zeros((16, 8))  # local batch 8, not divisible by 3
+    with pytest.raises(ValueError) as ei:
+        pipe(stacked, x)
+    msg = str(ei.value)
+    assert "num_microbatches=3" in msg
+    assert "global batch 16" in msg and "'dp': 2" in msg
+
+
+# -------------------------------------------------- cluster fixtures
+
+
+@pytest.fixture(scope="module")
+def mpmd_cluster():
+    """One virtual-slice cluster for the whole module (tier-1 wall-time
+    budget): every test uses its own pipeline name, so registry state
+    never crosses tests; the gang-death test runs last in file order."""
+    import os
+
+    prev = os.environ.get("RAY_TPU_VIRTUAL_SLICES")
+    os.environ["RAY_TPU_VIRTUAL_SLICES"] = "2"
+    ray_tpu.init(num_cpus=4, _system_config={"log_to_driver": 0})
+    yield ray_tpu._private.worker.global_worker
+    ray_tpu.shutdown()
+    if prev is None:
+        os.environ.pop("RAY_TPU_VIRTUAL_SLICES", None)
+    else:
+        os.environ["RAY_TPU_VIRTUAL_SLICES"] = prev
+
+
+# --------------------------------- shared chunked transfer (util.chunks)
+
+
+@pytest.mark.mpmd
+def test_chunk_tree_roundtrip_local(mpmd_cluster):
+    """put_tree/fetch_tree over the shared chunk path: values (incl. a
+    0-d leaf — the ascontiguousarray promotion guard — and a
+    non-contiguous leaf) roundtrip exactly; same-process fetches are
+    all LOCAL; the descriptor is metadata-only."""
+    from ray_tpu.util import chunks
+
+    w = mpmd_cluster
+    base = np.arange(48, dtype=np.float32).reshape(6, 8)
+    tree = {"mat": base, "t": base.T,  # .T is not C-contiguous
+            "scalar": np.float32(7.5), "zero_d": np.array(3.25)}
+    assert not base.T.flags.c_contiguous
+    refs, desc = chunks.put_tree(w, tree)
+    assert len(refs) == len(desc["leaves"]) == 4
+    assert desc["total_bytes"] == sum(e["nbytes"]
+                                      for e in desc["leaves"])
+    for e in desc["leaves"]:  # metadata only, no payload
+        assert set(e) >= {"object_id", "locator", "nbytes", "shape",
+                          "dtype"}
+    fetcher = chunks.ChunkFetcher(w)
+    out = chunks.fetch_tree(w, desc, fetcher)
+    np.testing.assert_array_equal(out["mat"], tree["mat"])
+    np.testing.assert_array_equal(out["t"], base.T)
+    assert out["zero_d"].shape == ()  # 0-d stayed 0-d
+    assert float(out["scalar"]) == 7.5
+    assert fetcher.chunks_local == 4 and fetcher.chunks_fetched == 0
+    assert fetcher.fetched_bytes == 0
+
+
+@pytest.mark.mpmd
+def test_chunk_tree_fetch_is_point_to_point(mpmd_cluster):
+    """A REMOTE process fetches each chunk exactly once, straight from
+    the owner: fetched_bytes == payload bytes (the no-full-copy
+    accounting both the weight fabric and the channels rely on)."""
+    from ray_tpu.util import chunks
+
+    w = mpmd_cluster
+    tree = {"a": np.arange(1024, dtype=np.float32),
+            "b": np.ones((32, 8), np.int32)}
+    refs, desc = chunks.put_tree(w, tree)
+
+    @ray_tpu.remote
+    def pull(desc):
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.util import chunks as ch
+
+        me = worker_mod.global_worker
+        fetcher = ch.ChunkFetcher(me)
+        out = ch.fetch_tree(me, desc, fetcher)
+        # fetch AGAIN through the same fetcher: the cache must prevent
+        # a second trip over the object plane
+        ch.fetch_tree(me, desc, fetcher)
+        return {"fetched": fetcher.chunks_fetched,
+                "local": fetcher.chunks_local,
+                "bytes": fetcher.fetched_bytes,
+                "a_sum": float(out["a"].sum()),
+                "b_shape": list(out["b"].shape)}
+
+    res = ray_tpu.get(pull.remote(desc))
+    assert res["fetched"] == 2 and res["bytes"] == desc["total_bytes"]
+    assert res["a_sum"] == float(np.arange(1024, dtype=np.float32).sum())
+    assert res["b_shape"] == [32, 8]
+    del refs  # the driver's refs were the chunks' lifetime
+
+
+@pytest.mark.mpmd
+def test_channel_roundtrip_and_retention(mpmd_cluster):
+    """ActivationChannel send/recv: exact payload roundtrip, mailbox
+    drained on take, recv bytes == sent bytes, and the sender's chunk
+    retention window stays bounded at two steps."""
+    from ray_tpu.mpmd.channels import ActivationChannel
+    from ray_tpu.util import state
+
+    # sends require an open registry entry (orphaned generations must
+    # not leak undeliverable entries toward the mailbox cap)
+    mpmd_cluster.conductor.call("pipeline_open", "chan-test",
+                                {"num_stages": 2}, timeout=10.0)
+    tx = ActivationChannel("chan-test", 0, 1)
+    rx = ActivationChannel("chan-test", 0, 1, stage=1)
+    try:
+        payload = {"h": np.random.default_rng(0).standard_normal(
+            (4, 16)).astype(np.float32), "mask": np.ones(4, np.int32)}
+        sent = tx.send(0, 2, "act", payload)
+        got = rx.recv(0, 2, "act", timeout=10.0)
+        np.testing.assert_array_equal(got["h"], payload["h"])
+        np.testing.assert_array_equal(got["mask"], payload["mask"])
+        assert rx.stats.recv_bytes == sent == tx.stats.sent_bytes
+        assert rx.stats.max_fetch_bytes <= payload["h"].nbytes
+        # mailbox drained by the take
+        assert state.pipeline_status()["mailbox_depth"] == 0
+        # a second take of the same key blocks (single delivery)
+        with pytest.raises(TimeoutError):
+            rx.recv(0, 2, "act", timeout=0.3)
+        # retention: sending the same slot across steps prunes refs
+        # older than one step back
+        for step in range(4):
+            tx.send(step, 0, "act", {"h": np.zeros(2, np.float32)})
+        assert {s for s, _mb, _k in tx.held_slots()} <= {2, 3}
+        # drain (the sender-side close barrier): blocks while payloads
+        # are undelivered, returns once the receiver took them
+        assert tx.drain(timeout=0.3) is False
+        rx.recv(2, 0, "act", timeout=5.0)
+        rx.recv(3, 0, "act", timeout=5.0)
+        assert tx.drain(timeout=5.0) is True
+    finally:
+        tx.close()
+        rx.close()
+
+
+@pytest.mark.mpmd
+def test_channel_generations_do_not_cross(mpmd_cluster):
+    """A closed pipeline's stage cannot send (orphaned old gangs fail
+    fast), and run_id scopes channel keys so an old generation's
+    payload can never be delivered to a reopened pipeline's recv."""
+    from ray_tpu.mpmd.channels import ActivationChannel
+
+    w = mpmd_cluster
+    # "/ch/" delimits channel keys: names that would break the key
+    # parse are rejected at open time
+    for bad in ("a/ch/b", "a/ch"):
+        res = w.conductor.call("pipeline_open", bad,
+                               {"num_stages": 2}, timeout=10.0)
+        assert "/ch" in (res.get("error") or "")
+    w.conductor.call("pipeline_open", "gen",
+                     {"num_stages": 2, "run_id": "r1"}, timeout=10.0)
+    old_tx = ActivationChannel("gen", 0, 1, run_id="r1")
+    try:
+        old_tx.send(0, 0, "act", {"h": np.ones(4, np.float32)})
+        # same name reopened under a new run id: the old payload is
+        # purged and new-generation keys never match old sends
+        w.conductor.call("pipeline_open", "gen",
+                         {"num_stages": 2, "run_id": "r2"},
+                         timeout=10.0)
+        new_rx = ActivationChannel("gen", 0, 1, stage=1, run_id="r2")
+        try:
+            with pytest.raises(TimeoutError):
+                new_rx.recv(0, 0, "act", timeout=0.3)
+        finally:
+            new_rx.close()
+        # the registry refuses cross-generation registrations too: a
+        # dead generation's stage cannot count toward (or flip) the
+        # new generation's formation
+        res = w.conductor.call(
+            "pipeline_register_stage", "gen", 0,
+            {"run_id": "r1"}, timeout=10.0)
+        assert "generation" in (res.get("error") or "")
+        res = w.conductor.call(
+            "pipeline_register_stage", "gen", 0,
+            {"run_id": "r2"}, timeout=10.0)
+        assert res.get("error") is None
+        # after close, the dead generation's sends are rejected
+        w.conductor.call("pipeline_close", "gen", timeout=10.0)
+        with pytest.raises(RuntimeError, match="not open"):
+            old_tx.send(1, 0, "act", {"h": np.ones(4, np.float32)})
+    finally:
+        old_tx.close()
+
+
+# ------------------------------------------------------ e2e + surfaces
+
+
+D = 8
+LR = 0.05
+M = 4
+STEPS = 4
+
+
+def _stage0(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stage1(params, h):
+    return h @ params["w"] + params["b"]
+
+
+def _loss(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _params():
+    rng = np.random.default_rng(0)
+    p0 = {"w": jnp.asarray(rng.standard_normal((D, D)) * 0.1,
+                           jnp.float32),
+          "b": jnp.zeros((D,), jnp.float32)}
+    p1 = {"w": jnp.asarray(rng.standard_normal((D, 1)) * 0.1,
+                           jnp.float32),
+          "b": jnp.zeros((1,), jnp.float32)}
+    return p0, p1
+
+
+def _data(step):
+    r = np.random.default_rng(100 + step)
+    x = r.standard_normal((8, D)).astype(np.float32)
+    t = np.sum(x, axis=1, keepdims=True).astype(np.float32)
+    return x, t
+
+
+def _dense_reference():
+    """Same stages, same optimizer, same microbatch accumulation math —
+    one process, no pipeline."""
+    p0, p1 = _params()
+    params = {"p0": p0, "p1": p1}
+    opt = optax.sgd(LR)
+    opt_state = opt.init(params)
+
+    def full_loss(params, x, t):
+        return _loss(_stage1(params["p1"], _stage0(params["p0"], x)), t)
+
+    losses = []
+    for step in range(STEPS):
+        x, t = _data(step)
+        xs = x.reshape(M, -1, D)
+        ts = t.reshape(M, -1, 1)
+        acc, step_losses = None, []
+        for i in range(M):
+            loss, g = jax.value_and_grad(full_loss)(params, xs[i],
+                                                    ts[i])
+            step_losses.append(float(loss))
+            acc = g if acc is None else jax.tree.map(
+                lambda a, b: a + b, acc, g)
+        grads = jax.tree.map(lambda a: a / M, acc)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(np.mean(step_losses)))
+    return losses
+
+
+@pytest.mark.mpmd
+def test_two_stage_pipeline_matches_dense_reference(mpmd_cluster):
+    """ISSUE-7 acceptance: a 2-stage MPMD pipeline on virtual slices
+    (JAX_PLATFORMS=cpu, no silicon) trains to the same loss trajectory
+    as the dense reference, with per-stage bubble_wait visible in the
+    merged timeline, the bubble-fraction gauge exported, and shardlint
+    reporting a bubble estimate for the schedule."""
+    from ray_tpu.train import PipelineTrainer, RunConfig, ScalingConfig
+    from ray_tpu.util import state
+
+    p0, p1 = _params()
+    trainer = PipelineTrainer(
+        [_stage0, _stage1], [p0, p1], _loss, optax.sgd(LR),
+        data_fn=_data, num_microbatches=M, num_steps=STEPS,
+        schedule="1f1b",
+        scaling_config=ScalingConfig(num_stages=2),
+        run_config=RunConfig(name="parity"))
+    result = trainer.fit()
+    assert result.error is None
+    losses = [m["loss"] for m in result.metrics_history]
+    assert len(losses) == STEPS
+    np.testing.assert_allclose(losses, _dense_reference(),
+                               rtol=1e-4, atol=1e-5)
+
+    # registry: formed, one stage-gang per virtual slice
+    st = state.pipeline_status("parity")
+    rec = st["pipelines"]["parity"]
+    assert rec["formed"] and rec["num_stages"] == 2
+    assert {v["slice_id"] for v in rec["stages"].values()} == {0, 1}
+    assert rec["schedule"] == "1f1b"
+    # shardlint's analytic estimate for this schedule rode along
+    assert rec["bubble_estimate"] == pytest.approx(
+        sched.bubble_fraction("1f1b", 2, M))
+    # measured per-stage bubble landed from both stage-gangs
+    assert set(rec["stats"]) == {0, 1}
+    for s in rec["stats"].values():
+        assert s["steps"] == STEPS
+        assert 0.0 <= s["bubble_fraction"] <= 1.0
+    assert rec["totals"]["activation_bytes"] > 0
+
+    # merged timeline: per-stage train-step markers carry bubble_wait,
+    # and the pipeline lane has one track per stage
+    trace = state.timeline(merged=True)
+    step_marks = [e for e in trace if e.get("cat") == "train_step"
+                  and e.get("ph") == "X"
+                  and str(e.get("pid", "")).startswith(
+                      "train:mpmd/parity")]
+    assert len(step_marks) == 2 * STEPS  # one per stage per step
+    assert {e["tid"] for e in step_marks} == {"rank 0", "rank 1"}
+    assert any(e["args"].get("bubble_wait_ms", 0) > 0
+               for e in step_marks)
+    lanes = {e["tid"] for e in trace if e.get("cat") == "pipeline"}
+    assert {"stage 0", "stage 1"} <= lanes
+
+    # Prometheus: gauge + channel byte counter exported by the gangs
+    prom = state.prometheus_metrics()
+    assert "ray_tpu_pipeline_bubble_fraction" in prom
+    assert "ray_tpu_pipeline_activations_bytes_total" in prom
+    sent = sum(float(line.rsplit(" ", 1)[1])
+               for line in prom.splitlines()
+               if line.startswith(
+                   "ray_tpu_pipeline_activations_bytes_total{")
+               and 'direction="send"' in line)
+    assert sent >= rec["totals"]["activation_bytes"]
+
+
+@pytest.mark.mpmd
+def test_all_surfaces_report_consistent_numbers(mpmd_cluster, capsys):
+    """pipeline_status() / CLI / /api/pipeline / timeline markers all
+    report the SAME per-stage numbers for one run."""
+    import urllib.request
+
+    from ray_tpu.dashboard import DashboardServer
+    from ray_tpu.mpmd import PipelineConductor
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import state
+
+    w = mpmd_cluster
+    p0, p1 = _params()
+    pipe = PipelineConductor("surfaces", [_stage0, _stage1], [p0, p1],
+                            optax.sgd(LR), _loss, num_microbatches=M,
+                            schedule="gpipe")
+    try:
+        pipe.form()
+        out = pipe.run(2, _data)
+    finally:
+        pipe.close()
+    local = {s["stage"]: s for s in out["stages"]}
+
+    # state API (authoritative conductor registry)
+    st = state.pipeline_status()["pipelines"]["surfaces"]
+    for s, mine in local.items():
+        reg = st["stats"][s]
+        assert reg["steps"] == mine["steps"] == 2
+        assert reg["sent_bytes"] == mine["sent_bytes"]
+        assert reg["recv_bytes"] == mine["recv_bytes"]
+        assert reg["bubble_fraction"] == pytest.approx(
+            mine["bubble_fraction"])
+
+    # CLI (same conductor snapshot; JSON stage keys are strings)
+    host, port = w.conductor_address
+    cli.main(["pipeline", "--json", "--address", f"{host}:{port}"])
+    cli_out = json.loads(capsys.readouterr().out)
+    cli_rec = cli_out["pipelines"]["surfaces"]
+    for s, mine in local.items():
+        assert cli_rec["stats"][str(s)]["sent_bytes"] == \
+            mine["sent_bytes"]
+    assert cli_rec["totals"]["activation_bytes"] == sum(
+        m["sent_bytes"] for m in local.values())
+    # human-readable path renders too
+    cli.main(["pipeline", "--events", "5",
+              "--address", f"{host}:{port}"])
+    text = capsys.readouterr().out
+    assert "surfaces" in text and "schedule=gpipe" in text
+
+    # dashboard /api/pipeline
+    srv = DashboardServer(w.conductor_address, port=0).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/api/pipeline",
+                                    timeout=10.0) as r:
+            dash = json.loads(r.read())
+    finally:
+        srv.stop()
+    dash_rec = dash["pipelines"]["surfaces"]
+    for s, mine in local.items():
+        assert dash_rec["stats"][str(s)]["recv_bytes"] == \
+            mine["recv_bytes"]
+    kinds = {e["kind"] for e in dash["events"]
+             if e.get("pipeline") == "surfaces"}
+    assert {"open", "formed", "stage_report", "closed"} <= kinds
+
+    # merged timeline: the stage_report markers carry the SAME numbers
+    trace = state.timeline(merged=True)
+    reports = {e["args"]["stage"]: e["args"] for e in trace
+               if e.get("cat") == "pipeline"
+               and e["args"].get("kind") == "stage_report"
+               and e["args"].get("pipeline") == "surfaces"}
+    assert set(reports) == {0, 1}
+    for s, mine in local.items():
+        assert reports[s]["sent_bytes"] == mine["sent_bytes"]
+        assert reports[s]["bubble_fraction"] == pytest.approx(
+            mine["bubble_fraction"], abs=1e-6)
+
+
+@pytest.mark.mpmd
+def test_stage_death_fails_pipeline_fast(mpmd_cluster):
+    """Gang-death fail-fast: killing one stage-gang mid-run kills the
+    survivors (their channel recvs can never complete) and the
+    driver's run raises well before any channel timeout."""
+    p0, p1 = _params()
+    from ray_tpu.mpmd import PipelineConductor
+
+    def slow_data(step):
+        time.sleep(0.05)
+        return _data(step)
+
+    pipe = PipelineConductor("doomed", [_stage0, _stage1], [p0, p1],
+                            optax.sgd(LR), _loss, num_microbatches=M,
+                            schedule="1f1b")
+    result = {}
+
+    def drive():
+        t0 = time.monotonic()
+        try:
+            pipe.run(500, slow_data, recv_timeout=120.0)
+            result["error"] = None
+        except Exception as e:  # noqa: BLE001 — the expected outcome
+            result["error"] = e
+        result["elapsed"] = time.monotonic() - t0
+
+    try:
+        pipe.form()
+        t = threading.Thread(target=drive)
+        t.start()
+        time.sleep(1.0)  # let the schedule get going
+        ray_tpu.kill(pipe._actors[0])
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "run() did not fail fast"
+        assert result["error"] is not None
+        # fail-fast: far below the 120s recv timeout
+        assert result["elapsed"] < 25.0
+        w = mpmd_cluster
+        events = w.conductor.call("get_pipeline_events", 1000,
+                                  timeout=10.0)
+        assert any(e.get("kind") == "stage_death"
+                   and e.get("pipeline") == "doomed" for e in events)
+    finally:
+        pipe.close()
+
+
+# ------------------------------------------------- config plumbing
+
+
+@pytest.mark.mpmd
+def test_scaling_config_num_stages():
+    from ray_tpu.train import ScalingConfig
+
+    assert ScalingConfig().num_stages == 1
+    assert ScalingConfig(num_stages=4).num_stages == 4
+
+
+@pytest.mark.mpmd
+def test_pipeline_trainer_rejects_stage_mismatch():
+    from ray_tpu.train import PipelineTrainer, ScalingConfig
+
+    with pytest.raises(ValueError, match="num_stages"):
+        PipelineTrainer([_stage0, _stage1], [None, None], _loss,
+                        optax.sgd(LR), data_fn=_data,
+                        num_microbatches=M,
+                        scaling_config=ScalingConfig(num_stages=3))
+
+
+@pytest.mark.mpmd
+def test_multi_host_stage_gangs_refused_loudly():
+    """One host per stage today: a config implying multi-host
+    stage-gangs must raise, not silently downgrade."""
+    from ray_tpu.mpmd import PipelineConductor
+    from ray_tpu.train import PipelineTrainer, ScalingConfig
+
+    with pytest.raises(NotImplementedError, match="one host per stage"):
+        PipelineTrainer([_stage0, _stage1], [None, None], _loss,
+                        optax.sgd(LR), data_fn=_data,
+                        num_microbatches=M,
+                        scaling_config=ScalingConfig(num_stages=2,
+                                                     num_workers=8))
+    with pytest.raises(NotImplementedError, match="one host per stage"):
+        PipelineConductor("x", [_stage0, _stage1], [None, None],
+                          optax.sgd(LR), _loss, num_microbatches=M,
+                          hosts_per_stage=2)
+
+
+@pytest.mark.mpmd
+def test_step_timer_has_bubble_wait_phase():
+    """bubble_wait is a first-class flight-recorder phase: recorded
+    time lands in the step record as bubble_wait_ms."""
+    from ray_tpu.observability.step_timer import PHASES, StepTimer
+
+    assert "bubble_wait" in PHASES
+    timer = StepTimer("t", enabled=True)
+    timer.record("bubble_wait", 0.25)
+    timer.record("device_step", 0.05)
+    rec = timer.end_step()
+    assert rec["bubble_wait_ms"] == pytest.approx(250.0)

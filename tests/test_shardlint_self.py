@@ -32,7 +32,7 @@ def test_package_lint_covers_the_whole_tree():
             seen.add(os.path.relpath(dirpath, PACKAGE_ROOT).split(
                 os.sep)[0])
     assert {"serve", "parallel", "train", "resilience", "weights",
-            "models"} <= seen
+            "models", "mpmd"} <= seen
 
 
 def test_kvcache_module_is_lint_covered():
@@ -41,6 +41,16 @@ def test_kvcache_module_is_lint_covered():
     own (a rename/move would silently drop it from coverage)."""
     path = os.path.join(PACKAGE_ROOT, "models", "kvcache.py")
     assert os.path.exists(path)
+    assert errors(lint_path(path)) == []
+
+
+def test_mpmd_package_is_lint_covered():
+    """The MPMD pipeline subsystem (ray_tpu/mpmd/) is inside the
+    self-lint set: the walk parses it and it carries zero error
+    findings of its own (a rename/move would silently drop it from
+    coverage)."""
+    path = os.path.join(PACKAGE_ROOT, "mpmd")
+    assert os.path.isdir(path)
     assert errors(lint_path(path)) == []
 
 
