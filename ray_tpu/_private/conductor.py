@@ -240,6 +240,14 @@ class ConductorHandler:
         self._kvcache_stats: Dict[str, Dict[str, Any]] = {}
         self._kvcache_events: List[Dict[str, Any]] = []
 
+        # Online learning loop (ray_tpu.online): sampler actors, the
+        # rollout buffer, and the learner each push stat snapshots
+        # (keyed by component id) + rollout/publish/swap/ingest markers;
+        # the conductor only aggregates — rollout payloads never land
+        # here.
+        self._online_stats: Dict[str, Dict[str, Any]] = {}
+        self._online_events: List[Dict[str, Any]] = []
+
         # MPMD pipelines (ray_tpu.mpmd): stage registry (a pipeline
         # flips "formed" atomically when its LAST stage registers —
         # the weights-fragment commit pattern) + the channel mailbox.
@@ -1618,6 +1626,101 @@ class ConductorHandler:
         with self._lock:
             return self._kvcache_events[-limit:]
 
+    # --------------------------------------------- online learning loop
+    # Samplers / the rollout buffer / the learner (ray_tpu.online) push
+    # their stat snapshots and instant markers here; util.state
+    # .online_status(), `ray_tpu online`, and the dashboard /api/online
+    # all read the same aggregate so every surface reports one set of
+    # numbers.
+
+    _ONLINE_EVENTS_KEPT = 10_000
+    _ONLINE_STATS_KEPT = 256
+
+    def report_online_stats(self, worker_id: str, component_id: str,
+                            stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._online_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            # learner snapshots are keyed by unique run ids: without an
+            # eviction bound, every finished run's final snapshot would
+            # accumulate forever. Oldest-first by last report time.
+            while len(self._online_stats) > self._ONLINE_STATS_KEPT:
+                oldest = min(self._online_stats,
+                             key=lambda k:
+                             self._online_stats[k].get("ts", 0.0))
+                del self._online_stats[oldest]
+
+    def get_online_status(self) -> Dict[str, Any]:
+        """One aggregate for every online-loop surface: components
+        grouped by role (sampler / buffer / learner) plus cluster
+        totals (rollouts, rollout tokens, buffer occupancy, learner
+        ingest, worst sampler staleness)."""
+        with self._lock:
+            comps = {k: dict(v) for k, v in self._online_stats.items()}
+        samplers = {k: v for k, v in comps.items()
+                    if v.get("role") == "sampler"}
+        buffers = {k: v for k, v in comps.items()
+                   if v.get("role") == "buffer"}
+        learners = {k: v for k, v in comps.items()
+                    if v.get("role") == "learner"}
+        totals: Dict[str, Any] = {
+            "samplers": len(samplers),
+            "rollouts": sum(int(s.get("rollouts", 0))
+                            for s in samplers.values()),
+            "rollout_tokens": sum(int(s.get("rollout_tokens", 0))
+                                  for s in samplers.values()),
+            "swaps": sum(int(s.get("swap_count", 0))
+                         for s in samplers.values()),
+            "buffer_occupancy": sum(int(b.get("occupancy", 0))
+                                    for b in buffers.values()),
+            "buffer_capacity": sum(int(b.get("capacity", 0))
+                                   for b in buffers.values()),
+            "buffer_rejected": sum(int(b.get("rejected", 0))
+                                   for b in buffers.values()),
+            "ingested_rollouts": sum(int(l.get("ingested_rollouts", 0))
+                                     for l in learners.values()),
+            "ingested_tokens": sum(int(l.get("ingested_tokens", 0))
+                                   for l in learners.values()),
+            "learner_steps": max((int(l.get("steps", 0))
+                                  for l in learners.values()),
+                                 default=0),
+            "published_versions": max((int(l.get("published_version", 0))
+                                       for l in learners.values()),
+                                      default=0),
+        }
+        stale = [s.get("staleness_versions") for s in samplers.values()
+                 if s.get("staleness_versions") is not None]
+        totals["staleness_versions"] = max(stale) if stale else None
+        high = [s.get("max_staleness_versions")
+                for s in samplers.values()
+                if s.get("max_staleness_versions") is not None]
+        totals["max_staleness_versions"] = max(high + stale) \
+            if (high or stale) else None
+        return {"samplers": samplers, "buffers": buffers,
+                "learners": learners, "totals": totals}
+
+    def report_online_event(self, event: Dict[str, Any]) -> None:
+        """Rollout / publish / swap / ingest instant markers for the
+        merged timeline's online lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._online_events.append(event)
+            if len(self._online_events) > self._ONLINE_EVENTS_KEPT:
+                del self._online_events[
+                    :len(self._online_events)
+                    - self._ONLINE_EVENTS_KEPT]
+
+    def get_online_events(self, limit: int = 10_000
+                          ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._online_events[-limit:]
+
     # ------------------------------------------------------ MPMD pipelines
     # ray_tpu.mpmd: stage registry, channel mailbox, per-stage stats and
     # instant markers. util.state.pipeline_status(), `ray_tpu pipeline`,
@@ -1897,6 +2000,15 @@ class ConductorHandler:
             if version in by_ver:
                 return {"error": f"version {version} of {name!r} is "
                                  "already committed"}
+            base_version = fragment.get("base_version")
+            if base_version is not None \
+                    and int(base_version) not in by_ver:
+                # delta against a base this registry no longer holds
+                # (GC'd between the publisher's probe and this call):
+                # reject so the publisher's full fallback runs — an
+                # inherit-from-nothing commit would be a torn manifest
+                return {"error": f"delta base {base_version} of "
+                                 f"{name!r} is gone"}
             key = (name, version)
             pend = self._weights_pending.get(key)
             if pend is not None and int(num_hosts) != pend["num_hosts"]:
@@ -1940,21 +2052,50 @@ class ConductorHandler:
             # chunk refs depend on gc/reap notices that only a registry
             # remembering the version can ever send (conductor bounce)
             committed = len(pend["fragments"]) == pend["num_hosts"]
+            error = None
             if committed:
-                del self._weights_pending[key]
-                manifest = self._weights_commit_locked(name, version, pend)
-                publish_msg = {"kind": "published", "name": name,
-                               "version": version, "step": step,
-                               "run_id": run_id,
-                               "total_bytes": manifest["total_bytes"]}
-                # EXTEND: a supersede notice queued above must still go
-                # out when the superseding fragment commits immediately
-                gc_msgs.extend(self._weights_gc_locked(name, None))
+                # delta commits inherit unchanged leaves from their base
+                # manifests — every named base must still be here (a
+                # fragment-time check passed, but another host's base
+                # could have been GC'd while this publish was pending)
+                gone = sorted({int(f["base_version"])
+                               for f in pend["fragments"].values()
+                               if f.get("base_version") is not None
+                               and int(f["base_version"]) not in by_ver})
+                if gone:
+                    del self._weights_pending[key]
+                    gc_msgs.append({
+                        "kind": "reaped", "name": name,
+                        "versions": [version],
+                        "object_ids": self._weights_object_ids(
+                            f["leaves"] for f in
+                            pend["fragments"].values())})
+                    self._weight_event_locked(
+                        {"kind": "reap", "name": name,
+                         "version": version,
+                         "detail": f"delta base {gone} gone"})
+                    error = (f"delta base {gone[0]} of {name!r} is "
+                             "gone")
+                else:
+                    del self._weights_pending[key]
+                    manifest = self._weights_commit_locked(name, version,
+                                                           pend)
+                    publish_msg = {"kind": "published", "name": name,
+                                   "version": version, "step": step,
+                                   "run_id": run_id,
+                                   "total_bytes":
+                                       manifest["total_bytes"]}
+                    # EXTEND: a supersede notice queued above must still
+                    # go out when the superseding fragment commits
+                    # immediately
+                    gc_msgs.extend(self._weights_gc_locked(name, None))
             self._notify_all_locked()
         if publish_msg is not None:
             self.publish("weights", publish_msg)
         for msg in gc_msgs:
             self.publish("weights", msg)
+        if error is not None:
+            return {"error": error}
         return {"committed": committed, "version": version}
 
     @staticmethod
@@ -2005,22 +2146,54 @@ class ConductorHandler:
     def _weights_commit_locked(self, name: str, version: int,
                                pend: Dict[str, Any]) -> Dict[str, Any]:
         """Merge host fragments into the version manifest. Must hold the
-        lock; records the publish event."""
+        lock; records the publish event.
+
+        Delta fragments mark unchanged leaves ``from_base``: those
+        inherit the named base manifest's chunk entries FOR THAT HOST
+        (entries are host-tagged at commit exactly so this attribution
+        survives the merge). The committed manifest is therefore always
+        self-contained — chains of deltas collapse one link per commit,
+        and a version stays fetchable no matter which of its ancestors
+        GC later drops. ``delta_bytes`` records what the publish
+        actually shipped; ``total_bytes`` stays the full resolved
+        size."""
         frags = pend["fragments"]
+        by_ver = self._weights_committed.get(name, {})
         n_leaves = max(int(f.get("n_leaves", 0)) for f in frags.values())
         leaves: List[Dict[str, Any]] = []
         total = 0
+        delta_bytes = 0
         n_chunks = 0
+        changed: List[int] = []
+        any_delta = any(f.get("base_version") is not None
+                        for f in frags.values())
         for i in range(n_leaves):
-            metas = [f["leaves"].get(str(i)) for _, f in sorted(
-                frags.items())]
-            meta = next(m for m in metas if m is not None)
-            shards = [s for m in metas if m is not None
-                      for s in m["shards"]]
+            meta = None
+            shards: List[Dict[str, Any]] = []
+            leaf_changed = False
+            for host, f in sorted(frags.items()):
+                m = f["leaves"].get(str(i))
+                if m is None:
+                    continue
+                meta = meta or m
+                if m.get("from_base"):
+                    base = by_ver[int(f["base_version"])]
+                    shards.extend(
+                        s for s in base["leaves"][i]["shards"]
+                        if s.get("host", host) == host)
+                else:
+                    own = [dict(s, host=host) for s in m["shards"]]
+                    shards.extend(own)
+                    if own:
+                        leaf_changed = True
+                        delta_bytes += sum(int(s["nbytes"])
+                                           for s in own)
             total += sum(int(s["nbytes"]) for s in shards)
             n_chunks += len(shards)
+            if leaf_changed:
+                changed.append(i)
             leaves.append({"shape": meta["shape"], "dtype": meta["dtype"],
-                           "shards": shards})
+                           "hash": meta.get("hash"), "shards": shards})
         treedef = next((f["treedef"] for _, f in sorted(frags.items())
                         if f.get("treedef") is not None), None)
         manifest = {"name": name, "version": version,
@@ -2028,20 +2201,43 @@ class ConductorHandler:
                     "ts": time.time(), "num_hosts": pend["num_hosts"],
                     "n_leaves": n_leaves, "n_chunks": n_chunks,
                     "total_bytes": total, "leaves": leaves,
-                    "treedef": treedef}
+                    "treedef": treedef,
+                    "delta": any_delta,
+                    "base_version": next(
+                        (int(f["base_version"]) for f in frags.values()
+                         if f.get("base_version") is not None), None),
+                    "changed_leaves": changed if any_delta else None,
+                    "delta_bytes": delta_bytes}
         self._weights_committed[name][version] = manifest
         self._weight_event_locked(
             {"kind": "publish", "name": name, "version": version,
              "step": pend.get("step"), "run_id": pend.get("run_id"),
-             "num_hosts": pend["num_hosts"], "bytes": total})
+             "num_hosts": pend["num_hosts"], "bytes": total,
+             "delta_bytes": delta_bytes if any_delta else None,
+             "changed_leaves": len(changed) if any_delta else None})
         return manifest
+
+    def _weights_live_ids_locked(self, name: str) -> set:
+        """Chunk object ids referenced by the KEPT manifests and pending
+        fragments of `name`. Delta manifests inherit their base's chunk
+        entries, so dropping a base version must free only the ids no
+        kept manifest still points at."""
+        live = set(self._weights_object_ids(
+            m["leaves"] for m in
+            self._weights_committed.get(name, {}).values()))
+        for (n, _v), pend in self._weights_pending.items():
+            if n == name:
+                live.update(self._weights_object_ids(
+                    f["leaves"] for f in pend["fragments"].values()))
+        return live
 
     def _weights_gc_locked(self, name: str,
                            keep: Optional[int]) -> List[Dict[str, Any]]:
         """Drop committed versions beyond keep-last-K (config
         weights_keep when `keep` is None). Returns the pubsub messages
         telling producers which versions' chunks to free — publish them
-        AFTER releasing the lock."""
+        AFTER releasing the lock. Ids still referenced by a kept
+        manifest (delta inheritance) are withheld from the notice."""
         from .config import config
 
         keep = config.weights_keep if keep is None else int(keep)
@@ -2055,9 +2251,12 @@ class ConductorHandler:
             self._dirty = True
             self._weight_event_locked(
                 {"kind": "gc", "name": name, "version": v})
+            live = self._weights_live_ids_locked(name)
+            dead = [oid for oid in self._weights_object_ids(
+                        [manifest["leaves"]])
+                    if oid not in live]
             msgs.append({"kind": "gc", "name": name, "versions": [v],
-                         "object_ids": self._weights_object_ids(
-                             [manifest["leaves"]])})
+                         "object_ids": dead})
         return msgs
 
     def weights_gc(self, name: str, keep: Optional[int] = None) -> int:
@@ -2130,9 +2329,11 @@ class ConductorHandler:
                 out[name] = {
                     "latest": self._weights_latest_locked(name),
                     "versions": [
-                        {k: m[k] for k in ("version", "step", "run_id",
-                                           "ts", "num_hosts", "n_leaves",
-                                           "n_chunks", "total_bytes")}
+                        {k: m.get(k) for k in (
+                            "version", "step", "run_id", "ts",
+                            "num_hosts", "n_leaves", "n_chunks",
+                            "total_bytes", "delta", "base_version",
+                            "delta_bytes")}
                         for m in sorted(
                             by_ver.values(),
                             key=self._weights_recency)],

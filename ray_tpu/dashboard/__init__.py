@@ -26,6 +26,10 @@ Routes:
   /api/pipeline          MPMD pipelines: stage registry + per-stage
                          bubble fraction / channel bytes and recent
                          pipeline events (ray_tpu.mpmd)
+  /api/online            online learning loop: sampler rollout +
+                         staleness stats, buffer occupancy, learner
+                         ingest, recent rollout/publish/swap/ingest
+                         events (ray_tpu.online)
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -155,6 +159,17 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def online(self) -> Dict[str, Any]:
+        """Online-loop aggregate + the recent event tail (one payload
+        so the SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_online_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_online_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
         the actors-table drill-down."""
@@ -266,6 +281,7 @@ class DashboardServer:
             self._json_route(lambda: d.simple("get_weight_versions")))
         app.router.add_get("/api/kvcache", self._json_route(d.kvcache))
         app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
+        app.router.add_get("/api/online", self._json_route(d.online))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

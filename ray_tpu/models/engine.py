@@ -101,9 +101,12 @@ def _splice_slot(cache, ck, cv, slot, config, plen):
 def _tick(params, config, cache, tokens, pos_vec):
     logits, cache = _model_fns(config)[2](params, tokens, config, cache,
                                           pos_vec)
-    nxt = jnp.argmax(logits[:, :config.vocab_size], axis=-1).astype(
-        jnp.int32)
-    return cache, nxt
+    live = logits[:, :config.vocab_size].astype(jnp.float32)
+    nxt = jnp.argmax(live, axis=-1).astype(jnp.int32)
+    # per-slot logprob of the chosen (greedy = max-logit) token — the
+    # rollout score stream (ray_tpu.online samplers record it per token)
+    lp = jnp.max(live, axis=-1) - jax.nn.logsumexp(live, axis=-1)
+    return cache, nxt, lp
 
 
 class _Request:
@@ -119,6 +122,9 @@ class _Request:
         self.cache_outcome: Optional[str] = None  # hit|partial|miss
         self.reused_tokens = 0
         self.block_table: List[int] = []
+        # per-token logprob of each emitted token (same order as the
+        # token stream) — the rollout score channel
+        self.scores: List[float] = []
 
 
 class TokenStream:
@@ -147,6 +153,12 @@ class TokenStream:
     @property
     def reused_tokens(self) -> int:
         return self._req.reused_tokens
+
+    @property
+    def scores(self) -> List[float]:
+        """Per-token logprobs of the tokens emitted SO FAR (aligned
+        with the token stream; complete once iteration finishes)."""
+        return list(self._req.scores)
 
 
 class ContinuousBatchingEngine:
@@ -393,15 +405,19 @@ class ContinuousBatchingEngine:
                                    self.config, plen)
         self.spliced_tokens += plen
         self.admitted += 1
-        first = int(np.argmax(
-            np.asarray(last_logits[0, :self.config.vocab_size])))
+        live = np.asarray(last_logits[0, :self.config.vocab_size],
+                          np.float32)
+        first = int(np.argmax(live))
+        m = float(live[first])
+        score = -float(np.log(np.exp(live - m).sum()))  # m - logsumexp
         req.slot = slot
         self._slot_req[slot] = req
         self._tokens[slot] = first
         self._pos[slot] = plen
-        self._emit(req, first)
+        self._emit(req, first, score)
 
-    def _emit(self, req: _Request, tok: int) -> None:
+    def _emit(self, req: _Request, tok: int, score: float = 0.0) -> None:
+        req.scores.append(score)
         req.out.put(tok)
         req.produced += 1
         if (req.eos_token is not None and tok == req.eos_token) \
@@ -422,15 +438,16 @@ class ContinuousBatchingEngine:
             if all(r is None for r in self._slot_req):
                 self._stopped.wait(self.idle_sleep_s)
                 continue
-            cache, nxt = _tick(self.params, self.config, self._cache,
-                               jnp.asarray(self._tokens),
-                               jnp.asarray(self._pos))
+            cache, nxt, lp = _tick(self.params, self.config, self._cache,
+                                   jnp.asarray(self._tokens),
+                                   jnp.asarray(self._pos))
             self._cache = cache
             nxt_np = np.asarray(nxt)
+            lp_np = np.asarray(lp)
             for slot, req in enumerate(self._slot_req):
                 if req is None:
                     continue
                 self._pos[slot] += 1
                 tok = int(nxt_np[slot])
                 self._tokens[slot] = tok
-                self._emit(req, tok)
+                self._emit(req, tok, float(lp_np[slot]))

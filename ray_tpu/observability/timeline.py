@@ -31,6 +31,12 @@ merges and labels them:
                  formation, per-stage run reports (bubble fraction,
                  channel bytes), stage deaths — beside the per-stage
                  train-step markers whose args carry bubble_wait_ms.
+- online:        pid = "online",          tid = the sampler id (or
+                 event kind) — instant markers of the online learning
+                 loop (ray_tpu.online): rollouts completing, learner
+                 ingests, weight publishes and sampler hot swaps, so
+                 the sampler/learner cadence reads directly against the
+                 weights lane's fabric-side publish/fetch/swap markers.
 """
 from __future__ import annotations
 
@@ -170,6 +176,34 @@ def pipeline_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def online_trace_events(events: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Instant markers for online-loop events (rollout, ingest,
+    publish, swap) — one lane per sampler (learner events lane under
+    their kind) beneath pid "online"."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        if ev.get("sampler"):
+            label += f":{ev['sampler']}"
+        if ev.get("weights_version") is not None:
+            label += f"@v{ev['weights_version']}"
+        elif ev.get("version") is not None:
+            label += f"@v{ev['version']}"
+        out.append({
+            "name": label, "cat": "online", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "online",
+            "tid": str(ev.get("sampler") or kind),
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -200,6 +234,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         kvcache_events: Optional[
                             List[Dict[str, Any]]] = None,
                         pipeline_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        online_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -216,6 +252,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(kvcache_trace_events(kvcache_events))
     if pipeline_events:
         trace.extend(pipeline_trace_events(pipeline_events))
+    if online_events:
+        trace.extend(online_trace_events(online_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -255,8 +293,12 @@ def merged_timeline(filename: Optional[str] = None,
                                timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-mpmd conductor
         pev = []
+    try:
+        oev = w.conductor.call("get_online_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-online conductor
+        oev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev)
+                                pev, oev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -1,0 +1,45 @@
+"""ray_tpu.online — the Podracer-style online learning loop.
+
+Closes the train→serve→train cycle the runtime's three legs enable
+(training gangs, the continuous-batching inference engine, the live
+weight fabric) with the Anakin/Sebulba split from the Podracer
+architectures paper (arXiv 2104.06272):
+
+- **Samplers** (:class:`RolloutSampler` / :func:`spawn_samplers`):
+  decoupled actor processes, each wrapping a
+  ``ContinuousBatchingEngine`` behind a ``WeightSync`` so weights
+  hot-swap BETWEEN decode ticks with no restart — the framework, not
+  the user, keeps samplers fresh. Rollouts carry the prompt, the
+  sampled completion, per-token logprob scores, and the weights
+  version that produced them.
+- **Rollout buffer** (:class:`RolloutBuffer` / :func:`from_rollouts`):
+  a bounded actor between samplers and the learner. ``put`` applies
+  backpressure (a full buffer rejects, samplers pause instead of
+  flooding the object plane); ``from_rollouts()`` exposes it through
+  the Data streaming-split contract (``streaming_split`` per learner
+  host, background prefetch) so ingestion overlaps the device step and
+  ``data_wait`` stays a flight-recorder phase.
+- **Learner** (:class:`OnlineTrainer`): a JaxTrainer gang reusing
+  ``TrainStep``/gang formation, training an online-distillation
+  objective on the rollout stream and publishing weights every K steps
+  via ``train.report(publish_weights=..., weights_delta=True)`` — the
+  weight fabric's delta publication ships only the leaves the
+  optimizer actually moved.
+
+The freshness invariant the loop maintains: sampler staleness (the
+``ray_tpu_weights_staleness_versions`` gauge) stays <= 1 version while
+the learner steps at full speed — ingestion and weight refresh both
+live off the critical path.
+
+Surfaces: ``util.state.online_status()``, ``ray_tpu online`` CLI,
+dashboard ``/api/online``, lazy Prometheus metrics
+(``ray_tpu_online_*``), and an ``online`` lane of
+rollout/publish/swap/ingest markers in the merged timeline.
+"""
+from .buffer import RolloutBuffer, RolloutStream, from_rollouts  # noqa: F401
+from .loop import OnlineConfig, OnlineResult, OnlineTrainer  # noqa: F401
+from .sampler import RolloutSampler, spawn_samplers  # noqa: F401
+
+__all__ = ["OnlineConfig", "OnlineResult", "OnlineTrainer",
+           "RolloutBuffer", "RolloutSampler", "RolloutStream",
+           "from_rollouts", "spawn_samplers"]

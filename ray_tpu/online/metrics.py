@@ -1,0 +1,60 @@
+"""Prometheus surface of the online learning loop — lazily created so
+importing ray_tpu.online never spawns a metrics pusher (the weights /
+kvcache / mpmd pattern). All ride the util.metrics conductor-push
+pipeline into /api/metrics and `ray_tpu metrics`:
+
+- ray_tpu_online_rollout_tokens_total{sampler}   tokens generated into
+                                                 rollouts, per sampler
+- ray_tpu_online_rollouts_total{sampler}         completed rollouts
+- ray_tpu_online_buffer_occupancy{buffer}        rollouts queued in the
+                                                 buffer right now
+- ray_tpu_online_buffer_rejected_total{buffer}   backpressured puts
+- ray_tpu_online_ingested_rollouts_total{run}    rollouts the learner
+                                                 consumed (ingest rate)
+
+Sampler staleness deliberately has no twin here: it IS the existing
+``ray_tpu_weights_staleness_versions`` gauge (each sampler's WeightSync
+sets it under consumer=<sampler id>) — one number, one gauge.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+# Rebound ONCE, to a fully-built dict: the unlocked fast path can only
+# ever observe None or the complete registry, never a partial one.
+_metrics: Optional[Dict[str, Any]] = None
+_lock = threading.Lock()
+
+
+def online_metrics() -> Dict[str, Any]:
+    global _metrics
+    m = _metrics
+    if m is not None:
+        return m
+    with _lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _metrics = dict(
+                rollout_tokens=Counter(
+                    "ray_tpu_online_rollout_tokens_total",
+                    "tokens generated into rollouts by online-loop "
+                    "samplers", tag_keys=("sampler",)),
+                rollouts=Counter(
+                    "ray_tpu_online_rollouts_total",
+                    "rollouts completed by online-loop samplers",
+                    tag_keys=("sampler",)),
+                buffer_occupancy=Gauge(
+                    "ray_tpu_online_buffer_occupancy",
+                    "rollouts currently queued in the online-loop "
+                    "buffer", tag_keys=("buffer",)),
+                buffer_rejected=Counter(
+                    "ray_tpu_online_buffer_rejected_total",
+                    "rollout puts rejected by a full buffer "
+                    "(sampler backpressure)", tag_keys=("buffer",)),
+                ingested_rollouts=Counter(
+                    "ray_tpu_online_ingested_rollouts_total",
+                    "rollouts the online learner pulled into training "
+                    "batches", tag_keys=("run",)))
+    return _metrics

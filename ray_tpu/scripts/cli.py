@@ -420,6 +420,61 @@ def cmd_pipeline(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_online(args) -> None:
+    """`ray_tpu online` — online learning loop view (ray_tpu.online):
+    per-sampler rollout/staleness stats, buffer occupancy and
+    backpressure, learner ingest progress, plus the cluster totals
+    every other surface (state API, /api/online, Prometheus, timeline
+    markers) reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.online_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    totals = st.get("totals") or {}
+    if not (st.get("samplers") or st.get("buffers")
+            or st.get("learners")):
+        print("no online-loop telemetry recorded (is an "
+              "OnlineTrainer / RolloutSampler running?)")
+        return
+    stale = totals.get("max_staleness_versions")
+    print(f"totals: samplers={totals.get('samplers', 0)} "
+          f"rollouts={totals.get('rollouts', 0)} "
+          f"rollout_tokens={totals.get('rollout_tokens', 0)} "
+          f"ingested={totals.get('ingested_rollouts', 0)} "
+          f"buffer={totals.get('buffer_occupancy', 0)}"
+          f"/{totals.get('buffer_capacity', 0)} "
+          f"max_staleness={stale if stale is not None else '-'}")
+    for key, s in sorted((st.get("samplers") or {}).items()):
+        print(f"  {key}: rollouts={s.get('rollouts', 0)} "
+              f"tokens={s.get('rollout_tokens', 0)} "
+              f"serving=v{s.get('serving_version')} "
+              f"latest=v{s.get('latest_version')} "
+              f"staleness={s.get('staleness_versions')} "
+              f"(max {s.get('max_staleness_versions')}) "
+              f"swaps={s.get('swap_count', 0)}"
+              + ("" if s.get("registry_reachable", True)
+                 else " [REGISTRY UNREACHABLE]"))
+    for key, b in sorted((st.get("buffers") or {}).items()):
+        print(f"  {key}: occupancy={b.get('occupancy', 0)}"
+              f"/{b.get('capacity', 0)} in={b.get('total_in', 0)} "
+              f"out={b.get('total_out', 0)} "
+              f"rejected={b.get('rejected', 0)}")
+    for key, l in sorted((st.get("learners") or {}).items()):
+        print(f"  {key}: steps={l.get('steps', 0)} "
+              f"ingested={l.get('ingested_rollouts', 0)} "
+              f"last_loss={l.get('last_loss')} "
+              f"published=v{l.get('published_version')}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_online_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_metrics(args) -> None:
     _connect(args)
     from ray_tpu.util import state
@@ -716,6 +771,17 @@ def main(argv=None) -> None:
                     help="also print the last N pipeline events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_pipeline)
+
+    sp = sub.add_parser("online",
+                        help="online learning loop: per-sampler "
+                             "rollout/staleness stats, buffer "
+                             "occupancy, learner ingest, recent "
+                             "events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N online-loop events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_online)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

@@ -322,7 +322,12 @@ class _LeafReader:
                        for sl, dim in zip(index, self.shape))
         out_shape = tuple(b - a for a, b in bounds)
         out = np.empty(out_shape, dtype=self.dtype)
-        filled = 0
+        # coverage mask: replicated shards overlap, and a later copy of
+        # an already-filled region must not be LOADED at all — shard
+        # order is the placement preference (ray_tpu.weights sorts
+        # same-host chunks first, so a colocated replica wins over a
+        # remote RPC pull)
+        mask = np.zeros(out_shape, dtype=bool)
         want = int(np.prod(out_shape)) if out_shape else 1
         for sh in self.shards:
             sidx = [tuple(t) for t in sh["index"]]
@@ -335,13 +340,18 @@ class _LeafReader:
                 inter.append((lo, hi, sa, a))
             if inter is None and self.shape:
                 continue
+            if self.shape:
+                dst = tuple(slice(lo - a, hi - a)
+                            for lo, hi, _, a in inter)
+                if mask[dst].all():
+                    continue  # fully covered: skip the load entirely
             arr = self._loader(sh)
             if not self.shape:  # scalar
                 return np.array(arr, dtype=self.dtype)
             src = tuple(slice(lo - sa, hi - sa) for lo, hi, sa, _ in inter)
-            dst = tuple(slice(lo - a, hi - a) for lo, hi, _, a in inter)
             out[dst] = arr[src]
-            filled += int(np.prod([hi - lo for lo, hi, _, _ in inter]))
+            mask[dst] = True
+        filled = int(mask.sum())
         if filled < want:
             raise ValueError(
                 f"checkpoint shards do not cover requested slice {index} "

@@ -88,7 +88,9 @@ def get_context() -> TrainContext:
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None, *,
            publish_weights: Any = None,
-           weights_name: Optional[str] = None) -> None:
+           weights_name: Optional[str] = None,
+           weights_delta: bool = False,
+           weights_version: Optional[int] = None) -> None:
     """Reference session.py:661. Reports metrics (and optionally a
     checkpoint) to the controlling trainer/tuner. Raises StopIteration-like
     control via the trainer if the trial was stopped (e.g. by a scheduler).
@@ -108,7 +110,13 @@ def report(metrics: Dict[str, Any],
     decode ticks. Equivalent to ``weights.publish(params, step=step)``
     from inside the train_fn. Without a ``step`` metric the registry
     assigns latest+1 (single-host only — a multi-host gang must report
-    a step so every host names the same version)."""
+    a step so every host names the same version).
+    ``weights_delta=True`` ships only the leaves whose content changed
+    since this process's previous publish of the name (the online
+    loop's per-step refresh path; full fallback when there is no usable
+    base). ``weights_version`` overrides the version id (the online
+    loop numbers publications consecutively so the staleness gauge
+    counts PUBLICATIONS behind, decoupled from step numbering)."""
     ctx = get_context()
     metrics = dict(metrics)
     ctx._report_count += 1
@@ -147,8 +155,10 @@ def report(metrics: Dict[str, Any],
             # below) the previous attempt's publications
             _weights.publish(publish_weights,
                              name=weights_name or ctx.experiment_name,
-                             step=step if explicit_step else None,
-                             run_id=ctx.run_id)
+                             step=(None if weights_version is not None
+                                   else step if explicit_step else None),
+                             version=weights_version,
+                             run_id=ctx.run_id, delta=weights_delta)
         except ValueError as e:
             if "already committed" not in str(e):
                 raise

@@ -40,6 +40,16 @@ def ensure_chunkable(host_arr: Any) -> np.ndarray:
     return arr
 
 
+def local_machine_id() -> str:
+    """This HOST's identity (worker._MACHINE_ID): two processes sharing
+    it can hand chunks over shm instead of RPC. Chunk entries carry the
+    producer's machine id so consumers can account (and prefer) the
+    same-host path."""
+    from ray_tpu._private.worker import _MACHINE_ID
+
+    return _MACHINE_ID
+
+
 def put_chunk(worker, host_arr: Any) -> Tuple[Any, Dict[str, Any]]:
     """Put one host array into `worker`'s own store. Returns
     ``(ref, entry)`` — hold `ref` for the chunk's lifetime; `entry` is
@@ -50,6 +60,7 @@ def put_chunk(worker, host_arr: Any) -> Tuple[Any, Dict[str, Any]]:
     ref = worker.put(arr)
     entry = {"object_id": ref.id,
              "locator": list(worker.address),
+             "machine": local_machine_id(),
              "nbytes": int(arr.nbytes),
              "shape": list(arr.shape),
              "dtype": str(arr.dtype)}
@@ -60,35 +71,65 @@ class ChunkFetcher:
     """Chunk puller with a per-instance cache: each needed chunk crosses
     the object plane at most once per fetcher, with remote-vs-local
     accounting (``chunks_local`` / ``chunks_fetched`` /
-    ``fetched_bytes``). Callable with a chunk entry dict."""
+    ``fetched_bytes``), split further into the same-host shm path
+    (``shm_bytes``) vs true cross-host RPC (``rpc_bytes``) by comparing
+    the entry's producer machine id against ours. Callable with a chunk
+    entry dict."""
 
     def __init__(self, worker, timeout: float = 60.0,
-                 on_read: Optional[Callable[[int, bool], None]] = None):
+                 on_read: Optional[Callable[[int, bool, bool],
+                                            None]] = None,
+                 seed_cache: Optional[Dict[str, np.ndarray]] = None):
         self._worker = worker
         self._timeout = timeout
         self._on_read = on_read
-        self._cache: Dict[str, np.ndarray] = {}
+        self._machine = local_machine_id()
+        # seed_cache: chunks something else already pulled (subscriber
+        # prefetch) — their first use accounts as a LOCAL read
+        self._cache: Dict[str, np.ndarray] = dict(seed_cache or {})
+        self._seeded = set(self._cache)
         self.chunks_local = 0
         self.chunks_fetched = 0
         self.fetched_bytes = 0
+        self.shm_bytes = 0
+        self.rpc_bytes = 0
+
+    @property
+    def cache(self) -> Dict[str, np.ndarray]:
+        """The pulled chunks by object id — holdable by a caller to
+        keep a version's bytes at hand across fetchers (prefetch)."""
+        return self._cache
 
     def __call__(self, entry: Dict[str, Any]) -> np.ndarray:
         oid = entry["object_id"]
         arr = self._cache.get(oid)
         if arr is not None:
+            if oid in self._seeded:
+                self._seeded.discard(oid)
+                self.chunks_local += 1
+                if self._on_read is not None:
+                    self._on_read(int(entry.get("nbytes", arr.nbytes)),
+                                  True, True)
             return arr
         was_local = self._worker.store.contains(oid)
         ref = ObjectRef(oid, locator=tuple(entry["locator"]),
                         owner=tuple(entry["locator"]))
         arr = np.asarray(self._worker.get(ref, timeout=self._timeout))
         nbytes = int(entry.get("nbytes", arr.nbytes))
+        # entries predating the machine field read as same-host (shm was
+        # the only deployment shape those versions supported)
+        same_host = entry.get("machine", self._machine) == self._machine
         if was_local:
             self.chunks_local += 1
         else:
             self.chunks_fetched += 1
             self.fetched_bytes += nbytes
+            if same_host:
+                self.shm_bytes += nbytes
+            else:
+                self.rpc_bytes += nbytes
         if self._on_read is not None:
-            self._on_read(nbytes, was_local)
+            self._on_read(nbytes, was_local, same_host)
         self._cache[oid] = arr
         return arr
 
@@ -130,5 +171,5 @@ def fetch_tree(worker, descriptor: Dict[str, Any],
     return jax.tree.unflatten(treedef, leaves)
 
 
-__all__ = ["ChunkFetcher", "ensure_chunkable", "fetch_tree", "put_chunk",
-           "put_tree"]
+__all__ = ["ChunkFetcher", "ensure_chunkable", "fetch_tree",
+           "local_machine_id", "put_chunk", "put_tree"]

@@ -160,6 +160,18 @@ def pipeline_status(name: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def online_status() -> Dict[str, Any]:
+    """Online learning loop view (ray_tpu.online): per-component stat
+    snapshots grouped by role — samplers (rollouts, tokens, serving/
+    latest version, staleness incl. its high-water mark), the rollout
+    buffer (occupancy, capacity, backpressured puts), the learner
+    (steps, ingested rollouts/tokens, last published version) — plus
+    cluster totals. The CLI analog is `python -m ray_tpu online`; the
+    dashboard serves it at /api/online."""
+    return _conductor().conductor.call("get_online_status",
+                                       timeout=10.0)
+
+
 def resilience_status() -> Dict[str, Any]:
     """Recovery-subsystem view (ray_tpu.resilience): per-host failure
     scores with quarantine/drain flags, the excluded host list, event

@@ -25,9 +25,10 @@ Surfaces: ``util.state.weight_versions()``, ``ray_tpu weights``
 (list/inspect/gc), dashboard ``/api/weights``, publish/fetch/swap
 markers in the merged timeline.
 """
-from .publisher import WeightPublisher, publish  # noqa: F401
+from .publisher import (WeightPublisher, leaf_content_hashes,  # noqa: F401
+                        publish)
 from .subscriber import FetchStats, WeightSubscriber  # noqa: F401
 from .sync import WeightSync  # noqa: F401
 
 __all__ = ["WeightPublisher", "WeightSubscriber", "WeightSync",
-           "FetchStats", "publish"]
+           "FetchStats", "leaf_content_hashes", "publish"]

@@ -22,10 +22,11 @@ entries.
 """
 from __future__ import annotations
 
+import hashlib
 import pickle
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.train.async_checkpoint import _leaf_snapshots
 from ray_tpu.util import chunks
@@ -36,6 +37,30 @@ from .metrics import weight_metrics
 
 def _worker():
     return require_worker("publishing weights")
+
+
+def _hash_snapshot(meta: Dict[str, Any],
+                   shards: List[Tuple[tuple, Any]]) -> str:
+    """Content hash of one leaf's host-local snapshot (blake2b over
+    shard index + bytes, plus shape/dtype so a reshaped same-bytes leaf
+    never reads as unchanged). Hashes the array buffer directly — no
+    bytes copy."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((meta["shape"], meta["dtype"])).encode())
+    for index, host_arr in shards:
+        h.update(repr(index).encode())
+        h.update(chunks.ensure_chunkable(host_arr).data)
+    return h.hexdigest()
+
+
+def leaf_content_hashes(tree: Any) -> List[str]:
+    """Per-leaf content hash of THIS host's local shards — the
+    delta-publication change detector: two publishes of a leaf hash
+    equal iff this host's share of it is bit-identical."""
+    import jax
+
+    leaves, _ = jax.tree.flatten(tree)
+    return [_hash_snapshot(*_leaf_snapshots(leaf)) for leaf in leaves]
 
 
 class WeightPublisher:
@@ -59,20 +84,39 @@ class WeightPublisher:
                           else int(num_hosts))
         self._worker = _worker()
         # version -> chunk refs: holding the refs IS what keeps the
-        # chunks alive (refcount ownership); dropped on gc/reap notice
+        # chunks alive (refcount ownership); dropped on gc/reap notice.
+        # With delta publication a chunk can be referenced by manifests
+        # NEWER than the version it was published under — gc notices
+        # name explicit object ids and the registry withholds ids still
+        # referenced by kept manifests, so refs held under an old
+        # version key keep pinning exactly the chunks that are live.
         self._refs: Dict[int, List[Any]] = {}
+        # delta base: the per-leaf content hashes of this host's share
+        # of the LAST publish that committed from this publisher
+        self._last_version: Optional[int] = None
+        self._last_hashes: Optional[List[str]] = None
         self._lock = threading.Lock()
         self._worker.subscribe_channel("weights", self._on_weights_msg)
 
     # ------------------------------------------------------------- publish
 
     def publish(self, tree: Any, *, step: Optional[int] = None,
-                version: Optional[int] = None, run_id: str = "") -> int:
+                version: Optional[int] = None, run_id: str = "",
+                delta: bool = False) -> int:
         """Publish this host's local shards of `tree` as `version`
         (defaults to `step`, else registry-latest + 1 — multi-host gangs
         must pass an explicit step so every host names the same
         version). Returns the version id; the version is fetchable once
-        every host committed."""
+        every host committed.
+
+        ``delta=True`` ships only the leaves whose content hash changed
+        since this publisher's previous publish (the base version): the
+        fragment names the base and the unchanged leaves inherit the
+        base manifest's chunk entries at commit, so per-step refresh
+        pays for the optimizer's actual movement, not the whole model.
+        Falls back to a FULL publication when there is no base to delta
+        against (first publish, or the base was GC'd from the
+        registry)."""
         import jax
 
         t0 = time.perf_counter()
@@ -104,11 +148,41 @@ class WeightPublisher:
                 f"weight publish rejected: version {version} of "
                 f"{self.name!r} is already committed")
         leaves, treedef = jax.tree.flatten(tree)
+        # snapshot once (device->host copy of replica-0 shards); hash
+        # ONLY on delta publishes — a delta-less workflow must not pay
+        # a full-model hash per publish. The first delta publish
+        # therefore has no base (it goes out full) and seeds the chain.
+        snaps = [_leaf_snapshots(leaf) for leaf in leaves]
+        hashes = [_hash_snapshot(meta, shards)
+                  for meta, shards in snaps] if delta else None
+        base_version: Optional[int] = None
+        base_hashes: Optional[List[str]] = None
+        if delta and self._last_version is not None \
+                and self._last_hashes is not None \
+                and len(self._last_hashes) == len(leaves):
+            base_version = self._last_version
+            base_hashes = self._last_hashes
+            try:
+                if not self._worker.conductor.call(
+                        "weights_has_version", self.name, base_version,
+                        timeout=10.0):
+                    # full fallback: the base aged out of the registry
+                    # (keep-last-K GC or operator gc) — nothing to
+                    # inherit unchanged leaves from
+                    base_version = base_hashes = None
+            except Exception:  # noqa: BLE001 — probe only; the commit
+                pass           # re-checks under its own lock
         frag_leaves: Dict[str, Any] = {}
         refs: List[Any] = []
         w = self._worker
-        for i, leaf in enumerate(leaves):
-            meta, shards = _leaf_snapshots(leaf)
+        for i, (meta, shards) in enumerate(snaps):
+            if base_hashes is not None and hashes[i] == base_hashes[i]:
+                # unchanged since the base: ship metadata only; the
+                # registry inherits the base manifest's chunk entries
+                # for this host at commit
+                frag_leaves[str(i)] = {**meta, "hash": hashes[i],
+                                       "from_base": True, "shards": []}
+                continue
             entries = []
             for index, host_arr in shards:
                 # shared chunked-transfer path (util.chunks): the put
@@ -118,9 +192,13 @@ class WeightPublisher:
                 refs.append(ref)
                 entries.append(dict(entry,
                                     index=[list(t) for t in index]))
-            frag_leaves[str(i)] = {**meta, "shards": entries}
+            frag_leaves[str(i)] = {
+                **meta, "hash": hashes[i] if hashes else None,
+                "shards": entries}
         fragment: Dict[str, Any] = {"leaves": frag_leaves,
                                     "n_leaves": len(leaves)}
+        if base_version is not None:
+            fragment["base_version"] = base_version
         if self.host_rank == 0:
             fragment["treedef"] = pickle.dumps(treedef, protocol=5)
         with self._lock:
@@ -143,7 +221,22 @@ class WeightPublisher:
             raise
         if res.get("error"):
             self._drop_call_refs(version, refs)
+            if "delta base" in res["error"]:
+                # the base was GC'd between our probe and the commit
+                # (registry-authoritative check): full fallback — and
+                # the hashes already computed for THIS tree seed the
+                # chain, so the next delta diffs against the fallback
+                # instead of also going out full
+                self._last_version = self._last_hashes = None
+                v = self.publish(tree, step=step, version=version,
+                                 run_id=run_id, delta=False)
+                self._last_version = v
+                self._last_hashes = hashes
+                return v
             raise ValueError(f"weight publish rejected: {res['error']}")
+        if hashes is not None:
+            self._last_version = version
+            self._last_hashes = hashes
         m = weight_metrics()
         m["publish_ms"].observe((time.perf_counter() - t0) * 1e3,
                                 tags={"name": self.name})
@@ -198,9 +291,12 @@ class WeightPublisher:
             return
         if msg.get("kind") not in ("gc", "reaped"):
             return
-        ids = set(msg.get("object_ids") or ())
         with self._lock:
-            if ids:
+            if "object_ids" in msg:
+                # explicit-id protocol — an EMPTY list is meaningful
+                # (every chunk of the dropped version is still
+                # referenced by a kept delta manifest: free nothing)
+                ids = set(msg["object_ids"] or ())
                 for v in list(self._refs):
                     held = self._refs[v]
                     held[:] = [r for r in held if r.id not in ids]
@@ -235,9 +331,13 @@ _publishers_lock = threading.Lock()
 
 def publish(tree: Any, *, name: str = "default",
             step: Optional[int] = None, version: Optional[int] = None,
-            run_id: str = "") -> int:
+            run_id: str = "", delta: bool = False) -> int:
     """Publish from a per-name process-cached :class:`WeightPublisher`
-    (`ray_tpu.train.report(..., publish_weights=...)` lands here)."""
+    (`ray_tpu.train.report(..., publish_weights=...)` lands here).
+    ``delta=True`` ships only the leaves that changed since this
+    process's previous publish of `name` (full fallback when there is
+    no usable base) — the caching is what gives consecutive report()
+    publishes a base to diff against."""
     cur = _worker()
     with _publishers_lock:
         pub = _publishers.get(name)
@@ -245,7 +345,8 @@ def publish(tree: Any, *, name: str = "default",
             # a publisher from a previous init/shutdown cycle holds a
             # dead worker (and chunks that died with it) — replace it
             pub = _publishers[name] = WeightPublisher(name)
-    return pub.publish(tree, step=step, version=version, run_id=run_id)
+    return pub.publish(tree, step=step, version=version, run_id=run_id,
+                       delta=delta)
 
 
 def _reset_publishers() -> None:

@@ -40,29 +40,41 @@ class FetchStats:
     version: int = 0
     chunks_fetched: int = 0        # pulled over the object plane
     chunks_local: int = 0          # already in this process's store
-    fetched_bytes: int = 0         # remote bytes only
+    fetched_bytes: int = 0         # bytes pulled over the object plane
+    shm_bytes: int = 0             # ...of which same-host (shm path)
+    rpc_bytes: int = 0             # ...of which true cross-host RPC
     max_read_bytes: int = 0        # largest single assembled slice
     # per-leaf: (largest single read, full leaf nbytes) — the
     # no-full-copy assertion compares these for sharded leaves
     leaf_read_bytes: List[Any] = field(default_factory=list)
     elapsed_s: float = 0.0
+    # delta-publication provenance of the fetched manifest
+    delta: bool = False
+    base_version: Optional[int] = None
+    changed_leaves: Optional[List[int]] = None
 
 
 class _ChunkFetcher(chunks.ChunkFetcher):
     """Shared chunked-transfer fetcher (util.chunks) feeding this
     fetch's :class:`FetchStats` — each needed chunk crosses the object
-    plane at most once per fetch, with remote-vs-local accounting."""
+    plane at most once per fetch, with remote-vs-local (and shm-vs-RPC)
+    accounting."""
 
-    def __init__(self, worker, stats: FetchStats):
-        def on_read(nbytes: int, was_local: bool,
+    def __init__(self, worker, stats: FetchStats, seed_cache=None):
+        def on_read(nbytes: int, was_local: bool, same_host: bool,
                     _stats=stats) -> None:
             if was_local:
                 _stats.chunks_local += 1
             else:
                 _stats.chunks_fetched += 1
                 _stats.fetched_bytes += nbytes
+                if same_host:
+                    _stats.shm_bytes += nbytes
+                else:
+                    _stats.rpc_bytes += nbytes
 
-        super().__init__(worker, timeout=60.0, on_read=on_read)
+        super().__init__(worker, timeout=60.0, on_read=on_read,
+                         seed_cache=seed_cache)
 
 
 class _AccountingReader(_LeafReader):
@@ -92,12 +104,39 @@ class WeightSubscriber:
     under a target sharding template.
     """
 
-    def __init__(self, name: str = "default"):
+    def __init__(self, name: str = "default", *,
+                 cache_chunks: bool = False):
         self.name = name
         self._worker = _worker()
         self._cv = threading.Condition()
         self.last_stats: Optional[FetchStats] = None
+        # (version, {object_id: host array}) — holding the arrays is
+        # what keeps the bytes at hand (a bare local-store entry would
+        # be refcount-freed the moment the pulling fetcher's refs die);
+        # fetch() seeds its chunk cache from this. Retention costs a
+        # host copy of the model, so it is OPT-IN: `cache_chunks=True`
+        # at construction, or implied by the first prefetch() call
+        # (the prefetch/delta workflow is what profits from it).
+        self._cache_chunks = bool(cache_chunks)
+        self._prefetched: Optional[tuple] = None
+        # guards _prefetched: the pubsub prefetch thread and the sync
+        # loop's fetch both publish results; a version must never
+        # CLOBBER a newer one's already-pulled chunks
+        self._pf_lock = threading.Lock()
         self._worker.subscribe_channel("weights", self._on_weights_msg)
+
+    def _store_prefetched(self, version: int,
+                          cache: Dict[str, Any]) -> None:
+        """Publish pulled chunks, newest version wins: an older
+        completion merges its entries UNDER a newer holder's (the
+        newer version's unchanged chunks may be the very arrays the
+        older pull produced) instead of discarding them."""
+        with self._pf_lock:
+            cur = self._prefetched
+            if cur is not None and cur[0] > version:
+                self._prefetched = (cur[0], {**cache, **cur[1]})
+            else:
+                self._prefetched = (version, cache)
 
     def _on_weights_msg(self, msg: Any) -> None:
         """Pure wakeup: waiters re-poll the registry, which stays the
@@ -136,6 +175,59 @@ class WeightSubscriber:
             with self._cv:
                 self._cv.wait(min(remaining, 0.5))
 
+    # ----------------------------------------------------------- prefetch
+
+    def prefetch(self, version: Optional[int] = None) -> FetchStats:
+        """Pull `version`'s chunk BYTES into this process's object
+        store without assembling any array — the subscriber-prefetch
+        path: WeightSync starts this the moment a version commits,
+        while the engine is still decoding the previous one, so the
+        later ``fetch(like=)`` finds every chunk local and the swap
+        critical section is assembly+apply only.
+
+        Skips chunks already present (an unchanged delta leaf whose
+        chunks an earlier fetch pulled costs nothing). Implies
+        ``cache_chunks``. Returns the transfer accounting."""
+        self._cache_chunks = True
+        stats = FetchStats()
+        t0 = time.perf_counter()
+        manifest = self._worker.conductor.call(
+            "weights_get_manifest", self.name, version, timeout=30.0)
+        if manifest is None:
+            raise KeyError(
+                f"no committed version "
+                f"{'(latest)' if version is None else version} "
+                f"of weights {self.name!r} in the registry")
+        stats.version = int(manifest["version"])
+        stats.delta = bool(manifest.get("delta"))
+        stats.base_version = manifest.get("base_version")
+        stats.changed_leaves = manifest.get("changed_leaves")
+        # seed from whatever was prefetched before (oid-keyed, so a
+        # delta version reuses every unchanged chunk of the PREVIOUS
+        # version for free), then keep only this manifest's chunks
+        prev = self._prefetched
+        fetcher = _ChunkFetcher(self._worker, stats,
+                                seed_cache=prev[1] if prev else None)
+        needed = set()
+        for leaf in manifest["leaves"]:
+            for shard in leaf["shards"]:
+                needed.add(shard["object_id"])
+                fetcher(shard)
+        self._store_prefetched(
+            stats.version, {oid: arr for oid, arr
+                            in fetcher.cache.items() if oid in needed})
+        stats.elapsed_s = time.perf_counter() - t0
+        if stats.fetched_bytes:
+            try:
+                self._worker.conductor.notify("report_weight_event", {
+                    "kind": "prefetch", "name": self.name,
+                    "version": stats.version,
+                    "fetched_bytes": stats.fetched_bytes,
+                    "chunks": stats.chunks_fetched})
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        return stats
+
     # -------------------------------------------------------------- fetch
 
     def fetch(self, *, version: Optional[int] = None,
@@ -157,7 +249,17 @@ class WeightSubscriber:
                 f"no committed version {'(latest)' if version is None else version} "
                 f"of weights {self.name!r} in the registry")
         stats.version = int(manifest["version"])
-        fetcher = _ChunkFetcher(self._worker, stats)
+        stats.delta = bool(manifest.get("delta"))
+        stats.base_version = manifest.get("base_version")
+        stats.changed_leaves = manifest.get("changed_leaves")
+        # seed from the prefetched chunks (oid-keyed, so both "this
+        # version was prefetched" and "a delta reuses the previous
+        # version's unchanged chunks" come for free); their first use
+        # accounts as a local read
+        prev = self._prefetched
+        fetcher = _ChunkFetcher(self._worker, stats,
+                                seed_cache=prev[1] if prev else None)
+        machine = chunks.local_machine_id()
         readers: List[_AccountingReader] = []
         for i, leaf in enumerate(manifest["leaves"]):
             shape = tuple(leaf["shape"])
@@ -166,8 +268,13 @@ class WeightSubscriber:
                 else dtype.itemsize
             stats.leaf_read_bytes.append(
                 {"leaf": i, "max_read_bytes": 0, "full_nbytes": full})
+            # same-host placement hint: order this host's chunks first —
+            # the reader's coverage mask then skips loading any remote
+            # replica of a slice a colocated (shm) chunk already filled
+            shards = sorted(leaf["shards"],
+                            key=lambda s: s.get("machine", "") != machine)
             readers.append(_AccountingReader(
-                shape, dtype, leaf["shards"], fetcher, stats, i))
+                shape, dtype, shards, fetcher, stats, i))
         if like is None:
             if manifest.get("treedef") is None:
                 raise ValueError(
@@ -190,6 +297,17 @@ class WeightSubscriber:
                     f"version {stats.version} of {self.name!r} was "
                     f"published with {len(readers)}")
             out = materialize_like(readers, treedef, like)
+        if self._cache_chunks:
+            # carry the pulled chunks forward (pruned to THIS
+            # manifest's object ids): the next delta fetch reuses
+            # every unchanged chunk without another transfer
+            manifest_oids = {s["object_id"]
+                             for leaf in manifest["leaves"]
+                             for s in leaf["shards"]}
+            self._store_prefetched(
+                stats.version, {oid: arr for oid, arr
+                                in fetcher.cache.items()
+                                if oid in manifest_oids})
         stats.elapsed_s = time.perf_counter() - t0
         self.last_stats = stats
         m = weight_metrics()
@@ -208,6 +326,7 @@ class WeightSubscriber:
         return out
 
     def close(self) -> None:
+        self._prefetched = None
         try:
             self._worker.unsubscribe_channel("weights",
                                              self._on_weights_msg)

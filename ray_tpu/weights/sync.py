@@ -30,7 +30,8 @@ class WeightSync:
     def __init__(self, engine: Any, name: str = "default", *,
                  template: Any = None, consumer: str = "",
                  poll_interval_s: float = 0.5,
-                 subscriber: Optional[WeightSubscriber] = None):
+                 subscriber: Optional[WeightSubscriber] = None,
+                 prefetch: bool = False):
         self.engine = engine
         self.name = name
         # the reshard target: defaults to the engine's current params
@@ -38,14 +39,60 @@ class WeightSync:
         self.template = template if template is not None else engine.params
         self.consumer = consumer or f"pid-{os.getpid()}"
         self.poll_interval_s = poll_interval_s
-        self._sub = subscriber or WeightSubscriber(name)
+        self._sub = subscriber or WeightSubscriber(
+            name, cache_chunks=prefetch)
         self._stop = threading.Event()
         self._swapped = threading.Condition()
         self.swap_count = 0
         self.last_error: Optional[str] = None
+        # staleness high-water mark over this sync's lifetime (poll-
+        # cycle sampled) — the online loop's <= 1 invariant reads it
+        self.max_staleness: Optional[int] = None
+        # False the moment a registry probe fails; True again on the
+        # next successful cycle. status() exposes it so a caller can
+        # tell "fresh" apart from "the registry stopped answering and
+        # `latest` is whatever we last learned".
+        self.registry_reachable = True
+        # subscriber prefetch: a pubsub "published" notice immediately
+        # pulls the new version's chunk bytes into this process's store
+        # on a side thread, while the engine still decodes the old
+        # version — by the time the sync loop assembles + swaps, every
+        # chunk is local and the critical section is apply-only
+        self.prefetch = prefetch
+        self.prefetch_bytes = 0
+        self.prefetched_version: Optional[int] = None
+        self._prefetch_lock = threading.Lock()
+        if prefetch:
+            self._sub._worker.subscribe_channel("weights",
+                                                self._on_published)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"weight-sync-{name}")
         self._thread.start()
+
+    # ----------------------------------------------------------- prefetch
+
+    def _on_published(self, msg: Any) -> None:
+        if not isinstance(msg, dict) or msg.get("name") != self.name \
+                or msg.get("kind") != "published":
+            return
+        version = msg.get("version")
+        t = threading.Thread(target=self._prefetch_one, args=(version,),
+                             daemon=True,
+                             name=f"weight-prefetch-{self.name}")
+        t.start()
+
+    def _prefetch_one(self, version) -> None:
+        with self._prefetch_lock:  # one transfer at a time; a burst of
+            # publishes degrades to prefetching the newest last, which
+            # is the one the sync loop will swap to
+            if self._stop.is_set():
+                return
+            try:
+                st = self._sub.prefetch(version=version)
+            except Exception:  # noqa: BLE001 — version GC'd/reaped
+                return         # between notice and pull; fetch retries
+            self.prefetch_bytes += st.fetched_bytes
+            self.prefetched_version = st.version
 
     # ------------------------------------------------------------- status
 
@@ -53,23 +100,36 @@ class WeightSync:
         latest = None
         try:
             latest = self._sub.latest_version()
+            self.registry_reachable = True
         except Exception as e:  # noqa: BLE001 — conductor unreachable
             self.last_error = str(e)
+            self.registry_reachable = False
         serving = getattr(self.engine, "params_version", None)
         # staleness is unknowable (None), not huge, until the engine is
         # actually serving a fabric version — versions are step numbers,
-        # so "latest - 0" would trip every staleness alert at boot
+        # so "latest - 0" would trip every staleness alert at boot.
+        # Equally unknowable with the registry unreachable: `latest` is
+        # then stale knowledge, not a freshness certificate.
         staleness = None
-        if latest is not None and serving is not None:
+        if latest is not None and serving is not None \
+                and self.registry_reachable:
             staleness = latest - serving
+            self.max_staleness = staleness if self.max_staleness is None \
+                else max(self.max_staleness, staleness)
         st = self._sub.last_stats
         return {"name": self.name, "consumer": self.consumer,
                 "serving_version": serving, "latest_version": latest,
+                "registry_reachable": self.registry_reachable,
                 "staleness_versions": staleness,
+                "max_staleness_versions": self.max_staleness,
                 "swap_count": self.swap_count,
                 "fetched_bytes": st.fetched_bytes if st else 0,
+                "rpc_bytes": st.rpc_bytes if st else 0,
+                "shm_bytes": st.shm_bytes if st else 0,
                 "max_read_bytes": st.max_read_bytes if st else 0,
                 "leaf_read_bytes": list(st.leaf_read_bytes) if st else [],
+                "prefetch_bytes": self.prefetch_bytes,
+                "prefetched_version": self.prefetched_version,
                 "last_error": self.last_error}
 
     def wait_for_swap(self, min_version: int, timeout: float = 30.0
@@ -93,10 +153,18 @@ class WeightSync:
 
     def _gauge(self, latest: Optional[int]) -> None:
         serving = getattr(self.engine, "params_version", None)
-        if latest is None or serving is None:
-            return  # unknown staleness: emit nothing, not a bogus delta
+        if latest is None or serving is None \
+                or not self.registry_reachable:
+            # unknown staleness: emit nothing — neither a bogus delta
+            # nor a reassuring 0 while the registry is unreachable (the
+            # gauge keeps its LAST known value; the reachability flag is
+            # what tells the operator it may be stale)
+            return
+        staleness = latest - serving
+        self.max_staleness = staleness if self.max_staleness is None \
+            else max(self.max_staleness, staleness)
         weight_metrics()["staleness"].set(
-            float(latest - serving),
+            float(staleness),
             tags={"name": self.name, "consumer": self.consumer})
 
     def _engine_stopped(self) -> bool:
@@ -113,12 +181,19 @@ class WeightSync:
                 return
             try:
                 latest = self._sub.latest_version()
+                self.registry_reachable = True
                 serving = getattr(self.engine, "params_version", None)
                 # follow whatever the registry calls latest (committed
                 # most recently) rather than `>`: a gang restarted from
                 # an older checkpoint republishes LOWER version numbers,
                 # and those are the live weights
                 if latest is not None and latest != serving:
+                    if self.prefetch:
+                        # barrier on the pubsub-kicked transfer (or do
+                        # the pull now — idempotent: chunks already
+                        # local cost nothing): after this, fetch() is
+                        # pure assembly
+                        self._prefetch_one(latest)
                     params = self._sub.fetch(version=latest,
                                              like=self.template)
                     applied = self.engine.update_params(params,
@@ -160,6 +235,7 @@ class WeightSync:
                 # between list and fetch); next cycle retries
                 failed_cycles += 1
                 self.last_error = f"{type(e).__name__}: {e}"
+                self.registry_reachable = False
                 logger.debug("weight sync cycle failed: %s", e)
             # pubsub publish notices wake the subscriber cv; this wait
             # piggybacks on it so swaps start promptly without a hot
@@ -172,6 +248,12 @@ class WeightSync:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.prefetch:
+            try:
+                self._sub._worker.unsubscribe_channel(
+                    "weights", self._on_published)
+            except Exception:  # noqa: BLE001 — worker already torn down
+                pass
         with self._sub._cv:
             self._sub._cv.notify_all()
         self._thread.join(timeout=10.0)

@@ -48,6 +48,12 @@ def pytest_configure(config):
         "(ray_tpu.mpmd: stage-gangs, 1F1B schedule, activation "
         "channels); the tier-1-safe smoke subset runs on a virtual "
         "cluster with log_to_driver=0 — select with `-m mpmd`")
+    config.addinivalue_line(
+        "markers", "online: online learning loop scenarios "
+        "(ray_tpu.online: sampler/learner split, rollout buffer, "
+        "delta weight publication); the tier-1-safe smoke subset runs "
+        "on a module-scoped virtual-slice cluster with "
+        "log_to_driver=0 — select with `-m online`")
 
 
 def _sweep_leaked_shm():

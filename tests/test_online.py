@@ -1,0 +1,655 @@
+"""Online learning loop (ray_tpu.online, ISSUE-8 acceptance surface):
+Podracer-style sampler/learner split with per-step weight refresh —
+delta publication in the weight fabric, subscriber prefetch, same-host
+chunk accounting, the rollout buffer, and the end-to-end online
+distillation run with the one-set-of-numbers check.
+
+The `online` marker tags the subsystem's scenarios; everything here is
+the tier-1-safe smoke subset (module-scoped virtual-slice 8-device CPU
+cluster, log_to_driver=0 per the established fixture pattern)."""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import io
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu import weights as wts
+from ray_tpu.weights.publisher import leaf_content_hashes
+
+
+# -------------------------------------------------- cluster fixture
+
+
+@pytest.fixture(scope="module")
+def online_cluster():
+    """One cluster for the whole module (tier-1 wall-time budget):
+    every test uses its own weight-set / buffer name, so registry state
+    never crosses tests."""
+    import os
+
+    prev_slices = os.environ.get("RAY_TPU_VIRTUAL_SLICES")
+    prev_metrics = os.environ.get("RAY_TPU_METRICS_INTERVAL_S")
+    os.environ["RAY_TPU_VIRTUAL_SLICES"] = "2"
+    os.environ["RAY_TPU_METRICS_INTERVAL_S"] = "0.2"
+    ray_tpu.init(num_cpus=4, _system_config={
+        "log_to_driver": 0,
+        "weights_keep": 3,
+    })
+    yield ray_tpu._private.worker.global_worker
+    ray_tpu.shutdown()
+    for key, prev in [("RAY_TPU_VIRTUAL_SLICES", prev_slices),
+                      ("RAY_TPU_METRICS_INTERVAL_S", prev_metrics)]:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+
+
+def _mesh(axes):
+    devs = np.array(jax.devices()[:int(np.prod([n for _, n in axes]))])
+    return Mesh(devs.reshape([n for _, n in axes]), [a for a, _ in axes])
+
+
+def _put(mesh, spec, arr):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _tree(mesh, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w_big": _put(mesh, P(("dp", "fsdp"), None),
+                      rng.standard_normal((64, 16)).astype(np.float32)),
+        "w_col": _put(mesh, P(None, ("dp", "fsdp")),
+                      rng.standard_normal((4, 32)).astype(np.float32)),
+        "bias": _put(mesh, P(None),
+                     rng.standard_normal(16).astype(np.float32)),
+    }
+
+
+class _FakeEngine:
+    """The minimal WeightSync target: update_params + params_version
+    (what ContinuousBatchingEngine exposes), applying swaps
+    immediately."""
+
+    def __init__(self, params=None, version=None):
+        self.params = params
+        self.params_version = version
+        self.swap_count = 0
+        self._stopped = threading.Event()
+
+    def update_params(self, params, version=None):
+        self.params = params
+        self.params_version = version
+        self.swap_count += 1
+        ev = threading.Event()
+        ev.set()
+        return ev
+
+
+# ---------------------------------------------- delta: change detection
+
+
+@pytest.mark.online
+def test_leaf_content_hashes_detect_changes():
+    """The delta change detector: per-leaf hashes equal iff the leaf's
+    bytes (and shape/dtype) are identical."""
+    rng = np.random.default_rng(0)
+    tree = {"a": jnp.asarray(rng.standard_normal((8, 4)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal(16), jnp.float32),
+            "c": jnp.int32(3)}
+    h0 = leaf_content_hashes(tree)
+    assert leaf_content_hashes(dict(tree)) == h0  # deterministic
+    changed = dict(tree, a=tree["a"] * 1.5)
+    h1 = leaf_content_hashes(changed)
+    assert h1[0] != h0[0] and h1[1:] == h0[1:]
+    # same bytes, different shape: must NOT read as unchanged
+    reshaped = dict(tree, b=tree["b"].reshape(4, 4))
+    assert leaf_content_hashes(reshaped)[1] != h0[1]
+    # same values, different dtype: must NOT read as unchanged
+    cast = dict(tree, b=tree["b"].astype(jnp.float16))
+    assert leaf_content_hashes(cast)[1] != h0[1]
+
+
+@pytest.mark.online
+def test_delta_publish_ships_only_changed_leaves(online_cluster):
+    """A delta publish records (base_version, changed_leaves), ships
+    strictly fewer bytes than a full one, and fetches bit-identically —
+    including under a dtype-cast template."""
+    w = online_cluster
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    pub = wts.WeightPublisher("delta-basic")
+    t1 = _tree(mesh, seed=1)
+    # delta=True with no base: goes out FULL and seeds the delta chain
+    pub.publish(t1, step=1, delta=True)
+    t2 = dict(t1, w_big=_put(mesh, P(("dp", "fsdp"), None),
+                             np.asarray(t1["w_big"]) * 1.5))
+    assert pub.publish(t2, step=2, delta=True) == 2
+    m1 = w.conductor.call("weights_get_manifest", "delta-basic", 1,
+                          timeout=10.0)
+    m2 = w.conductor.call("weights_get_manifest", "delta-basic", 2,
+                          timeout=10.0)
+    assert not m1["delta"]
+    assert m2["delta"] and m2["base_version"] == 1
+    assert m2["changed_leaves"] == [
+        i for i, k in enumerate(sorted(t1)) if k == "w_big"]
+    assert 0 < m2["delta_bytes"] < m2["total_bytes"]
+    assert m2["total_bytes"] == m1["total_bytes"]  # resolved size
+    # the unchanged leaves' chunk entries are INHERITED (same object
+    # ids as the base), the changed leaf's are new
+    by_shape = {tuple(lf["shape"]): lf for lf in m2["leaves"]}
+    base_by_shape = {tuple(lf["shape"]): lf for lf in m1["leaves"]}
+    same = {s["object_id"] for s in by_shape[(4, 32)]["shards"]}
+    assert same == {s["object_id"]
+                    for s in base_by_shape[(4, 32)]["shards"]}
+    new = {s["object_id"] for s in by_shape[(64, 16)]["shards"]}
+    assert not (new & {s["object_id"]
+                       for s in base_by_shape[(64, 16)]["shards"]})
+    sub = wts.WeightSubscriber("delta-basic")
+    out = sub.fetch(version=2)
+    np.testing.assert_array_equal(out["w_big"], np.asarray(t2["w_big"]))
+    np.testing.assert_array_equal(out["w_col"], np.asarray(t1["w_col"]))
+    assert sub.last_stats.delta and sub.last_stats.base_version == 1
+    # dtype-cast template over a delta manifest
+    mesh_tp = _mesh([("tp", 8)])
+    like = {"w_big": _put(mesh_tp, P(None, "tp"),
+                          np.zeros((64, 16), np.float16)),
+            "w_col": _put(mesh_tp, P(None, "tp"),
+                          np.zeros((4, 32), np.float32)),
+            "bias": _put(mesh_tp, P(None), np.zeros(16, np.float32))}
+    cast = sub.fetch(version=2, like=like)
+    assert cast["w_big"].dtype == jnp.float16
+    np.testing.assert_allclose(
+        np.asarray(cast["w_big"], np.float32),
+        np.asarray(t2["w_big"]).astype(np.float16).astype(np.float32))
+    sub.close()
+    pub.close()
+
+
+@pytest.mark.online
+def test_delta_chain_resolves_across_gcd_bases(online_cluster):
+    """Chains of deltas collapse at commit: any kept version stays
+    fetchable after its bases were GC'd, GC notices never free chunks a
+    kept delta still references, and a delta against a fully-GC'd base
+    falls back to a FULL publication."""
+    w = online_cluster
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    pub = wts.WeightPublisher("delta-chain")
+    trees = [_tree(mesh, seed=1)]
+    pub.publish(trees[0], step=1, delta=True)  # seeds the chain
+    for v in range(2, 5):  # v2..v4 each change only w_big
+        t = dict(trees[-1],
+                 w_big=_put(mesh, P(("dp", "fsdp"), None),
+                            np.asarray(trees[-1]["w_big"]) + v))
+        trees.append(t)
+        assert pub.publish(t, step=v, delta=True) == v
+    listing = w.conductor.call("get_weight_versions", timeout=10.0)
+    kept = [x["version"] for x in
+            listing["names"]["delta-chain"]["versions"]]
+    assert kept == [2, 3, 4]  # keep-last-3 (fixture): v1 GC'd
+    sub = wts.WeightSubscriber("delta-chain")
+    # v2's unchanged leaves inherited v1's chunks; v1 was GC'd — the
+    # chunks must still be alive (live-id-aware gc notice) and the
+    # manifest self-contained
+    for v in (2, 4):
+        out = sub.fetch(version=v)
+        np.testing.assert_array_equal(out["w_big"],
+                                      np.asarray(trees[v - 1]["w_big"]))
+        np.testing.assert_array_equal(out["w_col"],
+                                      np.asarray(trees[0]["w_col"]))
+    # full fallback: every version GC'd -> the next delta publish has
+    # no base and must go out full
+    assert w.conductor.call("weights_gc", "delta-chain", 0,
+                            timeout=10.0) == 3
+    assert pub.publish(trees[-1], step=5, delta=True) == 5
+    m5 = w.conductor.call("weights_get_manifest", "delta-chain", 5,
+                          timeout=10.0)
+    assert not m5["delta"] and m5["base_version"] is None
+    out = sub.fetch(version=5)
+    np.testing.assert_array_equal(out["w_big"],
+                                  np.asarray(trees[-1]["w_big"]))
+    sub.close()
+    pub.close()
+
+
+# ------------------------------------- rapid cadence + staleness gauge
+
+
+@pytest.mark.online
+def test_rapid_cadence_publication(online_cluster):
+    """20 versions at ~50ms intervals: keep-last-K GC holds, delta
+    chains resolve across the GC churn, and a live WeightSync-driven
+    engine never falls more than 1 version behind (high-water mark +
+    the Prometheus gauge)."""
+    w = online_cluster
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    pub = wts.WeightPublisher("rapid")
+    t = _tree(mesh, seed=7)
+    pub.publish(t, step=1, delta=True)  # seeds the chain
+    engine = _FakeEngine()
+    sync = wts.WeightSync(engine, "rapid", template=t,
+                          consumer="rapid-engine",
+                          poll_interval_s=0.015)
+    try:
+        sync.wait_for_swap(1, timeout=30.0)
+        for v in range(2, 21):
+            t = dict(t, w_big=_put(mesh, P(("dp", "fsdp"), None),
+                                   np.asarray(t["w_big"]) + 1.0))
+            pub.publish(t, step=v, delta=True)
+            time.sleep(0.05)
+        sync.wait_for_swap(20, timeout=30.0)
+        assert sync.max_staleness is not None \
+            and sync.max_staleness <= 1, sync.max_staleness
+        st = sync.status()
+        assert st["max_staleness_versions"] <= 1
+        assert st["staleness_versions"] == 0
+        # the gauge agrees (its final value for this consumer)
+        from ray_tpu.weights.metrics import weight_metrics
+
+        snap = weight_metrics()["staleness"]._snapshot()
+        mine = [val for tags, val in snap["values"].items()
+                if "rapid-engine" in tags]
+        assert mine and all(v <= 1 for v in mine), snap["values"]
+        # keep-last-K GC held at every point; final registry keeps 3
+        listing = w.conductor.call("get_weight_versions", timeout=10.0)
+        kept = [x["version"] for x in
+                listing["names"]["rapid"]["versions"]]
+        assert kept == [18, 19, 20]
+        # the engine's final params match the last published tree
+        np.testing.assert_array_equal(
+            np.asarray(engine.params["w_big"]), np.asarray(t["w_big"]))
+    finally:
+        sync.stop()
+    pub.close()
+
+
+@pytest.mark.online
+def test_sync_registry_unreachable_flag(online_cluster):
+    """ISSUE-8 bugfix: an unreachable registry must surface as
+    registry_reachable=False with staleness UNKNOWN (None) — not as a
+    stale `latest` reported fresh — and the staleness gauge must skip
+    the update (keep its last value, never report 0)."""
+    mesh = _mesh([("dp", 2), ("fsdp", 4)])
+    t = _tree(mesh, seed=9)
+    wts.publish(t, name="reach", step=1)
+    engine = _FakeEngine()
+    sync = wts.WeightSync(engine, "reach", template=t,
+                          consumer="reach-engine",
+                          poll_interval_s=0.02)
+    try:
+        sync.wait_for_swap(1, timeout=30.0)
+        st = sync.status()
+        assert st["registry_reachable"] is True
+        assert st["staleness_versions"] == 0
+        from ray_tpu.weights.metrics import weight_metrics
+
+        def gauge_values():
+            snap = weight_metrics()["staleness"]._snapshot()
+            return {tags: val for tags, val in snap["values"].items()
+                    if "reach-engine" in tags}
+
+        before = gauge_values()
+        assert before and all(v == 0 for v in before.values())
+        real = sync._sub.latest_version
+
+        def boom():
+            raise ConnectionError("conductor unreachable")
+
+        sync._sub.latest_version = boom
+        try:
+            deadline = time.monotonic() + 10.0
+            while sync.status()["registry_reachable"]:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            st = sync.status()
+            assert st["registry_reachable"] is False
+            assert st["staleness_versions"] is None
+            assert st["last_error"] and "unreachable" in st["last_error"]
+            # serving version still reported honestly; gauge unchanged
+            assert st["serving_version"] == 1
+            assert gauge_values() == before
+        finally:
+            sync._sub.latest_version = real
+        deadline = time.monotonic() + 10.0
+        while not sync.status()["registry_reachable"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert sync.status()["staleness_versions"] == 0
+    finally:
+        sync.stop()
+
+
+# ------------------------------- prefetch + same-host chunk accounting
+
+
+@pytest.mark.online
+def test_prefetch_and_delta_fetch_bytes(online_cluster):
+    """Chunks live in a REMOTE producer's store: prefetch pulls them
+    while nothing waits, the subsequent fetch is pure assembly
+    (0 transfer bytes), a delta version's fetch moves strictly fewer
+    bytes than the full one, and every transfer is same-host shm (no
+    cross-host RPC)."""
+
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self):
+            from ray_tpu import weights as wts_mod
+
+            self.pub = wts_mod.WeightPublisher("pf")
+            rng = np.random.default_rng(3)
+            self.t1 = {
+                "big": rng.standard_normal((256, 64)).astype(np.float32),
+                "small": rng.standard_normal(16).astype(np.float32)}
+            self.pub.publish(self.t1, step=1, delta=True)
+
+        def publish_delta(self):
+            t2 = dict(self.t1,
+                      small=self.t1["small"] + 1.0)
+            self.pub.publish(t2, step=2, delta=True)
+            return True
+
+        def tree(self):
+            return {k: v for k, v in self.t1.items()}
+
+    prod = Producer.remote()
+    sub = wts.WeightSubscriber("pf")
+    assert sub.wait_for_version(1, timeout=60.0) == 1
+    pf = sub.prefetch(version=1)
+    assert pf.fetched_bytes > 0 and pf.chunks_fetched == 2
+    assert pf.shm_bytes == pf.fetched_bytes and pf.rpc_bytes == 0
+    out = sub.fetch(version=1)
+    full_stats = sub.last_stats
+    # prefetch made the fetch pure assembly: nothing crossed the
+    # object plane again
+    assert full_stats.fetched_bytes == 0
+    assert full_stats.chunks_local == 2
+    expected = ray_tpu.get(prod.tree.remote(), timeout=30.0)
+    np.testing.assert_array_equal(out["big"], expected["big"])
+    # delta version: only the changed (small) leaf's chunk moves
+    assert ray_tpu.get(prod.publish_delta.remote(), timeout=60.0)
+    assert sub.wait_for_version(2, timeout=30.0) == 2
+    sub.fetch(version=2)
+    delta_stats = sub.last_stats
+    assert delta_stats.delta and delta_stats.base_version == 1
+    assert delta_stats.fetched_bytes == 16 * 4  # the small leaf only
+    assert delta_stats.fetched_bytes < pf.fetched_bytes
+    assert delta_stats.rpc_bytes == 0
+    # prefetch events landed in the weight event log
+    w = online_cluster
+    kinds = [e["kind"] for e in w.conductor.call(
+        "get_weight_events", 200, timeout=10.0)
+        if e.get("name") == "pf"]
+    assert "prefetch" in kinds
+    sub.close()
+    ray_tpu.kill(prod)
+
+
+@pytest.mark.online
+def test_chunk_fetcher_shm_vs_rpc_accounting(online_cluster):
+    """Chunk entries carry the producer's machine id: a same-host pull
+    accounts as shm, an entry claiming another machine as RPC (unit:
+    fabricated machine id — everything in this suite is one box)."""
+    from ray_tpu.util import chunks
+
+    @ray_tpu.remote
+    class Holder:
+        def hold(self):
+            from ray_tpu._private import worker as worker_mod
+
+            arr = np.arange(64, dtype=np.float32)
+            self.ref, entry = chunks.put_chunk(
+                worker_mod.global_worker, arr)
+            return entry
+
+    holder = Holder.remote()
+    entry = ray_tpu.get(holder.hold.remote(), timeout=60.0)
+    assert entry["machine"] == chunks.local_machine_id()
+    w = online_cluster
+    faked = dict(entry, machine="some-other-host/boot-id")
+    f1 = chunks.ChunkFetcher(w)
+    f1(faked)
+    assert f1.rpc_bytes == 64 * 4 and f1.shm_bytes == 0
+    # honest machine id: the same pull accounts as same-host shm
+    f2 = chunks.ChunkFetcher(w)
+    f2(entry)
+    assert f2.chunks_fetched == 1 and f2.shm_bytes == 64 * 4
+    assert f2.rpc_bytes == 0
+    # a seeded fetcher (the prefetch handoff) reads it as LOCAL —
+    # nothing crosses the object plane again
+    f3 = chunks.ChunkFetcher(w, seed_cache=f2.cache)
+    np.testing.assert_array_equal(f3(entry),
+                                  np.arange(64, dtype=np.float32))
+    assert f3.chunks_local == 1 and f3.fetched_bytes == 0
+    ray_tpu.kill(holder)
+
+
+@pytest.mark.online
+def test_leaf_reader_prefers_covering_shards_in_order():
+    """Same-host placement hint mechanics: shard order is the
+    preference, and a shard whose region is already covered is never
+    LOADED — a replicated slice with a local copy first never touches
+    the remote replica."""
+    from ray_tpu.train.async_checkpoint import _LeafReader
+
+    calls = []
+
+    def loader(shard):
+        calls.append(shard["tag"])
+        if shard["tag"] == "remote":
+            raise AssertionError("remote replica must not be loaded")
+        return np.arange(32, dtype=np.float32).reshape(8, 4)
+
+    shards = [
+        {"tag": "local", "index": [[0, 8, 1], [0, 4, 1]]},
+        {"tag": "remote", "index": [[0, 8, 1], [0, 4, 1]]},
+    ]
+    r = _LeafReader(None, (8, 4), np.float32, shards, loader=loader)
+    out = r.read((slice(0, 8), slice(0, 4)))
+    np.testing.assert_array_equal(
+        out, np.arange(32, dtype=np.float32).reshape(8, 4))
+    assert calls == ["local"]
+    # reversed order: the "remote" copy is first and IS loaded
+    r2 = _LeafReader(None, (8, 4), np.float32, shards[::-1],
+                     loader=loader)
+    with pytest.raises(AssertionError):
+        r2.read((slice(0, 8), slice(0, 4)))
+
+
+# --------------------------------------------------- rollout buffer
+
+
+@pytest.mark.online
+def test_rollout_buffer_backpressure_and_versions(online_cluster):
+    """Bounded capacity with put-side rejection (the backpressure
+    signal), FIFO pops, and version-tagged occupancy accounting."""
+    from ray_tpu.online import RolloutBuffer, from_rollouts
+
+    buf = ray_tpu.remote(RolloutBuffer).remote(4, name="bp-test")
+
+    def item(i, v):
+        return {"id": i, "weights_version": v}
+
+    assert ray_tpu.get(buf.put.remote([item(i, 1) for i in range(3)]),
+                       timeout=30.0) == 3
+    # only one slot left: 2 of 3 rejected
+    assert ray_tpu.get(buf.put.remote([item(i, 2) for i in range(3, 6)]),
+                       timeout=30.0) == 1
+    st = ray_tpu.get(buf.stats.remote(), timeout=30.0)
+    assert st["occupancy"] == 4 and st["capacity"] == 4
+    assert st["rejected"] == 2
+    assert st["versions_queued"] == {1: 3, 2: 1}
+    got = ray_tpu.get(buf.get_batch.remote(2), timeout=30.0)
+    assert [r["id"] for r in got] == [0, 1]  # FIFO
+    st = ray_tpu.get(buf.stats.remote(), timeout=30.0)
+    assert st["occupancy"] == 2 and st["versions_queued"] == {1: 1, 2: 1}
+    # streaming_split shards pop destructively -> disjoint batches
+    # (prefetch=0: a background pull here would race the other shard
+    # for the last items of this FINITE fill)
+    assert ray_tpu.get(buf.put.remote([item(i, 3) for i in range(6, 8)]),
+                       timeout=30.0) == 2
+    shards = from_rollouts(buf, batch_size=2,
+                           prefetch=0).streaming_split(2)
+    it_a = shards[0].iter_batches()
+    it_b = shards[1].iter_batches()
+    seen = [r["id"] for r in next(it_a)] + [r["id"] for r in next(it_b)]
+    assert sorted(seen) == [2, 3, 6, 7]
+    assert len(set(seen)) == 4
+    ray_tpu.kill(buf)
+
+
+# ----------------------------------------- sampler + engine scores
+
+
+@pytest.mark.online
+def test_rollout_sampler_inprocess(online_cluster):
+    """A RolloutSampler against a published v1: rollouts carry aligned
+    per-token logprob scores (<= 0) and the version tag; buffer
+    backpressure pauses generation without dropping rollouts."""
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_init
+    from ray_tpu.online import RolloutBuffer, RolloutSampler
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+    params = gpt2_init(cfg, jax.random.PRNGKey(0))
+    wts.publish(params, name="samp", step=1)
+    buf = ray_tpu.remote(RolloutBuffer).remote(8, name="samp-buf")
+    sampler = RolloutSampler(
+        "samp-0", "samp", lambda: (gpt2_init(cfg, jax.random.PRNGKey(0)),
+                                   cfg),
+        buf, max_new_tokens=6, prefetch=False)
+    try:
+        r = sampler._rollout_one()
+        assert r["weights_version"] == 1
+        assert r["completion"].shape == r["scores"].shape
+        assert len(r["completion"]) == 6
+        assert np.all(r["scores"] <= 0.0)
+        assert np.all(np.isfinite(r["scores"]))
+        st = sampler.status()
+        assert st["rollouts"] == 1 and st["rollout_tokens"] == 6
+        assert st["staleness_versions"] == 0
+    finally:
+        sampler.stop()
+    ray_tpu.kill(buf)
+
+
+# ------------------------------------------------------- the e2e loop
+
+
+@pytest.mark.online
+def test_online_distillation_e2e(online_cluster, tmp_path):
+    """ISSUE-8 acceptance: a learner gang trains while 2 samplers
+    generate through ContinuousBatchingEngine; sampler staleness stays
+    <= 1 version for the whole run; learner loss decreases; delta
+    publications ship strictly fewer bytes than full ones; and the
+    one-set-of-numbers check holds across state API == CLI ==
+    dashboard == timeline markers."""
+    from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.online import OnlineConfig, OnlineTrainer
+    from ray_tpu.train import RunConfig
+    from ray_tpu.util import state
+
+    w = online_cluster
+    mc = dataclasses.replace(GPT2Config.tiny(), dtype=jnp.float32)
+    trainer = OnlineTrainer(mc, config=OnlineConfig(
+        num_samplers=2, num_steps=10, batch_size=8, publish_every=2,
+        max_new_tokens=8, buffer_capacity=32, weights_name="e2e"),
+        run_config=RunConfig(name="online-e2e",
+                             storage_path=str(tmp_path)))
+    res = trainer.fit()
+    assert res.error is None
+
+    # learner loss decreases (distillation objective converging)
+    losses = [m["loss"] for m in res.metrics_history if "loss" in m]
+    assert len(losses) == 10
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+    # staleness <= 1 for the WHOLE run: per-sampler high-water marks
+    assert len(res.sampler_stats) == 2
+    for st in res.sampler_stats:
+        assert st["max_staleness_versions"] is not None
+        assert st["max_staleness_versions"] <= 1, st
+        assert st["rollouts"] > 0 and st["swap_count"] >= 1
+        # colocated samplers pulled everything over shm, never RPC
+        assert st["rpc_bytes"] == 0
+        assert st["registry_reachable"] is True
+
+    # delta publications ship strictly fewer bytes than full ones
+    versions = res.weight_versions["names"]["e2e"]["versions"]
+    deltas = [v for v in versions if v["delta"]]
+    assert deltas, versions
+    for v in deltas:
+        assert 0 < v["delta_bytes"] < v["total_bytes"], v
+
+    # rollouts flowed: samplers -> buffer -> learner
+    assert res.buffer_stats["total_in"] >= res.buffer_stats["total_out"]
+    assert res.buffer_stats["total_out"] >= 80  # 10 steps x batch 8
+    ingested = res.metrics_history[-1]["ingested_rollouts"]
+    assert ingested == 80
+
+    # ---- one set of numbers: state API == CLI == dashboard ----
+    api = state.online_status()
+    samplers = {k: v for k, v in api["samplers"].items()
+                if v.get("weights_name") == "e2e"}
+    assert len(samplers) == 2
+    assert api["totals"]["max_staleness_versions"] <= 1
+
+    from ray_tpu.scripts import cli
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(["online", "--json", "--address", "ignored:0"])
+    cli_payload = json.loads(buf.getvalue())
+    assert cli_payload["totals"] == api["totals"]
+    assert set(cli_payload["samplers"]) == set(api["samplers"])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(["online", "--events", "5", "--address", "ignored:0"])
+    text = buf.getvalue()
+    assert "totals:" in text and "max_staleness=" in text
+
+    import urllib.request
+
+    from ray_tpu.dashboard import DashboardServer
+
+    dash = DashboardServer(w.conductor_address, port=0).start()
+    try:
+        with urllib.request.urlopen(dash.url + "/api/online",
+                                    timeout=10.0) as r:
+            payload = json.loads(r.read())
+        assert payload["totals"] == api["totals"]
+        assert payload["events"]
+    finally:
+        dash.stop()
+
+    # ---- timeline: the online lane carries the loop's markers ----
+    trace = state.timeline(str(tmp_path / "merged.json"), merged=True)
+    online_ev = [e for e in trace if e.get("cat") == "online"]
+    kinds = {e["args"]["kind"] for e in online_ev}
+    assert {"rollout", "ingest", "publish", "swap"} <= kinds, kinds
+    # weights lane: the fabric-side publish markers carry delta bytes
+    wkinds = {e["tid"] for e in trace if e.get("cat") == "weights"}
+    assert {"publish", "swap"} <= wkinds
+
+    # ---- Prometheus: online metric families + the staleness gauge ----
+    from ray_tpu.util import metrics as metrics_mod
+
+    metrics_mod.flush()
+    deadline = time.monotonic() + 20.0
+    while True:
+        text = state.prometheus_metrics()
+        if ("ray_tpu_online_rollout_tokens_total" in text
+                and "ray_tpu_online_buffer_occupancy" in text
+                and "ray_tpu_online_ingested_rollouts_total" in text
+                and "ray_tpu_weights_staleness_versions" in text):
+            break
+        assert time.monotonic() < deadline, text[-2000:]
+        time.sleep(0.2)
+    assert 'sampler="sampler-0"' in text

@@ -32,7 +32,7 @@ def test_package_lint_covers_the_whole_tree():
             seen.add(os.path.relpath(dirpath, PACKAGE_ROOT).split(
                 os.sep)[0])
     assert {"serve", "parallel", "train", "resilience", "weights",
-            "models", "mpmd"} <= seen
+            "models", "mpmd", "online"} <= seen
 
 
 def test_kvcache_module_is_lint_covered():
@@ -50,6 +50,16 @@ def test_mpmd_package_is_lint_covered():
     findings of its own (a rename/move would silently drop it from
     coverage)."""
     path = os.path.join(PACKAGE_ROOT, "mpmd")
+    assert os.path.isdir(path)
+    assert errors(lint_path(path)) == []
+
+
+def test_online_package_is_lint_covered():
+    """The online learning loop (ray_tpu/online/) is inside the
+    self-lint set: the walk parses it and it carries zero error
+    findings of its own (a rename/move would silently drop it from
+    coverage)."""
+    path = os.path.join(PACKAGE_ROOT, "online")
     assert os.path.isdir(path)
     assert errors(lint_path(path)) == []
 
