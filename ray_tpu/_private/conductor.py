@@ -248,6 +248,13 @@ class ConductorHandler:
         self._online_stats: Dict[str, Dict[str, Any]] = {}
         self._online_events: List[Dict[str, Any]] = []
 
+        # Disaggregated serving (serve/disagg.py): prefill servers,
+        # decode servers, and routers push stat snapshots (keyed by
+        # component id) + kv_publish/kv_transfer/shed markers; the
+        # conductor only aggregates — KV payload never lands here.
+        self._disagg_stats: Dict[str, Dict[str, Any]] = {}
+        self._disagg_events: List[Dict[str, Any]] = []
+
         # MPMD pipelines (ray_tpu.mpmd): stage registry (a pipeline
         # flips "formed" atomically when its LAST stage registers —
         # the weights-fragment commit pattern) + the channel mailbox.
@@ -1578,7 +1585,7 @@ class ConductorHandler:
         "lookups", "hits", "partial_hits", "misses", "reused_tokens",
         "prefilled_tokens", "spliced_tokens", "inserted_blocks",
         "evictions", "cow_copies", "invalidations", "admitted",
-        "prefill_calls")
+        "prefill_admitted", "adopted", "prefill_calls")
 
     def report_kvcache_stats(self, worker_id: str, engine_id: str,
                              stats: Dict[str, Any]) -> None:
@@ -1720,6 +1727,113 @@ class ConductorHandler:
                           ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._online_events[-limit:]
+
+    # ---------------------------------------------- disaggregated serving
+    # Prefill/decode servers and routers (serve/disagg.py) push their
+    # stat snapshots and instant markers here; util.state.disagg_status(),
+    # `ray_tpu disagg`, and the dashboard /api/disagg all read the same
+    # aggregate so every surface reports one set of numbers.
+
+    _DISAGG_EVENTS_KEPT = 10_000
+    _DISAGG_STATS_KEPT = 256
+    # live gauges (router queue depth) only count snapshots at most this
+    # old — routers re-push on every dispatch/complete (0.5s throttle),
+    # so anything older is a dead component's frozen last word
+    _DISAGG_GAUGE_FRESH_S = 15.0
+
+    def report_disagg_stats(self, worker_id: str, component_id: str,
+                            stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._disagg_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            while len(self._disagg_stats) > self._DISAGG_STATS_KEPT:
+                oldest = min(self._disagg_stats,
+                             key=lambda k:
+                             self._disagg_stats[k].get("ts", 0.0))
+                del self._disagg_stats[oldest]
+
+    def get_disagg_status(self) -> Dict[str, Any]:
+        """One aggregate for every disagg surface: components grouped
+        by role (prefill / decode / router) plus cluster totals
+        (transfers, KV bytes split shm/rpc, adoptions, sheds, live
+        queue depth)."""
+        with self._lock:
+            comps = {k: dict(v) for k, v in self._disagg_stats.items()}
+        now = time.time()
+        prefill = {k: v for k, v in comps.items()
+                   if v.get("role") == "prefill"}
+        decode = {k: v for k, v in comps.items()
+                  if v.get("role") == "decode"}
+        routers = {k: v for k, v in comps.items()
+                   if v.get("role") == "router"}
+        totals: Dict[str, Any] = {
+            "prefill_replicas": len(prefill),
+            "decode_replicas": len(decode),
+            "prefills": sum(int(p.get("prefills", 0))
+                            for p in prefill.values()),
+            "prefilled_tokens": sum(int(p.get("prefilled_tokens", 0))
+                                    for p in prefill.values()),
+            "reused_tokens": sum(int(p.get("reused_tokens", 0))
+                                 for p in prefill.values()),
+            "published_transfers": sum(
+                int(p.get("published_transfers", 0))
+                for p in prefill.values()),
+            "published_bytes": sum(int(p.get("published_bytes", 0))
+                                   for p in prefill.values()),
+            "transfers": sum(int(d.get("transfers", 0))
+                             for d in decode.values()),
+            "kv_fetched_bytes": sum(int(d.get("kv_fetched_bytes", 0))
+                                    for d in decode.values()),
+            "shm_bytes": sum(int(d.get("shm_bytes", 0))
+                             for d in decode.values()),
+            "rpc_bytes": sum(int(d.get("rpc_bytes", 0))
+                             for d in decode.values()),
+            "adopted": sum(int(d.get("adopted", 0))
+                           for d in decode.values()),
+            "decoded_tokens": sum(int(d.get("decoded_tokens", 0))
+                                  for d in decode.values()),
+            "dispatched": sum(int(r.get("dispatched", 0))
+                              for r in routers.values()),
+            "shed": sum(int(r.get("shed", 0))
+                        for r in routers.values()),
+            # live gauge, not a counter: a crashed router's final
+            # snapshot (which never expires from the roster) must not
+            # contribute phantom queue depth forever — only snapshots
+            # fresh enough to still describe a living component count.
+            # Monotonic counters above tolerate stale snapshots; this
+            # is the input signal for the planned SLO autoscaler.
+            "queue_depth": sum(
+                int(r.get("pending", 0)) for r in routers.values()
+                if now - float(r.get("ts", 0.0))
+                <= self._DISAGG_GAUGE_FRESH_S),
+            "max_queue_depth_seen": max(
+                (int(r.get("max_pending", 0))
+                 for r in routers.values()), default=0),
+        }
+        return {"prefill": prefill, "decode": decode,
+                "routers": routers, "totals": totals}
+
+    def report_disagg_event(self, event: Dict[str, Any]) -> None:
+        """kv_publish / kv_transfer / shed instant markers for the
+        merged timeline's disagg lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._disagg_events.append(event)
+            if len(self._disagg_events) > self._DISAGG_EVENTS_KEPT:
+                del self._disagg_events[
+                    :len(self._disagg_events)
+                    - self._DISAGG_EVENTS_KEPT]
+
+    def get_disagg_events(self, limit: int = 10_000
+                          ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._disagg_events[-limit:]
 
     # ------------------------------------------------------ MPMD pipelines
     # ray_tpu.mpmd: stage registry, channel mailbox, per-stage stats and

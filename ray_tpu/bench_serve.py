@@ -1,0 +1,393 @@
+"""Open-loop serving load harness — the disaggregated-serving
+acceptance benchmark the ROADMAP names.
+
+Open loop means arrivals follow a SCHEDULE, not completions: requests
+land at their appointed time whether or not the system has drained the
+previous ones, which is what exposes head-of-line blocking, queue
+growth, and the shed knee (a closed-loop client self-throttles and
+hides all three). The workload shape:
+
+- **Zipf prompt popularity** (``rank^-a``): a few hot prompts sharing a
+  block-aligned system prefix dominate, so the prefill tier's prefix
+  cache gets realistic reuse.
+- **Arrival shapes**: ``uniform`` (constant rate), ``burst`` (groups
+  arriving simultaneously — the TTFT-p99 killer), ``diurnal`` (a
+  sinusoidal rate swing compressed into the run, peak ~2x the mean).
+- **Slow clients**: a fraction of requests drain their token stream
+  slowly (``token_sleep_s`` per token); decode must keep serving other
+  requests while they linger.
+
+Every request routes through a ``serve.disagg.DisaggRouter`` (disagg or
+colocated mode — same admission control), so shedding engages before
+queue depth is unbounded; sheds are counted, never retried (open loop).
+
+The JSON record (last stdout line; ``--out`` also writes it) carries
+TTFT p50/p99 ms, tokens/s, shed rate, and the KV-transfer accounting
+(published vs fetched bytes, shm vs rpc split) — the one-set-of-numbers
+evidence that no process materialized a full KV copy. Run tiny on CPU::
+
+    python -m ray_tpu.bench_serve --requests 32 --arrival burst
+
+``--cluster`` starts a local ray_tpu cluster and runs the prefill and
+decode tiers as separate actor processes (real chunk-fabric transfers,
+shm-accounted); without it everything runs in-process and the KV rides
+the record inline (fetched_bytes 0 — the colocated-process shape).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def make_prompts(config, *, n_distinct: int = 8, block_size: int = 16,
+                 sys_blocks: int = 2, seed: int = 0) -> List[List[int]]:
+    """Distinct prompts sharing a block-aligned system prefix (so the
+    prefix cache can bite), each with a short distinct tail."""
+    rng = np.random.default_rng(seed)
+    sys_prompt = rng.integers(1, config.vocab_size,
+                              sys_blocks * block_size).tolist()
+    return [sys_prompt + rng.integers(
+        1, config.vocab_size,
+        int(rng.integers(2, block_size + 1))).tolist()
+        for _ in range(n_distinct)]
+
+
+def arrival_offsets(n: int, rate_rps: float, shape: str,
+                    burst_size: int = 8) -> List[float]:
+    """Seconds-from-start arrival time of each request (open loop)."""
+    if rate_rps <= 0:
+        raise ValueError("rate_rps must be > 0")
+    if shape == "uniform":
+        return [i / rate_rps for i in range(n)]
+    if shape == "burst":
+        return [(i // burst_size) * (burst_size / rate_rps)
+                for i in range(n)]
+    if shape == "diurnal":
+        # sinusoidal intensity over the run: rate(t) swings between
+        # ~0.4x and ~2x the mean (one compressed "day"), integrated
+        # stepwise so the schedule stays deterministic
+        out, t = [], 0.0
+        horizon = n / rate_rps
+        for _ in range(n):
+            phase = min(1.0, t / max(horizon, 1e-9))
+            inst = rate_rps * (0.4 + 1.6 * np.sin(np.pi * phase) ** 2)
+            out.append(t)
+            t += 1.0 / inst
+        return out
+    raise ValueError(f"unknown arrival shape {shape!r} "
+                     "(uniform|burst|diurnal)")
+
+
+def run_load(router, prompts: Sequence[Sequence[int]], *,
+             n_requests: int = 64, max_new_tokens: int = 8,
+             rate_rps: float = 8.0, arrival: str = "uniform",
+             burst_size: int = 8, zipf_a: float = 1.1,
+             slow_client_frac: float = 0.0,
+             token_sleep_s: float = 0.02,
+             timeout_s: float = 120.0, seed: int = 0) -> Dict[str, Any]:
+    """Replay the open-loop schedule against `router` and return the
+    benchmark record (no JSON printing — callers compose it)."""
+    from ray_tpu.serve.handle import RequestShedError
+
+    rng = np.random.default_rng(seed)
+    pop = 1.0 / np.arange(1, len(prompts) + 1) ** zipf_a
+    picks = rng.choice(len(prompts), size=n_requests, p=pop / pop.sum())
+    slow = rng.random(n_requests) < slow_client_frac
+    offsets = arrival_offsets(n_requests, rate_rps, arrival, burst_size)
+
+    lock = threading.Lock()
+    ttfts: List[float] = []
+    tokens = [0] * n_requests
+    outcomes = {"ok": 0, "shed": 0, "error": 0}
+    errors: List[str] = []
+
+    def one(i: int) -> None:
+        t0 = time.perf_counter()
+        first: List[float] = []
+        try:
+            toks = router.generate(
+                prompts[int(picks[i])], max_new_tokens,
+                timeout_s=timeout_s,
+                on_first_token=lambda: first.append(
+                    time.perf_counter() - t0),
+                token_sleep_s=token_sleep_s if slow[i] else 0.0)
+            with lock:
+                outcomes["ok"] += 1
+                tokens[i] = len(toks)
+                if first:
+                    ttfts.append(first[0])
+        except RequestShedError:
+            with lock:
+                outcomes["shed"] += 1
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            with lock:
+                outcomes["error"] += 1
+                if len(errors) < 5:
+                    errors.append(f"{type(e).__name__}: {str(e)[:120]}")
+
+    t_start = time.perf_counter()
+    threads: List[threading.Thread] = []
+    for i in range(n_requests):
+        delay = offsets[i] - (time.perf_counter() - t_start)
+        if delay > 0:
+            time.sleep(delay)  # open loop: fire on schedule, not drain
+        th = threading.Thread(target=one, args=(i,), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout_s)
+    wall = time.perf_counter() - t_start
+
+    # ONE locked snapshot for the whole record: wedged request threads
+    # outlive their join timeout (daemon) and may still be mutating the
+    # outcome state while the record is built. hung is derived from the
+    # same view — every request thread records exactly one outcome
+    # before exiting, so completed+shed+errors+hung == n_requests holds
+    # by construction and a smaller population can never go unreported.
+    with lock:
+        snap = dict(outcomes)
+        total_tokens = int(sum(tokens))
+        ttft_ms = sorted(t * 1e3 for t in ttfts)
+        err_samples = list(errors)
+    hung = n_requests - sum(snap.values())
+    pct = (lambda p: round(float(np.percentile(ttft_ms, p)), 2)
+           if ttft_ms else None)
+    rec: Dict[str, Any] = {
+        "n_requests": n_requests,
+        "arrival": arrival,
+        "rate_rps": rate_rps,
+        "zipf_a": zipf_a,
+        "max_new_tokens": max_new_tokens,
+        "slow_client_frac": slow_client_frac,
+        "completed": snap["ok"],
+        "shed": snap["shed"],
+        "errors": snap["error"],
+        "shed_rate": round(snap["shed"] / n_requests, 4),
+        "ttft_p50_ms": pct(50),
+        "ttft_p99_ms": pct(99),
+        "tokens_total": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall, 1) if wall else 0.0,
+        "wall_s": round(wall, 3),
+    }
+    if hung:
+        rec["hung"] = hung
+    if err_samples:
+        rec["error_samples"] = err_samples
+    return rec
+
+
+def collect_kv_accounting(prefill: Sequence[Any],
+                          decode: Sequence[Any]) -> Dict[str, int]:
+    """Sum the tiers' transfer counters (local objects or actors) —
+    the record's no-full-copy evidence."""
+    from ray_tpu.serve.disagg import _call
+
+    out = {"transfers": 0, "published_transfers": 0,
+           "published_bytes": 0, "fetched_bytes": 0,
+           "shm_bytes": 0, "rpc_bytes": 0}
+    for p in prefill:
+        s = _call(p, "stats")
+        out["published_transfers"] += int(s.get("published_transfers", 0))
+        out["published_bytes"] += int(s.get("published_bytes", 0))
+    for d in decode:
+        s = _call(d, "stats")
+        out["transfers"] += int(s.get("transfers", 0))
+        out["fetched_bytes"] += int(s.get("kv_fetched_bytes", 0))
+        out["shm_bytes"] += int(s.get("shm_bytes", 0))
+        out["rpc_bytes"] += int(s.get("rpc_bytes", 0))
+    return out
+
+
+def _build_tiers(params, config, args, use_cluster: bool):
+    """(router, prefill_list, decode_list, cleanup) for one mode."""
+    from ray_tpu.serve.disagg import (DecodeServer, DisaggRouter,
+                                      PrefillServer)
+
+    # retention must cover every transfer that can be legitimately
+    # in flight (held from publish until the router acks after decode):
+    # decode_replicas * (capacity + queue depth), and affinity can
+    # route ALL of them to ONE prefill server — a smaller window would
+    # reap chunks a decode replica is about to fetch, failing requests
+    # under exactly the burst load the harness measures
+    retain = max(32, 2 * args.decode_replicas
+                 * (args.max_batch + args.queue_depth))
+    kw = dict(kv_block_size=args.block_size,
+              kv_pool_blocks=args.pool_blocks, retain=retain)
+    if use_cluster:
+        import ray_tpu
+
+        prefill = [ray_tpu.remote(PrefillServer).options(
+            max_concurrency=8).remote(params, config, **kw)
+            for _ in range(args.prefill_replicas)]
+        decode = [ray_tpu.remote(DecodeServer).options(
+            max_concurrency=args.max_batch + 4).remote(
+                params, config, max_batch=args.max_batch)
+            for _ in range(args.decode_replicas)]
+        import ray_tpu as _rt
+        for a in prefill + decode:  # fail fast on a broken __init__
+            _rt.get(a.stats.remote(), timeout=120.0)
+    else:
+        prefill = [PrefillServer(params, config, **kw)
+                   for _ in range(args.prefill_replicas)]
+        decode = [DecodeServer(params, config, max_batch=args.max_batch)
+                  for _ in range(args.decode_replicas)]
+    router = DisaggRouter(decode=decode, prefill=prefill,
+                          max_queue_depth=args.queue_depth,
+                          affinity_tokens=args.block_size)
+
+    def cleanup():
+        if use_cluster:
+            import ray_tpu
+
+            for a in prefill + decode:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+        else:
+            for d in decode:
+                d.stop()
+
+    return router, prefill, decode, cleanup
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop disaggregated-serving load harness")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="mean arrival rate, requests/s")
+    ap.add_argument("--arrival", default="burst",
+                    choices=["uniform", "burst", "diurnal"])
+    ap.add_argument("--burst-size", type=int, default=8)
+    ap.add_argument("--zipf-a", type=float, default=1.1)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--slow-frac", type=float, default=0.125,
+                    help="fraction of slow clients (token-paced drain)")
+    ap.add_argument("--token-sleep", type=float, default=0.02)
+    ap.add_argument("--distinct", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--pool-blocks", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--prefill-replicas", type=int, default=1)
+    ap.add_argument("--decode-replicas", type=int, default=1)
+    ap.add_argument("--queue-depth", type=int, default=8,
+                    help="router backlog bound per decode replica")
+    ap.add_argument("--cluster", action="store_true",
+                    help="run the tiers as actors on a local cluster "
+                         "(real chunk-fabric transfers)")
+    ap.add_argument("--colocated-baseline", action="store_true",
+                    help="also run the single-engine colocated path "
+                         "for comparison")
+    ap.add_argument("--out", default="", help="also write JSON here")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init
+
+    config = LlamaConfig.tiny()
+    params = llama_init(config, jax.random.PRNGKey(args.seed))
+    prompts = make_prompts(config, n_distinct=args.distinct,
+                           block_size=args.block_size, seed=args.seed)
+
+    use_cluster = args.cluster
+    if use_cluster:
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=max(4, args.prefill_replicas
+                                  + args.decode_replicas + 2),
+                     _system_config={"log_to_driver": 0},
+                     ignore_reinit_error=True)
+    record: Dict[str, Any] = {
+        "metric": "disagg_serve_load",
+        "platform": jax.devices()[0].platform,
+        "cluster": use_cluster,
+        "prefill_replicas": args.prefill_replicas,
+        "decode_replicas": args.decode_replicas,
+        "max_batch": args.max_batch,
+        "queue_depth": args.queue_depth,
+    }
+    load_kw = dict(n_requests=args.requests, max_new_tokens=args.max_new,
+                   rate_rps=args.rate, arrival=args.arrival,
+                   burst_size=args.burst_size, zipf_a=args.zipf_a,
+                   slow_client_frac=args.slow_frac,
+                   token_sleep_s=args.token_sleep, seed=args.seed)
+    try:
+        router, prefill, decode, cleanup = _build_tiers(
+            params, config, args, use_cluster)
+        try:
+            # warm the compile caches off the clock: each distinct
+            # prompt shape costs one prefill compile on first sight.
+            # Snapshot the counters after warm-up so the recorded
+            # accounting covers exactly the measured open-loop run —
+            # published==fetched must cross-check against n_requests'
+            # expected KV bytes, not n_requests + warm-up traffic.
+            for p in prompts:
+                router.generate(p, 2)
+            warm_kv = collect_kv_accounting(prefill, decode)
+            warm_rt = router.stats()
+            record["disagg"] = run_load(router, prompts, **load_kw)
+            kv = collect_kv_accounting(prefill, decode)
+            record["disagg"]["kv_transfer"] = {
+                k: v - warm_kv.get(k, 0) for k, v in kv.items()}
+            record["disagg"]["router"] = {
+                k: (v - warm_rt[k]
+                    if k in ("dispatched", "completed", "shed") else v)
+                for k, v in router.stats().items()}
+            router.publish_telemetry(force=True)
+        finally:
+            cleanup()
+        if args.colocated_baseline:
+            from ray_tpu.models.engine import ContinuousBatchingEngine
+            from ray_tpu.serve.disagg import DisaggRouter
+
+            eng = ContinuousBatchingEngine(
+                params, config, max_batch=args.max_batch,
+                kv_block_size=args.block_size,
+                kv_pool_blocks=args.pool_blocks)
+            try:
+                colo = DisaggRouter(colocated=eng,
+                                    max_queue_depth=args.queue_depth)
+                for p in prompts:
+                    colo.generate(p, 2)
+                warm_rt = colo.stats()
+                record["colocated"] = run_load(colo, prompts, **load_kw)
+                record["colocated"]["kv_transfer"] = {
+                    "transfers": 0, "published_bytes": 0,
+                    "fetched_bytes": 0, "shm_bytes": 0, "rpc_bytes": 0}
+                record["colocated"]["router"] = {
+                    k: (v - warm_rt[k]
+                        if k in ("dispatched", "completed", "shed")
+                        else v)
+                    for k, v in colo.stats().items()}
+            finally:
+                eng.stop()
+        # the headline numbers are the disagg run's
+        top = record["disagg"]
+        record.update(value=top["tokens_per_sec"], unit="tokens/s",
+                      ttft_p50_ms=top["ttft_p50_ms"],
+                      ttft_p99_ms=top["ttft_p99_ms"],
+                      shed_rate=top["shed_rate"])
+    finally:
+        if use_cluster:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+    line = json.dumps(record)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

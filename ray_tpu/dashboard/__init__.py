@@ -30,6 +30,11 @@ Routes:
                          staleness stats, buffer occupancy, learner
                          ingest, recent rollout/publish/swap/ingest
                          events (ray_tpu.online)
+  /api/disagg            disaggregated prefill/decode serving: prefill
+                         reuse + published KV, decode transfer
+                         accounting (shm vs rpc), router shed/queue
+                         depth, recent kv_publish/kv_transfer/shed
+                         events (serve/disagg.py)
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -170,6 +175,17 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def disagg(self) -> Dict[str, Any]:
+        """Disaggregated-serving aggregate + the recent event tail (one
+        payload so the SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_disagg_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_disagg_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
         the actors-table drill-down."""
@@ -282,6 +298,7 @@ class DashboardServer:
         app.router.add_get("/api/kvcache", self._json_route(d.kvcache))
         app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
         app.router.add_get("/api/online", self._json_route(d.online))
+        app.router.add_get("/api/disagg", self._json_route(d.disagg))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

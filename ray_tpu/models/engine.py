@@ -27,6 +27,18 @@ between ticks are capped at ``RAY_TPU_MAX_PREFILLS_PER_TICK`` (default
 1) so a burst of arrivals cannot head-of-line-block every in-flight
 decode for the whole drain.
 
+Disaggregated serving (serve/disagg.py) splits the two phases across
+replicas: a decode replica's engine never prefills at all — it ADOPTS a
+prompt's already-computed KV rows via ``adopt_prefill()``, which splices
+them into a free slot between ticks through the same `_splice_slot`
+program (O(prompt_len), never a full-cache copy) and emits the
+prefill-produced first token. Adoption is its own admission phase with
+its own per-tick cap (``RAY_TPU_MAX_ADOPTIONS_PER_TICK``, default 4 —
+splices are cheap relative to prefills) and its own counters
+(``adopted`` / ``max_adoptions_admitted_per_tick`` vs
+``prefill_admitted`` / ``max_prefills_admitted_per_tick``), so the
+kvcache CLI/dashboard numbers stay truthful in both modes.
+
 Per-request token queues make it the natural producer for Serve's
 streaming path; `ContinuousBatchingEngine` is thread-safe for
 concurrent submit/iterate from replica request threads. The streamed
@@ -48,7 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .generate import _model_fns
-from .kvcache import PagedKVCache
+from .kvcache import PagedKVCache, resolve_pool_config
 
 _DONE = object()
 _ENGINE_SEQ = itertools.count()
@@ -79,6 +91,48 @@ def _prefill_paged(params, suffix, config, prefix_k, prefix_v):
     return logits[:, -1], ck, cv
 
 
+def _prefill_with_cache(params, config, kv_cache, prompt, empty_prefix,
+                        event_extra=None):
+    """The prefill-behind-the-prefix-cache sequence shared by the
+    colocated engine's `_admit_one` and the disagg `PrefillServer`:
+    lookup → gather → `_prefill_paged` on the suffix → commit +
+    prefix_hit event → greedy first token + its logprob score. ONE
+    implementation keeps the two paths bit-identical (the disagg
+    equivalence tests depend on it). Returns `(ck, cv, block_table,
+    first, score, outcome, reused, suffix_len)`; the caller owns the
+    returned pins (empty list when no cache)."""
+    plen = prompt.shape[1]
+    prompt_np = prompt[0]
+    outcome, reused = "miss", 0
+    if kv_cache is not None:
+        match = kv_cache.lookup(prompt_np, max_tokens=plen - 1)
+        outcome, reused = match.outcome, match.tokens
+        prefix_k, prefix_v = kv_cache.gather(match)
+    else:
+        match = None
+        prefix_k = prefix_v = empty_prefix
+    cached = int(prefix_k.shape[1])
+    suffix = prompt[:, cached:]
+    last_logits, ck, cv = _prefill_paged(params, suffix, config,
+                                         prefix_k, prefix_v)
+    table: List[Any] = []
+    if kv_cache is not None:
+        kv_cache.note_prefilled(suffix.shape[1])
+        table = kv_cache.commit(prompt_np, ck, cv, match)
+        if match.tokens:
+            event = {"kind": "prefix_hit", "outcome": outcome,
+                     "reused_tokens": reused, "prompt_tokens": plen}
+            if event_extra:
+                event.update(event_extra)
+            kv_cache.record_event(event)
+    live = np.asarray(last_logits[0, :config.vocab_size], np.float32)
+    first = int(np.argmax(live))
+    m = float(live[first])
+    score = -float(np.log(np.exp(live - m).sum()))  # m - logsumexp
+    return (ck, cv, table, first, score, outcome, int(reused),
+            int(suffix.shape[1]))
+
+
 @functools.partial(jax.jit, static_argnums=(4, 5),
                    donate_argnums=(0,))
 def _splice_slot(cache, ck, cv, slot, config, plen):
@@ -107,6 +161,23 @@ def _tick(params, config, cache, tokens, pos_vec):
     # rollout score stream (ray_tpu.online samplers record it per token)
     lp = jnp.max(live, axis=-1) - jax.nn.logsumexp(live, axis=-1)
     return cache, nxt, lp
+
+
+class _Adoption:
+    """A pending slot adoption: a prompt's prefilled KV rows computed
+    elsewhere (a prefill replica) plus the first token its last-position
+    logits produced. The decode loop splices it between ticks."""
+
+    __slots__ = ("req", "plen", "ck", "cv", "first_token", "score")
+
+    def __init__(self, req: "_Request", plen: int, ck, cv,
+                 first_token: int, score: float):
+        self.req = req
+        self.plen = int(plen)
+        self.ck = ck
+        self.cv = cv
+        self.first_token = int(first_token)
+        self.score = float(score)
 
 
 class _Request:
@@ -170,7 +241,8 @@ class ContinuousBatchingEngine:
                  prefix_cache: Optional[bool] = None,
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
-                 max_prefills_per_tick: Optional[int] = None):
+                 max_prefills_per_tick: Optional[int] = None,
+                 max_adoptions_per_tick: Optional[int] = None):
         # config: any family _model_fns knows (LlamaConfig, GPT2Config)
         self.params = params
         self.config = config
@@ -193,13 +265,15 @@ class ContinuousBatchingEngine:
             max_prefills_per_tick = int(os.environ.get(
                 "RAY_TPU_MAX_PREFILLS_PER_TICK", "1"))
         self.max_prefills_per_tick = max(1, int(max_prefills_per_tick))
-        block_size = int(kv_block_size
-                         or os.environ.get("RAY_TPU_KV_BLOCK_SIZE", "16"))
-        pool_blocks = int(kv_pool_blocks
-                          or int(os.environ.get("RAY_TPU_KV_POOL_BLOCKS",
-                                                "0"))
-                          or max_batch * (-(-config.max_seq_len
-                                            // block_size)))
+        # adoptions (disaggregated decode) are capped per-phase: a
+        # splice is O(prompt) and never compiles a prefill program, so
+        # its default budget is looser than the prefill cap
+        if max_adoptions_per_tick is None:
+            max_adoptions_per_tick = int(os.environ.get(
+                "RAY_TPU_MAX_ADOPTIONS_PER_TICK", "4"))
+        self.max_adoptions_per_tick = max(1, int(max_adoptions_per_tick))
+        block_size, pool_blocks = resolve_pool_config(
+            config, kv_block_size, kv_pool_blocks, slots=max_batch)
         self.kv_cache: Optional[PagedKVCache] = (
             PagedKVCache(config, block_size=block_size,
                          num_blocks=pool_blocks)
@@ -207,18 +281,24 @@ class ContinuousBatchingEngine:
         shape = self._cache[0]["k"].shape  # [maxB, S, H, hd]
         self._empty_prefix = jnp.zeros(
             (len(self._cache), 0) + shape[2:], self._cache[0]["k"].dtype)
-        # admission accounting (kv_stats / acceptance surface)
+        # admission accounting (kv_stats / acceptance surface) — split
+        # per phase: prefill admissions vs adoptions of KV prefilled on
+        # another replica (serve/disagg.py)
         self.prefill_calls = 0
         self.prefilled_tokens = 0
         self.spliced_tokens = 0
-        self.admitted = 0
-        self.max_admitted_per_tick = 0
+        self.admitted = 0            # total slots admitted (both phases)
+        self.prefill_admitted = 0
+        self.adopted = 0
+        self.max_prefills_admitted_per_tick = 0
+        self.max_adoptions_admitted_per_tick = 0
         self._last_stats_push = 0.0
         self._tokens = np.zeros(max_batch, np.int32)
         self._pos = np.zeros(max_batch, np.int32)
         self._slot_req: List[Optional[_Request]] = [None] * max_batch
         self._free = list(range(max_batch))
         self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._pending_adopt: "queue.Queue[_Adoption]" = queue.Queue()
         self._lock = threading.Lock()
         self._next_rid = 0
         self._stopped = threading.Event()
@@ -253,6 +333,62 @@ class ContinuousBatchingEngine:
                  timeout_s: float = 120.0) -> List[int]:
         return list(self.stream(prompt_tokens, max_new_tokens, eos_token,
                                 timeout_s))
+
+    def adopt_prefill(self, prompt_len: int, first_token: int, ck, cv,
+                      max_new_tokens: int,
+                      eos_token: Optional[int] = None, *,
+                      score: float = 0.0,
+                      cache_outcome: Optional[str] = None,
+                      reused_tokens: int = 0,
+                      timeout_s: float = 120.0) -> TokenStream:
+        """Adopt a prompt whose prefill ran ELSEWHERE (a disaggregated
+        prefill replica): ``ck/cv [L, prompt_len, H, hd]`` are the
+        prompt's KV rows and `first_token` the token its last-position
+        logits produced. The decode loop splices the rows into a free
+        slot between ticks (`_splice_slot`, O(prompt_len) — never a
+        full-cache copy) and this engine NEVER runs a prefill program
+        for the request, so a decode replica's `_prefill_paged` compile
+        cache stays flat. Returns the request's TokenStream, whose
+        first yielded token is `first_token`."""
+        plen = int(prompt_len)
+        if plen < 1:
+            raise ValueError("prompt_len must be >= 1")
+        if plen + max_new_tokens > self.config.max_seq_len:
+            # the first token is already produced, so the exact bound
+            # would allow one more token than submit() — but the two
+            # admission paths must reject IDENTICALLY or the disagg
+            # tier and the colocated fallback diverge at the
+            # sequence-length boundary
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        ref = self._cache[0]["k"]
+        # validate the FULL layout on the caller's thread: a mismatch
+        # surfacing inside _splice_slot would kill the decode loop
+        # thread and wedge every request on this engine. Dtype is part
+        # of the layout — asarray below would otherwise silently cast
+        # a float32 prefill tier into a bfloat16 decode pool and break
+        # bit-identity with no error anywhere.
+        want = (len(self._cache), plen) + tuple(ref.shape[2:])
+        got_k = jnp.asarray(ck)
+        got_v = jnp.asarray(cv)
+        if (tuple(got_k.shape) != want or tuple(got_v.shape) != want
+                or got_k.dtype != ref.dtype or got_v.dtype != ref.dtype):
+            raise ValueError(
+                f"adopted KV layout k={tuple(got_k.shape)}:{got_k.dtype} "
+                f"v={tuple(got_v.shape)}:{got_v.dtype} does not match "
+                f"this engine's cache layout {want}:{ref.dtype} — the "
+                f"prefill and decode tiers must run the same model "
+                f"config")
+        ck, cv = got_k, got_v
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = _Request(rid, np.zeros((1, plen), np.int32),
+                       max_new_tokens, eos_token)
+        req.cache_outcome = cache_outcome
+        req.reused_tokens = int(reused_tokens)
+        self._pending_adopt.put(_Adoption(req, plen, ck, cv,
+                                          first_token, score))
+        return TokenStream(req, timeout_s)
 
     def update_params(self, params: Any,
                       version: Optional[int] = None) -> threading.Event:
@@ -305,6 +441,13 @@ class ContinuousBatchingEngine:
         with self._lock:
             return self.max_batch - len(self._free)
 
+    @property
+    def free_slots(self) -> int:
+        """Open decode slots right now (the disagg router's decode-pick
+        signal; pending-but-unadmitted requests do not subtract)."""
+        with self._lock:
+            return len(self._free)
+
     # ------------------------------------------------------- telemetry
     def kv_stats(self) -> Dict[str, Any]:
         """Prefix-cache + admission counters — the snapshot pushed to
@@ -320,8 +463,14 @@ class ContinuousBatchingEngine:
             engine_id=self.engine_id,
             max_batch=self.max_batch,
             max_prefills_per_tick=self.max_prefills_per_tick,
+            max_adoptions_per_tick=self.max_adoptions_per_tick,
             admitted=self.admitted,
-            max_admitted_per_tick=self.max_admitted_per_tick,
+            prefill_admitted=self.prefill_admitted,
+            adopted=self.adopted,
+            max_prefills_admitted_per_tick=(
+                self.max_prefills_admitted_per_tick),
+            max_adoptions_admitted_per_tick=(
+                self.max_adoptions_admitted_per_tick),
             prefill_calls=self.prefill_calls,
             prefill_programs=programs,
             spliced_tokens=self.spliced_tokens,
@@ -359,6 +508,17 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------------ loop
     def _admit(self) -> None:
+        # adoptions first (disaggregated decode: splices, no prefill
+        # program), then prefill admissions — each against its own
+        # per-phase cap so the counters stay truthful in both modes
+        adopted = 0
+        while self._free and adopted < self.max_adoptions_per_tick:
+            try:
+                adoption = self._pending_adopt.get_nowait()
+            except queue.Empty:
+                break
+            self._adopt_one(adoption)
+            adopted += 1
         admitted = 0
         while self._free and admitted < self.max_prefills_per_tick:
             try:
@@ -367,49 +527,50 @@ class ContinuousBatchingEngine:
                 break
             self._admit_one(req)
             admitted += 1
+        if adopted:
+            self.max_adoptions_admitted_per_tick = max(
+                self.max_adoptions_admitted_per_tick, adopted)
         if admitted:
-            self.max_admitted_per_tick = max(self.max_admitted_per_tick,
-                                             admitted)
+            self.max_prefills_admitted_per_tick = max(
+                self.max_prefills_admitted_per_tick, admitted)
+        if adopted or admitted:
             self.publish_kv_telemetry()
+
+    def _adopt_one(self, adoption: _Adoption) -> None:
+        with self._lock:
+            slot = self._free.pop()
+        req = adoption.req
+        plen = adoption.plen
+        self._cache = _splice_slot(self._cache, adoption.ck, adoption.cv,
+                                   np.int32(slot), self.config, plen)
+        self.spliced_tokens += plen
+        self.admitted += 1
+        self.adopted += 1
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._tokens[slot] = adoption.first_token
+        self._pos[slot] = plen
+        self._emit(req, adoption.first_token, adoption.score)
 
     def _admit_one(self, req: _Request) -> None:
         with self._lock:
             slot = self._free.pop()
         plen = req.prompt.shape[1]
-        prompt_np = req.prompt[0]
-        match = None
+        ck, cv, table, first, score, outcome, reused, suffix_len = \
+            _prefill_with_cache(self.params, self.config, self.kv_cache,
+                                req.prompt, self._empty_prefix,
+                                event_extra={"rid": req.rid})
         if self.kv_cache is not None:
-            match = self.kv_cache.lookup(prompt_np, max_tokens=plen - 1)
-            req.cache_outcome = match.outcome
-            req.reused_tokens = match.tokens
-            prefix_k, prefix_v = self.kv_cache.gather(match)
-        else:
-            prefix_k = prefix_v = self._empty_prefix
-        cached = int(prefix_k.shape[1])
-        suffix = req.prompt[:, cached:]
-        last_logits, ck, cv = _prefill_paged(self.params, suffix,
-                                             self.config, prefix_k,
-                                             prefix_v)
+            req.cache_outcome = outcome
+            req.reused_tokens = reused
+            req.block_table = table
         self.prefill_calls += 1
-        self.prefilled_tokens += suffix.shape[1]
-        if self.kv_cache is not None:
-            self.kv_cache.note_prefilled(suffix.shape[1])
-            req.block_table = self.kv_cache.commit(prompt_np, ck, cv,
-                                                   match)
-            if match.tokens:
-                self.kv_cache.record_event({
-                    "kind": "prefix_hit", "outcome": match.outcome,
-                    "reused_tokens": match.tokens,
-                    "prompt_tokens": plen, "rid": req.rid})
+        self.prefilled_tokens += suffix_len
         self._cache = _splice_slot(self._cache, ck, cv, np.int32(slot),
                                    self.config, plen)
         self.spliced_tokens += plen
         self.admitted += 1
-        live = np.asarray(last_logits[0, :self.config.vocab_size],
-                          np.float32)
-        first = int(np.argmax(live))
-        m = float(live[first])
-        score = -float(np.log(np.exp(live - m).sum()))  # m - logsumexp
+        self.prefill_admitted += 1
         req.slot = slot
         self._slot_req[slot] = req
         self._tokens[slot] = first

@@ -47,6 +47,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -57,6 +58,23 @@ import numpy as np
 
 _ROOT_DIGEST = b"ray_tpu-kv-root"
 _EVENTS_KEPT = 512
+
+
+def resolve_pool_config(config: Any,
+                        block_size: Optional[int] = None,
+                        pool_blocks: Optional[int] = None, *,
+                        slots: int = 4) -> Tuple[int, int]:
+    """Resolve ``(block_size, pool_blocks)`` from explicit args, the
+    ``RAY_TPU_KV_BLOCK_SIZE`` / ``RAY_TPU_KV_POOL_BLOCKS`` env knobs, or
+    the ``slots * ceil(max_seq_len / block_size)`` sizing default — the
+    ONE implementation every pool owner (the colocated engine, the
+    disaggregated prefill tier) defaults through."""
+    bs = int(block_size
+             or os.environ.get("RAY_TPU_KV_BLOCK_SIZE", "16"))
+    pb = int(pool_blocks
+             or int(os.environ.get("RAY_TPU_KV_POOL_BLOCKS", "0"))
+             or slots * (-(-config.max_seq_len // bs)))
+    return bs, pb
 
 
 def _chain(digest: bytes, tokens: Tuple[int, ...]) -> bytes:
@@ -302,8 +320,16 @@ class PagedKVCache:
         if match.tokens == 0:
             return self._empty_k, self._empty_k
         bids = jnp.asarray(match.bids, jnp.int32)
-        return _gather_prefix(self._pool_k, self._pool_v, bids,
-                              match.tokens)
+        with self._lock:
+            # dispatch under the lock: commit()'s pool writes are jitted
+            # with the pool DONATED, so a gather dispatched between a
+            # concurrent commit's donation and its pool-reference swap
+            # would read a deleted Array (concurrent callers exist — the
+            # disaggregated prefill tier runs prefills in parallel).
+            # Same-device stream order makes the dispatch itself the
+            # only critical section; the compute overlaps freely.
+            return _gather_prefix(self._pool_k, self._pool_v, bids,
+                                  match.tokens)
 
     # ------------------------------------------------------------ commit
 

@@ -25,6 +25,14 @@ bounded at 2*M live microbatch tensors regardless of run length.
 Wakeup rides the `pipeline` pubsub channel with a bounded poll as the
 safety net (a conductor restart drops subscriptions), mirroring
 WeightSubscriber.wait_for_version.
+
+``prefetch(step, mb, kind)`` starts the pull in the background so the
+next microbatch's chunks stream WHILE the stage computes the current
+one (the ``WeightSync(prefetch=True)`` shape) — ``run_stage`` issues it
+right after each recv, shrinking ``bubble_wait`` to the residual wait;
+``stats.prefetch_hits`` counts recvs served this way and the
+no-full-copy accounting is unchanged (the prefetch's fetcher is adopted
+by the recv, so every chunk still crosses the plane exactly once).
 """
 from __future__ import annotations
 
@@ -57,6 +65,10 @@ class ChannelStats:
     fetched_remote_bytes: int = 0
     max_fetch_bytes: int = 0
     wait_s: float = 0.0  # cumulative blocked-in-recv (bubble) time
+    # recvs served by a prefetch issued during stage compute (the
+    # WeightSync(prefetch=True) shape): their fetch overlapped compute,
+    # so only the residual wait — not the whole transfer — is bubble
+    prefetch_hits: int = 0
     per_kind: Dict[str, int] = field(default_factory=dict)
 
 
@@ -94,6 +106,11 @@ class ActivationChannel:
         self._held: Dict[Tuple[int, int, str], List[Any]] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition()
+        # (step, mb, kind) -> in-flight prefetch record; recv() drains
+        # it instead of polling the mailbox itself
+        self._prefetched: Dict[Tuple[int, int, str],
+                               Dict[str, Any]] = {}
+        self._closed = False
         self._worker.subscribe_channel("pipeline", self._on_msg)
 
     # ------------------------------------------------------------- pubsub
@@ -147,21 +164,23 @@ class ActivationChannel:
 
     # --------------------------------------------------------------- recv
 
-    def recv(self, step: int, mb: int, kind: str,
-             timeout: float = 60.0) -> Any:
-        """Block until the (step, mb, kind) payload is deliverable,
-        then pull its chunks point-to-point from the sender. The blocked
-        time accumulates into ``stats.wait_s`` (the caller additionally
-        times it into the StepTimer's ``bubble_wait`` phase)."""
+    def _take_descriptor(self, step: int, mb: int, kind: str,
+                         timeout: float) -> Dict[str, Any]:
+        """Poll the mailbox until (step, mb, kind) is deliverable (the
+        pubsub wakeup shortens the poll); single delivery — the caller
+        owns the descriptor."""
         key = self._key(step, mb, kind)
-        t0 = time.monotonic()
-        deadline = t0 + timeout
-        desc = None
+        deadline = time.monotonic() + timeout
         while True:
             desc = self._worker.conductor.call("pipeline_channel_take",
                                                key, timeout=30.0)
             if desc is not None:
-                break
+                return desc
+            if self._closed:
+                raise RuntimeError(
+                    f"pipeline {self.name!r}: channel "
+                    f"{self.src}->{self.dst} closed while waiting for "
+                    f"{kind} microbatch {mb} of step {step}")
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
@@ -171,9 +190,92 @@ class ActivationChannel:
                     "dead or wedged?")
             with self._cv:
                 self._cv.wait(min(remaining, self._poll))
-        self.stats.wait_s += time.monotonic() - t0
-        fetcher = chunks.ChunkFetcher(self._worker)
-        tree = chunks.fetch_tree(self._worker, desc, fetcher)
+
+    def prefetch(self, step: int, mb: int, kind: str,
+                 timeout: float = 60.0) -> None:
+        """Start pulling (step, mb, kind) in the BACKGROUND so a later
+        recv() finds the chunks already fetched — issued during stage
+        compute, the same prefetch shape as ``WeightSync(prefetch=
+        True)``: the transfer overlaps compute and only the residual
+        wait lands in ``bubble_wait``. Idempotent per slot; errors
+        surface at the consuming recv()."""
+        key3 = (int(step), int(mb), kind)
+        with self._lock:
+            if self._closed or key3 in self._prefetched:
+                return
+            rec: Dict[str, Any] = {"done": threading.Event(),
+                                   "tree": None, "fetcher": None,
+                                   "desc": None, "error": None}
+            self._prefetched[key3] = rec
+
+        def pull():
+            try:
+                desc = self._take_descriptor(step, mb, kind, timeout)
+                # record the take IMMEDIATELY: delivery is single-shot,
+                # so recv() must be able to tell "descriptor consumed,
+                # fetch failed" (not retryable) apart from "take timed
+                # out" (retryable on recv's own budget)
+                rec["desc"] = desc
+                fetcher = chunks.ChunkFetcher(self._worker)
+                rec["tree"] = chunks.fetch_tree(self._worker, desc,
+                                                fetcher)
+                rec["fetcher"] = fetcher
+            except Exception as e:  # noqa: BLE001 — re-raised at recv
+                rec["error"] = e
+            finally:
+                rec["done"].set()
+
+        threading.Thread(
+            target=pull, daemon=True,
+            name=f"chan-prefetch-{self.src}to{self.dst}").start()
+
+    def recv(self, step: int, mb: int, kind: str,
+             timeout: float = 60.0) -> Any:
+        """Block until the (step, mb, kind) payload is deliverable,
+        then pull its chunks point-to-point from the sender (or adopt
+        the in-flight prefetch's pull). The blocked time accumulates
+        into ``stats.wait_s`` (the caller additionally times it into
+        the StepTimer's ``bubble_wait`` phase)."""
+        key3 = (int(step), int(mb), kind)
+        with self._lock:
+            pre = self._prefetched.pop(key3, None)
+        t0 = time.monotonic()
+        if pre is not None:
+            if not pre["done"].wait(timeout):
+                # still in flight: re-stash so a RETRIED recv adopts the
+                # pull once it lands — dropping the record here would
+                # orphan a descriptor the thread consumes moments later
+                # (single delivery: no fresh take could ever succeed)
+                with self._lock:
+                    self._prefetched.setdefault(key3, pre)
+                raise TimeoutError(
+                    f"pipeline {self.name!r}: prefetch of {kind} "
+                    f"microbatch {mb} of step {step} from stage "
+                    f"{self.src} did not finish within {timeout}s")
+            if isinstance(pre["error"], TimeoutError) \
+                    and pre["desc"] is None:
+                # the background take timed out against the PREFETCH
+                # issuance clock WITHOUT consuming the descriptor — a
+                # slow upstream may have published since, so fall back
+                # to a fresh take on recv's own budget (pre-prefetch
+                # behavior) instead of failing a recv that would have
+                # succeeded. A fetch timeout AFTER the take (desc set)
+                # is NOT retryable — delivery is single-shot — so it
+                # re-raises below like any other prefetch error.
+                pre = None
+            elif pre["error"] is not None:
+                raise pre["error"]
+        if pre is not None:
+            self.stats.wait_s += time.monotonic() - t0
+            self.stats.prefetch_hits += 1
+            desc, fetcher, tree = pre["desc"], pre["fetcher"], \
+                pre["tree"]
+        else:
+            remaining = max(0.0, timeout - (time.monotonic() - t0))
+            desc = self._take_descriptor(step, mb, kind, remaining)
+            self.stats.wait_s += time.monotonic() - t0
+            fetcher = chunks.ChunkFetcher(self._worker)
+            tree = chunks.fetch_tree(self._worker, desc, fetcher)
         nbytes = int(desc["total_bytes"])
         self.stats.recv_msgs += 1
         self.stats.recv_chunks += len(desc["leaves"])
@@ -237,7 +339,11 @@ class ActivationChannel:
 
     def close(self) -> None:
         """Drop every held chunk (and its undelivered descriptors)
-        and the pubsub callback."""
+        and the pubsub callback; in-flight prefetch polls exit on the
+        closed flag."""
+        self._closed = True
+        with self._cv:
+            self._cv.notify_all()  # wake prefetch polls so they exit
         try:
             self._worker.unsubscribe_channel("pipeline", self._on_msg)
         except Exception:  # noqa: BLE001 — worker already torn down
@@ -245,6 +351,7 @@ class ActivationChannel:
         with self._lock:
             slots = list(self._held)
             self._held.clear()
+            self._prefetched.clear()
         if slots:
             self._discard_mailbox(slots)
 

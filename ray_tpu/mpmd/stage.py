@@ -190,6 +190,22 @@ def run_stage(program: StageProgram, *, name: str, stage: int,
 
     losses: List[float] = []
     bubble_fracs: List[float] = []
+
+    def prefetch_next(idx: int, step: int) -> None:
+        """Start the NEXT recv-needing tick's channel pull now, so it
+        streams during this tick's compute (channels.prefetch — the
+        bubble_wait shrinker; within-step only, the sender may not
+        exist across a step boundary yet)."""
+        for t in ticks[idx + 1:]:
+            if t.op == FORWARD:
+                if s > 0:
+                    in_ch.prefetch(step, t.mb, "act",
+                                   timeout=recv_timeout)
+                    return
+            elif not last:
+                gin_ch.prefetch(step, t.mb, "grad",
+                                timeout=recv_timeout)
+                return
     # first execution of each jitted program traces+compiles and is
     # attributed to the compile phase; every later call (including the
     # rest of step 0's microbatches) is device_step
@@ -216,7 +232,7 @@ def run_stage(program: StageProgram, *, name: str, stage: int,
                     micro_t = _split_microbatches(t_full,
                                                   num_microbatches)
             step_losses: List[Any] = []
-            for tick in ticks:
+            for tick_idx, tick in enumerate(ticks):
                 if tick.op == FORWARD:
                     if s == 0:
                         x = jax.tree.map(lambda a: a[tick.mb], micro_x)
@@ -226,6 +242,7 @@ def run_stage(program: StageProgram, *, name: str, stage: int,
                             x = in_ch.recv(step, tick.mb, "act",
                                            timeout=recv_timeout)
                         bubble_s += time.perf_counter() - t0
+                        prefetch_next(tick_idx, step)
                     t0 = time.perf_counter()
                     y = program.forward(tick.mb, x)
                     if timer is not None:
@@ -253,6 +270,7 @@ def run_stage(program: StageProgram, *, name: str, stage: int,
                             dy = gin_ch.recv(step, tick.mb, "grad",
                                              timeout=recv_timeout)
                         bubble_s += time.perf_counter() - t0
+                        prefetch_next(tick_idx, step)
                         t0 = time.perf_counter()
                         gx = program.backward(tick.mb, dy=dy)
                         if timer is not None:
@@ -301,6 +319,7 @@ def run_stage(program: StageProgram, *, name: str, stage: int,
         "recv_bytes": sum(c.stats.recv_bytes for c in chans),
         "sent_msgs": sum(c.stats.sent_msgs for c in chans),
         "recv_msgs": sum(c.stats.recv_msgs for c in chans),
+        "prefetch_hits": sum(c.stats.prefetch_hits for c in chans),
         "channel_wait_s": sum(c.stats.wait_s for c in chans),
         "elapsed_s": time.perf_counter() - t_run0,
     }

@@ -37,6 +37,12 @@ merges and labels them:
                  ingests, weight publishes and sampler hot swaps, so
                  the sampler/learner cadence reads directly against the
                  weights lane's fabric-side publish/fetch/swap markers.
+- disagg:        pid = "disagg",          tid = event kind — instant
+                 markers of disaggregated serving (serve/disagg.py):
+                 KV publishes on the prefill tier, prefill->decode
+                 KV transfers with their shm/rpc byte split, and
+                 router sheds, so cross-replica KV traffic lines up
+                 against request latency and the kvcache lane.
 """
 from __future__ import annotations
 
@@ -204,6 +210,32 @@ def online_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def disagg_trace_events(events: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Instant markers for disaggregated-serving events (kv_publish,
+    kv_transfer, shed) — mirrors the kvcache track under pid
+    "disagg"."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        where = ev.get("server") or ev.get("router")
+        if where:
+            label += f":{where}"
+        if ev.get("bytes") is not None:
+            label += f" {ev['bytes']}B"
+        out.append({
+            "name": label, "cat": "disagg", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "disagg", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -236,6 +268,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         pipeline_events: Optional[
                             List[Dict[str, Any]]] = None,
                         online_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        disagg_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -254,6 +288,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(pipeline_trace_events(pipeline_events))
     if online_events:
         trace.extend(online_trace_events(online_events))
+    if disagg_events:
+        trace.extend(disagg_trace_events(disagg_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -297,8 +333,12 @@ def merged_timeline(filename: Optional[str] = None,
         oev = w.conductor.call("get_online_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-online conductor
         oev = []
+    try:
+        dev = w.conductor.call("get_disagg_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-disagg conductor
+        dev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev, oev)
+                                pev, oev, dev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

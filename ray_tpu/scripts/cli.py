@@ -342,8 +342,12 @@ def cmd_kvcache(args) -> None:
           f"cow={totals.get('cow_copies', 0)}")
     for key, s in sorted(engines.items()):
         if not s.get("enabled", False):
+            # decode replicas under disaggregation run cache-disabled:
+            # they adopt prefilled KV, they never prefill
             print(f"  {key}: prefix cache DISABLED "
-                  f"(admitted={s.get('admitted', 0)})")
+                  f"(admitted={s.get('admitted', 0)} "
+                  f"prefill={s.get('prefill_admitted', 0)} "
+                  f"adopted={s.get('adopted', 0)})")
             continue
         print(f"  {key}: hits={s.get('hits', 0)} "
               f"partial={s.get('partial_hits', 0)} "
@@ -471,6 +475,68 @@ def cmd_online(args) -> None:
     if args.events:
         w = worker_mod.global_worker
         events = w.conductor.call("get_online_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
+def cmd_disagg(args) -> None:
+    """`ray_tpu disagg` — disaggregated prefill/decode serving view
+    (serve/disagg.py): prefill-tier reuse + published KV, decode-tier
+    transfer accounting (shm vs rpc — the no-full-copy evidence),
+    router dispatch/shed/queue-depth, plus the cluster totals every
+    other surface (state API, /api/disagg, Prometheus, timeline
+    markers) reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.disagg_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    totals = st.get("totals") or {}
+    if not (st.get("prefill") or st.get("decode") or st.get("routers")):
+        print("no disagg telemetry recorded (is a PrefillServer/"
+              "DecodeServer/DisaggRouter running?)")
+        return
+    print(f"totals: transfers={totals.get('transfers', 0)} "
+          f"kv_bytes={totals.get('kv_fetched_bytes', 0)} "
+          f"(shm={totals.get('shm_bytes', 0)} "
+          f"rpc={totals.get('rpc_bytes', 0)}) "
+          f"adopted={totals.get('adopted', 0)} "
+          f"dispatched={totals.get('dispatched', 0)} "
+          f"shed={totals.get('shed', 0)} "
+          f"queue_depth={totals.get('queue_depth', 0)} "
+          f"(max {totals.get('max_queue_depth_seen', 0)})")
+    for key, p in sorted((st.get("prefill") or {}).items()):
+        pc = p.get("prefix_cache") or {}
+        print(f"  {key}: prefills={p.get('prefills', 0)} "
+              f"prefilled_tok={p.get('prefilled_tokens', 0)} "
+              f"reused_tok={p.get('reused_tokens', 0)} "
+              f"published={p.get('published_transfers', 0)} "
+              f"({p.get('published_bytes', 0)}B) "
+              f"held={p.get('held_transfers', 0)} "
+              f"acked={p.get('acked', 0)}"
+              + (f" hit_rate={pc.get('hit_rate', 0.0):.2%}"
+                 if pc else ""))
+    for key, d in sorted((st.get("decode") or {}).items()):
+        print(f"  {key}: transfers={d.get('transfers', 0)} "
+              f"fetched={d.get('kv_fetched_bytes', 0)}B "
+              f"(shm={d.get('shm_bytes', 0)} rpc={d.get('rpc_bytes', 0)}) "
+              f"adopted={d.get('adopted', 0)} "
+              f"slots={d.get('free_slots', 0)}/{d.get('capacity', 0)} "
+              f"prefill_programs={d.get('prefill_programs', 0)}")
+    for key, r in sorted((st.get("routers") or {}).items()):
+        print(f"  {key}: mode={r.get('mode')} "
+              f"dispatched={r.get('dispatched', 0)} "
+              f"completed={r.get('completed', 0)} "
+              f"shed={r.get('shed', 0)} "
+              f"pending={r.get('pending', 0)} "
+              f"(max {r.get('max_pending', 0)}, "
+              f"depth_knob={r.get('max_queue_depth')})")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_disagg_events", args.events,
                                   timeout=10.0)
         _print_event_tail(events, args.events)
 
@@ -782,6 +848,16 @@ def main(argv=None) -> None:
                     help="also print the last N online-loop events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_online)
+
+    sp = sub.add_parser("disagg",
+                        help="disaggregated prefill/decode serving: "
+                             "KV-transfer accounting (shm vs rpc), "
+                             "router shed/queue depth, recent events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N disagg events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_disagg)
 
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "

@@ -19,7 +19,9 @@ from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
 from .context import get_request_context  # noqa: F401
 from .controller import ServeController
-from .handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse  # noqa: F401
+from .disagg import DecodeServer, DisaggRouter, PrefillServer  # noqa: F401
+from .handle import (CONTROLLER_NAME, DeploymentHandle,  # noqa: F401
+                     DeploymentResponse, RequestShedError)
 from .http_util import Request, Response  # noqa: F401
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .replica import HandleMarker
@@ -49,6 +51,7 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[Union[int, str]] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
                 user_config: Optional[Any] = None,
                 autoscaling_config: Optional[Union[dict, AutoscalingConfig]] = None,
                 health_check_period_s: Optional[float] = None,
@@ -66,6 +69,7 @@ class Deployment:
             num_replicas = None
         for field, value in [("num_replicas", num_replicas),
                              ("max_ongoing_requests", max_ongoing_requests),
+                             ("max_queued_requests", max_queued_requests),
                              ("user_config", user_config),
                              ("autoscaling_config", autoscaling_config),
                              ("health_check_period_s", health_check_period_s),

@@ -35,6 +35,12 @@ class DeploymentConfig:
     """Per-deployment config — reference serve/config.py DeploymentConfig."""
     num_replicas: int = 1
     max_ongoing_requests: int = 8
+    # admission control (router load shedding): each replica may hold
+    # at most max_ongoing + max_queued_requests in-flight through a
+    # handle; past that the router rejects with RequestShedError +
+    # retry_after instead of queueing unboundedly. -1 disables (the
+    # pre-admission behavior); RAY_TPU_SERVE_MAX_QUEUE_DEPTH overrides.
+    max_queued_requests: int = -1
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 2.0
@@ -47,6 +53,9 @@ class DeploymentConfig:
             raise ValueError("num_replicas must be >= 0")
         if self.max_ongoing_requests < 1:
             raise ValueError("max_ongoing_requests must be >= 1")
+        if self.max_queued_requests < -1:
+            raise ValueError("max_queued_requests must be >= -1 "
+                             "(-1 disables admission control)")
         if self.autoscaling_config is not None:
             self.autoscaling_config.validate()
 

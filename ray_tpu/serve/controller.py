@@ -164,6 +164,20 @@ class ServeController:
                 return self._version, []
             return self._version, list(st.replicas)
 
+    def get_deployment_limits(self, app: str, deployment: str
+                              ) -> Dict[str, Any]:
+        """Admission-control knobs the router enforces client-side
+        (fetched alongside the replica set on a version change)."""
+        with self._lock:
+            st = self._deployments.get((app, deployment))
+            if st is None:
+                return {}
+            return {
+                "max_ongoing_requests": st.config.max_ongoing_requests,
+                "max_queued_requests": getattr(
+                    st.config, "max_queued_requests", -1),
+            }
+
     def get_route_table(self) -> Dict[str, Tuple[str, str]]:
         with self._lock:
             return {info["route_prefix"]: (app, info["ingress"])

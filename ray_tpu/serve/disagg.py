@@ -1,0 +1,737 @@
+"""Disaggregated prefill/decode serving: dedicated prefill replicas
+stream KV blocks to decode replicas over the chunk fabric.
+
+Why (the ROADMAP serving-envelope item, and the Gemma-on-TPU serving
+envelope PAPERS.md: arXiv 2605.25645 measures): with prefill and decode
+sharing one replica, a long prefill stalls every in-flight decode tick —
+TTFT p99 and tokens/s both degrade under load. Splitting the phases
+turns prefill into horizontally scalable compute-bound work and keeps
+decode ticks free of head-of-line blocking:
+
+- **PrefillServer** runs ``engine._prefill_paged`` behind the paged KV
+  prefix cache (models/kvcache.py — shared system prompts still
+  amortize), then publishes the prompt's KV rows plus the first token
+  through ``util.chunks``: each leaf goes into the SENDER's own object
+  store and only a metadata descriptor travels the control plane.
+  Same no-full-copy invariant as the weight fabric and the MPMD
+  activation channels — the bytes move sender -> receiver exactly once
+  (shm zero-copy same-host, 64MB-ranged streaming across hosts), the
+  conductor never holds payload, and the sender's ObjectRefs ARE the
+  chunks' lifetime (``ack()`` releases them; a bounded retention window
+  reaps unacked transfers).
+- **DecodeServer** pulls the KV point-to-point with a ``ChunkFetcher``
+  (shm-vs-rpc accounting) and ADOPTS it into its engine's decode slab
+  via ``ContinuousBatchingEngine.adopt_prefill`` — an O(prompt_len)
+  splice between ticks, never an O(pool) copy — so a decode replica
+  never executes a prefill program at all (its ``_prefill_paged``
+  compile cache stays flat; asserted in tests/test_disagg.py).
+- **DisaggRouter** dispatches: the prefill replica is chosen by
+  prefix-cache AFFINITY (a stable hash of the prompt's first cache
+  block, so prompts sharing a system prompt land on the replica that
+  already holds its KV), the decode replica by free-slot count; with no
+  prefill tier configured it falls back to today's colocated
+  single-replica path, bit-identical. On top it does **admission
+  control + load shedding**: per-replica in-flight is bounded at
+  capacity + ``max_queue_depth``; past the knob the request is REJECTED
+  with a ``RequestShedError`` carrying ``retry_after_s`` — shed at the
+  router, before the engine wedges.
+
+Surfaces (the full treatment): ``util.state.disagg_status()``,
+``ray_tpu disagg`` CLI, dashboard ``/api/disagg`` + SPA tab, lazy
+Prometheus (``ray_tpu_disagg_kv_bytes_total{direction}``,
+``ray_tpu_disagg_transfers_total``, ``ray_tpu_serve_shed_total``,
+``ray_tpu_disagg_queue_depth``), and ``disagg`` instant markers in the
+merged timeline. Knobs: ``RAY_TPU_DISAGG_QUEUE_DEPTH`` (router backlog
+bound per decode replica, default 8), ``RAY_TPU_DISAGG_RETRY_AFTER_S``
+(shed hint, default 1.0), ``RAY_TPU_MAX_ADOPTIONS_PER_TICK`` (decode
+adoption cap, models/engine.py), plus the kvcache knobs on the prefill
+tier. The open-loop acceptance benchmark lives in
+``ray_tpu/bench_serve.py``.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .handle import RequestShedError, shed_counter
+
+_SERVER_SEQ = itertools.count()
+
+# ----------------------------------------------------- prometheus (lazy)
+# Created on first component construction, never at import (the
+# weights / kvcache / online pattern — rebound ONCE to a complete dict).
+
+_metrics: Optional[Dict[str, Any]] = None
+_metrics_lock = threading.Lock()
+
+
+def disagg_metrics() -> Dict[str, Any]:
+    global _metrics
+    m = _metrics
+    if m is not None:
+        return m
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _metrics = dict(
+                kv_bytes=Counter(
+                    "ray_tpu_disagg_kv_bytes_total",
+                    "KV-block bytes moved between prefill and decode "
+                    "replicas over the chunk fabric",
+                    tag_keys=("direction",)),
+                transfers=Counter(
+                    "ray_tpu_disagg_transfers_total",
+                    "completed prefill->decode KV transfers (counted "
+                    "when the decode replica's fetch finishes)"),
+                queue_depth=Gauge(
+                    "ray_tpu_disagg_queue_depth",
+                    "requests in flight through a disagg router "
+                    "(executing + queued at its decode tier)",
+                    tag_keys=("router",)))
+    return _metrics
+
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker
+
+
+def _notify_event(event: Dict[str, Any]) -> None:
+    """Best-effort instant marker into the conductor's disagg event log
+    (the merged timeline's `disagg` lane). No-op without a cluster."""
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_disagg_event", dict(event))
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
+def _push_stats(component_id: str, stats: Dict[str, Any]) -> None:
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_disagg_stats", w.worker_id,
+                           component_id, stats)
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
+def _call(target: Any, method: str, *args, block: bool = True, **kw):
+    """Invoke `method` on a local component or a ray_tpu actor handle
+    (the router accepts either, so tests and the load harness can run
+    replicas in-process while deployments run them as actors)."""
+    fn = getattr(target, method)
+    remote = getattr(fn, "remote", None)
+    if remote is not None:
+        import ray_tpu
+
+        ref = remote(*args, **kw)
+        return ray_tpu.get(ref) if block else ref
+    return fn(*args, **kw)
+
+
+# ------------------------------------------------------------ prefill tier
+
+class PrefillServer:
+    """One prefill replica: compute-bound prefill behind the prefix
+    cache, KV rows published as sender-owned chunks.
+
+    ``prefill()`` returns a metadata-only record (safe to route through
+    actors/the control plane): the first token, its logprob score, the
+    prefix-cache outcome, and the chunk descriptor a DecodeServer
+    fetches the KV from. The prompt's cache pins are released as soon as
+    the KV is exported — blocks stay cached for future lookups."""
+
+    def __init__(self, params: Any, config: Any, *,
+                 prefix_cache: bool = True,
+                 kv_block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 retain: int = 32,
+                 server_id: Optional[str] = None):
+        from ray_tpu.models.generate import _model_fns
+        from ray_tpu.models.kvcache import (PagedKVCache,
+                                            resolve_pool_config)
+
+        import jax.numpy as jnp
+
+        self.params = params
+        self.config = config
+        self.server_id = server_id or \
+            f"pf-{os.getpid()}-{next(_SERVER_SEQ)}"
+        block_size, pool_blocks = resolve_pool_config(
+            config, kv_block_size, kv_pool_blocks)
+        self.kv_cache: Optional[PagedKVCache] = (
+            PagedKVCache(config, block_size=block_size,
+                         num_blocks=pool_blocks)
+            if prefix_cache else None)
+        probe = _model_fns(config)[1](config, 1, max_len=1)
+        shape = probe[0]["k"].shape  # [1, 1, H, hd]
+        self._empty_prefix = jnp.zeros(
+            (len(probe), 0) + shape[2:], probe[0]["k"].dtype)
+        # retention bounds how many unacked transfers this server keeps
+        # alive; size it past the decode tier's admitted bound
+        # (decode_replicas * (max_batch + queue_depth)) — transfers are
+        # held from publish until the router's post-decode ack, and
+        # prefix affinity can route all of them here, so a smaller
+        # window reaps chunks a decode replica is about to fetch
+        self._retain = max(1, int(retain))
+        self._lock = threading.Lock()
+        # transfer_id -> chunk refs; holding them IS the chunks'
+        # lifetime (ack() or retention-window reap drops them)
+        self._held: "OrderedDict[str, List[Any]]" = OrderedDict()
+        self._seq = itertools.count()
+        self._stats = {k: 0 for k in (
+            "prefills", "prefilled_tokens", "reused_tokens",
+            "published_transfers", "published_bytes", "acked",
+            "reaped_unacked")}
+        self._last_push = 0.0
+        disagg_metrics()  # lazy registration before the first event
+
+    # ---------------------------------------------------------- data plane
+
+    def prefill(self, prompt_tokens) -> Dict[str, Any]:
+        """Prefill one prompt (suffix-only on a cache hit) and publish
+        its KV rows. Returns the transfer record for a DecodeServer."""
+        from ray_tpu.models.engine import _prefill_with_cache
+        from ray_tpu.util import chunks
+
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        plen = prompt.shape[1]
+        if plen < 1:
+            raise ValueError("empty prompt")
+        ck, cv, table, first, score, outcome, reused, suffix_len = \
+            _prefill_with_cache(self.params, self.config, self.kv_cache,
+                                prompt, self._empty_prefix)
+        if self.kv_cache is not None:
+            # pins drop NOW: the KV is exported below, and refcount-0
+            # blocks stay cached for the next prompt's lookup
+            self.kv_cache.release(table)
+        # the transfer payload: exactly the prompt's KV rows, host-side
+        # (this is the ONLY materialization outside the fill itself —
+        # the same single-copy the colocated splice reads on-device)
+        kv_k = np.asarray(ck[:, :plen])
+        kv_v = np.asarray(cv[:, :plen])
+        del ck, cv
+        rec: Dict[str, Any] = {
+            "transfer_id": f"{self.server_id}-{next(self._seq)}",
+            "plen": plen, "first_token": first, "score": score,
+            "outcome": outcome, "reused_tokens": int(reused),
+            "prefill_server": self.server_id,
+        }
+        nbytes = int(kv_k.nbytes + kv_v.nbytes)
+        w = _worker()
+        if w is not None:
+            refs, desc = chunks.put_tree(w, {"k": kv_k, "v": kv_v})
+            rec["kv"] = desc
+            reaped = []
+            with self._lock:
+                self._held[rec["transfer_id"]] = refs
+                while len(self._held) > self._retain:
+                    reaped.append(self._held.popitem(last=False))
+                self._stats["reaped_unacked"] += len(reaped)
+        else:
+            # clusterless (unit tests / in-process harness): the arrays
+            # ride the record directly — no chunk plane to publish to
+            rec["kv_inline"] = (kv_k, kv_v)
+        # send is counted for BOTH paths: the receiver counts recv for
+        # inline adoptions too, and a consumer cross-checking
+        # send == recv must see the totals agree in either mode
+        disagg_metrics()["kv_bytes"].inc(
+            nbytes, tags={"direction": "send"})
+        with self._lock:
+            self._stats["prefills"] += 1
+            self._stats["prefilled_tokens"] += suffix_len
+            self._stats["reused_tokens"] += int(reused)
+            self._stats["published_transfers"] += 1
+            self._stats["published_bytes"] += nbytes
+        _notify_event({"kind": "kv_publish", "server": self.server_id,
+                       "transfer_id": rec["transfer_id"],
+                       "bytes": nbytes, "plen": plen,
+                       "outcome": outcome})
+        self.publish_telemetry()
+        return rec
+
+    def set_retention(self, retain: int) -> None:
+        """Raise the retention window (routers push the decode tier's
+        admitted bound at construction so the default can never reap an
+        in-flight transfer); never shrinks below the constructor
+        value."""
+        with self._lock:
+            self._retain = max(self._retain, int(retain))
+
+    def ack(self, transfer_id: str) -> bool:
+        """Receiver finished fetching: drop the chunks' refs (their
+        lifetime). Returns False if retention already reaped them."""
+        with self._lock:
+            held = self._held.pop(transfer_id, None)
+            if held is not None:
+                self._stats["acked"] += 1
+        return held is not None
+
+    # ------------------------------------------------------------ telemetry
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            s: Dict[str, Any] = dict(self._stats)
+            s["held_transfers"] = len(self._held)
+        s["role"] = "prefill"
+        s["server_id"] = self.server_id
+        if self.kv_cache is not None:
+            s["prefix_cache"] = self.kv_cache.stats()
+        return s
+
+    def kv_stats(self) -> Dict[str, Any]:
+        """Engine-shaped snapshot for the kvcache surface (the prefill
+        tier is where prefix reuse happens under disaggregation)."""
+        s: Dict[str, Any] = (self.kv_cache.stats() if self.kv_cache
+                             else {"enabled": False})
+        with self._lock:
+            s.update(engine_id=self.server_id, phase="prefill",
+                     prefill_calls=self._stats["prefills"],
+                     admitted=self._stats["prefills"],
+                     prefill_admitted=self._stats["prefills"],
+                     adopted=0)
+        return s
+
+    def publish_telemetry(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_push < 0.5:
+            return
+        self._last_push = now
+        _push_stats(self.server_id, self.stats())
+        w = _worker()
+        if w is None:
+            if self.kv_cache is not None:
+                self.kv_cache.drain_events()
+            return
+        try:
+            w.conductor.notify("report_kvcache_stats", w.worker_id,
+                               self.server_id, self.kv_stats())
+            if self.kv_cache is not None:
+                for ev in self.kv_cache.drain_events():
+                    ev.setdefault("engine", self.server_id)
+                    w.conductor.notify("report_kvcache_event", ev)
+        except Exception:  # noqa: BLE001 — cluster shutting down
+            pass
+
+
+# ------------------------------------------------------------- decode tier
+
+class _CountedStream:
+    """Iterates an adopted TokenStream and folds the drained token count
+    into the owning DecodeServer's ``decoded_tokens`` (in the finally, so
+    an abandoned/failed stream still accounts what it actually yielded).
+    Everything else proxies to the underlying stream."""
+
+    def __init__(self, server: "DecodeServer", stream: Any):
+        self._server = server
+        self._stream = stream
+
+    def __iter__(self):
+        n = 0
+        try:
+            for tok in self._stream:
+                n += 1
+                yield tok
+        finally:
+            self._server._count_decoded(n)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._stream, name)
+
+
+class DecodeServer:
+    """One decode replica: a prefix-cache-DISABLED batching engine that
+    only ever adopts prefilled KV — it never runs a prefill program
+    (``prefill_programs()`` reports this process's `_prefill_paged`
+    compile-cache size so tests can assert it stays flat)."""
+
+    def __init__(self, params: Any, config: Any, *,
+                 max_batch: int = 8,
+                 server_id: Optional[str] = None,
+                 **engine_kw):
+        from ray_tpu.models.engine import ContinuousBatchingEngine
+
+        engine_kw.setdefault("prefix_cache", False)
+        self.engine = ContinuousBatchingEngine(params, config,
+                                               max_batch=max_batch,
+                                               **engine_kw)
+        self.server_id = server_id or \
+            f"dec-{os.getpid()}-{next(_SERVER_SEQ)}"
+        self._lock = threading.Lock()
+        self._stats = {k: 0 for k in (
+            "transfers", "kv_fetched_bytes", "shm_bytes", "rpc_bytes",
+            "chunks_local", "decoded_tokens")}
+        self._last_push = 0.0
+        disagg_metrics()
+
+    # ---------------------------------------------------------- data plane
+
+    def _adopt(self, rec: Dict[str, Any], max_new_tokens: int,
+               eos_token: Optional[int], timeout_s: float):
+        from ray_tpu.util import chunks
+
+        desc = rec.get("kv")
+        if desc is not None:
+            w = _worker()
+            if w is None:
+                raise RuntimeError(
+                    "a chunk-published transfer needs a live cluster "
+                    "(ray_tpu.init) on the decode side")
+            fetcher = chunks.ChunkFetcher(w)
+            tree = chunks.fetch_tree(w, desc, fetcher)
+            kv_k, kv_v = tree["k"], tree["v"]
+            acc = fetcher.stats()
+        else:
+            kv_k, kv_v = rec["kv_inline"]
+            acc = {"chunks_local": 2, "chunks_fetched": 0,
+                   "fetched_bytes": 0, "shm_bytes": 0, "rpc_bytes": 0}
+        nbytes = int(kv_k.nbytes + kv_v.nbytes)
+        # adopt (which VALIDATES length bounds and KV layout) before any
+        # accounting: a rejected adoption must not leave transfers >
+        # adopted or a kv_transfer marker with no decode behind it — the
+        # surfaces assert one set of numbers
+        stream = self.engine.adopt_prefill(
+            rec["plen"], rec["first_token"], kv_k, kv_v,
+            max_new_tokens, eos_token, score=rec.get("score", 0.0),
+            cache_outcome=rec.get("outcome"),
+            reused_tokens=rec.get("reused_tokens", 0),
+            timeout_s=timeout_s)
+        with self._lock:
+            self._stats["transfers"] += 1
+            self._stats["kv_fetched_bytes"] += acc["fetched_bytes"]
+            self._stats["shm_bytes"] += acc["shm_bytes"]
+            self._stats["rpc_bytes"] += acc["rpc_bytes"]
+            self._stats["chunks_local"] += acc["chunks_local"]
+        m = disagg_metrics()
+        m["transfers"].inc()
+        m["kv_bytes"].inc(nbytes, tags={"direction": "recv"})
+        _notify_event({"kind": "kv_transfer", "server": self.server_id,
+                       "transfer_id": rec.get("transfer_id"),
+                       "bytes": nbytes, "plen": rec["plen"],
+                       "shm_bytes": acc["shm_bytes"],
+                       "rpc_bytes": acc["rpc_bytes"],
+                       "outcome": rec.get("outcome")})
+        return stream
+
+    def stream_from(self, rec: Dict[str, Any], max_new_tokens: int,
+                    eos_token: Optional[int] = None,
+                    timeout_s: float = 120.0):
+        """Adopt a transfer and return the live token stream (in-process
+        callers only — streams do not cross the actor boundary). The
+        stream proxies the underlying TokenStream (``cache_outcome``
+        etc.) and folds drained tokens into ``decoded_tokens`` so the
+        streaming path reports the same one set of numbers as
+        ``decode_from``."""
+        return _CountedStream(
+            self, self._adopt(rec, max_new_tokens, eos_token, timeout_s))
+
+    def decode_from(self, rec: Dict[str, Any], max_new_tokens: int,
+                    eos_token: Optional[int] = None,
+                    timeout_s: float = 120.0) -> List[int]:
+        """Adopt a transfer and decode it to completion (actor-friendly:
+        returns the full token list, first token included)."""
+        stream = self._adopt(rec, max_new_tokens, eos_token, timeout_s)
+        toks = list(stream)
+        self._count_decoded(len(toks))
+        return toks
+
+    def _count_decoded(self, n: int) -> None:
+        with self._lock:
+            self._stats["decoded_tokens"] += n
+        self.publish_telemetry()
+
+    # -------------------------------------------------------- control plane
+
+    def capacity(self) -> int:
+        return self.engine.max_batch
+
+    def free_slots(self) -> int:
+        return self.engine.free_slots
+
+    def prefill_programs(self) -> int:
+        """`_prefill_paged` compile-cache size in THIS process — must
+        stay flat on a pure decode replica (0 when it runs alone)."""
+        from ray_tpu.models.engine import _prefill_paged
+
+        try:
+            return _prefill_paged._cache_size()
+        except Exception:  # noqa: BLE001 — older jax without _cache_size
+            return -1
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            s: Dict[str, Any] = dict(self._stats)
+        s.update(role="decode", server_id=self.server_id,
+                 capacity=self.engine.max_batch,
+                 free_slots=self.engine.free_slots,
+                 adopted=self.engine.adopted,
+                 prefill_programs=self.prefill_programs())
+        return s
+
+    def publish_telemetry(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_push < 0.5:
+            return
+        self._last_push = now
+        _push_stats(self.server_id, self.stats())
+        # the engine's own kvcache push carries the adoption counters
+        # to the kvcache surface (per-phase truthfulness)
+        self.engine.publish_kv_telemetry(force=True)
+
+    def stop(self) -> None:
+        self.engine.stop()
+        self.publish_telemetry(force=True)
+
+
+# ----------------------------------------------------------------- router
+
+class DisaggRouter:
+    """Dispatch + admission control over a prefill tier and a decode
+    tier (each a sequence of in-process servers or actor handles).
+
+    With an empty prefill tier the router degrades to the colocated
+    single-engine path — same engine code, bit-identical outputs — so
+    one deployment shape serves both modes."""
+
+    def __init__(self, decode: Sequence[Any] = (),
+                 prefill: Sequence[Any] = (), *,
+                 colocated: Any = None,
+                 max_queue_depth: Optional[int] = None,
+                 retry_after_s: Optional[float] = None,
+                 affinity_tokens: int = 16,
+                 router_id: Optional[str] = None):
+        # every combination generate() cannot serve is rejected HERE,
+        # not per-request after a prefill was already published
+        if prefill and not decode:
+            raise ValueError(
+                "a prefill tier needs a decode tier to stream KV to")
+        if not prefill and colocated is None:
+            raise ValueError(
+                "need a prefill+decode pair or a colocated engine")
+        self._decode = list(decode)
+        self._prefill = list(prefill)
+        self._colocated = colocated
+        if max_queue_depth is None:
+            max_queue_depth = int(os.environ.get(
+                "RAY_TPU_DISAGG_QUEUE_DEPTH", "8"))
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        if retry_after_s is None:
+            retry_after_s = float(os.environ.get(
+                "RAY_TPU_DISAGG_RETRY_AFTER_S", "1.0"))
+        self.retry_after_s = float(retry_after_s)
+        # prompts sharing their first `affinity_tokens` tokens (the
+        # system prompt's first cache block) land on one prefill replica
+        self.affinity_tokens = max(1, int(affinity_tokens))
+        self.router_id = router_id or \
+            f"router-{os.getpid()}-{next(_SERVER_SEQ)}"
+        self._lock = threading.Lock()
+        if self._decode:
+            self._cap = [int(_call(d, "capacity")) for d in self._decode]
+        else:
+            self._cap = [int(colocated.max_batch)]
+        self._inflight = [0] * len(self._cap)
+        if self._prefill:
+            # every admissible request can be in flight at once and
+            # affinity can route ALL of them to one prefill server —
+            # push the bound so its retention window can never reap a
+            # transfer a decode replica is about to fetch
+            hint = 2 * (sum(self._cap)
+                        + len(self._cap) * self.max_queue_depth)
+            for pf in self._prefill:
+                try:
+                    _call(pf, "set_retention", hint, block=False)
+                except Exception:  # noqa: BLE001 — replica mid-restart
+                    pass
+        self._stats = {k: 0 for k in (
+            "dispatched", "completed", "shed", "max_pending")}
+        self._last_push = 0.0
+        disagg_metrics()
+
+    # ------------------------------------------------------------ admission
+
+    def _admit_or_shed(self) -> int:
+        """Reserve a decode replica (index) or shed. Sheds when EVERY
+        replica's in-flight estimate has reached capacity +
+        max_queue_depth — the bound that keeps queue depth finite. The
+        bound check and the in-flight reservation happen under ONE lock
+        acquisition (check-then-act would let N racing callers all pass
+        the check before any reserves, exceeding the bound by N-1);
+        shed-side metrics and the conductor notify run after release so
+        overload never serializes healthy admissions behind a socket
+        write."""
+        with self._lock:
+            open_idx = [i for i in range(len(self._cap))
+                        if self._inflight[i]
+                        < self._cap[i] + self.max_queue_depth]
+            if open_idx:
+                # probe-free first cut: least estimated in-flight,
+                # reserved NOW so the bound holds under concurrency
+                idx = min(open_idx, key=lambda i: self._inflight[i])
+                self._inflight[idx] += 1
+                pending = sum(self._inflight)
+                self._stats["dispatched"] += 1
+                self._stats["max_pending"] = max(
+                    self._stats["max_pending"], pending)
+            else:
+                self._stats["shed"] += 1
+                pending = sum(self._inflight)
+        if not open_idx:
+            shed_counter().inc(tags={"app": "disagg",
+                                     "deployment": self.router_id})
+            _notify_event({"kind": "shed", "router": self.router_id,
+                           "pending": pending,
+                           "retry_after_s": self.retry_after_s})
+            # push the snapshot NOW (0.5s-throttled): under sustained
+            # overload nothing completes, and a completion-only push
+            # would freeze the conductor surfaces — queue depth aging
+            # out to 0 — during exactly the storm they exist to show
+            self.publish_telemetry()
+            raise RequestShedError(
+                f"disagg router {self.router_id}: every decode "
+                f"replica is at capacity + queue depth "
+                f"{self.max_queue_depth}; retry after "
+                f"{self.retry_after_s:.1f}s",
+                retry_after_s=self.retry_after_s)
+        if self._decode and len(open_idx) > 1:
+            # refine by live free-slot count (the decode-pick policy);
+            # the in-flight estimate breaks ties and covers probe lag.
+            # The probes are ISSUED before any is awaited so N actor
+            # replicas answer concurrently — sequential blocking gets
+            # here would add N x RPC latency to every dispatch.
+            # Moving the reservation re-checks the target's bound under
+            # the lock — a refinement may not overfill a replica that
+            # filled up while we probed.
+            try:
+                from ray_tpu._private.object_store import ObjectRef
+
+                import ray_tpu
+
+                probes = [(i, _call(self._decode[i], "free_slots",
+                                    block=False)) for i in open_idx]
+                # expected free slots once in-transit dispatches land:
+                # the probe already excludes EXECUTING requests, which
+                # are also in this router's in-flight estimate, so
+                # subtracting the full estimate would double-count them
+                # and rank a deep backlog above a busy-but-shallower
+                # replica. cap - inflight is that expectation for load
+                # this router dispatched; min() with the probe keeps it
+                # honest about slots held by load we never saw.
+                frees = [(min(int(ray_tpu.get(v)
+                                  if isinstance(v, ObjectRef) else v),
+                              self._cap[i] - self._inflight[i]), i)
+                         for i, v in probes]
+                best = max(frees)[1]
+            except Exception:  # noqa: BLE001 — replica mid-restart
+                best = idx
+            if best != idx:
+                with self._lock:
+                    if self._inflight[best] < self._cap[best] + \
+                            self.max_queue_depth:
+                        self._inflight[idx] -= 1
+                        self._inflight[best] += 1
+                        idx = best
+        disagg_metrics()["queue_depth"].set(
+            pending, tags={"router": self.router_id})
+        self.publish_telemetry()
+        return idx
+
+    def _complete(self, idx: int) -> None:
+        with self._lock:
+            if self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+            self._stats["completed"] += 1
+            pending = sum(self._inflight)
+        disagg_metrics()["queue_depth"].set(
+            pending, tags={"router": self.router_id})
+        self.publish_telemetry()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _pick_prefill(self, prompt: np.ndarray) -> Any:
+        head = tuple(int(t) for t in prompt[:self.affinity_tokens])
+        idx = hash(head) % len(self._prefill)
+        return self._prefill[idx]
+
+    def generate(self, prompt_tokens, max_new_tokens: int,
+                 eos_token: Optional[int] = None, *,
+                 timeout_s: float = 120.0,
+                 on_first_token=None,
+                 token_sleep_s: float = 0.0) -> List[int]:
+        """One request end-to-end. `on_first_token()` (optional) fires
+        the moment the first token exists — at prefill completion under
+        disaggregation — which is what the harness's TTFT measures.
+        `token_sleep_s` simulates a slow client consuming the stream
+        (bench_serve.py's backpressure knob): decode ticks must keep
+        serving OTHER requests while this one drains slowly."""
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        idx = self._admit_or_shed()
+        try:
+            if not self._prefill:
+                out: List[int] = []
+                for tok in self._colocated.stream(prompt, max_new_tokens,
+                                                  eos_token,
+                                                  timeout_s=timeout_s):
+                    if not out and on_first_token is not None:
+                        on_first_token()
+                    out.append(tok)
+                    if token_sleep_s > 0:
+                        time.sleep(token_sleep_s)
+                return out
+            pf = self._pick_prefill(prompt)
+            rec = _call(pf, "prefill", prompt.tolist())
+            try:
+                if on_first_token is not None:
+                    on_first_token()  # rec carries the first token
+                dec = self._decode[idx]
+                toks = _call(dec, "decode_from", rec, max_new_tokens,
+                             eos_token, timeout_s)
+            finally:
+                # Ack even when decode failed: the transfer can never be
+                # consumed again, and an un-acked record pins the sender's
+                # chunk refs until the retention window overflows — which
+                # on a quiet tier is never.
+                _call(pf, "ack", rec["transfer_id"], block=False)
+            if token_sleep_s > 0:
+                for _ in toks:
+                    time.sleep(token_sleep_s)
+            return toks
+        finally:
+            self._complete(idx)
+
+    # ------------------------------------------------------------ telemetry
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            s: Dict[str, Any] = dict(self._stats)
+            s["pending"] = sum(self._inflight)
+        s.update(role="router", router_id=self.router_id,
+                 mode="disagg" if self._prefill else "colocated",
+                 decode_replicas=len(self._cap),
+                 prefill_replicas=len(self._prefill),
+                 capacity=sum(self._cap),
+                 max_queue_depth=self.max_queue_depth,
+                 retry_after_s=self.retry_after_s)
+        return s
+
+    def publish_telemetry(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_push < 0.5:
+            return
+        self._last_push = now
+        _push_stats(self.router_id, self.stats())
+
+
+__all__ = ["DecodeServer", "DisaggRouter", "PrefillServer",
+           "RequestShedError", "disagg_metrics"]

@@ -8,12 +8,50 @@ completion) and periodically reconciles against replica-reported queue
 lengths, like the reference's cached RunningReplica queue-length probes."""
 from __future__ import annotations
 
+import logging
+import os
 import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+logger = logging.getLogger(__name__)
+
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class RequestShedError(RuntimeError):
+    """Raised by admission control instead of queueing past the knob
+    (router load shedding — reject-with-retry-after, shed BEFORE the
+    replica/engine wedges). ``retry_after_s`` is the client's backoff
+    hint; the HTTP proxy maps it to a 503 + Retry-After header."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+_shed_counter = None
+_shed_counter_lock = threading.Lock()
+
+
+def shed_counter():
+    """Process-wide shed counter (lazy — importing serve must not spawn
+    a metrics pusher), shared by the Router and the disagg router so
+    `ray_tpu_serve_shed_total` covers every shed path."""
+    global _shed_counter
+    c = _shed_counter
+    if c is not None:
+        return c
+    with _shed_counter_lock:
+        if _shed_counter is None:
+            from ray_tpu.util.metrics import Counter
+
+            _shed_counter = Counter(
+                "ray_tpu_serve_shed_total",
+                "requests rejected by admission control (queue depth "
+                "past the knob)", tag_keys=("app", "deployment"))
+    return _shed_counter
 
 
 class RequestMetadata:
@@ -231,6 +269,15 @@ class Router:
         self._handle_id = f"router-{id(self):x}"
         self._metrics_started = False
         self._stopped = False
+        # admission control: per-replica in-flight is bounded at
+        # max_ongoing + max_queued_requests (deployment config, fetched
+        # with the replica set); the env knob overrides the queue part
+        env_depth = os.environ.get("RAY_TPU_SERVE_MAX_QUEUE_DEPTH")
+        self._env_queue_depth = (int(env_depth) if env_depth not in
+                                 (None, "") else None)
+        self._limits: Dict[str, Any] = {}
+        self._limits_pending = False
+        self._warned_default_bound = False
 
     def _controller(self):
         import ray_tpu
@@ -267,9 +314,19 @@ class Router:
         if not stale:
             return
         import ray_tpu
+        ctrl = self._controller()
         version, replicas = ray_tpu.get(
-            self._controller().get_replicas.remote(
-                self._app, self._deployment))
+            ctrl.get_replicas.remote(self._app, self._deployment))
+        limits = None
+        with self._lock:
+            need_limits = version != self._version or \
+                self._limits_pending
+        if need_limits:
+            try:
+                limits = ray_tpu.get(ctrl.get_deployment_limits.remote(
+                    self._app, self._deployment))
+            except Exception:  # noqa: BLE001 — pre-admission controller
+                limits = None
         with self._lock:
             self._last_refresh = time.monotonic()
             if version != self._version:
@@ -277,20 +334,90 @@ class Router:
                 self._replicas = list(replicas)
                 self._inflight = {tag: self._inflight.get(tag, 0)
                                   for tag, _ in self._replicas}
+            if need_limits:
+                if limits is not None:
+                    self._limits = dict(limits)
+                    self._limits_pending = False
+                else:
+                    # transient controller failure must not disable
+                    # admission control until the next redeploy —
+                    # retry the fetch on the next refresh
+                    self._limits_pending = True
 
     _PICK_TIMEOUT_S = 30.0
 
-    def _try_pick(self) -> Optional[Tuple[str, Any]]:
+    def _shed_bound(self) -> Optional[int]:
+        """Per-replica in-flight bound for admission control
+        (max_ongoing + max_queued_requests), or None when shedding is
+        disabled (max_queued_requests < 0 and no env override)."""
+        lim = self._limits or {}
+        queued = lim.get("max_queued_requests", -1)
+        if self._env_queue_depth is not None:
+            queued = self._env_queue_depth
+        if queued is None or int(queued) < 0:
+            return None
+        if "max_ongoing_requests" not in lim:
+            # limits fetch unavailable (pre-admission controller / RPC
+            # failure): the replica's REAL capacity is unknown. If only
+            # the deployment config asked for shedding, leave it off
+            # until the retried fetch (_limits_pending) lands — guessing
+            # would shed healthy capacity on any deployment sized above
+            # the default. But an explicit env knob is an operator
+            # demanding admission control NOW: honor it against the
+            # config default rather than silently queueing unboundedly,
+            # and say which capacity was assumed.
+            if self._env_queue_depth is None:
+                return None
+            from .config import DeploymentConfig
+
+            ongoing = DeploymentConfig().max_ongoing_requests
+            if not self._warned_default_bound:
+                self._warned_default_bound = True
+                logger.warning(
+                    "RAY_TPU_SERVE_MAX_QUEUE_DEPTH is set but %s#%s's "
+                    "limits are not available from the controller — "
+                    "shedding against the default max_ongoing_requests "
+                    "(%d) until the limits fetch succeeds",
+                    self._app, self._deployment, ongoing)
+            return ongoing + int(queued)
+        return int(lim["max_ongoing_requests"]) + int(queued)
+
+    def _raise_shed(self, bound: int) -> None:
+        retry = float(os.environ.get("RAY_TPU_SERVE_RETRY_AFTER_S",
+                                     "1.0"))
+        shed_counter().inc(tags={"app": self._app,
+                                 "deployment": self._deployment})
+        raise RequestShedError(
+            f"deployment {self._app}#{self._deployment}: every replica "
+            f"is at its in-flight bound ({bound}); retry after "
+            f"{retry:.1f}s", retry_after_s=retry)
+
+    _SHED = object()  # _try_pick sentinel: every replica at its bound
+
+    def _try_pick(self, bound: Optional[int] = None):
         """One non-blocking pow-2 choice; None when no replicas are
-        known. On success the replica's in-flight count is already
-        incremented."""
+        known. The admission bound is enforced UNDER the same lock as
+        the in-flight reservation (check-then-act would let N racing
+        callers all pass a separate shed check before any increments,
+        making max_queued_requests advisory): candidates are the
+        replicas still under `bound`, and when there are none the
+        `_SHED` sentinel is returned for the caller to raise on outside
+        the lock. On success the replica's in-flight count is already
+        incremented. An empty replica set defers to the pick wait (a
+        deploying app is not overload)."""
         with self._lock:
             if not self._replicas:
                 return None
-            if len(self._replicas) == 1:
-                chosen = self._replicas[0]
+            cands = self._replicas
+            if bound is not None:
+                cands = [r for r in self._replicas
+                         if self._inflight.get(r[0], 0) < bound]
+                if not cands:
+                    return self._SHED
+            if len(cands) == 1:
+                chosen = cands[0]
             else:
-                a, b = random.sample(self._replicas, 2)
+                a, b = random.sample(cands, 2)
                 chosen = a if self._inflight.get(a[0], 0) <= \
                     self._inflight.get(b[0], 0) else b
             self._inflight[chosen[0]] = \
@@ -303,11 +430,13 @@ class Router:
             f"{self._app}#{self._deployment} after "
             f"{self._PICK_TIMEOUT_S:.0f}s")
 
-    def _pick(self) -> Tuple[str, Any]:
+    def _pick(self, bound: Optional[int] = None) -> Tuple[str, Any]:
         deadline = time.monotonic() + self._PICK_TIMEOUT_S
         while True:
             self._refresh()
-            chosen = self._try_pick()
+            chosen = self._try_pick(bound)
+            if chosen is self._SHED:
+                self._raise_shed(bound)
             if chosen is not None:
                 return chosen
             if time.monotonic() > deadline:
@@ -331,7 +460,8 @@ class Router:
                     "offload the call with loop.run_in_executor")
             time.sleep(0.1)
 
-    async def _pick_async(self) -> Tuple[str, Any]:
+    async def _pick_async(self, bound: Optional[int] = None
+                          ) -> Tuple[str, Any]:
         """Async pick: the controller refresh (a blocking RPC) runs on
         the default executor and the no-replica wait is an
         `await asyncio.sleep`, so the caller's event loop keeps serving
@@ -342,7 +472,9 @@ class Router:
         deadline = time.monotonic() + self._PICK_TIMEOUT_S
         while True:
             await loop.run_in_executor(None, self._refresh)
-            chosen = self._try_pick()
+            chosen = self._try_pick(bound)
+            if chosen is self._SHED:
+                self._raise_shed(bound)
             if chosen is not None:
                 return chosen
             if time.monotonic() > deadline:
@@ -385,9 +517,11 @@ class Router:
     def assign(self, meta: RequestMetadata, args, kwargs,
                retries: int = 2) -> DeploymentResponse:
         self._start_metrics_push()
+        self._refresh()
+        bound = self._shed_bound()
         last_err: Optional[Exception] = None
         for _ in range(retries + 1):
-            tag, handle = self._pick()
+            tag, handle = self._pick(bound)
             try:
                 ref = handle.handle_request.remote(
                     meta.to_dict(), list(args), dict(kwargs))
@@ -409,9 +543,11 @@ class Router:
 
         loop = asyncio.get_running_loop()
         self._start_metrics_push()
+        await loop.run_in_executor(None, self._refresh)
+        bound = self._shed_bound()
         last_err: Optional[Exception] = None
         for _ in range(retries + 1):
-            tag, handle = await self._pick_async()
+            tag, handle = await self._pick_async(bound)
             try:
                 ref = await loop.run_in_executor(
                     None, lambda: handle.handle_request.remote(
@@ -432,9 +568,11 @@ class Router:
         from ray_tpu._private.worker import global_worker
 
         self._start_metrics_push()
+        self._refresh()
+        bound = self._shed_bound()
         last_err: Optional[Exception] = None
         for _ in range(retries + 1):
-            tag, handle = self._pick()
+            tag, handle = self._pick(bound)
             stream_id, q = global_worker.open_stream()
             try:
                 ref = handle.handle_request_streaming.remote(

@@ -14,7 +14,8 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Tuple
 
-from .handle import CONTROLLER_NAME, DeploymentHandle, RequestMetadata
+from .handle import (CONTROLLER_NAME, DeploymentHandle, RequestMetadata,
+                     RequestShedError)
 from .http_util import Request, coerce_response
 
 MULTIPLEX_HEADER = "serve_multiplexed_model_id"
@@ -115,6 +116,11 @@ class ProxyActor:
             try:
                 resp = handle._router.assign(meta, args, kwargs)
                 return cp.dumps(resp.result(timeout_s=60.0))
+            except RequestShedError as e:
+                # admission-control shed: RESOURCE_EXHAUSTED is the
+                # retryable overload code (the gRPC twin of the HTTP
+                # handler's 503 + Retry-After)
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             except Exception as e:  # noqa: BLE001 — surface as INTERNAL
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
@@ -128,6 +134,8 @@ class ProxyActor:
                     yield cp.dumps(item)
                 if sresp.kind == "value":  # plain method: one message
                     yield cp.dumps(sresp.value)
+            except RequestShedError as e:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"{type(e).__name__}: {e}")
@@ -250,6 +258,14 @@ class ProxyActor:
                 sresp, first = await loop.run_in_executor(
                     self._pool,
                     self._call_and_open, app, ingress, req, prefix)
+            except RequestShedError as e:
+                # admission control shed: 503 + Retry-After, the
+                # standard backpressure contract for HTTP clients
+                return web.Response(
+                    status=503,
+                    headers={"Retry-After":
+                             str(max(1, int(e.retry_after_s)))},
+                    text=str(e))
             except Exception as e:  # noqa: BLE001 — surface as 500
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
             if first[0] == "value":

@@ -100,6 +100,17 @@ class ChunkFetcher:
         keep a version's bytes at hand across fetchers (prefetch)."""
         return self._cache
 
+    def stats(self) -> Dict[str, int]:
+        """One accounting snapshot (the no-full-copy evidence every
+        consumer of the chunk fabric reports): chunks served locally vs
+        pulled point-to-point, and the pulled bytes split same-host shm
+        vs cross-host RPC."""
+        return {"chunks_local": self.chunks_local,
+                "chunks_fetched": self.chunks_fetched,
+                "fetched_bytes": self.fetched_bytes,
+                "shm_bytes": self.shm_bytes,
+                "rpc_bytes": self.rpc_bytes}
+
     def __call__(self, entry: Dict[str, Any]) -> np.ndarray:
         oid = entry["object_id"]
         arr = self._cache.get(oid)

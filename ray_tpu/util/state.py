@@ -172,6 +172,19 @@ def online_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def disagg_status() -> Dict[str, Any]:
+    """Disaggregated-serving view (serve/disagg.py): per-component stat
+    snapshots grouped by role — prefill servers (prefills, prefix
+    reuse, published transfers/bytes), decode servers (transfers, KV
+    bytes split shm/rpc, adoptions, free slots, prefill-program count —
+    flat on a pure decode replica), routers (dispatched, shed, live and
+    high-water queue depth) — plus cluster totals. The CLI analog is
+    `python -m ray_tpu disagg`; the dashboard serves it at
+    /api/disagg."""
+    return _conductor().conductor.call("get_disagg_status",
+                                       timeout=10.0)
+
+
 def resilience_status() -> Dict[str, Any]:
     """Recovery-subsystem view (ray_tpu.resilience): per-host failure
     scores with quarantine/drain flags, the excluded host list, event

@@ -54,6 +54,13 @@ def pytest_configure(config):
         "delta weight publication); the tier-1-safe smoke subset runs "
         "on a module-scoped virtual-slice cluster with "
         "log_to_driver=0 — select with `-m online`")
+    config.addinivalue_line(
+        "markers", "disagg: disaggregated prefill/decode serving "
+        "scenarios (serve/disagg.py: KV-block streaming over the "
+        "chunk fabric, router admission control, the open-loop load "
+        "harness); everything is tier-1-safe on CPU on a "
+        "module-scoped cluster with log_to_driver=0 — select with "
+        "`-m disagg`")
 
 
 def _sweep_leaked_shm():

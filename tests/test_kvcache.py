@@ -253,7 +253,8 @@ def test_admission_cap_bounds_prefill_bursts(model, monkeypatch):
             got = [f.result(timeout=120) for f in futs]
         for p, g in zip(prompts, got):
             assert g == _reference(model, p, 4), p
-        assert eng.max_admitted_per_tick <= 1
+        assert eng.max_prefills_admitted_per_tick <= 1
+        assert eng.adopted == 0                       # colocated path
     finally:
         eng.stop()
     monkeypatch.setenv("RAY_TPU_MAX_PREFILLS_PER_TICK", "3")

@@ -270,6 +270,49 @@ def test_channel_roundtrip_and_retention(mpmd_cluster):
 
 
 @pytest.mark.mpmd
+def test_channel_prefetch_overlaps_and_keeps_accounting(mpmd_cluster):
+    """prefetch(step, mb, kind) pulls microbatch t+1's chunks in the
+    background (the bubble_wait shrinker): the consuming recv is served
+    from the prefetch (prefetch_hits), payloads stay exact, and the
+    no-full-copy accounting is unchanged — recv bytes == sent bytes,
+    each chunk crossing the plane at most once (the prefetch's fetcher
+    is ADOPTED by the recv, not duplicated)."""
+    from ray_tpu.mpmd.channels import ActivationChannel
+
+    mpmd_cluster.conductor.call("pipeline_open", "chan-prefetch",
+                                {"num_stages": 2}, timeout=10.0)
+    tx = ActivationChannel("chan-prefetch", 0, 1)
+    rx = ActivationChannel("chan-prefetch", 0, 1, stage=1)
+    try:
+        rng = np.random.default_rng(1)
+        payloads = [{"h": rng.standard_normal((8, 16)).astype(
+            np.float32)} for _ in range(3)]
+        sent = 0
+        # prefetch BEFORE the send exists: the background poll must
+        # wait for the sender, not error
+        rx.prefetch(0, 0, "act", timeout=10.0)
+        for mb, p in enumerate(payloads):
+            sent += tx.send(0, mb, "act", p)
+        # mb 1 and 2 prefetched while "computing" mb 0 (already sent:
+        # the fetch itself overlaps)
+        rx.prefetch(0, 1, "act", timeout=10.0)
+        rx.prefetch(0, 2, "act", timeout=10.0)
+        for mb, p in enumerate(payloads):
+            got = rx.recv(0, mb, "act", timeout=10.0)
+            np.testing.assert_array_equal(got["h"], p["h"])
+        assert rx.stats.prefetch_hits == 3
+        assert rx.stats.recv_msgs == 3
+        assert rx.stats.recv_bytes == sent == tx.stats.sent_bytes
+        # prefetch is idempotent per slot and consumed exactly once
+        with pytest.raises(TimeoutError):
+            rx.recv(0, 0, "act", timeout=0.3)
+        assert tx.drain(timeout=5.0) is True
+    finally:
+        tx.close()
+        rx.close()
+
+
+@pytest.mark.mpmd
 def test_channel_generations_do_not_cross(mpmd_cluster):
     """A closed pipeline's stage cannot send (orphaned old gangs fail
     fast), and run_id scopes channel keys so an old generation's
@@ -426,6 +469,10 @@ def test_two_stage_pipeline_matches_dense_reference(mpmd_cluster):
         assert s["steps"] == STEPS
         assert 0.0 <= s["bubble_fraction"] <= 1.0
     assert rec["totals"]["activation_bytes"] > 0
+    # the in-step recvs after the first were prefetched during compute
+    # (run_stage issues prefetch right after every recv)
+    assert sum(s.get("prefetch_hits", 0)
+               for s in rec["stats"].values()) > 0
 
     # merged timeline: per-stage train-step markers carry bubble_wait,
     # and the pipeline lane has one track per stage

@@ -64,6 +64,17 @@ def test_online_package_is_lint_covered():
     assert errors(lint_path(path)) == []
 
 
+def test_disagg_modules_are_lint_covered():
+    """Disaggregated serving (serve/disagg.py) and its load harness
+    (bench_serve.py) are inside the self-lint set: the walk parses
+    them and they carry zero error findings of their own (a
+    rename/move would silently drop them from coverage)."""
+    for rel in (os.path.join("serve", "disagg.py"), "bench_serve.py"):
+        path = os.path.join(PACKAGE_ROOT, rel)
+        assert os.path.exists(path), rel
+        assert errors(lint_path(path)) == [], rel
+
+
 def test_driver_entry_is_clean_too():
     repo_root = os.path.dirname(PACKAGE_ROOT)
     entry = os.path.join(repo_root, "__graft_entry__.py")
