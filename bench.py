@@ -46,7 +46,13 @@ supervisor therefore:
      engine and grafts tokens/s + TTFT p50/p99 + paged-KV prefix hit
      rate into the final record under "serve" — never as the headline,
      so a CPU serve fallback cannot masquerade as the trajectory
-     number. BENCH_SERVE=0 disables.
+     number. BENCH_SERVE=0 disables;
+  8. embeds the step-time oracle's predicted-vs-measured numbers
+     ("oracle": roofline prediction + residual_ratio) and attributes
+     any regression vs the most recent prior BENCH_r*.json to the
+     step_breakdown phase that moved ("regression": {phase, delta_ms,
+     pct}) — never attributing against a record whose headline was a
+     CPU fallback or a failure.
 """
 from __future__ import annotations
 
@@ -341,6 +347,15 @@ def main() -> None:
             sys.exit(INVALID_MEASUREMENT_RC)
     dt = (dt1 + dt2) / 2
 
+    # flight-recorder derivation shared with the oracle harness and the
+    # conductor's train_progress: one record per timing run, summarized
+    # by step_timer.summarize_records instead of re-deriving inline
+    from ray_tpu.observability.step_timer import summarize_records
+
+    run_records = [{"device_step_ms": dt1 / iters * 1e3},
+                   {"device_step_ms": dt2 / iters * 1e3}]
+    device_summary = summarize_records(run_records)["phases"]["device_step"]
+
     tok_per_sec_per_chip = tokens_per_step * iters / dt / n_chips
     flops_per_token = (_model_flops_per_token(cfg)
                        + _attn_flops_per_token(cfg, seq))
@@ -353,6 +368,37 @@ def main() -> None:
             f"chip peak {peak / 1e12:.0f} TFLOP/s (MFU {implied_mfu:.2f}) — "
             "measurement invalid, refusing to report", file=sys.stderr)
         sys.exit(INVALID_MEASUREMENT_RC)
+
+    # Step-time oracle (observability.roofline): the analytic roofline's
+    # predicted-vs-measured for this dp layout, embedded so every BENCH
+    # record names how far reality sat from the model. The dp grad sync
+    # is one psum of the param pytree; on one chip there is no comms
+    # term and the prediction is the pure compute roofline.
+    from ray_tpu.analysis.collectives import CollectiveUse
+    from ray_tpu.analysis.shardcheck import MeshLayout
+    from ray_tpu.observability import roofline
+
+    param_bytes = int(sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params0)
+        if hasattr(x, "size")))
+    grad_sync = [CollectiveUse("psum", ("dp",), param_bytes)] \
+        if n_chips > 1 else []
+    predicted = roofline.predict_step_time(
+        MeshLayout({"dp": n_chips}, name="bench_dp"), grad_sync,
+        flops_per_token * tokens_per_step,
+        _chip_peak(devices[0]) * n_chips,
+        links=roofline.device_link_constants(devices[0]),
+        name="bench_dp")
+    measured_ms = device_summary["mean_ms"]
+    oracle = {
+        "predicted": {k: round(predicted[k], 4) for k in
+                      ("device_step_ms", "ici_wait_ms", "dcn_wait_ms",
+                       "predicted_step_ms")},
+        "measured_device_step_ms": round(measured_ms, 3),
+        "residual_ratio": round(
+            measured_ms / predicted["predicted_step_ms"], 4)
+        if predicted["predicted_step_ms"] > 0 else None,
+    }
 
     print(json.dumps({
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip" if on_tpu
@@ -375,9 +421,11 @@ def main() -> None:
         "step_breakdown": {
             "data_wait_ms": 0.0,
             "compile_ms": round(compile_dt * 1e3, 1),
-            "device_step_ms": round(dt / iters * 1e3, 3),
+            "device_step_ms": round(device_summary["mean_ms"], 3),
+            "device_step_p99_ms": round(device_summary["p99_ms"], 3),
             "mfu": round(implied_flops / _chip_peak(devices[0]), 6),
         },
+        "oracle": oracle,
     }))
 
 
@@ -485,6 +533,78 @@ def _attach_serve(rec: dict, extra_env: dict = None) -> dict:
     rec["serve"] = srec if srec is not None else {"error": serr}
     if srec is None:
         sys.stderr.write(f"bench: serve stage failed ({serr})\n")
+    return rec
+
+
+def _prior_bench_records(bench_dir: str = None):
+    """(filename, record) pairs of prior BENCH_r*.json rounds beside
+    this script, newest round first (by the driver wrapper's "n" round
+    counter — lexical filename order misplaces r100 vs r99). The driver
+    wraps each round's parsed record under "parsed"; bare records are
+    accepted too."""
+    base = bench_dir or os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(base, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(raw, dict):
+            continue
+        parsed = raw.get("parsed") if isinstance(raw.get("parsed"),
+                                                 dict) else raw
+        if isinstance(parsed, dict):
+            n = raw.get("n") if isinstance(raw.get("n"), int) else -1
+            rounds.append((n, os.path.basename(path), parsed))
+    rounds.sort(key=lambda r: (r[0], r[1]), reverse=True)
+    return [(fname, parsed) for _, fname, parsed in rounds]
+
+
+def _attribute_regression(rec: dict, bench_dir: str = None) -> dict:
+    """Perf-regression attribution: diff this run's step_breakdown
+    against the most recent prior BENCH record and name the phase that
+    moved. A record whose headline is a CPU fallback (the r04/r05 lie),
+    a failure, or a different metric is never the baseline; a prior
+    round without a step_breakdown is skipped the same way. regression
+    is None when no phase got slower."""
+    cur = rec.get("step_breakdown")
+    if not isinstance(cur, dict):
+        return rec
+    for fname, prior in _prior_bench_records(bench_dir):
+        if ("cpu_fallback" in prior or "error" in prior
+                or "tpu_error" in prior
+                or not prior.get("value")
+                or prior.get("metric") != rec.get("metric")):
+            continue
+        prev = prior.get("step_breakdown")
+        if not isinstance(prev, dict):
+            continue
+        # phases only: the breakdown also carries summary keys
+        # (device_step_p99_ms) that would double-count their phase and
+        # attribute a "regression" to 2-sample noise
+        phases = ("data_wait", "bubble_wait", "compile", "device_step",
+                  "checkpoint", "report", "other")
+        deltas = {
+            p: float(cur[f"{p}_ms"]) - float(prev[f"{p}_ms"])
+            for p in phases
+            if isinstance(cur.get(f"{p}_ms"), (int, float))
+            and isinstance(prev.get(f"{p}_ms"), (int, float))}
+        if not deltas:
+            continue
+        phase, delta = max(deltas.items(), key=lambda kv: kv[1])
+        rec = dict(rec)
+        if delta <= 0:
+            rec["regression"] = None  # explicitly: nothing got slower
+            return rec
+        base = float(prev.get(f"{phase}_ms") or 0.0)
+        rec["regression"] = {
+            "phase": phase,
+            "delta_ms": round(delta, 3),
+            "pct": round(100.0 * delta / base, 2) if base > 0 else None,
+            "vs": fname,
+        }
+        return rec
     return rec
 
 
@@ -600,11 +720,11 @@ def _supervise() -> int:
                 _save_tuned(best)  # next round starts from the winner
             # serve stage LAST (after the headline is safe on stdout):
             # its record rides inside the final line's "serve" key
-            print(json.dumps(_attach_serve(best)))
+            print(json.dumps(_attach_serve(_attribute_regression(best))))
             return 0
 
     if rec is not None:
-        print(json.dumps(_attach_serve(rec)))
+        print(json.dumps(_attach_serve(_attribute_regression(rec))))
         return 0
 
     sys.stderr.write(f"bench: default-backend run failed ({tpu_err}); "

@@ -255,6 +255,15 @@ class ConductorHandler:
         self._disagg_stats: Dict[str, Dict[str, Any]] = {}
         self._disagg_events: List[Dict[str, Any]] = []
 
+        # Step-time oracle (observability.roofline): predicted step-time
+        # breakdowns keyed by layout + predicted-vs-measured validation
+        # records (residuals, fitted calibration). One aggregate feeds
+        # util.state.oracle_status(), `ray_tpu oracle`, /api/oracle, and
+        # the merged timeline's predicted-step-time counter track.
+        self._oracle_predictions: Dict[str, Dict[str, Any]] = {}
+        self._oracle_validations: List[Dict[str, Any]] = []
+        self._oracle_events: List[Dict[str, Any]] = []
+
         # MPMD pipelines (ray_tpu.mpmd): stage registry (a pipeline
         # flips "formed" atomically when its LAST stage registers —
         # the weights-fragment commit pattern) + the channel mailbox.
@@ -1834,6 +1843,91 @@ class ConductorHandler:
                           ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._disagg_events[-limit:]
+
+    # ------------------------------------------------- step-time oracle
+    # observability.roofline pushes layout predictions and validation
+    # records here; util.state.oracle_status(), `ray_tpu oracle`, and
+    # the dashboard /api/oracle all read the same aggregate so every
+    # surface reports one set of numbers. Events feed the merged
+    # timeline's predicted-step-time counter track.
+
+    _ORACLE_PREDICTIONS_KEPT = 256
+    _ORACLE_VALIDATIONS_KEPT = 1024
+    _ORACLE_EVENTS_KEPT = 10_000
+
+    def _oracle_event_locked(self, event: Dict[str, Any]) -> None:
+        event.setdefault("ts", time.time())
+        self._oracle_events.append(event)
+        if len(self._oracle_events) > self._ORACLE_EVENTS_KEPT:
+            del self._oracle_events[
+                :len(self._oracle_events) - self._ORACLE_EVENTS_KEPT]
+
+    def report_oracle_prediction(self, worker_id: str, layout: str,
+                                 prediction: Dict[str, Any]) -> None:
+        if not isinstance(prediction, dict):
+            return
+        with self._lock:
+            rec = dict(prediction, layout=str(layout),
+                       worker_id=worker_id, ts=time.time())
+            self._oracle_predictions[str(layout)] = rec
+            while len(self._oracle_predictions) > \
+                    self._ORACLE_PREDICTIONS_KEPT:
+                oldest = min(self._oracle_predictions,
+                             key=lambda k:
+                             self._oracle_predictions[k].get("ts", 0.0))
+                del self._oracle_predictions[oldest]
+            self._oracle_event_locked(dict(
+                kind="prediction", layout=str(layout),
+                predicted_step_ms=prediction.get("predicted_step_ms"),
+                device_step_ms=prediction.get("device_step_ms"),
+                ici_wait_ms=prediction.get("ici_wait_ms"),
+                dcn_wait_ms=prediction.get("dcn_wait_ms")))
+
+    def report_oracle_validation(self, worker_id: str,
+                                 rec: Dict[str, Any]) -> None:
+        if not isinstance(rec, dict):
+            return
+        with self._lock:
+            rec = dict(rec, worker_id=worker_id, ts=time.time())
+            self._oracle_validations.append(rec)
+            if len(self._oracle_validations) > \
+                    self._ORACLE_VALIDATIONS_KEPT:
+                del self._oracle_validations[
+                    :len(self._oracle_validations)
+                    - self._ORACLE_VALIDATIONS_KEPT]
+            self._oracle_event_locked(dict(
+                kind="validation", layout=rec.get("layout"),
+                run_id=rec.get("run_id"),
+                calibration=rec.get("calibration"),
+                residuals=rec.get("residuals"),
+                n_steps=rec.get("n_steps")))
+
+    def get_oracle_status(self) -> Dict[str, Any]:
+        """One aggregate for every oracle surface: the latest prediction
+        per layout, the validation tail, and totals (counts + the last
+        fitted calibration and its worst phase residual)."""
+        with self._lock:
+            preds = {k: dict(v)
+                     for k, v in self._oracle_predictions.items()}
+            vals = [dict(v) for v in self._oracle_validations[-100:]]
+            n_validations = len(self._oracle_validations)
+        last = vals[-1] if vals else {}
+        residuals = last.get("residuals") or {}
+        totals: Dict[str, Any] = {
+            "layouts": len(preds),
+            "validations": n_validations,
+            "last_calibration": last.get("calibration"),
+            "worst_residual_ratio": max(
+                (float(r) for r in residuals.values()), default=None,
+                key=lambda r: abs(r - 1.0)),
+        }
+        return {"predictions": preds, "validations": vals,
+                "totals": totals}
+
+    def get_oracle_events(self, limit: int = 10_000
+                          ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._oracle_events[-limit:]
 
     # ------------------------------------------------------ MPMD pipelines
     # ray_tpu.mpmd: stage registry, channel mailbox, per-stage stats and

@@ -61,8 +61,10 @@ _LAZY = {
     "estimate_training_dcn_traffic": "collectives",
     "scan_collectives": "collectives",
     "BUILTIN_LAYOUTS": "layouts",
+    "LayoutTrace": "layouts",
     "analyze_builtin_layouts": "layouts",
     "analyze_layout": "layouts",
+    "trace_builtin_layouts": "layouts",
 }
 
 

@@ -53,7 +53,14 @@ _COST_MODEL: Dict[str, Callable[[float, int, int], float]] = {
     "ppermute": lambda b, n, d: float(b),
     "pmin": lambda b, n, d: 2.0 * b * (d - 1) / d,
     "pmax": lambda b, n, d: 2.0 * b * (d - 1) / d,
+    # jax 0.4.x traces psum as psum2 under check_rep — same ring cost
+    "psum2": lambda b, n, d: 2.0 * b * (d - 1) / d,
 }
+
+# Named-axis primitives that move no payload (replication/VMA
+# bookkeeping and index queries; pbroadcast is jax 0.4.x's check_rep
+# marker, pvary the newer name): never collected, never costed.
+_NON_COMM = frozenset({"pvary", "pbroadcast", "axis_index"})
 
 
 @dataclass(frozen=True)
@@ -64,15 +71,46 @@ class CollectiveUse:
     axes: Tuple[str, ...]
     in_bytes: int
 
-    def dcn_bytes(self, layout: MeshLayout) -> float:
+    def modeled(self) -> bool:
+        """False for a collective the cost table doesn't cover — its
+        byte estimates fall back to the raw input size (an upper-ish
+        bound with no ring discount), and `check_collectives` emits an
+        `unmodeled-collective` INFO finding naming it so oracle
+        predictions surface the blind spot instead of absorbing it."""
+        return self.primitive in _COST_MODEL
+
+    def spans(self, layout: MeshLayout) -> Tuple[int, int]:
+        """(n, d): total participant count over this use's axes and its
+        DCN span (1 = entirely on ICI)."""
         n = int(np.prod([layout.axis_size(a) for a in self.axes],
                         dtype=np.int64)) or 1
         d = int(np.prod([layout.dcn_factor(a) for a in self.axes],
                         dtype=np.int64)) or 1
-        if d <= 1:
-            return 0.0
+        return n, d
+
+    def link_bytes(self, layout: MeshLayout) -> Tuple[float, float]:
+        """(ici_bytes, dcn_bytes): the per-chip ring traffic split by
+        link class — one spans() evaluation for both shares (the
+        oracle's comms numerators)."""
+        n, d = self.spans(layout)
+        if n <= 1:
+            return 0.0, 0.0
+        total = self._ring_share(n, n)  # span=n makes every hop count
+        dcn = self._ring_share(n, d) if d > 1 else 0.0
+        return max(0.0, total - dcn), dcn
+
+    def dcn_bytes(self, layout: MeshLayout) -> float:
+        return self.link_bytes(layout)[1]
+
+    def ring_bytes(self, layout: MeshLayout) -> float:
+        """Total per-chip ring traffic over ALL links."""
+        ici, dcn = self.link_bytes(layout)
+        return ici + dcn
+
+    def _ring_share(self, n: int, span: int) -> float:
         model = _COST_MODEL.get(self.primitive)
-        return model(self.in_bytes, n, d) if model else float(self.in_bytes)
+        return model(self.in_bytes, n, span) if model \
+            else float(self.in_bytes)
 
 
 def _axis_names(params: Dict[str, Any]) -> Tuple[str, ...]:
@@ -89,25 +127,35 @@ def _walk_jaxpr(jaxpr: Any, out: List[CollectiveUse]) -> None:
         from jax.extend.core import ClosedJaxpr, Jaxpr
     except ImportError:  # jax < 0.4.38
         from jax.core import ClosedJaxpr, Jaxpr
+    def _sub_jaxprs(params):
+        subs = []
+        for v in params.values():
+            for item in v if isinstance(v, (tuple, list)) else (v,):
+                if isinstance(item, ClosedJaxpr):
+                    subs.append(item.jaxpr)
+                elif isinstance(item, Jaxpr):
+                    subs.append(item)
+        return subs
+
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
-        if name in _COST_MODEL:
+        subs = _sub_jaxprs(eqn.params)
+        # Any primitive carrying named mesh axes is a collective to the
+        # walker — including ones the cost table does not model yet
+        # (collected so `check_collectives` can NAME the blind spot
+        # instead of the byte estimate silently falling back). Call-like
+        # primitives (pjit / scan / xla_pmap — anything wrapping a
+        # sub-jaxpr) are NOT collectives even when they carry an
+        # axis_name: their bodies are priced by the recursion below,
+        # counting the wrapper too would double-charge the whole input.
+        if name not in _NON_COMM and not subs:
             axes = _axis_names(eqn.params)
             if axes:
                 nbytes = sum(_nbytes(v.aval) for v in eqn.invars
                              if hasattr(v, "aval"))
                 out.append(CollectiveUse(name, axes, nbytes))
-        for v in eqn.params.values():
-            if isinstance(v, ClosedJaxpr):
-                _walk_jaxpr(v.jaxpr, out)
-            elif isinstance(v, Jaxpr):
-                _walk_jaxpr(v, out)
-            elif isinstance(v, (tuple, list)):
-                for item in v:
-                    if isinstance(item, ClosedJaxpr):
-                        _walk_jaxpr(item.jaxpr, out)
-                    elif isinstance(item, Jaxpr):
-                        _walk_jaxpr(item, out)
+        for sub in subs:
+            _walk_jaxpr(sub, out)
 
 
 def scan_collectives(fn: Callable, *abstract_args: Any,
@@ -157,6 +205,14 @@ def check_collectives(layout: MeshLayout, uses: Sequence[CollectiveUse],
     findings: List[Finding] = []
     loc = where or layout.name
     for use in uses:
+        if not use.modeled():
+            findings.append(Finding(
+                "unmodeled-collective", INFO, loc,
+                f"{use.primitive} over {use.axes} has no entry in the "
+                "collective cost model — byte estimates fall back to "
+                f"its raw input size ({_fmt_bytes(float(use.in_bytes))})"
+                " and oracle step-time predictions treat it as opaque",
+                "add the primitive to analysis.collectives._COST_MODEL"))
         dcn_axes = [a for a in use.axes if layout.dcn_factor(a) > 1]
         if not dcn_axes:
             continue

@@ -33,6 +33,9 @@ RULES: Dict[str, str] = {
         "large param fully replicated on every device (HBM blow-up)",
     "collective-over-dcn":
         "bandwidth-heavy collective spans a slow DCN axis",
+    "unmodeled-collective":
+        "collective primitive without a cost-model entry; byte and "
+        "step-time estimates fall back to its raw input size",
     "pipeline-bubble":
         "pipeline schedule's analytic bubble fraction (S-1)/(M+S-1); "
         "warning past 20%",

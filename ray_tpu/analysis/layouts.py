@@ -13,15 +13,30 @@ come back clean.
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..parallel.mesh import MeshConfig
 from ..parallel.multislice import HybridMeshConfig
-from .collectives import (abstract_mesh, check_collectives,
+from .collectives import (CollectiveUse, abstract_mesh, check_collectives,
                           estimate_training_dcn_traffic, scan_collectives)
 from .findings import Finding, INFO
 from .shardcheck import (DEFAULT_REPLICATED_THRESHOLD, MeshLayout,
-                         check_specs)
+                         _nbytes, check_specs)
+
+
+@dataclass
+class LayoutTrace:
+    """One dryrun layout's oracle inputs: the deviceless mesh layout,
+    the traced collectives, and rough analytic work terms. The roofline
+    model (observability.roofline) prices these; the findings-based
+    analyzers below reuse the same traces so both surfaces describe one
+    program."""
+
+    layout: MeshLayout
+    uses: List[CollectiveUse] = field(default_factory=list)
+    flops_per_step: float = 0.0
+    tokens_per_step: int = 0
 
 
 def analyze_layout(config: MeshConfig, n_devices: int,
@@ -109,35 +124,78 @@ def analyze_dcn_dp_tp(n_devices: int = 8,
         replicated_threshold=replicated_threshold, name="dcn_dp_tp")
 
 
-def _pipeline_findings(config: MeshConfig, n_devices: int,
-                       num_slices: int, pp: int, data_parallel: int,
-                       name: str) -> List[Finding]:
-    """Trace the GPipe pipeline over an abstract mesh and lint its
-    collectives (ppermute ring + final-stage psum over 'pp') plus the
-    schedule's analytic bubble estimate (rule pipeline-bubble). The
-    microbatch count follows the M = 4*S sizing rule, so the builtin
-    layouts' own estimates stay at INFO."""
+def _trace_dcn_dp_tp(n_devices: int = 8) -> LayoutTrace:
+    """Oracle inputs for the hybrid GPT-2 training layout. The data-
+    parallel gradient sync IS a psum of the full param pytree over the
+    data axes (the same model `estimate_training_dcn_traffic` prices),
+    so it appears here as one explicit CollectiveUse."""
+    import jax
+
+    from ..models.gpt2 import GPT2Config
+    from ..observability.flops import train_flops_per_token
+
+    cfg = GPT2Config.tiny()
+    seq = 32
+    layout = MeshLayout.from_config(HybridMeshConfig(dp=-1, tp=2,
+                                                     dcn_dp=2),
+                                    n_devices, num_slices=2,
+                                    name="dcn_dp_tp")
+    param_bytes = sum(_nbytes(leaf) for leaf in
+                      jax.tree_util.tree_leaves(_abstract_gpt2(cfg)))
+    tokens = 2 * (n_devices // 2) * seq
+    return LayoutTrace(
+        layout=layout,
+        uses=[CollectiveUse("psum", ("dp", "fsdp"), param_bytes)],
+        flops_per_step=train_flops_per_token(cfg, seq) * tokens,
+        tokens_per_step=tokens)
+
+
+def _trace_pipeline(config: MeshConfig, n_devices: int,
+                    num_slices: int, pp: int, data_parallel: int,
+                    name: str) -> LayoutTrace:
+    """Trace the toy GPipe pipeline (ppermute ring + final-stage psum
+    over 'pp') over an abstract mesh. Empty uses when this jax has no
+    AbstractMesh."""
     import jax.numpy as jnp
 
     from ..parallel.pipeline import make_pipeline_fn
-    from .pipelines import check_pipeline_schedule
 
     m = 4 * pp
-    findings = check_pipeline_schedule(pp, m, "gpipe",
-                                       where=f"{name}/schedule")
     layout = MeshLayout.from_config(config, n_devices, num_slices,
                                     name=name)
     mesh = abstract_mesh(layout)
-    if mesh is None:  # jax without AbstractMesh: nothing to trace
-        return findings + [Finding(
-            "collective-over-dcn", INFO, f"{name}/collectives",
-            "collective scan skipped: this jax has no AbstractMesh")]
     d, batch = 16, data_parallel * m
+    # toy tanh-matmul "model": ~6 flops per param per row (fwd+bwd)
+    flops = 6.0 * (pp * d * d + pp * d) * batch
+    if mesh is None:  # jax without AbstractMesh: nothing to trace
+        return LayoutTrace(layout=layout, flops_per_step=flops,
+                           tokens_per_step=batch)
     pipe = make_pipeline_fn(
         lambda p, h: jnp.tanh(h @ p[0] + p[1]), mesh, num_microbatches=m)
     params = (_sds((pp, d, d)), _sds((pp, d)))
     uses = scan_collectives(pipe, params, _sds((batch, d)))
-    return findings + check_collectives(layout, uses,
+    return LayoutTrace(layout=layout, uses=uses, flops_per_step=flops,
+                       tokens_per_step=batch)
+
+
+def _pipeline_findings(config: MeshConfig, n_devices: int,
+                       num_slices: int, pp: int, data_parallel: int,
+                       name: str) -> List[Finding]:
+    """Lint the traced GPipe pipeline's collectives plus the schedule's
+    analytic bubble estimate (rule pipeline-bubble). The microbatch
+    count follows the M = 4*S sizing rule, so the builtin layouts' own
+    estimates stay at INFO."""
+    from .pipelines import check_pipeline_schedule
+
+    findings = check_pipeline_schedule(pp, 4 * pp, "gpipe",
+                                       where=f"{name}/schedule")
+    trace = _trace_pipeline(config, n_devices, num_slices, pp,
+                            data_parallel, name)
+    if not trace.uses:  # jax without AbstractMesh: nothing was traced
+        return findings + [Finding(
+            "collective-over-dcn", INFO, f"{name}/collectives",
+            "collective scan skipped: this jax has no AbstractMesh")]
+    return findings + check_collectives(trace.layout, trace.uses,
                                         where=f"{name}/collectives")
 
 
@@ -159,8 +217,8 @@ def analyze_dp_pp(n_devices: int = 8, **_) -> List[Finding]:
                               name="dp_pp")
 
 
-def analyze_dp_sp(n_devices: int = 8, **_) -> List[Finding]:
-    """The dryrun's dp x sp ring-attention layout (ppermute over 'sp')."""
+def _trace_dp_sp(n_devices: int = 8) -> LayoutTrace:
+    """The dryrun's dp x sp ring-attention trace (ppermute over 'sp')."""
     from jax.sharding import PartitionSpec as P
 
     from ..ops.ring_attention import ring_attention
@@ -170,20 +228,34 @@ def analyze_dp_sp(n_devices: int = 8, **_) -> List[Finding]:
     dp = max(1, n_devices // sp)
     layout = MeshLayout.from_config(MeshConfig(dp=dp, sp=sp), n_devices,
                                     name="dp_sp")
+    batch, seq, heads, hd = 2 * dp, 32, 4, 8
+    # causal attention score+value matmuls, fwd only: 2·B·T²·H·hd
+    flops = 2.0 * batch * seq * seq * heads * hd
     mesh = abstract_mesh(layout)
     if mesh is None:
-        return []
+        return LayoutTrace(layout=layout, flops_per_step=flops,
+                           tokens_per_step=batch * seq)
     ring = shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=True),
         mesh=mesh, in_specs=(P("dp", "sp"),) * 3,
         out_specs=P("dp", "sp"), check_vma=False)
-    qkv = _sds((2 * dp, 32, 4, 8))
+    qkv = _sds((batch, seq, heads, hd))
     uses = scan_collectives(ring, qkv, qkv, qkv)
-    return check_collectives(layout, uses, where="dp_sp/collectives")
+    return LayoutTrace(layout=layout, uses=uses, flops_per_step=flops,
+                       tokens_per_step=batch * seq)
 
 
-def analyze_dp_ep(n_devices: int = 8, **_) -> List[Finding]:
-    """The dryrun's dp x ep MoE layout (all_to_all over 'ep')."""
+def analyze_dp_sp(n_devices: int = 8, **_) -> List[Finding]:
+    """The dryrun's dp x sp ring-attention layout (ppermute over 'sp')."""
+    trace = _trace_dp_sp(n_devices)
+    if not trace.uses:
+        return []
+    return check_collectives(trace.layout, trace.uses,
+                             where="dp_sp/collectives")
+
+
+def _trace_dp_ep(n_devices: int = 8) -> LayoutTrace:
+    """The dryrun's dp x ep MoE trace (all_to_all over 'ep')."""
     from jax.sharding import PartitionSpec as P
 
     from ..ops import moe_ffn
@@ -193,19 +265,33 @@ def analyze_dp_ep(n_devices: int = 8, **_) -> List[Finding]:
     dp = max(1, n_devices // ep)
     layout = MeshLayout.from_config(MeshConfig(dp=dp, ep=ep), n_devices,
                                     name="dp_ep")
+    t_local, d, f, e, k = 8, 16, 32, 8, 2
+    tokens = dp * ep * t_local
+    # top_k experts x 3 matmuls (gate/up/down) x 2·d·f, fwd only
+    flops = 6.0 * d * f * k * tokens
     mesh = abstract_mesh(layout)
     if mesh is None:
-        return []
-    t_local, d, f, e, k = 8, 16, 32, 8, 2
+        return LayoutTrace(layout=layout, flops_per_step=flops,
+                           tokens_per_step=tokens)
     fn = shard_map(
         functools.partial(moe_ffn, top_k=k, capacity_factor=float(e),
                           axis_name="ep"),
         mesh=mesh, in_specs=(P(("dp", "ep")), P(), P("ep"), P("ep")),
         out_specs=P(("dp", "ep")), check_vma=False)
-    uses = scan_collectives(fn, _sds((dp * ep * t_local, d)),
+    uses = scan_collectives(fn, _sds((tokens, d)),
                             _sds((d, e)), _sds((e, d, f)),
                             _sds((e, f, d)))
-    return check_collectives(layout, uses, where="dp_ep/collectives")
+    return LayoutTrace(layout=layout, uses=uses, flops_per_step=flops,
+                       tokens_per_step=tokens)
+
+
+def analyze_dp_ep(n_devices: int = 8, **_) -> List[Finding]:
+    """The dryrun's dp x ep MoE layout (all_to_all over 'ep')."""
+    trace = _trace_dp_ep(n_devices)
+    if not trace.uses:
+        return []
+    return check_collectives(trace.layout, trace.uses,
+                             where="dp_ep/collectives")
 
 
 BUILTIN_LAYOUTS: Dict[str, Callable[..., List[Finding]]] = {
@@ -225,6 +311,28 @@ def analyze_builtin_layouts(
     return {name: fn(n_devices) for name, fn in BUILTIN_LAYOUTS.items()}
 
 
-__all__ = ["BUILTIN_LAYOUTS", "analyze_builtin_layouts", "analyze_layout",
-           "analyze_dcn_dp_tp", "analyze_dcn_pp_fsdp", "analyze_dp_ep",
-           "analyze_dp_pp", "analyze_dp_sp"]
+def trace_builtin_layouts(n_devices: int = 8) -> Dict[str, LayoutTrace]:
+    """Oracle inputs (layout + traced collectives + rough work terms)
+    for every built-in dryrun layout — the backend of
+    ``observability.roofline.predict_builtin_layouts`` and
+    ``ray_tpu analyze --predict-step-time``."""
+    fsdp = n_devices // 2
+    pp_flat = 4
+    return {
+        "dcn_dp_tp": _trace_dcn_dp_tp(n_devices),
+        "dcn_pp_fsdp": _trace_pipeline(
+            HybridMeshConfig(fsdp=fsdp, dcn_pp=2), n_devices,
+            num_slices=2, pp=2, data_parallel=fsdp, name="dcn_pp_fsdp"),
+        "dp_pp": _trace_pipeline(
+            MeshConfig(dp=max(1, n_devices // pp_flat), pp=pp_flat),
+            n_devices, num_slices=1, pp=pp_flat,
+            data_parallel=max(1, n_devices // pp_flat), name="dp_pp"),
+        "dp_sp": _trace_dp_sp(n_devices),
+        "dp_ep": _trace_dp_ep(n_devices),
+    }
+
+
+__all__ = ["BUILTIN_LAYOUTS", "LayoutTrace", "analyze_builtin_layouts",
+           "analyze_layout", "analyze_dcn_dp_tp", "analyze_dcn_pp_fsdp",
+           "analyze_dp_ep", "analyze_dp_pp", "analyze_dp_sp",
+           "trace_builtin_layouts"]

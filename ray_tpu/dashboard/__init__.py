@@ -35,6 +35,11 @@ Routes:
                          accounting (shm vs rpc), router shed/queue
                          depth, recent kv_publish/kv_transfer/shed
                          events (serve/disagg.py)
+  /api/oracle            step-time oracle: roofline predictions per
+                         layout (device/ici/dcn breakdown),
+                         predicted-vs-measured validations (residuals,
+                         fitted calibration), recent prediction/
+                         validation events (observability.roofline)
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -186,6 +191,17 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def oracle(self) -> Dict[str, Any]:
+        """Step-time-oracle aggregate + the recent event tail (one
+        payload so the SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_oracle_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_oracle_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
         the actors-table drill-down."""
@@ -299,6 +315,7 @@ class DashboardServer:
         app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
         app.router.add_get("/api/online", self._json_route(d.online))
         app.router.add_get("/api/disagg", self._json_route(d.disagg))
+        app.router.add_get("/api/oracle", self._json_route(d.oracle))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

@@ -14,6 +14,10 @@ layer answers the ML questions a TPU runtime must answer natively:
   ``/api/train`` and ``python -m ray_tpu train-status``)
 - how does it all line up in time?      -> ``timeline`` (one merged
   chrome trace: driver spans, worker task events, step markers)
+- what SHOULD this step have cost?      -> ``roofline`` (the step-time
+  oracle: per-generation ICI/DCN link constants + the compute roofline
+  turn a layout's traced collectives into a predicted step-time
+  breakdown, validated against flight-recorder measurements)
 """
 from .flops import (  # noqa: F401
     NOMINAL_PEAK_FLOPS,
@@ -28,5 +32,21 @@ from .flops import (  # noqa: F401
     train_flops_per_token,
 )
 from .gang import find_stragglers, step_skew, summarize_run  # noqa: F401
-from .step_timer import PHASES, StepTimer, telemetry_enabled  # noqa: F401
+from .roofline import (  # noqa: F401
+    LINK_CONSTANTS,
+    LinkConstants,
+    NOMINAL_LINK_CONSTANTS,
+    calibration_fit,
+    device_link_constants,
+    predict_builtin_layouts,
+    predict_step_time,
+    validate_records,
+    validate_run,
+)
+from .step_timer import (  # noqa: F401
+    PHASES,
+    StepTimer,
+    summarize_records,
+    telemetry_enabled,
+)
 from .timeline import merged_chrome_trace, merged_timeline  # noqa: F401

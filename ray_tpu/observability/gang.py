@@ -18,6 +18,9 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional
 
+from .step_timer import percentile as _percentile
+from .step_timer import summarize_records
+
 DEFAULT_STRAGGLER_K = 1.5
 STRAGGLER_WINDOW = 20          # trailing steps examined
 STRAGGLER_MIN_FRACTION = 0.6   # slow in >= this fraction of window steps
@@ -36,13 +39,6 @@ def _duration_ms(rec: Dict[str, Any]) -> Optional[float]:
     (host-side data stalls are a different pathology), else total."""
     d = rec.get("device_step_ms") or 0.0
     return d if d > 0 else rec.get("total_ms")
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
 
 
 def step_skew(by_rank: Dict[int, Dict[str, Any]]) -> Dict[str, float]:
@@ -134,11 +130,19 @@ def summarize_run(steps: Dict[int, Dict[int, Dict[str, Any]]],
         out["last_step_skew"] = step_skew(steps[last_step])
         # headline breakdown: the latest step's lowest reporting rank
         by_rank = steps[last_step]
-        lead = by_rank[min(by_rank)]
+        lead_rank = min(by_rank)
+        lead = by_rank[lead_rank]
         out["last_step_breakdown"] = {
             key: lead[key] for key in
             ("data_wait_ms", "bubble_wait_ms", "compile_ms",
              "device_step_ms", "checkpoint_ms", "report_ms", "other_ms",
              "total_ms")
             if key in lead}
+        # per-phase p50/p99 + trailing EMA over the lead rank's buffered
+        # window — the shared step_timer.summarize_records derivation
+        # (also used by the oracle validation harness and bench), so
+        # train_progress consumers stop re-deriving it from raw records
+        lead_recs = [steps[s][lead_rank] for s in sorted(steps)
+                     if lead_rank in steps[s]]
+        out["phase_summary"] = summarize_records(lead_recs)["phases"]
     return out

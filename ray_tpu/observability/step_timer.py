@@ -47,6 +47,48 @@ def telemetry_enabled() -> bool:
     return os.environ.get("RAY_TPU_STEP_TELEMETRY", "1") != "0"
 
 
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an ascending list — THE percentile
+    of the flight-recorder stack (gang aggregation, summarize_records,
+    the oracle validation harness all share it)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+_EMA_ALPHA = 0.3  # trailing EMA weight of the newest step
+
+
+def summarize_records(records, ema_alpha: float = _EMA_ALPHA
+                      ) -> Dict[str, Any]:
+    """Per-phase summary over a window of step records (the StepTimer
+    record schema: ``<phase>_ms`` keys plus ``other_ms``/``total_ms``):
+    mean / p50 / p99 plus a trailing EMA in record order — the ONE
+    derivation shared by the oracle validation harness, the conductor's
+    train_progress aggregation, and bench.py, instead of each
+    re-deriving stats from raw records."""
+    phases: Dict[str, Dict[str, float]] = {}
+    for name in (*PHASES, "other", "total"):
+        key = f"{name}_ms"
+        vals = [float(r[key]) for r in records
+                if isinstance(r.get(key), (int, float))]
+        if not vals:
+            continue
+        ordered = sorted(vals)
+        ema = vals[0]
+        for v in vals[1:]:
+            ema = ema_alpha * v + (1.0 - ema_alpha) * ema
+        phases[name] = {
+            "mean_ms": sum(vals) / len(vals),
+            "p50_ms": percentile(ordered, 0.5),
+            "p99_ms": percentile(ordered, 0.99),
+            "ema_ms": ema,
+            "last_ms": vals[-1],
+        }
+    return {"steps": len(records), "phases": phases}
+
+
 class _NoopCM:
     __slots__ = ()
 

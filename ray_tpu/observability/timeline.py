@@ -43,6 +43,11 @@ merges and labels them:
                  KV transfers with their shm/rpc byte split, and
                  router sheds, so cross-replica KV traffic lines up
                  against request latency and the kvcache lane.
+- oracle:        pid = "oracle" — a predicted-step-time COUNTER track
+                 (one "C" series per layout, observability.roofline)
+                 that draws the analytic roofline under the measured
+                 train-step markers, plus instant validation markers
+                 carrying the fitted calibration and residuals.
 """
 from __future__ import annotations
 
@@ -236,6 +241,47 @@ def disagg_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def oracle_trace_events(events: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """The step-time oracle's track (observability.roofline): every
+    prediction event becomes a point on a per-layout ``predicted_step_ms``
+    counter series under pid "oracle" (the analytic roofline drawn under
+    the measured train-step markers); validation events become instant
+    markers carrying calibration + residuals."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        layout = ev.get("layout")
+        if kind == "prediction":
+            pred = ev.get("predicted_step_ms")
+            if pred is None:
+                continue
+            out.append({
+                "name": f"predicted_step_ms:{layout}" if layout
+                else "predicted_step_ms",
+                "cat": "oracle", "ph": "C", "ts": ts * 1e6,
+                "pid": "oracle",
+                "args": {"predicted_step_ms": round(float(pred), 3)},
+            })
+            continue
+        label = kind
+        if layout:
+            label += f":{layout}"
+        cal = ev.get("calibration")
+        if cal is not None:
+            label += f" cal={float(cal):.2f}"
+        out.append({
+            "name": label, "cat": "oracle", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "oracle", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -270,6 +316,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         online_events: Optional[
                             List[Dict[str, Any]]] = None,
                         disagg_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        oracle_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -290,6 +338,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(online_trace_events(online_events))
     if disagg_events:
         trace.extend(disagg_trace_events(disagg_events))
+    if oracle_events:
+        trace.extend(oracle_trace_events(oracle_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -337,8 +387,12 @@ def merged_timeline(filename: Optional[str] = None,
         dev = w.conductor.call("get_disagg_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-disagg conductor
         dev = []
+    try:
+        orev = w.conductor.call("get_oracle_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-oracle conductor
+        orev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev, oev, dev)
+                                pev, oev, dev, orev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -541,6 +541,57 @@ def cmd_disagg(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_oracle(args) -> None:
+    """`ray_tpu oracle` — step-time oracle view (observability.roofline):
+    the latest roofline prediction per layout, the predicted-vs-measured
+    validation tail (per-phase residuals, fitted calibration), plus the
+    totals every other surface (state API, /api/oracle, Prometheus,
+    timeline counter track) reports from the same aggregate."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.oracle_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    preds = st.get("predictions") or {}
+    vals = st.get("validations") or []
+    if not preds and not vals:
+        print("no oracle telemetry recorded (run `ray_tpu analyze "
+              "--predict-step-time` or roofline.record_prediction / "
+              "validate_run)")
+        return
+    totals = st.get("totals") or {}
+    cal = totals.get("last_calibration")
+    worst = totals.get("worst_residual_ratio")
+    print(f"totals: layouts={totals.get('layouts', 0)} "
+          f"validations={totals.get('validations', 0)}"
+          + (f" last_calibration={cal:.3f}" if cal is not None else "")
+          + (f" worst_residual={worst:.2f}x" if worst is not None
+             else ""))
+    for layout, p in sorted(preds.items()):
+        print(f"  {layout}: predicted="
+              f"{p.get('predicted_step_ms', 0.0):.3f}ms "
+              f"(device={p.get('device_step_ms', 0.0):.3f} "
+              f"ici={p.get('ici_wait_ms', 0.0):.3f} "
+              f"dcn={p.get('dcn_wait_ms', 0.0):.3f}) "
+              f"dcn_bytes={p.get('dcn_bytes', 0):.0f}"
+              + (" UNMODELED:" + ",".join(p["unmodeled_collectives"])
+                 if p.get("unmodeled_collectives") else ""))
+    for v in vals[-5:]:
+        res = " ".join(f"{k}={r:.2f}x" for k, r
+                       in (v.get("residuals") or {}).items())
+        print(f"  validation run={v.get('run_id')} "
+              f"layout={v.get('layout')} steps={v.get('n_steps')} "
+              f"calibration={v.get('calibration', 1.0):.3f} {res}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_oracle_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_metrics(args) -> None:
     _connect(args)
     from ray_tpu.util import state
@@ -625,6 +676,23 @@ def cmd_analyze(args) -> None:
             os.environ["JAX_PLATFORMS"] = prev_platform
 
 
+def _format_predictions(preds: dict) -> str:
+    lines = ["predicted step time per layout (roofline, "
+             "compile-excluded; observability.roofline):"]
+    for name, p in sorted(preds.items()):
+        extra = ""
+        if p.get("unmodeled_collectives"):
+            extra = (" [unmodeled: "
+                     + ", ".join(p["unmodeled_collectives"]) + "]")
+        lines.append(
+            f"  {name:<14} {p['predicted_step_ms']:>10.4f} ms  "
+            f"(device {p['device_step_ms']:.4f} + "
+            f"ici {p['ici_wait_ms']:.4f} + "
+            f"dcn {p['dcn_wait_ms']:.4f})  "
+            f"dcn {p['dcn_bytes'] / 2 ** 20:.2f} MiB/step{extra}")
+    return "\n".join(lines)
+
+
 def _run_analyze(args) -> None:
     from ray_tpu import analysis
 
@@ -641,7 +709,9 @@ def _run_analyze(args) -> None:
         if not os.path.exists(p):
             raise SystemExit(f"no such file or directory: {p}")
         findings.extend(analysis.lint_path(p))
-    if args.layouts:
+    predict = getattr(args, "predict_step_time", False)
+    predictions = None
+    if args.layouts or predict:
         # If jax first loads HERE, it initializes under our forced
         # JAX_PLATFORMS=cpu — its config value is our pin, not the
         # caller's, so restore to None (auto-detect), not to `prev`.
@@ -654,15 +724,31 @@ def _run_analyze(args) -> None:
         prev = jax.config.jax_platforms if jax_preloaded else None
         jax.config.update("jax_platforms", "cpu")
         try:
-            for name, fs in analysis.analyze_builtin_layouts().items():
-                findings.extend(fs)
+            if args.layouts:
+                for name, fs in \
+                        analysis.analyze_builtin_layouts().items():
+                    findings.extend(fs)
+            if predict:
+                from ray_tpu.observability import roofline
+
+                predictions = roofline.predict_builtin_layouts()
         finally:
             jax.config.update("jax_platforms", prev)
+    sorted_findings = [f.to_dict() for f in
+                       analysis.sort_findings(findings)]
     if args.json:
-        print(json.dumps([f.to_dict() for f in
-                          analysis.sort_findings(findings)], indent=2))
+        # plain --json keeps the historical bare findings list; the
+        # predictions ride in a wrapper object only when asked for
+        if predictions is not None:
+            print(json.dumps({"findings": sorted_findings,
+                              "predicted_step_time": predictions},
+                             indent=2))
+        else:
+            print(json.dumps(sorted_findings, indent=2))
     else:
         print(analysis.format_report(findings))
+        if predictions is not None:
+            print(_format_predictions(predictions))
     worst = analysis.max_severity(findings)
     order = list(analysis.SEVERITIES)
     if findings and order.index(worst) <= order.index(args.fail_on):
@@ -859,6 +945,16 @@ def main(argv=None) -> None:
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_disagg)
 
+    sp = sub.add_parser("oracle",
+                        help="step-time oracle: roofline predictions "
+                             "per layout, predicted-vs-measured "
+                             "residuals, fitted calibration")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N oracle events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_oracle)
+
     sp = sub.add_parser("microbench",
                         help="core-runtime micro benchmarks (ray_perf "
                              "analog): task/actor/put-get/queue/churn")
@@ -875,6 +971,10 @@ def main(argv=None) -> None:
     sp.add_argument("--layouts", action="store_true",
                     help="also analyze the built-in dryrun mesh layouts "
                          "(sharding specs, collectives over DCN)")
+    sp.add_argument("--predict-step-time", action="store_true",
+                    help="also print the step-time oracle's roofline "
+                         "prediction (device/ici/dcn breakdown) per "
+                         "built-in dryrun layout")
     sp.add_argument("--json", action="store_true",
                     help="machine-readable findings")
     sp.add_argument("--fail-on", choices=["error", "warning", "info"],

@@ -185,6 +185,17 @@ def disagg_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def oracle_status() -> Dict[str, Any]:
+    """Step-time oracle view (observability.roofline): the latest
+    roofline prediction per layout ({device_step, ici_wait, dcn_wait}
+    breakdown + predicted total), the predicted-vs-measured validation
+    tail (per-phase residuals, fitted calibration), and totals. The CLI
+    analog is `python -m ray_tpu oracle`; the dashboard serves it at
+    /api/oracle."""
+    return _conductor().conductor.call("get_oracle_status",
+                                       timeout=10.0)
+
+
 def resilience_status() -> Dict[str, Any]:
     """Recovery-subsystem view (ray_tpu.resilience): per-host failure
     scores with quarantine/drain flags, the excluded host list, event

@@ -61,6 +61,13 @@ def pytest_configure(config):
         "harness); everything is tier-1-safe on CPU on a "
         "module-scoped cluster with log_to_driver=0 — select with "
         "`-m disagg`")
+    config.addinivalue_line(
+        "markers", "oracle: step-time oracle scenarios "
+        "(observability.roofline: ICI/DCN roofline prediction, "
+        "flight-recorder validation + calibration fit, bench "
+        "regression attribution); everything is tier-1-safe on CPU, "
+        "cluster tests run on a module-scoped cluster with "
+        "log_to_driver=0 — select with `-m oracle`")
 
 
 def _sweep_leaked_shm():

@@ -64,6 +64,16 @@ def test_online_package_is_lint_covered():
     assert errors(lint_path(path)) == []
 
 
+def test_roofline_module_is_lint_covered():
+    """The step-time oracle (observability/roofline.py) is inside the
+    self-lint set: the walk parses it and it carries zero error
+    findings of its own (a rename/move would silently drop it from
+    coverage)."""
+    path = os.path.join(PACKAGE_ROOT, "observability", "roofline.py")
+    assert os.path.exists(path)
+    assert errors(lint_path(path)) == []
+
+
 def test_disagg_modules_are_lint_covered():
     """Disaggregated serving (serve/disagg.py) and its load harness
     (bench_serve.py) are inside the self-lint set: the walk parses
