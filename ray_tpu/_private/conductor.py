@@ -255,6 +255,14 @@ class ConductorHandler:
         self._disagg_stats: Dict[str, Dict[str, Any]] = {}
         self._disagg_events: List[Dict[str, Any]] = []
 
+        # Serving autoscaler (serve/autoscale.py): policy loops push
+        # status snapshots (targets, decisions, replica-seconds) +
+        # scale_up/scale_down/drain markers; the conductor only
+        # aggregates. util.state.autoscaler_status(), `ray_tpu
+        # autoscale`, and /api/autoscale all read the same aggregate.
+        self._autoscale_stats: Dict[str, Dict[str, Any]] = {}
+        self._autoscale_events: List[Dict[str, Any]] = []
+
         # Step-time oracle (observability.roofline): predicted step-time
         # breakdowns keyed by layout + predicted-vs-measured validation
         # records (residuals, fitted calibration). One aggregate feeds
@@ -945,8 +953,13 @@ class ConductorHandler:
 
     def list_workers(self) -> List[Dict[str, Any]]:
         with self._lock:
+            # lease_node_id: the node whose resources (possibly zero —
+            # 0-CPU actor leases) the current lease took; the node
+            # autoscaler's idle check needs it because zero-resource
+            # leases don't show up in available-vs-total accounting
             return [{"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
-                     "address": w.address, "node_id": w.node_id}
+                     "address": w.address, "node_id": w.node_id,
+                     "lease_node_id": w.lease_node_id}
                     for w in self._workers.values()]
 
     # ----------------------------------------------------------------- actors
@@ -1843,6 +1856,78 @@ class ConductorHandler:
                           ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._disagg_events[-limit:]
+
+    # ------------------------------------------------ serving autoscaler
+    # serve/autoscale.py policy loops push status snapshots and
+    # scale_up/scale_down/drain instant markers here;
+    # util.state.autoscaler_status(), `ray_tpu autoscale`, and the
+    # dashboard /api/autoscale all read the same aggregate so every
+    # surface reports one set of numbers.
+
+    _AUTOSCALE_STATS_KEPT = 64
+    _AUTOSCALE_EVENTS_KEPT = 10_000
+
+    def report_autoscale_stats(self, worker_id: str, autoscaler_id: str,
+                               stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._autoscale_stats[str(autoscaler_id)] = dict(
+                stats, worker_id=worker_id,
+                autoscaler_id=str(autoscaler_id), ts=time.time())
+            while len(self._autoscale_stats) > self._AUTOSCALE_STATS_KEPT:
+                oldest = min(self._autoscale_stats,
+                             key=lambda k:
+                             self._autoscale_stats[k].get("ts", 0.0))
+                del self._autoscale_stats[oldest]
+
+    def get_autoscale_status(self) -> Dict[str, Any]:
+        """One aggregate for every autoscale surface: per-loop status
+        snapshots plus cluster totals (decisions by direction, drains,
+        replica-seconds per tier, current targets)."""
+        with self._lock:
+            loops = {k: dict(v)
+                     for k, v in self._autoscale_stats.items()}
+        totals: Dict[str, Any] = {
+            "autoscalers": len(loops),
+            "scale_ups": sum(sum(s.get("scale_ups", {}).values())
+                             for s in loops.values()),
+            "scale_downs": sum(sum(s.get("scale_downs", {}).values())
+                               for s in loops.values()),
+            "drains_completed": sum(int(s.get("drains_completed", 0))
+                                    for s in loops.values()),
+            "drains_forced": sum(int(s.get("drains_forced", 0))
+                                 for s in loops.values()),
+            "replica_seconds": {
+                tier: round(sum(
+                    float(s.get("replica_seconds", {}).get(tier, 0.0))
+                    for s in loops.values()), 3)
+                for tier in ("prefill", "decode")},
+            "active_replicas": {
+                tier: sum(int(s.get(f"{tier}_active", 0))
+                          for s in loops.values())
+                for tier in ("prefill", "decode")},
+        }
+        return {"autoscalers": loops, "totals": totals}
+
+    def report_autoscale_event(self, event: Dict[str, Any]) -> None:
+        """scale_up / scale_down / drain instant markers for the merged
+        timeline's autoscale lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._autoscale_events.append(event)
+            if len(self._autoscale_events) > self._AUTOSCALE_EVENTS_KEPT:
+                del self._autoscale_events[
+                    :len(self._autoscale_events)
+                    - self._AUTOSCALE_EVENTS_KEPT]
+
+    def get_autoscale_events(self, limit: int = 10_000
+                             ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._autoscale_events[-limit:]
 
     # ------------------------------------------------- step-time oracle
     # observability.roofline pushes layout predictions and validation

@@ -9,14 +9,19 @@ group) — homogeneous, topology-known, reserved/released as a unit. The
 provider is the cloud hook (GKE/GCE TPU pools); FakeNodeProvider fakes it
 against the live conductor exactly like the reference's
 FakeMultiNodeProvider (node_provider.py:237) so the real reconcile loop is
-testable on one machine."""
+testable on one machine.
+
+This is the NODE-level autoscaler (hosts in, hosts out). The
+SERVING-level autoscaler — replica counts against a TTFT SLO — lives in
+serve/autoscale.py; the two compose: serve scale-up creates actor
+demand, which lands here as pending demand when no host can fit it."""
 from __future__ import annotations
 
 import threading
 import time
 import uuid
 from abc import ABC, abstractmethod
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 
@@ -348,13 +353,37 @@ class StandardAutoscaler:
                     _subtract(free[-1], req)
                     break
 
-        # 3) terminate long-idle autoscaled nodes above min_workers
+        # 3) terminate long-idle autoscaled nodes above min_workers.
+        # "idle" is NOT just available == total: that was a
+        # FakeNodeProvider-era assumption from when accounting nodes
+        # never hosted live work. Zero-resource actor leases (0-CPU
+        # serve replicas, disagg tiers) take nothing from the node's
+        # resource pool, so a node can read available == total while
+        # actively serving — check for live workers leased against the
+        # node before calling it idle.
+        try:
+            workers = self._conductor.call("list_workers", timeout=10.0)
+        except Exception:  # noqa: BLE001 — conductor briefly away: skip
+            workers = None  # termination this round, never guess idle
+        busy_nodes = set()
+        if workers is not None:
+            busy_nodes = {
+                w.get("lease_node_id") or w.get("node_id")
+                for w in workers
+                if w.get("state") in ("ACTOR", "BUSY")}
         terminated: List[str] = []
         for nid, t in list(self._tracked.items()):
+            if workers is None:
+                # can't tell busy from idle: skip termination this
+                # round WITHOUT resetting idle clocks — a conductor
+                # hiccup must not make every idle node re-earn its
+                # whole idle_timeout_s
+                break
             n = cluster_nodes.get(nid)
             if n is None:
                 continue
-            idle = n.get("alive") and n["available"] == n["total"]
+            idle = (n.get("alive") and n["available"] == n["total"]
+                    and nid not in busy_nodes)
             if not idle:
                 t.idle_since = None
                 continue

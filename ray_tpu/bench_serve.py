@@ -204,17 +204,20 @@ def collect_kv_accounting(prefill: Sequence[Any],
     return out
 
 
-def _build_tiers(params, config, args, use_cluster: bool):
-    """(router, prefill_list, decode_list, cleanup) for one mode."""
-    from ray_tpu.serve.disagg import (DecodeServer, DisaggRouter,
-                                      PrefillServer)
+def _tier_factories(params, config, args, use_cluster: bool):
+    """(prefill_factory, decode_factory, kill) — one replica per call,
+    in-process objects or actors. The autoscaled run grows tiers through
+    exactly these, so a scaled-up replica pays the same real cold-start
+    (engine init + first compile) a production scale-up would."""
+    from ray_tpu.serve.disagg import DecodeServer, PrefillServer
 
     # retention must cover every transfer that can be legitimately
     # in flight (held from publish until the router acks after decode):
     # decode_replicas * (capacity + queue depth), and affinity can
     # route ALL of them to ONE prefill server — a smaller window would
     # reap chunks a decode replica is about to fetch, failing requests
-    # under exactly the burst load the harness measures
+    # under exactly the burst load the harness measures. The router
+    # re-pushes the live bound on every add_*, this only seeds it.
     retain = max(32, 2 * args.decode_replicas
                  * (args.max_batch + args.queue_depth))
     kw = dict(kv_block_size=args.block_size,
@@ -222,39 +225,224 @@ def _build_tiers(params, config, args, use_cluster: bool):
     if use_cluster:
         import ray_tpu
 
-        prefill = [ray_tpu.remote(PrefillServer).options(
-            max_concurrency=8).remote(params, config, **kw)
-            for _ in range(args.prefill_replicas)]
-        decode = [ray_tpu.remote(DecodeServer).options(
-            max_concurrency=args.max_batch + 4).remote(
-                params, config, max_batch=args.max_batch)
-            for _ in range(args.decode_replicas)]
-        import ray_tpu as _rt
-        for a in prefill + decode:  # fail fast on a broken __init__
-            _rt.get(a.stats.remote(), timeout=120.0)
+        def prefill_factory():
+            a = ray_tpu.remote(PrefillServer).options(
+                max_concurrency=8).remote(params, config, **kw)
+            ray_tpu.get(a.stats.remote(), timeout=120.0)  # fail fast
+            return a
+
+        def decode_factory():
+            a = ray_tpu.remote(DecodeServer).options(
+                max_concurrency=args.max_batch + 4).remote(
+                    params, config, max_batch=args.max_batch)
+            ray_tpu.get(a.stats.remote(), timeout=120.0)
+            return a
+
+        def kill(replica):
+            try:
+                ray_tpu.kill(replica)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
     else:
-        prefill = [PrefillServer(params, config, **kw)
-                   for _ in range(args.prefill_replicas)]
-        decode = [DecodeServer(params, config, max_batch=args.max_batch)
-                  for _ in range(args.decode_replicas)]
+        def prefill_factory():
+            return PrefillServer(params, config, **kw)
+
+        def decode_factory():
+            return DecodeServer(params, config,
+                                max_batch=args.max_batch)
+
+        def kill(replica):
+            stop = getattr(replica, "stop", None)
+            if callable(stop):
+                try:
+                    stop()
+                except Exception:  # noqa: BLE001 — already stopped
+                    pass
+
+    return prefill_factory, decode_factory, kill
+
+
+def _build_tiers(params, config, args, use_cluster: bool,
+                 prefill_replicas: Optional[int] = None,
+                 decode_replicas: Optional[int] = None):
+    """(router, prefill_list, decode_list, cleanup) for one mode."""
+    from ray_tpu.serve.disagg import DisaggRouter
+
+    pf_n = (args.prefill_replicas if prefill_replicas is None
+            else prefill_replicas)
+    dec_n = (args.decode_replicas if decode_replicas is None
+             else decode_replicas)
+    prefill_factory, decode_factory, kill = _tier_factories(
+        params, config, args, use_cluster)
+    prefill = [prefill_factory() for _ in range(pf_n)]
+    decode = [decode_factory() for _ in range(dec_n)]
     router = DisaggRouter(decode=decode, prefill=prefill,
                           max_queue_depth=args.queue_depth,
                           affinity_tokens=args.block_size)
 
     def cleanup():
-        if use_cluster:
-            import ray_tpu
-
-            for a in prefill + decode:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:  # noqa: BLE001 — already gone
-                    pass
-        else:
-            for d in decode:
-                d.stop()
+        # the ROUTER's live view, not the construction-time lists: an
+        # autoscaled run may have grown or drained either tier
+        live = [r["target"] for t in ("prefill", "decode")
+                for r in router.tier_replicas(t)]
+        for a in live:
+            kill(a)
 
     return router, prefill, decode, cleanup
+
+
+def _warm(router, prompts) -> None:
+    """Warm the compile caches off the clock: each distinct prompt
+    shape costs one prefill compile on first sight."""
+    for p in prompts:
+        router.generate(p, 2)
+
+
+def _static_run(params, config, args, use_cluster, prompts, load_kw,
+                pf_n: int, dec_n: int) -> Dict[str, Any]:
+    """One fixed-(P,D) provisioning replayed through the open-loop
+    schedule; replica-hours are simply (P + D) x wall."""
+    router, prefill, decode, cleanup = _build_tiers(
+        params, config, args, use_cluster, prefill_replicas=pf_n,
+        decode_replicas=dec_n)
+    try:
+        _warm(router, prompts)
+        warm_rt = router.stats()  # counters cover ONLY the measured run
+        rec = run_load(router, prompts, **load_kw)
+        st = router.stats()
+        rec["router"] = {k: st[k] - warm_rt[k] for k in
+                         ("dispatched", "completed", "shed")}
+        rec["router"]["max_pending"] = st["max_pending"]
+    finally:
+        cleanup()
+    rec["config"] = f"{pf_n}x{dec_n}"
+    rec["prefill_replicas"] = pf_n
+    rec["decode_replicas"] = dec_n
+    rec["replica_hours"] = round(
+        (pf_n + dec_n) * rec["wall_s"] / 3600.0, 6)
+    return rec
+
+
+def _autoscaled_run(params, config, args, use_cluster, prompts,
+                    load_kw, target_p99_ms: float) -> Dict[str, Any]:
+    """The closed control loop under the same schedule: tiers start at
+    the minimum, the serve/autoscale.py policy drives them, and
+    replica-hours are the loop's measured integral of live replicas."""
+    from ray_tpu.serve.autoscale import (DisaggAutoscaler, DisaggPolicy,
+                                         TierSpec)
+
+    prefill_factory, decode_factory, _kill = _tier_factories(
+        params, config, args, use_cluster)
+    router, prefill, decode, cleanup = _build_tiers(
+        params, config, args, use_cluster,
+        prefill_replicas=args.min_prefill,
+        decode_replicas=args.min_decode)
+    scaler = DisaggAutoscaler(
+        router,
+        prefill=TierSpec(prefill_factory,
+                         min_replicas=args.min_prefill,
+                         max_replicas=args.max_prefill,
+                         up_delay_s=args.up_delay,
+                         down_delay_s=args.down_delay,
+                         cooldown_s=args.cooldown),
+        decode=TierSpec(decode_factory,
+                        min_replicas=args.min_decode,
+                        max_replicas=args.max_decode,
+                        up_delay_s=args.up_delay,
+                        down_delay_s=args.down_delay,
+                        cooldown_s=args.cooldown),
+        interval_s=args.autoscale_interval,
+        drain_grace_s=args.drain_grace)
+    scaler.policy.target_p99_ms = target_p99_ms
+    try:
+        _warm(router, prompts)
+        warm_rt = router.stats()  # counters cover ONLY the measured run
+        # the warm phase's first-compile TTFTs must not read as an SLO
+        # breach when the policy wakes up
+        router.reset_signal_windows()
+        scaler.start()
+        rec = run_load(router, prompts, **load_kw)
+        st = router.stats()
+        rec["router"] = {k: st[k] - warm_rt[k] for k in
+                         ("dispatched", "completed", "shed")}
+        rec["router"]["max_pending"] = st["max_pending"]
+    finally:
+        scaler.stop()
+        cleanup()
+    st = scaler.status()
+    rs = st["replica_seconds"]
+    rec["config"] = "autoscale"
+    rec["replica_hours"] = round(
+        (rs["prefill"] + rs["decode"]) / 3600.0, 6)
+    rec["autoscale"] = {
+        "target_p99_ms": target_p99_ms,
+        "bounds": {"prefill": st["prefill_bounds"],
+                   "decode": st["decode_bounds"]},
+        "scale_ups": st["scale_ups"],
+        "scale_downs": st["scale_downs"],
+        "drains_completed": st["drains_completed"],
+        "drains_forced": st["drains_forced"],
+        "replica_seconds": rs,
+        "final_active": {"prefill": st["prefill_active"],
+                         "decode": st["decode_active"]},
+    }
+    return rec
+
+
+def _clean_run(rec: Dict[str, Any]) -> bool:
+    """A run may headline/verdict only when every request is accounted
+    ok|shed — a hung or errored request silently shrinking the measured
+    population is exactly the lie the r04/r05 rule exists to prevent."""
+    return not rec.get("hung") and not rec.get("errors")
+
+
+def compare_verdict(auto: Dict[str, Any], sweep: List[Dict[str, Any]],
+                    target_p99_ms: float) -> Dict[str, Any]:
+    """The acceptance comparison: the autoscaled run beats a static
+    (P,D) either because the static config misses the SLO (TTFT p99
+    over target, or it sheds more at the peak than the autoscaled run
+    did), or — when the static config does meet it — because the
+    autoscaler matched the SLO with strictly fewer replica-hours. Shed
+    discipline is additionally checked against the BEST static config
+    (lowest p99). Any hung/errored run voids the verdict entirely."""
+    valid = _clean_run(auto) and all(_clean_run(s) for s in sweep)
+    auto_p99 = auto.get("ttft_p99_ms")
+    auto_ok = auto_p99 is not None and auto_p99 <= target_p99_ms
+    per = []
+    for s in sweep:
+        p99 = s.get("ttft_p99_ms")
+        slo_ok = (p99 is not None and p99 <= target_p99_ms
+                  and s["shed_rate"] <= auto["shed_rate"] + 1e-9)
+        if not slo_ok:
+            beats, how = True, ("static misses the SLO (p99 over "
+                                "target, or sheds more at the peak)")
+        elif auto_ok and auto["replica_hours"] < s["replica_hours"]:
+            beats, how = True, "met the SLO at fewer replica-hours"
+        else:
+            beats, how = False, "static config not dominated"
+        per.append({"config": s["config"],
+                    "ttft_p99_ms": p99,
+                    "shed_rate": s["shed_rate"],
+                    "replica_hours": s["replica_hours"],
+                    "static_meets_slo": slo_ok,
+                    "beats": beats, "how": how})
+    # "best static" ranks shed rate BEFORE p99: a config shedding half
+    # its traffic has a flattering p99 on what little it admitted
+    best = min((s for s in sweep if s.get("ttft_p99_ms") is not None),
+               key=lambda s: (s["shed_rate"], s["ttft_p99_ms"],
+                              s["replica_hours"]),
+               default=None)
+    shed_ok = (best is not None
+               and auto["shed_rate"] <= best["shed_rate"] + 1e-9)
+    return {
+        "valid": valid,
+        "autoscale_meets_slo": auto_ok,
+        "beats_all_static": valid and auto_ok and shed_ok
+        and all(p["beats"] for p in per),
+        "shed_at_peak_ok": shed_ok,
+        "best_static": best["config"] if best else None,
+        "per_config": per,
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -285,9 +473,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--colocated-baseline", action="store_true",
                     help="also run the single-engine colocated path "
                          "for comparison")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-driven autoscaler "
+                         "(serve/autoscale.py) instead of a static "
+                         "provisioning; tiers start at the minimum")
+    ap.add_argument("--compare-static", default="",
+                    help='static (P,D) sweep as comma "PxD" configs, '
+                         'e.g. "1x1,2x1,1x2,2x2": run each, plus the '
+                         "autoscaled run, and record the verdict "
+                         "(implies --autoscale)")
+    ap.add_argument("--target-p99-ms", type=float, default=None,
+                    help="TTFT SLO for the policy AND the verdict "
+                         "(default: RAY_TPU_AUTOSCALE_TARGET_P99_MS)")
+    ap.add_argument("--min-prefill", type=int, default=1)
+    ap.add_argument("--max-prefill", type=int, default=2)
+    ap.add_argument("--min-decode", type=int, default=1)
+    ap.add_argument("--max-decode", type=int, default=2)
+    ap.add_argument("--up-delay", type=float, default=1.0)
+    ap.add_argument("--down-delay", type=float, default=5.0)
+    ap.add_argument("--cooldown", type=float, default=2.0)
+    ap.add_argument("--autoscale-interval", type=float, default=0.25)
+    ap.add_argument("--drain-grace", type=float, default=30.0)
+    ap.add_argument("--window-s", type=float, default=None,
+                    help="signal recency window (sets "
+                         "RAY_TPU_AUTOSCALE_WINDOW_S for the run; a "
+                         "compressed diurnal needs a window shorter "
+                         "than its day)")
     ap.add_argument("--out", default="", help="also write JSON here")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.window_s is not None:
+        import os as os_mod
+
+        os_mod.environ["RAY_TPU_AUTOSCALE_WINDOW_S"] = str(args.window_s)
 
     import jax
 
@@ -302,8 +520,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if use_cluster:
         import ray_tpu
 
+        # every mode's replica actors (default 1 CPU per lease) must
+        # fit: the plain tiers, the autoscaler's max bounds, AND the
+        # largest static config in the --compare-static sweep
+        sweep_max = max(
+            (int(p) + int(d) for p, _, d in
+             (s.partition("x") for s in args.compare_static.split(",")
+              if s)), default=0)
         ray_tpu.init(num_cpus=max(4, args.prefill_replicas
-                                  + args.decode_replicas + 2),
+                                  + args.decode_replicas,
+                                  args.max_prefill + args.max_decode,
+                                  sweep_max) + 2,
                      _system_config={"log_to_driver": 0},
                      ignore_reinit_error=True)
     record: Dict[str, Any] = {
@@ -320,6 +547,45 @@ def main(argv: Optional[List[str]] = None) -> int:
                    burst_size=args.burst_size, zipf_a=args.zipf_a,
                    slow_client_frac=args.slow_frac,
                    token_sleep_s=args.token_sleep, seed=args.seed)
+    if args.compare_static or args.autoscale:
+        from ray_tpu.serve.autoscale import default_target_p99_ms
+
+        target = (args.target_p99_ms if args.target_p99_ms is not None
+                  else default_target_p99_ms())
+        record.update(metric="autoscale_serve_load",
+                      target_p99_ms=target)
+        try:
+            sweep: List[Dict[str, Any]] = []
+            for spec in [s for s in args.compare_static.split(",") if s]:
+                pf_n, _, dec_n = spec.partition("x")
+                sweep.append(_static_run(
+                    params, config, args, use_cluster, prompts,
+                    load_kw, int(pf_n), int(dec_n)))
+            record["autoscale_run"] = _autoscaled_run(
+                params, config, args, use_cluster, prompts, load_kw,
+                target)
+            if sweep:
+                record["sweep"] = sweep
+                record["verdict"] = compare_verdict(
+                    record["autoscale_run"], sweep, target)
+            top = record["autoscale_run"]
+            record.update(value=top["tokens_per_sec"], unit="tokens/s",
+                          ttft_p50_ms=top["ttft_p50_ms"],
+                          ttft_p99_ms=top["ttft_p99_ms"],
+                          shed_rate=top["shed_rate"],
+                          replica_hours=top["replica_hours"])
+        finally:
+            if use_cluster:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+        line = json.dumps(record)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(record, f, indent=1)
+        print(line)
+        return 0
+
     try:
         router, prefill, decode, cleanup = _build_tiers(
             params, config, args, use_cluster)

@@ -35,6 +35,12 @@ Routes:
                          accounting (shm vs rpc), router shed/queue
                          depth, recent kv_publish/kv_transfer/shed
                          events (serve/disagg.py)
+  /api/autoscale         serving autoscaler: per-loop tier targets,
+                         scale-up/down decision counts, drain
+                         outcomes, replica-seconds, recent scale_up/
+                         drain/scale_down events (serve/autoscale.py;
+                         the NODE-level autoscaler stays at
+                         /api/autoscaler)
   /api/oracle            step-time oracle: roofline predictions per
                          layout (device/ici/dcn breakdown),
                          predicted-vs-measured validations (residuals,
@@ -191,6 +197,17 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def autoscale(self) -> Dict[str, Any]:
+        """Serving-autoscaler aggregate + the recent event tail (one
+        payload so the SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_autoscale_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_autoscale_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def oracle(self) -> Dict[str, Any]:
         """Step-time-oracle aggregate + the recent event tail (one
         payload so the SPA's panel needs a single fetch)."""
@@ -315,6 +332,8 @@ class DashboardServer:
         app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
         app.router.add_get("/api/online", self._json_route(d.online))
         app.router.add_get("/api/disagg", self._json_route(d.disagg))
+        app.router.add_get("/api/autoscale",
+                           self._json_route(d.autoscale))
         app.router.add_get("/api/oracle", self._json_route(d.oracle))
         app.router.add_get(
             "/api/rpc",

@@ -43,6 +43,11 @@ merges and labels them:
                  KV transfers with their shm/rpc byte split, and
                  router sheds, so cross-replica KV traffic lines up
                  against request latency and the kvcache lane.
+- autoscale:     pid = "autoscale",       tid = event kind — instant
+                 markers of the serving autoscaler (serve/autoscale.py):
+                 scale_up / drain / scale_down per tier, so replica-set
+                 changes line up against the disagg lane's shed markers
+                 and the request traffic they react to.
 - oracle:        pid = "oracle" — a predicted-step-time COUNTER track
                  (one "C" series per layout, observability.roofline)
                  that draws the analytic roofline under the measured
@@ -241,6 +246,32 @@ def disagg_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def autoscale_trace_events(events: List[Dict[str, Any]]
+                           ) -> List[Dict[str, Any]]:
+    """Instant markers for serving-autoscaler events (scale_up, drain,
+    scale_down) — mirrors the disagg track under pid "autoscale" so
+    replica-set changes read against the shed/transfer markers they
+    react to."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        if ev.get("tier"):
+            label += f":{ev['tier']}"
+        if ev.get("to") is not None:
+            label += f"->{ev['to']}"
+        out.append({
+            "name": label, "cat": "autoscale", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "autoscale", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def oracle_trace_events(events: List[Dict[str, Any]]
                         ) -> List[Dict[str, Any]]:
     """The step-time oracle's track (observability.roofline): every
@@ -318,6 +349,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         disagg_events: Optional[
                             List[Dict[str, Any]]] = None,
                         oracle_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        autoscale_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -340,6 +373,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(disagg_trace_events(disagg_events))
     if oracle_events:
         trace.extend(oracle_trace_events(oracle_events))
+    if autoscale_events:
+        trace.extend(autoscale_trace_events(autoscale_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -391,8 +426,13 @@ def merged_timeline(filename: Optional[str] = None,
         orev = w.conductor.call("get_oracle_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-oracle conductor
         orev = []
+    try:
+        asev = w.conductor.call("get_autoscale_events", limit,
+                                timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-autoscale conductor
+        asev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev, oev, dev, orev)
+                                pev, oev, dev, orev, asev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -541,6 +541,54 @@ def cmd_disagg(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_autoscale(args) -> None:
+    """`ray_tpu autoscale` — serving-autoscaler view
+    (serve/autoscale.py): per-loop tier targets, decision counts,
+    drain outcomes, and replica-seconds, plus the cluster totals every
+    other surface (state API, /api/autoscale, Prometheus, timeline
+    markers) reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.autoscaler_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    loops = st.get("autoscalers") or {}
+    if not loops:
+        print("no autoscaler telemetry recorded (is a "
+              "serve.autoscale.DisaggAutoscaler running?)")
+        return
+    totals = st.get("totals") or {}
+    rs = totals.get("replica_seconds") or {}
+    print(f"totals: scale_ups={totals.get('scale_ups', 0)} "
+          f"scale_downs={totals.get('scale_downs', 0)} "
+          f"drains={totals.get('drains_completed', 0)} "
+          f"(forced {totals.get('drains_forced', 0)}) "
+          f"replica_s=prefill:{rs.get('prefill', 0.0):.1f}"
+          f"/decode:{rs.get('decode', 0.0):.1f}")
+    for key, s in sorted(loops.items()):
+        print(f"  {key}: router={s.get('router')} "
+              f"target_p99={s.get('target_p99_ms')}ms")
+        for tier in ("prefill", "decode"):
+            bounds = s.get(f"{tier}_bounds") or ["?", "?"]
+            print(f"    {tier}: active={s.get(f'{tier}_active', 0)}"
+                  f"/{s.get(f'{tier}_replicas', 0)} "
+                  f"bounds=[{bounds[0]},{bounds[1]}] "
+                  f"ups={(s.get('scale_ups') or {}).get(tier, 0)} "
+                  f"downs={(s.get('scale_downs') or {}).get(tier, 0)} "
+                  f"last={(s.get('last_reason') or {}).get(tier, '')!r}")
+        if s.get("draining"):
+            for d in s["draining"]:
+                print(f"    DRAINING {d.get('tier')}:{d.get('rid')}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_autoscale_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_oracle(args) -> None:
     """`ray_tpu oracle` — step-time oracle view (observability.roofline):
     the latest roofline prediction per layout, the predicted-vs-measured
@@ -944,6 +992,16 @@ def main(argv=None) -> None:
                     help="also print the last N disagg events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_disagg)
+
+    sp = sub.add_parser("autoscale",
+                        help="serving autoscaler: per-tier targets and "
+                             "decision counts, drain outcomes, "
+                             "replica-seconds, recent events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N autoscale events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_autoscale)
 
     sp = sub.add_parser("oracle",
                         help="step-time oracle: roofline predictions "

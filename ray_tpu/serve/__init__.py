@@ -15,6 +15,8 @@ from typing import Any, Dict, List, Optional, Union
 import cloudpickle
 
 from .asgi import ingress  # noqa: F401
+from .autoscale import (DisaggAutoscaler, DisaggPolicy,  # noqa: F401
+                        ScalingPolicy, SlidingWindow, TierSpec)
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
 from .context import get_request_context  # noqa: F401

@@ -1,0 +1,774 @@
+"""SLO-driven autoscaler for (disaggregated) serving: close the
+control loop.
+
+Every input the loop needs already exists — per-replica TTFT telemetry
+(PR 3/6), bounded-queue admission with shed counters and live queue
+depths (PR 9), and the preemption grace/drain flow (PR 4) — this module
+adds the POLICY that turns them into replica counts. The Gemma-on-TPU
+serving envelope (PAPERS.md: arXiv 2605.25645) frames what "enough
+replicas" means; the TPU concurrency-limits roofline (arXiv 2011.03641)
+is why prefill and decode saturate on DIFFERENT signals and must scale
+independently:
+
+- **prefill** is compute-bound burst work: its saturation shows up as
+  queueing delay ahead of the first token — recent p99 TTFT against the
+  target SLO — discounted by the prefix-cache hit rate (a hit-heavy
+  window prefills only suffixes and needs fewer prefill chips).
+- **decode** is memory-bound steady work: its saturation is free-slot
+  exhaustion — when the tier's decode slots run out, admission control
+  starts queueing and then shedding, long before prefill notices.
+
+Pieces (each independently testable, no cluster required):
+
+- ``SlidingWindow``: trailing-window samples -> recent p50/p99 summary
+  (the shared ``step_timer.percentile``), so the policy reads *recent*
+  percentiles, not lifetime-cumulative ones that lag load shifts.
+- ``ScalingPolicy``: the hysteresis + cooldown core — desired-vs-current
+  persistence gates (scale up only after the pressure held for
+  ``up_delay_s``, down after ``down_delay_s``, nothing within
+  ``cooldown_s`` of the last change) — shared by the disagg loop AND the
+  generic Serve controller's reconcile tick (serve/controller.py), so
+  one engine owns "don't flap" everywhere.
+- ``DisaggPolicy``: maps a signals snapshot to desired per-tier counts.
+- ``DisaggAutoscaler``: the loop. Scale-up builds a replica via the
+  tier's factory and registers it with the router — new replicas admit
+  immediately. Scale-down REUSES the graceful-drain flow: the router
+  stops dispatching to the victim (``begin_drain``) while its in-flight
+  requests finish and its KV transfers are acked, then
+  ``prepare_for_shutdown`` (the replica-side grace drain, the same
+  shape as serve/replica.py and the preemption grace window) runs
+  before the actor dies — an in-flight request is NEVER dropped by a
+  scale-down.
+
+Surfaces (the full treatment): ``util.state.autoscaler_status()``,
+``ray_tpu autoscale`` CLI, dashboard ``/api/autoscale`` + SPA tab, lazy
+Prometheus (``ray_tpu_autoscale_target_replicas{tier}``,
+``ray_tpu_autoscale_decisions_total{tier,direction}``,
+``ray_tpu_autoscale_replica_seconds_total{tier}``), and scale_up /
+scale_down / drain instant markers in the merged timeline (drains are
+mirrored into the resilience lane — they ARE the grace flow).
+
+Knobs (env, all overridable per-instance): RAY_TPU_AUTOSCALE_TARGET_P99_MS
+(the SLO), RAY_TPU_AUTOSCALE_UP_DELAY_S / _DOWN_DELAY_S / _COOLDOWN_S
+(hysteresis), RAY_TPU_AUTOSCALE_INTERVAL_S (tick), _DRAIN_GRACE_S (the
+drain window), _WINDOW_S (signal recency). The acceptance benchmark is
+``python -m ray_tpu.bench_serve --autoscale --compare-static``.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.observability.step_timer import percentile
+
+_SEQ = itertools.count()
+
+TIERS = ("prefill", "decode")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def default_target_p99_ms() -> float:
+    """The serving SLO the loop closes on (recent p99 TTFT, ms)."""
+    return _env_float("RAY_TPU_AUTOSCALE_TARGET_P99_MS", 1500.0)
+
+
+# ----------------------------------------------------- prometheus (lazy)
+
+_metrics: Optional[Dict[str, Any]] = None
+_metrics_lock = threading.Lock()
+
+
+def autoscale_metrics() -> Dict[str, Any]:
+    global _metrics
+    m = _metrics
+    if m is not None:
+        return m
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _metrics = dict(
+                target=Gauge(
+                    "ray_tpu_autoscale_target_replicas",
+                    "replica count the autoscaler is currently driving "
+                    "a tier toward",
+                    tag_keys=("tier",)),
+                decisions=Counter(
+                    "ray_tpu_autoscale_decisions_total",
+                    "scale decisions taken (direction=up|down)",
+                    tag_keys=("tier", "direction")),
+                replica_seconds=Counter(
+                    "ray_tpu_autoscale_replica_seconds_total",
+                    "cumulative live replica-seconds per tier (the "
+                    "provisioning cost the policy is minimizing)",
+                    tag_keys=("tier",)))
+    return _metrics
+
+
+# --------------------------------------------------------- sliding window
+
+class SlidingWindow:
+    """Trailing-window scalar samples -> recent summary.
+
+    The policy (and `serve status` / router stats) must read RECENT
+    percentiles: a lifetime-cumulative histogram still remembers the
+    morning's quiet hours at the evening peak. Samples older than
+    ``window_s`` age out; ``max_samples`` bounds memory under a flood.
+    Percentiles come from the shared ``step_timer.percentile`` so every
+    recent-p99 in the system is the same derivation."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 max_samples: int = 2048):
+        if window_s is None:
+            window_s = _env_float("RAY_TPU_AUTOSCALE_WINDOW_S", 30.0)
+        self.window_s = float(window_s)
+        self.max_samples = int(max_samples)
+        self._lock = threading.Lock()
+        self._samples: List[Tuple[float, float]] = []  # (ts, value)
+
+    def add(self, value: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._samples.append((now, float(value)))
+            if len(self._samples) > self.max_samples:
+                del self._samples[:len(self._samples) - self.max_samples]
+
+    def _values(self, now: Optional[float]) -> List[float]:
+        now = time.monotonic() if now is None else now
+        horizon = now - self.window_s
+        with self._lock:
+            # prune in place so a long-lived idle window frees its tail
+            i = 0
+            while i < len(self._samples) and self._samples[i][0] < horizon:
+                i += 1
+            if i:
+                del self._samples[:i]
+            return [v for _, v in self._samples]
+
+    def summary(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """{"n", "mean", "p50", "p99", "last"} over the live window
+        ({"n": 0} when empty — callers treat missing signals as
+        no-evidence, never as zero)."""
+        vals = self._values(now)
+        if not vals:
+            return {"n": 0}
+        ordered = sorted(vals)
+        return {"n": len(vals),
+                "mean": sum(vals) / len(vals),
+                "p50": percentile(ordered, 0.5),
+                "p99": percentile(ordered, 0.99),
+                "last": vals[-1]}
+
+
+# --------------------------------------------------------- policy engine
+
+class ScalingPolicy:
+    """Hysteresis + cooldown around a desired-replicas signal.
+
+    Semantics (lifted from serve/controller.py's reconcile tick, now THE
+    shared engine): the clock toward scaling up runs only while
+    desired > current — any tick at-or-below resets it (and vice versa
+    for down) — so a transient burst never scales and an oscillating
+    signal never flaps; ``cooldown_s`` additionally freezes the tier
+    after any change so back-to-back moves can't chase noise."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 up_delay_s: Optional[float] = None,
+                 down_delay_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None):
+        if up_delay_s is None:
+            up_delay_s = _env_float("RAY_TPU_AUTOSCALE_UP_DELAY_S", 2.0)
+        if down_delay_s is None:
+            down_delay_s = _env_float("RAY_TPU_AUTOSCALE_DOWN_DELAY_S",
+                                      10.0)
+        if cooldown_s is None:
+            cooldown_s = _env_float("RAY_TPU_AUTOSCALE_COOLDOWN_S", 5.0)
+        if max_replicas < max(1, min_replicas):
+            raise ValueError(
+                f"invalid replica bounds [{min_replicas}, {max_replicas}]")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_delay_s = float(up_delay_s)
+        self.down_delay_s = float(down_delay_s)
+        self.cooldown_s = float(cooldown_s)
+        # last instant the tier was NOT under up/down pressure — the
+        # persistence gate measures from here (None until the first
+        # decide() so injected clocks and the real one never mix)
+        self._calm_up: Optional[float] = None
+        self._calm_down: Optional[float] = None
+        self._last_change: Optional[float] = None
+
+    def clamp(self, n: int) -> int:
+        return min(max(int(n), self.min_replicas), self.max_replicas)
+
+    def decide(self, desired: int, current: int,
+               now: Optional[float] = None) -> int:
+        """The new target (== current when the gates hold it back)."""
+        now = time.monotonic() if now is None else now
+        desired = self.clamp(desired)
+        if self._calm_up is None:
+            self._calm_up = self._calm_down = now
+        if desired <= current:
+            self._calm_up = now       # not under scale-up pressure
+        if desired >= current:
+            self._calm_down = now     # not over-provisioned
+        in_cooldown = (self._last_change is not None
+                       and now - self._last_change < self.cooldown_s)
+        if desired > current and not in_cooldown \
+                and now - self._calm_up >= self.up_delay_s:
+            self._last_change = now
+            self._calm_up = self._calm_down = now
+            return desired
+        if desired < current and not in_cooldown \
+                and now - self._calm_down >= self.down_delay_s:
+            self._last_change = now
+            self._calm_up = self._calm_down = now
+            return desired
+        return current
+
+
+class DisaggPolicy:
+    """Signals -> desired replica counts, one tier at a time.
+
+    The signals snapshot (``DisaggRouter.signals()`` + per-tick
+    free-slot probes; every key optional — missing evidence never
+    scales):
+
+    - ``ttft_p99_ms``: recent p99 TTFT (router sliding window). Under
+      disaggregation TTFT ends when prefill returns the first token, so
+      this IS the prefill queueing-delay signal.
+    - ``cache_hit_rate``: recent fraction of prefills served fully or
+      partially from the prefix cache. A hit-heavy window prefills only
+      suffixes — scale-down of the prefill tier is gated on it (or on
+      the tier being outright idle).
+    - ``prefill_inflight_p99``: recent concurrent prefills — the
+      does-it-fit-in-one-fewer check for prefill scale-down.
+    - ``decode_free_p50`` / ``decode_busy_p99``: recent free and busy
+      decode slots across the tier; ``decode_cap_per_replica`` sizes
+      what one fewer replica could still hold.
+    - ``queue_depth_p99``: recent router pending — backlog past the
+      decode tier's capacity also reads as slot exhaustion (sheds live
+      at that same bound).
+    """
+
+    # scale down only when the recent p99 fits inside one-fewer replicas
+    # at this utilization — the headroom that makes drain safe
+    low_util = 0.7
+    # prefill scale-down additionally wants the SLO comfortably met
+    down_ratio = 0.5
+    # ...and a hit-heavy cache (or an idle tier): hit windows need fewer
+    # prefill chips even at the same request rate
+    hit_floor = 0.5
+
+    def __init__(self, target_p99_ms: Optional[float] = None,
+                 prefill_policy: Optional[ScalingPolicy] = None,
+                 decode_policy: Optional[ScalingPolicy] = None):
+        self.target_p99_ms = (default_target_p99_ms()
+                              if target_p99_ms is None
+                              else float(target_p99_ms))
+        self.policies = {"prefill": prefill_policy or ScalingPolicy(),
+                         "decode": decode_policy or ScalingPolicy()}
+
+    # -- desired (pure; no hysteresis — ScalingPolicy applies that) ------
+
+    def desired_decode(self, signals: Dict[str, Any],
+                       current: int) -> Tuple[int, str]:
+        free_p50 = signals.get("decode_free_p50")
+        busy_p99 = signals.get("decode_busy_p99")
+        depth_p99 = signals.get("queue_depth_p99")
+        cap = max(1, int(signals.get("decode_cap_per_replica", 1)))
+        capacity = current * cap
+        if free_p50 is not None and free_p50 <= 0:
+            return current + 1, "decode slots exhausted (free p50 = 0)"
+        if depth_p99 is not None and depth_p99 > capacity:
+            return current + 1, (
+                f"backlog p99 {depth_p99:.0f} past tier capacity "
+                f"{capacity}")
+        # slot DEMAND, not just engine-busy slots: a slow client drains
+        # its stream long after the engine slot freed, but it still
+        # occupies the router's admission bound — the thing a removed
+        # replica would shrink. Take the worse of the two recent views.
+        demand = max((v for v in (busy_p99, depth_p99)
+                      if v is not None), default=None)
+        if current > 1 and demand is not None \
+                and demand <= self.low_util * (current - 1) * cap:
+            return current - 1, (
+                f"slot demand p99 {demand:.1f} fits in {current - 1} "
+                f"replica(s) at {self.low_util:.0%} utilization")
+        return current, "steady"
+
+    def desired_prefill(self, signals: Dict[str, Any],
+                        current: int) -> Tuple[int, str]:
+        ttft_p99 = signals.get("ttft_p99_ms")
+        hit_rate = signals.get("cache_hit_rate")
+        inflight_p99 = signals.get("prefill_inflight_p99")
+        if current > 1 and ttft_p99 is None and inflight_p99 is None:
+            # missing evidence never scales UP — but for a tier above
+            # its floor, a request window with no samples at all IS the
+            # evidence: nothing has needed prefill for a whole window
+            return current - 1, "tier idle (no requests in the window)"
+        if ttft_p99 is not None and ttft_p99 > self.target_p99_ms:
+            return current + 1, (
+                f"TTFT p99 {ttft_p99:.0f}ms over target "
+                f"{self.target_p99_ms:.0f}ms (queueing delay)")
+        if current > 1 and ttft_p99 is not None \
+                and ttft_p99 < self.down_ratio * self.target_p99_ms:
+            hit_heavy = hit_rate is not None and hit_rate >= self.hit_floor
+            idle = inflight_p99 is not None and \
+                inflight_p99 <= self.low_util * (current - 1)
+            # a hit-heavy window needs fewer prefill chips; an idle tier
+            # trivially does — either way the SLO is comfortably met
+            if hit_heavy or idle:
+                why = (f"hit rate {hit_rate:.0%} — suffix-only prefills"
+                       if hit_heavy else
+                       f"inflight p99 {inflight_p99:.1f} fits in "
+                       f"{current - 1}")
+                return current - 1, (
+                    f"TTFT p99 {ttft_p99:.0f}ms well under target; {why}")
+        return current, "steady"
+
+    def decide(self, signals: Dict[str, Any], current: Dict[str, int],
+               now: Optional[float] = None
+               ) -> Dict[str, Tuple[int, str]]:
+        """{tier: (target, reason)} after hysteresis; target == current
+        means hold."""
+        out: Dict[str, Tuple[int, str]] = {}
+        for tier, fn in (("prefill", self.desired_prefill),
+                         ("decode", self.desired_decode)):
+            cur = int(current[tier])
+            desired, reason = fn(signals, cur)
+            target = self.policies[tier].decide(desired, cur, now)
+            out[tier] = (target, reason if target != cur else "hold")
+        return out
+
+
+# ----------------------------------------------------------- the loop
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker
+
+
+def _notify_event(event: Dict[str, Any]) -> None:
+    """Best-effort instant marker into the conductor's autoscale event
+    log (the merged timeline's `autoscale` lane)."""
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_autoscale_event", dict(event))
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
+def _notify_resilience(event: Dict[str, Any]) -> None:
+    """Drains ride the resilience grace flow — mirror them into its
+    event log/counters too (the PR-4 lane preemptions already use)."""
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_resilience_event", dict(event))
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
+class TierSpec:
+    """How one tier scales: bounds plus the factory that builds a fresh
+    replica (in-process object or actor handle — the router accepts
+    either; the autoscaler tears actors down with kill after the grace
+    drain)."""
+
+    def __init__(self, factory: Callable[[], Any], *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 up_delay_s: Optional[float] = None,
+                 down_delay_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None):
+        self.factory = factory
+        self.policy = ScalingPolicy(min_replicas, max_replicas,
+                                    up_delay_s, down_delay_s, cooldown_s)
+
+
+class _Draining:
+    __slots__ = ("tier", "rid", "since", "grace_deadline")
+
+    def __init__(self, tier: str, rid: str, since: float, grace_s: float):
+        self.tier = tier
+        self.rid = rid
+        self.since = since
+        self.grace_deadline = since + grace_s
+
+
+class DisaggAutoscaler:
+    """Drives a ``DisaggRouter``'s prefill/decode replica sets toward
+    the TTFT SLO. One ``tick()`` = read signals, decide, apply; the
+    background thread just calls tick on ``interval_s``. Fully
+    synchronous and injectable (``now`` flows through) so tests replay
+    load shapes without sleeping."""
+
+    def __init__(self, router: Any, *,
+                 prefill: TierSpec, decode: TierSpec,
+                 policy: Optional[DisaggPolicy] = None,
+                 interval_s: Optional[float] = None,
+                 drain_grace_s: Optional[float] = None,
+                 autoscaler_id: Optional[str] = None):
+        if not router.tier_replicas("prefill") \
+                or not router.tier_replicas("decode"):
+            raise ValueError("the autoscaler drives disagg routers "
+                             "(a prefill AND a decode tier); colocated "
+                             "deployments autoscale via the Serve "
+                             "controller's AutoscalingConfig")
+        self.router = router
+        self.specs = {"prefill": prefill, "decode": decode}
+        self.policy = policy or DisaggPolicy(
+            prefill_policy=prefill.policy, decode_policy=decode.policy)
+        self.interval_s = (interval_s if interval_s is not None else
+                           _env_float("RAY_TPU_AUTOSCALE_INTERVAL_S", 1.0))
+        self.drain_grace_s = (
+            drain_grace_s if drain_grace_s is not None else
+            _env_float("RAY_TPU_AUTOSCALE_DRAIN_GRACE_S", 30.0))
+        self.autoscaler_id = autoscaler_id or \
+            f"autoscale-{os.getpid()}-{next(_SEQ)}"
+        self._free_win = SlidingWindow()
+        self._busy_win = SlidingWindow()
+        self._lock = threading.Lock()
+        self._draining: List[_Draining] = []
+        self._stats: Dict[str, Any] = {
+            "scale_ups": {t: 0 for t in TIERS},
+            "scale_downs": {t: 0 for t in TIERS},
+            "drains_completed": 0,
+            "drains_forced": 0,
+            "replica_seconds": {t: 0.0 for t in TIERS},
+            "last_reason": {t: "" for t in TIERS},
+        }
+        self._last_tick: Optional[float] = None
+        self._last_push = 0.0
+        self._teardowns: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        autoscale_metrics()  # lazy registration before the first event
+
+    # ------------------------------------------------------------ signals
+
+    def probe_signals(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Router windows + a live free-slot probe of the active decode
+        replicas (folded into this loop's own sliding windows so one
+        slow probe doesn't blind the policy)."""
+        from .disagg import _call
+
+        sig = self.router.signals()
+        reps = [r for r in self.router.tier_replicas("decode")
+                if not r["draining"]]
+        free = cap = 0
+        ok = False
+        # issue every probe BEFORE resolving any (the _admit_or_shed
+        # pattern): N actor replicas answer concurrently instead of
+        # serializing N round-trips into every control-loop tick
+        probes = []
+        for r in reps:
+            try:
+                probes.append((r, _call(r["target"], "free_slots",
+                                        block=False)))
+            except Exception:  # noqa: BLE001 — replica mid-restart
+                pass
+        for r, v in probes:
+            try:
+                from ray_tpu._private.object_store import ObjectRef
+
+                if isinstance(v, ObjectRef):
+                    import ray_tpu
+
+                    v = ray_tpu.get(v)
+                free += int(v)
+                cap += int(r["cap"])
+                ok = True
+            except Exception:  # noqa: BLE001 — replica mid-restart
+                pass
+        if ok:
+            self._free_win.add(free, now)
+            self._busy_win.add(cap - free, now)
+        free_sum = self._free_win.summary(now)
+        busy_sum = self._busy_win.summary(now)
+        if free_sum["n"]:
+            sig["decode_free_p50"] = free_sum["p50"]
+            sig["decode_busy_p99"] = busy_sum["p99"]
+        if reps:
+            sig["decode_cap_per_replica"] = max(
+                1, int(sum(r["cap"] for r in reps) / len(reps)))
+        return sig
+
+    # --------------------------------------------------------------- tick
+
+    def tick(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One control-loop pass; returns the actions taken."""
+        now = time.monotonic() if now is None else now
+        actions: List[Dict[str, Any]] = []
+        self._account_replica_seconds(now)
+        self._advance_drains(now, actions)
+        signals = self.probe_signals(now)
+        current = {t: self._active_count(t) for t in TIERS}
+        decisions = self.policy.decide(signals, current, now)
+        m = autoscale_metrics()
+        for tier in TIERS:
+            target, reason = decisions[tier]
+            # the TierSpec bounds are the authoritative capacity limits
+            # — a caller-supplied policy (its own clamps, or a test
+            # stand-in) must not scale past what the tier may hold
+            target = self.specs[tier].policy.clamp(target)
+            self._stats["last_reason"][tier] = reason
+            m["target"].set(target, tags={"tier": tier})
+            if target > current[tier]:
+                actions.extend(self._scale_up(
+                    tier, target - current[tier], target, reason))
+            elif target < current[tier]:
+                actions.extend(self._scale_down(
+                    tier, current[tier] - target, target, reason, now))
+        self.publish_telemetry(force=bool(actions))
+        return actions
+
+    def _active_count(self, tier: str) -> int:
+        return sum(1 for r in self.router.tier_replicas(tier)
+                   if not r["draining"])
+
+    def _account_replica_seconds(self, now: float) -> None:
+        if self._last_tick is not None:
+            dt = max(0.0, now - self._last_tick)
+            m = autoscale_metrics()
+            for tier in TIERS:
+                live = len(self.router.tier_replicas(tier))
+                self._stats["replica_seconds"][tier] += live * dt
+                if live:
+                    m["replica_seconds"].inc(live * dt,
+                                             tags={"tier": tier})
+        self._last_tick = now
+
+    # ----------------------------------------------------------- scale up
+
+    def _scale_up(self, tier: str, n: int, target: int,
+                  reason: str) -> List[Dict[str, Any]]:
+        actions = []
+        for _ in range(n):
+            try:
+                replica = self.specs[tier].factory()
+            except Exception as e:  # noqa: BLE001 — no capacity yet:
+                # hold the target; the next tick retries
+                self._stats["last_reason"][tier] = \
+                    f"scale-up blocked: {type(e).__name__}: {e}"
+                break
+            rid = (self.router.add_prefill(replica) if tier == "prefill"
+                   else self.router.add_decode(replica))
+            self._stats["scale_ups"][tier] += 1
+            autoscale_metrics()["decisions"].inc(
+                tags={"tier": tier, "direction": "up"})
+            ev = {"kind": "scale_up", "tier": tier, "replica": rid,
+                  "to": target, "reason": reason,
+                  "autoscaler": self.autoscaler_id}
+            _notify_event(ev)
+            actions.append(ev)
+        return actions
+
+    # --------------------------------------------------------- scale down
+
+    def _scale_down(self, tier: str, n: int, target: int, reason: str,
+                    now: float) -> List[Dict[str, Any]]:
+        """Begin draining the newest active replicas (never below the
+        initial set's oldest — newest-first mirrors the Serve
+        controller's pending-first scale-down)."""
+        actions = []
+        active = [r for r in self.router.tier_replicas(tier)
+                  if not r["draining"]]
+        for r in list(reversed(active))[:n]:
+            if not self.router.begin_drain(tier, r["rid"]):
+                continue
+            self._draining.append(
+                _Draining(tier, r["rid"], now, self.drain_grace_s))
+            self._stats["scale_downs"][tier] += 1
+            autoscale_metrics()["decisions"].inc(
+                tags={"tier": tier, "direction": "down"})
+            ev = {"kind": "drain", "tier": tier, "replica": r["rid"],
+                  "to": target, "inflight": r["inflight"],
+                  "grace_s": self.drain_grace_s, "reason": reason,
+                  "autoscaler": self.autoscaler_id}
+            _notify_event(ev)
+            _notify_resilience({"kind": "serve_drain", "name": r["rid"],
+                                "tier": tier,
+                                "grace_s": self.drain_grace_s})
+            actions.append(ev)
+        return actions
+
+    def _replica_drained(self, d: _Draining) -> bool:
+        """The zero-drop condition: no in-flight left at the router AND
+        — for a prefill replica — no unacked KV transfer still held. A
+        prefill call returns long before the decode side fetches its
+        KV, so router in-flight alone would let a drain kill chunks a
+        decode replica is about to read."""
+        from .disagg import _call
+
+        if not self.router.drained(d.tier, d.rid):
+            return False
+        if d.tier != "prefill":
+            return True
+        rep = next((r for r in self.router.tier_replicas("prefill")
+                    if r["rid"] == d.rid), None)
+        if rep is None:
+            return True
+        try:
+            return int(_call(rep["target"], "stats")
+                       .get("held_transfers", 0)) == 0
+        except Exception:  # noqa: BLE001 — replica already dead
+            return True
+
+    def _advance_drains(self, now: float,
+                        actions: List[Dict[str, Any]]) -> None:
+        """Finalize drains whose replica has nothing left in flight (or
+        whose grace window expired — the replica-side
+        prepare_for_shutdown still runs, off the tick thread, so even
+        the forced path waits out stragglers up to its own timeout
+        before the actor dies)."""
+        still: List[_Draining] = []
+        for d in self._draining:
+            drained = self._replica_drained(d)
+            if not drained and now < d.grace_deadline:
+                still.append(d)
+                continue
+            self._finalize_drain(d, drained)
+            ev = {"kind": "scale_down", "tier": d.tier,
+                  "replica": d.rid, "drained": bool(drained),
+                  "waited_s": round(now - d.since, 3),
+                  "autoscaler": self.autoscaler_id}
+            _notify_event(ev)
+            actions.append(ev)
+        self._draining = still
+
+    def _finalize_drain(self, d: _Draining, drained: bool) -> None:
+        replica = self.router.remove(d.tier, d.rid)
+        self._stats["drains_completed" if drained
+                    else "drains_forced"] += 1
+        if replica is None:
+            return
+        # replica-side teardown runs OFF the tick thread: a forced
+        # drain's shutdown window must not stall the control loop
+        # during exactly the load spike that may follow a scale-down
+        t = threading.Thread(
+            target=self._shutdown_replica, args=(replica, drained),
+            daemon=True, name=f"autoscale-teardown-{d.rid}")
+        t.start()
+        self._teardowns.append(t)
+        self._teardowns = [x for x in self._teardowns if x.is_alive()]
+
+    def _shutdown_replica(self, replica: Any, drained: bool) -> None:
+        """The replica-side grace drain (serve/replica.py shape): wait
+        out in-flight work / unacked transfers, stop the engine, then
+        release the actor. Drained replicas return from the wait
+        immediately; the FORCED path (router-side grace expired with
+        requests still running) gets one final bounded window so a
+        straggling stream isn't cut mid-token the instant the deadline
+        passes."""
+        from .disagg import _call
+
+        grace = 5.0 if drained else min(self.drain_grace_s, 10.0)
+        try:
+            _call(replica, "prepare_for_shutdown", grace)
+        except Exception:  # noqa: BLE001 — replica already dead
+            pass
+        remote = getattr(getattr(replica, "stats", None), "remote", None)
+        if remote is not None:  # actor handle: release the process
+            try:
+                import ray_tpu
+
+                ray_tpu.kill(replica)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+
+    # ------------------------------------------------------------ status
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            s = {
+                "autoscaler_id": self.autoscaler_id,
+                "router": self.router.router_id,
+                "target_p99_ms": self.policy.target_p99_ms,
+                "interval_s": self.interval_s,
+                "drain_grace_s": self.drain_grace_s,
+                "scale_ups": dict(self._stats["scale_ups"]),
+                "scale_downs": dict(self._stats["scale_downs"]),
+                "drains_completed": self._stats["drains_completed"],
+                "drains_forced": self._stats["drains_forced"],
+                "replica_seconds": {
+                    t: round(v, 3) for t, v
+                    in self._stats["replica_seconds"].items()},
+                "last_reason": dict(self._stats["last_reason"]),
+                "draining": [{"tier": d.tier, "rid": d.rid}
+                             for d in self._draining],
+            }
+        for tier in TIERS:
+            reps = self.router.tier_replicas(tier)
+            s[f"{tier}_replicas"] = len(reps)
+            s[f"{tier}_active"] = sum(1 for r in reps
+                                      if not r["draining"])
+            s[f"{tier}_bounds"] = [self.specs[tier].policy.min_replicas,
+                                   self.specs[tier].policy.max_replicas]
+        return s
+
+    def publish_telemetry(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_push < 0.5:
+            return
+        self._last_push = now
+        w = _worker()
+        if w is None:
+            return
+        try:
+            w.conductor.notify("report_autoscale_stats", w.worker_id,
+                               self.autoscaler_id, self.status())
+        except Exception:  # noqa: BLE001 — cluster shutting down
+            pass
+
+    # -------------------------------------------------------------- loop
+
+    def start(self) -> "DisaggAutoscaler":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    import traceback
+
+                    traceback.print_exc()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serve-autoscale")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # finalize in-progress drains NOW: an abandoned draining
+        # replica would stay registered (and its engine running)
+        # forever — the replica-side grace still runs in the teardown
+        # threads, which we wait out below
+        if self._draining:
+            past_every_deadline = max(
+                [time.monotonic()]
+                + [d.grace_deadline for d in self._draining])
+            self._advance_drains(past_every_deadline, [])
+        for t in self._teardowns:
+            t.join(timeout=self.drain_grace_s + 15.0)
+        self.publish_telemetry(force=True)
+
+
+__all__ = ["DisaggAutoscaler", "DisaggPolicy", "ScalingPolicy",
+           "SlidingWindow", "TierSpec", "autoscale_metrics",
+           "default_target_p99_ms"]

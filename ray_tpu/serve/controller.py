@@ -29,13 +29,21 @@ class _DeploymentState:
         self.init_args = init_args
         self.config = config
         self.target_num_replicas = config.num_replicas
+        self.scaling_policy = None  # autoscale.ScalingPolicy, lazy
         if config.autoscaling_config is not None:
-            self.target_num_replicas = max(
-                config.autoscaling_config.min_replicas, 1)
+            from .autoscale import ScalingPolicy
+
+            cfg = config.autoscaling_config
+            self.target_num_replicas = max(cfg.min_replicas, 1)
+            # the SHARED hysteresis engine (serve/autoscale.py) — the
+            # same persistence gates the disagg tier loop uses;
+            # cooldown 0 keeps the reference controller semantics
+            self.scaling_policy = ScalingPolicy(
+                cfg.min_replicas, cfg.max_replicas,
+                up_delay_s=cfg.upscale_delay_s,
+                down_delay_s=cfg.downscale_delay_s, cooldown_s=0.0)
         self.replicas: List[Tuple[str, Any]] = []  # (tag, ActorHandle)
         self.last_health_check = 0.0
-        self.last_scale_up_ok = time.monotonic()
-        self.last_scale_down_ok = time.monotonic()
         self.status = "DEPLOYING"
         # handle_id -> (total inflight from that handle, monotonic ts)
         self.handle_metrics: Dict[str, Tuple[float, float]] = {}
@@ -45,6 +53,15 @@ class _DeploymentState:
 
     def to_status(self) -> Dict[str, Any]:
         mets = list(self.replica_metrics.values())
+        # worst-replica recent p99s (merging percentiles across windows
+        # would be a lie; the max is the honest deployment-level number
+        # beside the cumulative counters)
+        recent = {}
+        for key in ("ttft_ms", "latency_ms"):
+            vals = [m["recent"][key]["p99"] for m in mets
+                    if (m.get("recent") or {}).get(key, {}).get("n")]
+            if vals:
+                recent[f"{key[:-3]}_p99_ms"] = round(max(vals), 3)
         return {"name": self.name, "status": self.status,
                 "target_num_replicas": self.target_num_replicas,
                 "replicas": [tag for tag, _ in self.replicas],
@@ -54,6 +71,7 @@ class _DeploymentState:
                                         for m in mets),
                     "num_errors": sum(m.get("num_errors", 0)
                                       for m in mets),
+                    "recent": recent,
                     "per_replica": dict(self.replica_metrics)}}
 
 
@@ -480,7 +498,7 @@ class ServeController:
 
     def _autoscale(self, st: _DeploymentState):
         cfg: Optional[AutoscalingConfig] = st.config.autoscaling_config
-        if cfg is None:
+        if cfg is None or st.scaling_policy is None:
             return  # NOTE: runs even with zero replicas, else a
         # min_replicas=0 deployment that scaled to zero could never wake up.
         now = time.monotonic()
@@ -488,18 +506,18 @@ class ServeController:
             st.handle_metrics = {
                 h: (v, ts) for h, (v, ts) in st.handle_metrics.items()
                 if now - ts < self._METRICS_STALE_S}
-            total = sum(v for v, _ in st.handle_metrics.values())
+            handle_total = sum(v for v, _ in st.handle_metrics.values())
+            # replica-reported queue depth (collected on the health
+            # cadence): a deployment whose handles stopped reporting —
+            # or that is driven through the HTTP proxy's own handle —
+            # still autoscales on what its replicas actually hold
+            replica_total = sum(
+                float(m.get("inflight", 0))
+                for m in st.replica_metrics.values())
+        total = max(handle_total, replica_total)
         desired = int(math.ceil(total / cfg.target_ongoing_requests))
-        desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
-        now = time.monotonic()
-        current = st.target_num_replicas
-        if desired <= current:
-            st.last_scale_up_ok = now  # not under pressure
-        if desired >= current:
-            st.last_scale_down_ok = now  # not over-provisioned
-        if desired > current and \
-                now - st.last_scale_up_ok >= cfg.upscale_delay_s:
-            st.target_num_replicas = desired
-        elif desired < current and \
-                now - st.last_scale_down_ok >= cfg.downscale_delay_s:
-            st.target_num_replicas = desired
+        # the shared hysteresis engine (serve/autoscale.ScalingPolicy)
+        # owns the clamp + persistence gates — one "don't flap" core
+        # for both this controller and the disagg tier loop
+        st.target_num_replicas = st.scaling_policy.decide(
+            desired, st.target_num_replicas, now)

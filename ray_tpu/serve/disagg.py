@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .autoscale import SlidingWindow
 from .handle import RequestShedError, shed_counter
 
 _SERVER_SEQ = itertools.count()
@@ -165,10 +166,13 @@ class PrefillServer:
 
         import jax.numpy as jnp
 
+        from ray_tpu.util.chunks import local_machine_id
+
         self.params = params
         self.config = config
         self.server_id = server_id or \
             f"pf-{os.getpid()}-{next(_SERVER_SEQ)}"
+        self.machine = local_machine_id()
         block_size, pool_blocks = resolve_pool_config(
             config, kv_block_size, kv_pool_blocks)
         self.kv_cache: Optional[PagedKVCache] = (
@@ -279,6 +283,29 @@ class PrefillServer:
                 self._stats["acked"] += 1
         return held is not None
 
+    def describe(self) -> Dict[str, Any]:
+        """Registration record for a router: identity + host (the
+        decode-side placement-affinity input)."""
+        return {"server_id": self.server_id, "role": "prefill",
+                "machine": self.machine}
+
+    def prepare_for_shutdown(self, timeout_s: float = 30.0) -> bool:
+        """Grace drain (the serve/replica.py shape, reused by autoscale
+        scale-down): wait until every published transfer has been acked
+        — a decode replica may still be fetching our chunks — then
+        report whether the drain completed. The chunks' refs are this
+        object's lifetime either way; the caller frees them by dropping
+        the server."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            with self._lock:
+                held = len(self._held)
+            if held == 0 or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        self.publish_telemetry(force=True)
+        return held == 0
+
     # ------------------------------------------------------------ telemetry
 
     def stats(self) -> Dict[str, Any]:
@@ -363,12 +390,15 @@ class DecodeServer:
                  **engine_kw):
         from ray_tpu.models.engine import ContinuousBatchingEngine
 
+        from ray_tpu.util.chunks import local_machine_id
+
         engine_kw.setdefault("prefix_cache", False)
         self.engine = ContinuousBatchingEngine(params, config,
                                                max_batch=max_batch,
                                                **engine_kw)
         self.server_id = server_id or \
             f"dec-{os.getpid()}-{next(_SERVER_SEQ)}"
+        self.machine = local_machine_id()
         self._lock = threading.Lock()
         self._stats = {k: 0 for k in (
             "transfers", "kv_fetched_bytes", "shm_bytes", "rpc_bytes",
@@ -470,6 +500,28 @@ class DecodeServer:
         except Exception:  # noqa: BLE001 — older jax without _cache_size
             return -1
 
+    def describe(self) -> Dict[str, Any]:
+        """Registration record for a router: identity, capacity, host
+        (the decode-side placement-affinity anchor)."""
+        return {"server_id": self.server_id, "role": "decode",
+                "capacity": self.engine.max_batch,
+                "machine": self.machine}
+
+    def prepare_for_shutdown(self, timeout_s: float = 30.0) -> bool:
+        """Grace drain (the serve/replica.py shape, reused by autoscale
+        scale-down): wait until every decode slot has finished its
+        stream, then stop the engine. Returns whether the drain
+        completed inside the window — the engine stops either way, so
+        the caller may safely drop/kill the replica afterwards."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            drained = self.engine.free_slots == self.engine.max_batch
+            if drained or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        self.stop()
+        return drained
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             s: Dict[str, Any] = dict(self._stats)
@@ -497,13 +549,55 @@ class DecodeServer:
 
 # ----------------------------------------------------------------- router
 
+class _TierReplica:
+    """One router-side replica slot. generate() holds the OBJECT (not an
+    index) across its whole lifetime, so the replica set can grow,
+    drain, and shrink mid-traffic without invalidating in-flight
+    bookkeeping."""
+
+    __slots__ = ("target", "rid", "cap", "inflight", "draining",
+                 "machine")
+
+    def __init__(self, target: Any, rid: str, cap: int,
+                 machine: Optional[str] = None):
+        self.target = target
+        self.rid = rid
+        self.cap = int(cap)
+        self.inflight = 0
+        self.draining = False
+        self.machine = machine
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"rid": self.rid, "target": self.target, "cap": self.cap,
+                "inflight": self.inflight, "draining": self.draining,
+                "machine": self.machine}
+
+
+# cache-outcome weights for the router's recent hit-rate signal: a full
+# hit skips the prefill entirely, a partial roughly halves it, a miss
+# pays it all — the policy reads "fraction of prefill work the cache is
+# absorbing right now"
+_OUTCOME_WEIGHT = {"hit": 1.0, "partial": 0.5, "miss": 0.0}
+
+
 class DisaggRouter:
     """Dispatch + admission control over a prefill tier and a decode
     tier (each a sequence of in-process servers or actor handles).
 
     With an empty prefill tier the router degrades to the colocated
     single-engine path — same engine code, bit-identical outputs — so
-    one deployment shape serves both modes."""
+    one deployment shape serves both modes.
+
+    The replica sets are LIVE: ``add_prefill``/``add_decode`` admit a
+    new replica to dispatch immediately, ``begin_drain`` stops
+    dispatching to one while its in-flight requests finish and its KV
+    transfers get acked, and ``remove`` retires it once ``drained`` —
+    the serve/autoscale.py control loop drives exactly this API
+    mid-traffic. Dispatch policy: decode by free-slot count, prefill by
+    prefix-cache affinity WITHIN the subset co-located with the chosen
+    decode replica's host (when one exists), so KV transfers stay on
+    shm — the ``shm_affinity`` split in stats() reports how often that
+    held."""
 
     def __init__(self, decode: Sequence[Any] = (),
                  prefill: Sequence[Any] = (), *,
@@ -520,8 +614,6 @@ class DisaggRouter:
         if not prefill and colocated is None:
             raise ValueError(
                 "need a prefill+decode pair or a colocated engine")
-        self._decode = list(decode)
-        self._prefill = list(prefill)
         self._colocated = colocated
         if max_queue_depth is None:
             max_queue_depth = int(os.environ.get(
@@ -537,57 +629,165 @@ class DisaggRouter:
         self.router_id = router_id or \
             f"router-{os.getpid()}-{next(_SERVER_SEQ)}"
         self._lock = threading.Lock()
-        if self._decode:
-            self._cap = [int(_call(d, "capacity")) for d in self._decode]
-        else:
-            self._cap = [int(colocated.max_batch)]
-        self._inflight = [0] * len(self._cap)
-        if self._prefill:
-            # every admissible request can be in flight at once and
-            # affinity can route ALL of them to one prefill server —
-            # push the bound so its retention window can never reap a
-            # transfer a decode replica is about to fetch
-            hint = 2 * (sum(self._cap)
-                        + len(self._cap) * self.max_queue_depth)
-            for pf in self._prefill:
-                try:
-                    _call(pf, "set_retention", hint, block=False)
-                except Exception:  # noqa: BLE001 — replica mid-restart
-                    pass
+        self._decode: List[_TierReplica] = [
+            self._register(d, "decode") for d in decode]
+        self._prefill: List[_TierReplica] = [
+            self._register(p, "prefill") for p in prefill]
+        if not self._decode:
+            self._decode = [_TierReplica(
+                colocated, f"{self.router_id}-colocated",
+                int(colocated.max_batch))]
+        self._push_retention_hint()
+        # recent-signal windows (serve/autoscale.SlidingWindow): the
+        # policy — and `recent` in stats() — reads these, not lifetime
+        # counters, so a load shift shows up within the window
+        self._ttft_win = SlidingWindow()
+        self._depth_win = SlidingWindow()
+        self._pf_inflight_win = SlidingWindow()
+        self._cache_win = SlidingWindow()
+        self._pf_inflight = 0
         self._stats = {k: 0 for k in (
-            "dispatched", "completed", "shed", "max_pending")}
+            "dispatched", "completed", "shed", "max_pending",
+            "shm_affinity_hits", "shm_affinity_total")}
         self._last_push = 0.0
         disagg_metrics()
 
+    # ----------------------------------------------------- replica set ops
+
+    def _register(self, target: Any, tier: str) -> _TierReplica:
+        try:
+            info = _call(target, "describe")
+        except Exception:  # noqa: BLE001 — pre-describe replica impls
+            info = {}
+        rid = info.get("server_id") or \
+            f"{tier}-{self.router_id}-{next(_SERVER_SEQ)}"
+        cap = int(info.get("capacity")
+                  or (_call(target, "capacity") if tier == "decode"
+                      else 0))
+        return _TierReplica(target, rid, cap, info.get("machine"))
+
+    def _push_retention_hint(self) -> None:
+        """Every admissible request can be in flight at once and
+        affinity can route ALL of them to one prefill server — push the
+        bound so its retention window can never reap a transfer a
+        decode replica is about to fetch. Re-pushed whenever the
+        replica set grows."""
+        with self._lock:
+            prefill = list(self._prefill)
+            hint = 2 * sum(r.cap + self.max_queue_depth
+                           for r in self._decode)
+        for pf in prefill:
+            try:
+                _call(pf.target, "set_retention", hint, block=False)
+            except Exception:  # noqa: BLE001 — replica mid-restart
+                pass
+
+    def add_decode(self, target: Any) -> str:
+        """Admit a new decode replica — it becomes dispatchable the
+        moment this returns."""
+        rep = self._register(target, "decode")
+        with self._lock:
+            self._decode.append(rep)
+        self._push_retention_hint()
+        self.publish_telemetry(force=True)
+        return rep.rid
+
+    def add_prefill(self, target: Any) -> str:
+        """Admit a new prefill replica (affinity re-hashes over the
+        grown set on the next dispatch)."""
+        rep = self._register(target, "prefill")
+        with self._lock:
+            self._prefill.append(rep)
+        self._push_retention_hint()
+        self.publish_telemetry(force=True)
+        return rep.rid
+
+    def _tier(self, tier: str) -> List[_TierReplica]:
+        if tier not in ("prefill", "decode"):
+            raise ValueError(f"unknown tier {tier!r}")
+        return self._prefill if tier == "prefill" else self._decode
+
+    def tier_replicas(self, tier: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.snapshot() for r in self._tier(tier)]
+
+    def begin_drain(self, tier: str, rid: str) -> bool:
+        """Stop dispatching to one replica; its in-flight requests keep
+        running and its KV transfers still get acked. Refuses to drain
+        the LAST active replica of a tier (the router must stay able to
+        serve). Returns whether the drain started."""
+        with self._lock:
+            reps = self._tier(tier)
+            active = [r for r in reps if not r.draining]
+            for r in reps:
+                if r.rid == rid and not r.draining:
+                    if len(active) <= 1:
+                        return False
+                    r.draining = True
+                    break
+            else:
+                return False
+        self.publish_telemetry(force=True)
+        return True
+
+    def drained(self, tier: str, rid: str) -> bool:
+        """True when a draining replica has zero in-flight left (its
+        dispatch stopped at begin_drain; this is the router-side half of
+        the grace drain — the replica-side prepare_for_shutdown
+        double-checks engine slots and unacked transfers)."""
+        with self._lock:
+            for r in self._tier(tier):
+                if r.rid == rid:
+                    return r.draining and r.inflight == 0
+        return True  # already removed
+
+    def remove(self, tier: str, rid: str) -> Optional[Any]:
+        """Retire a draining replica from the set; returns its target
+        so the caller can tear it down (grace-drain first — see
+        serve/autoscale.py)."""
+        with self._lock:
+            reps = self._tier(tier)
+            for i, r in enumerate(reps):
+                if r.rid == rid:
+                    if not r.draining:
+                        raise ValueError(
+                            f"{tier} replica {rid} is not draining — "
+                            "begin_drain() first so dispatch stops "
+                            "before the replica disappears")
+                    del reps[i]
+                    return r.target
+        return None
+
     # ------------------------------------------------------------ admission
 
-    def _admit_or_shed(self) -> int:
-        """Reserve a decode replica (index) or shed. Sheds when EVERY
+    def _admit_or_shed(self) -> _TierReplica:
+        """Reserve a decode replica or shed. Sheds when EVERY active
         replica's in-flight estimate has reached capacity +
-        max_queue_depth — the bound that keeps queue depth finite. The
-        bound check and the in-flight reservation happen under ONE lock
-        acquisition (check-then-act would let N racing callers all pass
-        the check before any reserves, exceeding the bound by N-1);
-        shed-side metrics and the conductor notify run after release so
-        overload never serializes healthy admissions behind a socket
-        write."""
+        max_queue_depth — the bound that keeps queue depth finite
+        (draining replicas receive nothing, so they neither admit nor
+        extend the bound). The bound check and the in-flight
+        reservation happen under ONE lock acquisition (check-then-act
+        would let N racing callers all pass the check before any
+        reserves, exceeding the bound by N-1); shed-side metrics and
+        the conductor notify run after release so overload never
+        serializes healthy admissions behind a socket write."""
         with self._lock:
-            open_idx = [i for i in range(len(self._cap))
-                        if self._inflight[i]
-                        < self._cap[i] + self.max_queue_depth]
-            if open_idx:
+            open_reps = [r for r in self._decode if not r.draining
+                         and r.inflight < r.cap + self.max_queue_depth]
+            pending = sum(r.inflight for r in self._decode)
+            if open_reps:
                 # probe-free first cut: least estimated in-flight,
                 # reserved NOW so the bound holds under concurrency
-                idx = min(open_idx, key=lambda i: self._inflight[i])
-                self._inflight[idx] += 1
-                pending = sum(self._inflight)
+                rep = min(open_reps, key=lambda r: r.inflight)
+                rep.inflight += 1
+                pending += 1
                 self._stats["dispatched"] += 1
                 self._stats["max_pending"] = max(
                     self._stats["max_pending"], pending)
             else:
                 self._stats["shed"] += 1
-                pending = sum(self._inflight)
-        if not open_idx:
+        self._depth_win.add(pending)
+        if not open_reps:
             shed_counter().inc(tags={"app": "disagg",
                                      "deployment": self.router_id})
             _notify_event({"kind": "shed", "router": self.router_id,
@@ -604,7 +804,7 @@ class DisaggRouter:
                 f"{self.max_queue_depth}; retry after "
                 f"{self.retry_after_s:.1f}s",
                 retry_after_s=self.retry_after_s)
-        if self._decode and len(open_idx) > 1:
+        if self._prefill and len(open_reps) > 1:
             # refine by live free-slot count (the decode-pick policy);
             # the in-flight estimate breaks ties and covers probe lag.
             # The probes are ISSUED before any is awaited so N actor
@@ -618,8 +818,8 @@ class DisaggRouter:
 
                 import ray_tpu
 
-                probes = [(i, _call(self._decode[i], "free_slots",
-                                    block=False)) for i in open_idx]
+                probes = [(r, _call(r.target, "free_slots",
+                                    block=False)) for r in open_reps]
                 # expected free slots once in-transit dispatches land:
                 # the probe already excludes EXECUTING requests, which
                 # are also in this router's in-flight estimate, so
@@ -630,39 +830,59 @@ class DisaggRouter:
                 # honest about slots held by load we never saw.
                 frees = [(min(int(ray_tpu.get(v)
                                   if isinstance(v, ObjectRef) else v),
-                              self._cap[i] - self._inflight[i]), i)
-                         for i, v in probes]
-                best = max(frees)[1]
+                              r.cap - r.inflight), i)
+                         for i, (r, v) in enumerate(probes)]
+                best = probes[max(frees)[1]][0]
             except Exception:  # noqa: BLE001 — replica mid-restart
-                best = idx
-            if best != idx:
+                best = rep
+            if best is not rep:
                 with self._lock:
-                    if self._inflight[best] < self._cap[best] + \
-                            self.max_queue_depth:
-                        self._inflight[idx] -= 1
-                        self._inflight[best] += 1
-                        idx = best
+                    if not best.draining and best.inflight < \
+                            best.cap + self.max_queue_depth:
+                        rep.inflight -= 1
+                        best.inflight += 1
+                        rep = best
         disagg_metrics()["queue_depth"].set(
             pending, tags={"router": self.router_id})
         self.publish_telemetry()
-        return idx
+        return rep
 
-    def _complete(self, idx: int) -> None:
+    def _complete(self, rep: _TierReplica) -> None:
         with self._lock:
-            if self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if rep.inflight > 0:
+                rep.inflight -= 1
             self._stats["completed"] += 1
-            pending = sum(self._inflight)
+            pending = sum(r.inflight for r in self._decode)
         disagg_metrics()["queue_depth"].set(
             pending, tags={"router": self.router_id})
         self.publish_telemetry()
 
     # ------------------------------------------------------------- dispatch
 
-    def _pick_prefill(self, prompt: np.ndarray) -> Any:
+    def _pick_prefill(self, prompt: np.ndarray,
+                      decode_machine: Optional[str]) -> _TierReplica:
+        """Prefix-cache affinity WITHIN the host-local subset: among
+        prefill replicas co-located with the chosen decode replica (so
+        the KV transfer rides shm, never RPC), the prompt's first cache
+        block hashes to one stable choice; with no co-located replica
+        the hash falls back to the whole active set. On one host the
+        subset IS the whole set, so single-host affinity (and
+        bit-identity) is unchanged."""
         head = tuple(int(t) for t in prompt[:self.affinity_tokens])
-        idx = hash(head) % len(self._prefill)
-        return self._prefill[idx]
+        with self._lock:
+            cands = [r for r in self._prefill if not r.draining]
+            if not cands:  # every prefill draining: keep serving
+                cands = list(self._prefill)
+            local = [r for r in cands
+                     if decode_machine is not None
+                     and r.machine == decode_machine]
+            pool = local or cands
+            rep = pool[hash(head) % len(pool)]
+            self._stats["shm_affinity_total"] += 1
+            if decode_machine is not None \
+                    and rep.machine == decode_machine:
+                self._stats["shm_affinity_hits"] += 1
+        return rep
 
     def generate(self, prompt_tokens, max_new_tokens: int,
                  eos_token: Optional[int] = None, *,
@@ -676,53 +896,119 @@ class DisaggRouter:
         (bench_serve.py's backpressure knob): decode ticks must keep
         serving OTHER requests while this one drains slowly."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
-        idx = self._admit_or_shed()
+        rep = self._admit_or_shed()
+        t_admit = time.perf_counter()
         try:
             if not self._prefill:
                 out: List[int] = []
                 for tok in self._colocated.stream(prompt, max_new_tokens,
                                                   eos_token,
                                                   timeout_s=timeout_s):
-                    if not out and on_first_token is not None:
-                        on_first_token()
+                    if not out:
+                        self._ttft_win.add(
+                            (time.perf_counter() - t_admit) * 1e3)
+                        if on_first_token is not None:
+                            on_first_token()
                     out.append(tok)
                     if token_sleep_s > 0:
                         time.sleep(token_sleep_s)
                 return out
-            pf = self._pick_prefill(prompt)
-            rec = _call(pf, "prefill", prompt.tolist())
+            pf = self._pick_prefill(prompt, rep.machine)
+            with self._lock:
+                self._pf_inflight += 1
+                pf.inflight += 1
+            self._pf_inflight_win.add(self._pf_inflight)
+            try:
+                rec = _call(pf.target, "prefill", prompt.tolist())
+            finally:
+                with self._lock:
+                    self._pf_inflight -= 1
+                    if pf.inflight > 0:
+                        pf.inflight -= 1
+            # the first token exists NOW — this is the TTFT the recent
+            # window (and the policy's queueing-delay signal) reads
+            self._ttft_win.add((time.perf_counter() - t_admit) * 1e3)
+            self._cache_win.add(
+                _OUTCOME_WEIGHT.get(rec.get("outcome"), 0.0))
             try:
                 if on_first_token is not None:
                     on_first_token()  # rec carries the first token
-                dec = self._decode[idx]
-                toks = _call(dec, "decode_from", rec, max_new_tokens,
-                             eos_token, timeout_s)
+                toks = _call(rep.target, "decode_from", rec,
+                             max_new_tokens, eos_token, timeout_s)
             finally:
                 # Ack even when decode failed: the transfer can never be
                 # consumed again, and an un-acked record pins the sender's
                 # chunk refs until the retention window overflows — which
                 # on a quiet tier is never.
-                _call(pf, "ack", rec["transfer_id"], block=False)
+                _call(pf.target, "ack", rec["transfer_id"], block=False)
             if token_sleep_s > 0:
                 for _ in toks:
                     time.sleep(token_sleep_s)
             return toks
         finally:
-            self._complete(idx)
+            self._complete(rep)
 
     # ------------------------------------------------------------ telemetry
+
+    def reset_signal_windows(self) -> None:
+        """Fresh recent-signal windows. Callers that warm compile
+        caches through the router (bench_serve's off-the-clock phase)
+        reset before attaching an autoscaler — multi-second first
+        compiles would otherwise read as a TTFT-SLO breach for a whole
+        window and trigger spurious scale-ups."""
+        self._ttft_win = SlidingWindow()
+        self._depth_win = SlidingWindow()
+        self._pf_inflight_win = SlidingWindow()
+        self._cache_win = SlidingWindow()
+
+    def signals(self) -> Dict[str, Any]:
+        """The autoscale policy's input snapshot (recent windows; keys
+        absent when there is no evidence yet — see
+        serve/autoscale.DisaggPolicy for what each drives)."""
+        sig: Dict[str, Any] = {}
+        ttft = self._ttft_win.summary()
+        if ttft["n"]:
+            sig["ttft_p99_ms"] = ttft["p99"]
+        depth = self._depth_win.summary()
+        if depth["n"]:
+            sig["queue_depth_p99"] = depth["p99"]
+        pf = self._pf_inflight_win.summary()
+        if pf["n"]:
+            sig["prefill_inflight_p99"] = pf["p99"]
+        cache = self._cache_win.summary()
+        if cache["n"]:
+            sig["cache_hit_rate"] = cache["mean"]
+        return sig
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             s: Dict[str, Any] = dict(self._stats)
-            s["pending"] = sum(self._inflight)
+            s["pending"] = sum(r.inflight for r in self._decode)
+            decode = list(self._decode)
+            prefill = list(self._prefill)
         s.update(role="router", router_id=self.router_id,
-                 mode="disagg" if self._prefill else "colocated",
-                 decode_replicas=len(self._cap),
-                 prefill_replicas=len(self._prefill),
-                 capacity=sum(self._cap),
+                 mode="disagg" if prefill else "colocated",
+                 decode_replicas=sum(1 for r in decode
+                                     if not r.draining),
+                 prefill_replicas=sum(1 for r in prefill
+                                      if not r.draining),
+                 draining_replicas=sum(
+                     1 for r in decode + prefill if r.draining),
+                 capacity=sum(r.cap for r in decode if not r.draining),
                  max_queue_depth=self.max_queue_depth,
                  retry_after_s=self.retry_after_s)
+        if s["shm_affinity_total"]:
+            s["shm_affinity_hit_rate"] = round(
+                s["shm_affinity_hits"] / s["shm_affinity_total"], 4)
+        # recent trailing-window summaries beside the lifetime counters
+        # (`serve status`/CLI show both; the autoscale policy reads the
+        # same derivation through signals())
+        s["recent"] = {
+            "ttft_ms": self._ttft_win.summary(),
+            "queue_depth": self._depth_win.summary(),
+            "prefill_inflight": self._pf_inflight_win.summary(),
+            "cache_hit_rate": self._cache_win.summary(),
+        }
         return s
 
     def publish_telemetry(self, force: bool = False) -> None:

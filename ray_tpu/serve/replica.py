@@ -173,6 +173,8 @@ class ReplicaActor:
     def __init__(self, replica_tag: str, deployment_name: str, app_name: str,
                  serialized_callable: bytes, init_args: bytes,
                  user_config: Optional[Any] = None):
+        from .autoscale import SlidingWindow
+
         self.replica_tag = replica_tag
         self.deployment_name = deployment_name
         self.app_name = app_name
@@ -182,6 +184,12 @@ class ReplicaActor:
         self._num_errors = 0
         self._start_time = time.time()
         self._tags = {"app": app_name, "deployment": deployment_name}
+        # trailing-window twins of the cumulative Prometheus histograms:
+        # `serve status` (and the autoscaling signal path) read RECENT
+        # p50/p99, which a lifetime histogram can't give once load
+        # shifts (serve/autoscale.SlidingWindow, shared derivation)
+        self._recent_latency = SlidingWindow()
+        self._recent_ttft = SlidingWindow()
 
         target = cloudpickle.loads(serialized_callable)
         args, kwargs = cloudpickle.loads(init_args)
@@ -248,12 +256,14 @@ class ReplicaActor:
                 with self._lock:
                     self._num_errors += 1
             m = _replica_metrics()
-            m["latency"].observe((time.perf_counter() - t0) * 1e3,
-                                 tags=self._tags)
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            m["latency"].observe(latency_ms, tags=self._tags)
+            self._recent_latency.add(latency_ms)
             if ttft_s is not None:
                 m["ttft"].observe(ttft_s * 1e3,
                                   tags=dict(self._tags,
                                             cache=cache_label or ""))
+                self._recent_ttft.add(ttft_s * 1e3)
             m["requests"].inc(1, tags=dict(self._tags, outcome=outcome))
             m["inflight"].set(self._inflight,
                               tags=dict(self._tags,
@@ -345,11 +355,17 @@ class ReplicaActor:
 
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:
-            return {"replica_tag": self.replica_tag,
-                    "inflight": self._inflight,
-                    "num_requests": self._num_requests,
-                    "num_errors": self._num_errors,
-                    "uptime_s": time.time() - self._start_time}
+            out = {"replica_tag": self.replica_tag,
+                   "inflight": self._inflight,
+                   "num_requests": self._num_requests,
+                   "num_errors": self._num_errors,
+                   "uptime_s": time.time() - self._start_time}
+        # recent trailing-window summaries beside the lifetime counters
+        # (piggybacked to the controller on the health cadence; shown
+        # by `serve status` and read by the autoscaling signal path)
+        out["recent"] = {"latency_ms": self._recent_latency.summary(),
+                         "ttft_ms": self._recent_ttft.summary()}
+        return out
 
     def check_health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

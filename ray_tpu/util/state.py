@@ -185,6 +185,19 @@ def disagg_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def autoscaler_status() -> Dict[str, Any]:
+    """Serving-autoscaler view (serve/autoscale.py): per-loop status
+    snapshots (per-tier targets and bounds, scale-up/down decision
+    counts, drain outcomes, replica-seconds — the provisioning cost the
+    policy minimizes, last decision reason) plus cluster totals. The
+    CLI analog is `python -m ray_tpu autoscale`; the dashboard serves
+    it at /api/autoscale. (The NODE-level autoscaler —
+    ray_tpu.autoscaler, which launches/terminates hosts — mirrors its
+    status separately at /api/autoscaler.)"""
+    return _conductor().conductor.call("get_autoscale_status",
+                                       timeout=10.0)
+
+
 def oracle_status() -> Dict[str, Any]:
     """Step-time oracle view (observability.roofline): the latest
     roofline prediction per layout ({device_step, ici_wait, dcn_wait}

@@ -62,6 +62,13 @@ def pytest_configure(config):
         "module-scoped cluster with log_to_driver=0 — select with "
         "`-m disagg`")
     config.addinivalue_line(
+        "markers", "autoscale: SLO-driven serving-autoscaler scenarios "
+        "(serve/autoscale.py: sliding-window signals, hysteresis "
+        "policy, scale-up/drain against real disagg tiers); everything "
+        "is tier-1-safe on CPU, the e2e surface check runs on a "
+        "module-scoped cluster with log_to_driver=0 — select with "
+        "`-m autoscale`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "

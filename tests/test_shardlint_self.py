@@ -85,6 +85,16 @@ def test_disagg_modules_are_lint_covered():
         assert errors(lint_path(path)) == [], rel
 
 
+def test_autoscale_module_is_lint_covered():
+    """The serving autoscaler (serve/autoscale.py) is inside the
+    self-lint set: the walk parses it and it carries zero error
+    findings of its own (a rename/move would silently drop it from
+    coverage)."""
+    path = os.path.join(PACKAGE_ROOT, "serve", "autoscale.py")
+    assert os.path.exists(path)
+    assert errors(lint_path(path)) == []
+
+
 def test_driver_entry_is_clean_too():
     repo_root = os.path.dirname(PACKAGE_ROOT)
     entry = os.path.join(repo_root, "__graft_entry__.py")
