@@ -263,6 +263,15 @@ class ConductorHandler:
         self._autoscale_stats: Dict[str, Dict[str, Any]] = {}
         self._autoscale_events: List[Dict[str, Any]] = []
 
+        # Serving-plane fault tolerance (serve/disagg.py failover +
+        # serve/autoscale.py self-healing): routers push failover/shed
+        # accounting, healers push death/replacement/breaker counters.
+        # The failover/replace/breaker_trip instant markers ride the
+        # RESILIENCE event log (they ARE recovery events); this roster
+        # feeds util.state.servefault_status(), `ray_tpu servefault`,
+        # and /api/servefault with one set of numbers.
+        self._servefault_stats: Dict[str, Dict[str, Any]] = {}
+
         # Step-time oracle (observability.roofline): predicted step-time
         # breakdowns keyed by layout + predicted-vs-measured validation
         # records (residuals, fitted calibration). One aggregate feeds
@@ -1856,6 +1865,93 @@ class ConductorHandler:
                           ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._disagg_events[-limit:]
+
+    # ------------------------------------------ serving fault tolerance
+    # Disagg routers (failover/shed accounting) and self-healers
+    # (death/replacement/breaker counters) push snapshots here;
+    # util.state.servefault_status(), `ray_tpu servefault`, and the
+    # dashboard /api/servefault all read the same aggregate. The
+    # instant markers (failover / replace / breaker_trip) land in the
+    # resilience event log — recovery events belong in the resilience
+    # lane of the merged timeline.
+
+    _SERVEFAULT_STATS_KEPT = 128
+    _SERVEFAULT_EVENT_KINDS = ("failover", "replace", "breaker_trip",
+                               "replica_death", "chaos", "serve_drain")
+
+    def report_servefault_stats(self, worker_id: str, component_id: str,
+                                stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._servefault_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            while len(self._servefault_stats) > \
+                    self._SERVEFAULT_STATS_KEPT:
+                oldest = min(self._servefault_stats,
+                             key=lambda k:
+                             self._servefault_stats[k].get("ts", 0.0))
+                del self._servefault_stats[oldest]
+
+    def get_servefault_status(self) -> Dict[str, Any]:
+        """One aggregate for every servefault surface: router snapshots
+        (failovers by phase, sheds by cause, corpses removed) + healer
+        snapshots (deaths, replacements, breaker) + cluster totals."""
+        with self._lock:
+            comps = {k: dict(v)
+                     for k, v in self._servefault_stats.items()}
+        routers = {k: v for k, v in comps.items()
+                   if v.get("role") == "router"}
+        healers = {k: v for k, v in comps.items()
+                   if v.get("role") == "healer"}
+        tiers = ("prefill", "decode")
+
+        def _sum_tiered(snaps, key):
+            return {t: sum(int((s.get(key) or {}).get(t, 0))
+                           for s in snaps.values()) for t in tiers}
+
+        sheds_by_cause: Dict[str, int] = {}
+        for r in routers.values():
+            for cause, n in (r.get("sheds_by_cause") or {}).items():
+                sheds_by_cause[cause] = \
+                    sheds_by_cause.get(cause, 0) + int(n)
+        totals: Dict[str, Any] = {
+            "routers": len(routers),
+            "healers": len(healers),
+            "failovers": _sum_tiered(routers, "failovers"),
+            "failovers_total": sum(
+                sum((r.get("failovers") or {}).values())
+                for r in routers.values()),
+            "failover_requests": sum(
+                int(r.get("failover_requests", 0))
+                for r in routers.values()),
+            "sheds_by_cause": sheds_by_cause,
+            "removed_dead": _sum_tiered(routers, "removed_dead"),
+            "deaths": _sum_tiered(healers, "deaths"),
+            "replacements": _sum_tiered(healers, "replacements"),
+            "replacements_total": sum(
+                sum((h.get("replacements") or {}).values())
+                for h in healers.values()),
+            "replacements_blocked": sum(
+                int(h.get("replacements_blocked", 0))
+                for h in healers.values()),
+            "breaker_trips": sum(int(h.get("breaker_trips", 0))
+                                 for h in healers.values()),
+            "drains_reaped": sum(int(h.get("drains_reaped", 0))
+                                 for h in healers.values()),
+        }
+        return {"routers": routers, "healers": healers,
+                "totals": totals}
+
+    def get_servefault_events(self, limit: int = 10_000
+                              ) -> List[Dict[str, Any]]:
+        """The servefault slice of the resilience event log (the
+        markers live there — one lane, one set of numbers)."""
+        with self._lock:
+            events = list(self._resilience_events)
+        kinds = self._SERVEFAULT_EVENT_KINDS
+        return [e for e in events if e.get("kind") in kinds][-limit:]
 
     # ------------------------------------------------ serving autoscaler
     # serve/autoscale.py policy loops push status snapshots and

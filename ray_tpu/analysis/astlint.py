@@ -17,6 +17,15 @@ so broken or dependency-heavy modules still lint):
   passed to a `jax.jit(...)` call in the same file. `.item()` on a tracer
   aborts tracing; `print` runs at trace time and shows a tracer, not the
   value (fix: `jax.debug.print`).
+- unsupervised-actor-call (info): in modules using serve.disagg's
+  ``_call`` dispatch helper, a bare ``_call(<replica>.target, ...)`` /
+  ``_call(<replica>["target"], ...)`` outside the router's
+  ``_tier_call`` failover wrapper. The wrapper is what turns a replica
+  death into corpse removal + bounded failover; a bare call raises the
+  raw ActorDiedError to the caller, silently dropping the request's
+  fault-tolerance guarantee. Advisory: call sites that are already
+  supervised (probe loops in try/except, fire-and-forget acks) suppress
+  with a justification comment.
 
 Suppression: append `# shardlint: ok` to the flagged line, or
 `# shardlint: disable=<rule-id>` to suppress one rule on that line.
@@ -28,7 +37,7 @@ import os
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from .findings import ERROR, Finding, WARNING
+from .findings import ERROR, Finding, INFO, WARNING
 
 # Module-attribute calls that block the calling thread.
 _BLOCKING_ATTRS: Dict[Tuple[str, str], str] = {
@@ -238,6 +247,57 @@ def _lint_host_sync_in_jit(tree: ast.AST, aliases: _Aliases,
     return findings
 
 
+# ------------------------------------------------- unsupervised-actor-call
+
+
+def _is_tier_target(expr: ast.AST) -> bool:
+    """`<anything>.target` or `<anything>["target"]` — the shapes a
+    router-side replica handle takes (a `_TierReplica` object or its
+    `snapshot()` dict)."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "target":
+        return True
+    return (isinstance(expr, ast.Subscript)
+            and isinstance(expr.slice, ast.Constant)
+            and expr.slice.value == "target")
+
+
+def _lint_unsupervised_actor_call(tree: ast.AST, aliases: _Aliases,
+                                  path: str) -> List[Finding]:
+    """Active only in modules where serve.disagg's `_call` dispatch
+    helper is in scope (defined locally, or imported from the disagg
+    module) — everywhere else a `_call` name is someone else's
+    function."""
+    defines = any(isinstance(n, ast.FunctionDef) and n.name == "_call"
+                  for n in ast.iter_child_nodes(tree))
+    imp = aliases.from_imports.get("_call")
+    imported = imp is not None and imp[1] == "_call" \
+        and imp[0].endswith("disagg")
+    if not (defines or imported):
+        return []
+    # every node lexically inside the sanctioned failover wrapper
+    sanctioned = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "_tier_call":
+            sanctioned.update(id(n) for n in ast.walk(fn))
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in sanctioned:
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Name) and f.id == "_call"):
+            continue
+        if not node.args or not _is_tier_target(node.args[0]):
+            continue
+        findings.append(Finding(
+            "unsupervised-actor-call", INFO, f"{path}:{node.lineno}",
+            "bare _call() on a tier-replica target bypasses the "
+            "failover wrapper — a replica death here raises "
+            "unsupervised to the caller",
+            "route through DisaggRouter._tier_call, or suppress with "
+            "a justification when the site is already supervised"))
+    return findings
+
+
 # ---------------------------------------------------------------- drivers
 
 
@@ -251,6 +311,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     aliases = _Aliases(tree)
     findings = _lint_blocking_in_async(tree, aliases, path)
     findings += _lint_host_sync_in_jit(tree, aliases, path)
+    findings += _lint_unsupervised_actor_call(tree, aliases, path)
     if not findings:
         return findings
     suppressed = _suppressions(source)

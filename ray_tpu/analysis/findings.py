@@ -42,6 +42,9 @@ RULES: Dict[str, str] = {
     "blocking-in-async":
         "blocking call (time.sleep / ray_tpu.get / Queue.get) inside "
         "an async def",
+    "unsupervised-actor-call":
+        "bare call on a serve tier-replica target bypasses the "
+        "failover wrapper (replica death raises unsupervised)",
     "host-sync-in-jit":
         "host synchronization (.item() / device_get / print) inside a "
         "jitted function",

@@ -90,9 +90,17 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
              burst_size: int = 8, zipf_a: float = 1.1,
              slow_client_frac: float = 0.0,
              token_sleep_s: float = 0.02,
-             timeout_s: float = 120.0, seed: int = 0) -> Dict[str, Any]:
+             timeout_s: float = 120.0,
+             deadline_s: Optional[float] = None,
+             outputs: Optional[Dict[int, List[int]]] = None,
+             seed: int = 0) -> Dict[str, Any]:
     """Replay the open-loop schedule against `router` and return the
-    benchmark record (no JSON printing — callers compose it)."""
+    benchmark record (no JSON printing — callers compose it).
+    `deadline_s` propagates a per-request deadline (sheds past it carry
+    cause "deadline" — slow clients exercise exactly that edge).
+    `outputs`, when given, collects each completed request's token list
+    by request index — the chaos harness diffs it against a clean run's
+    to prove failed-over requests stayed bit-identical."""
     from ray_tpu.serve.handle import RequestShedError
 
     rng = np.random.default_rng(seed)
@@ -103,8 +111,10 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
 
     lock = threading.Lock()
     ttfts: List[float] = []
+    latencies: List[float] = []
     tokens = [0] * n_requests
     outcomes = {"ok": 0, "shed": 0, "error": 0}
+    shed_causes: Dict[str, int] = {}
     errors: List[str] = []
 
     def one(i: int) -> None:
@@ -114,17 +124,26 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
             toks = router.generate(
                 prompts[int(picks[i])], max_new_tokens,
                 timeout_s=timeout_s,
+                deadline_s=deadline_s,
                 on_first_token=lambda: first.append(
                     time.perf_counter() - t0),
                 token_sleep_s=token_sleep_s if slow[i] else 0.0)
+            wall = time.perf_counter() - t0
             with lock:
                 outcomes["ok"] += 1
                 tokens[i] = len(toks)
+                latencies.append(wall)
                 if first:
                     ttfts.append(first[0])
-        except RequestShedError:
+                if outputs is not None:
+                    outputs[i] = list(toks)
+        except RequestShedError as e:
+            # a shed WITHOUT a cause is a regression the chaos verdict
+            # must catch — never default it to a legitimate cause
+            cause = getattr(e, "cause", None) or "unattributed"
             with lock:
                 outcomes["shed"] += 1
+                shed_causes[cause] = shed_causes.get(cause, 0) + 1
         except Exception as e:  # noqa: BLE001 — recorded, not fatal
             with lock:
                 outcomes["error"] += 1
@@ -154,10 +173,14 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
         snap = dict(outcomes)
         total_tokens = int(sum(tokens))
         ttft_ms = sorted(t * 1e3 for t in ttfts)
+        lat_ms = sorted(t * 1e3 for t in latencies)
+        causes = dict(shed_causes)
         err_samples = list(errors)
     hung = n_requests - sum(snap.values())
     pct = (lambda p: round(float(np.percentile(ttft_ms, p)), 2)
            if ttft_ms else None)
+    lpct = (lambda p: round(float(np.percentile(lat_ms, p)), 2)
+            if lat_ms else None)
     rec: Dict[str, Any] = {
         "n_requests": n_requests,
         "arrival": arrival,
@@ -169,12 +192,17 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
         "shed": snap["shed"],
         "errors": snap["error"],
         "shed_rate": round(snap["shed"] / n_requests, 4),
+        "shed_causes": causes,
         "ttft_p50_ms": pct(50),
         "ttft_p99_ms": pct(99),
+        "latency_p50_ms": lpct(50),
+        "latency_p99_ms": lpct(99),
         "tokens_total": total_tokens,
         "tokens_per_sec": round(total_tokens / wall, 1) if wall else 0.0,
         "wall_s": round(wall, 3),
     }
+    if deadline_s is not None:
+        rec["deadline_s"] = deadline_s
     if hung:
         rec["hung"] = hung
     if err_samples:
@@ -204,11 +232,18 @@ def collect_kv_accounting(prefill: Sequence[Any],
     return out
 
 
-def _tier_factories(params, config, args, use_cluster: bool):
+def _tier_factories(params, config, args, use_cluster: bool,
+                    chaos_spec: Optional[str] = None):
     """(prefill_factory, decode_factory, kill) — one replica per call,
     in-process objects or actors. The autoscaled run grows tiers through
     exactly these, so a scaled-up replica pays the same real cold-start
-    (engine init + first compile) a production scale-up would."""
+    (engine init + first compile) a production scale-up would.
+    `chaos_spec` scripts kill_replica faults into the replicas; each
+    factory numbers its replicas per role (creation index) so the plan
+    targets exactly one, and a self-healer replacement (a later index)
+    never re-fires the same action."""
+    import itertools as it
+
     from ray_tpu.serve.disagg import DecodeServer, PrefillServer
 
     # retention must cover every transfer that can be legitimately
@@ -220,21 +255,25 @@ def _tier_factories(params, config, args, use_cluster: bool):
     # re-pushes the live bound on every add_*, this only seeds it.
     retain = max(32, 2 * args.decode_replicas
                  * (args.max_batch + args.queue_depth))
+    pf_seq, dec_seq = it.count(), it.count()
     kw = dict(kv_block_size=args.block_size,
-              kv_pool_blocks=args.pool_blocks, retain=retain)
+              kv_pool_blocks=args.pool_blocks, retain=retain,
+              chaos=chaos_spec)
     if use_cluster:
         import ray_tpu
 
         def prefill_factory():
             a = ray_tpu.remote(PrefillServer).options(
-                max_concurrency=8).remote(params, config, **kw)
+                max_concurrency=8).remote(
+                    params, config, chaos_replica=next(pf_seq), **kw)
             ray_tpu.get(a.stats.remote(), timeout=120.0)  # fail fast
             return a
 
         def decode_factory():
             a = ray_tpu.remote(DecodeServer).options(
                 max_concurrency=args.max_batch + 4).remote(
-                    params, config, max_batch=args.max_batch)
+                    params, config, max_batch=args.max_batch,
+                    chaos=chaos_spec, chaos_replica=next(dec_seq))
             ray_tpu.get(a.stats.remote(), timeout=120.0)
             return a
 
@@ -245,11 +284,14 @@ def _tier_factories(params, config, args, use_cluster: bool):
                 pass
     else:
         def prefill_factory():
-            return PrefillServer(params, config, **kw)
+            return PrefillServer(params, config,
+                                 chaos_replica=next(pf_seq), **kw)
 
         def decode_factory():
             return DecodeServer(params, config,
-                                max_batch=args.max_batch)
+                                max_batch=args.max_batch,
+                                chaos=chaos_spec,
+                                chaos_replica=next(dec_seq))
 
         def kill(replica):
             stop = getattr(replica, "stop", None)
@@ -389,6 +431,129 @@ def _autoscaled_run(params, config, args, use_cluster, prompts,
     return rec
 
 
+def _fault_run(params, config, args, prompts, load_kw,
+               chaos_spec: Optional[str]):
+    """One open-loop run with tier self-healing attached (actor
+    replicas over the real chunk fabric): the chaos harness's unit of
+    measurement. Returns (record, outputs-by-request-index). The
+    self-healer WATCHES (event-driven death handling) without the
+    scaling tick — recovery here is pure failover + replacement, never
+    a load decision."""
+    from ray_tpu.serve.autoscale import DisaggAutoscaler, TierSpec
+    from ray_tpu.serve.disagg import DisaggRouter
+
+    pf_n = args.prefill_replicas
+    dec_n = max(2, args.decode_replicas)  # failover needs a survivor
+    prefill_factory, decode_factory, kill = _tier_factories(
+        params, config, args, True, chaos_spec)
+    prefill = [prefill_factory() for _ in range(pf_n)]
+    decode = [decode_factory() for _ in range(dec_n)]
+    router = DisaggRouter(decode=decode, prefill=prefill,
+                          max_queue_depth=args.queue_depth,
+                          affinity_tokens=args.block_size)
+    # bounds sized so a replacement always fits; the huge delays make
+    # the hysteresis machinery inert even if someone calls tick()
+    scaler = DisaggAutoscaler(
+        router,
+        prefill=TierSpec(prefill_factory, min_replicas=pf_n,
+                         max_replicas=pf_n + 1, up_delay_s=3600.0,
+                         down_delay_s=3600.0),
+        decode=TierSpec(decode_factory, min_replicas=dec_n,
+                        max_replicas=dec_n + 1, up_delay_s=3600.0,
+                        down_delay_s=3600.0),
+        interval_s=3600.0, drain_grace_s=args.drain_grace)
+    outputs: Dict[int, List[int]] = {}
+    try:
+        _warm(router, prompts)
+        warm_rt = router.stats()
+        router.reset_signal_windows()
+        scaler.watch()
+        rec = run_load(router, prompts, outputs=outputs, **load_kw)
+        st = router.stats()
+        rec["router"] = {k: st[k] - warm_rt[k] for k in
+                         ("dispatched", "completed", "shed")}
+        rec["router"]["max_pending"] = st["max_pending"]
+        # give the event-driven heal a moment to finish registering a
+        # replacement before the teardown sweeps the replica set
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            h = scaler.servefault_stats()
+            if sum(h["deaths"].values()) == \
+                    sum(h["replacements"].values()) \
+                    + h["replacements_blocked"]:
+                break
+            time.sleep(0.25)
+        rec["servefault"] = router.servefault_stats()
+        rec["healer"] = scaler.servefault_stats()
+        router.publish_servefault(force=True)
+    finally:
+        scaler.stop()
+        for t in ("prefill", "decode"):
+            for r in router.tier_replicas(t):
+                kill(r["target"])
+    return rec, outputs
+
+
+def _chaos_record(params, config, args, prompts, load_kw
+                  ) -> Dict[str, Any]:
+    """The acceptance scenario: a clean replay vs the same replay with
+    a scripted replica kill. Records the failover recovery impact and
+    the zero-silently-dropped / bit-identical verdict."""
+    # the decode pick's free-slot tie-break favors the LAST replica,
+    # so that's the one whose token counter reliably reaches the kill
+    # point; prefill affinity hashes, so replica 0 is as good as any
+    victim = (max(2, args.decode_replicas) - 1
+              if args.chaos_role == "decode" else 0)
+    plan = [{"action": "kill_replica", "role": args.chaos_role,
+             "at": args.chaos_at, "replica": victim}]
+    spec = json.dumps(plan)
+    clean, clean_out = _fault_run(params, config, args, prompts,
+                                  load_kw, None)
+    chaos, chaos_out = _fault_run(params, config, args, prompts,
+                                  load_kw, spec)
+    common = sorted(set(clean_out) & set(chaos_out))
+    mismatched = [i for i in common if clean_out[i] != chaos_out[i]]
+    n = load_kw["n_requests"]
+    sf = chaos.get("servefault") or {}
+    healer = chaos.get("healer") or {}
+    deaths = sum((healer.get("deaths") or {}).values())
+    causes = chaos.get("shed_causes") or {}
+    verdict = {
+        # every accepted request either completed or shed WITH a cause
+        "zero_silently_dropped": (not chaos.get("hung")
+                                  and chaos.get("errors", 0) == 0
+                                  and chaos["completed"]
+                                  + chaos["shed"] == n),
+        # falsifiable: run_load buckets cause-less sheds under
+        # "unattributed" instead of defaulting them to a real cause
+        "all_sheds_attributed": ("unattributed" not in causes
+                                 and sum(causes.values())
+                                 == chaos["shed"]),
+        # failed-over requests match the clean run token-for-token
+        "bit_identical_completed": not mismatched,
+        "compared_outputs": len(common),
+        "mismatched_outputs": mismatched[:8],
+        "kill_fired": deaths >= 1,
+        "failovers": sum((sf.get("failovers") or {}).values()),
+        "replaced": sum((healer.get("replacements") or {}).values()),
+    }
+    verdict["pass"] = bool(
+        verdict["zero_silently_dropped"]
+        and verdict["all_sheds_attributed"]
+        and verdict["bit_identical_completed"]
+        and verdict["kill_fired"])
+    recovery = {
+        "ttft_p99_ms_clean": clean.get("ttft_p99_ms"),
+        "ttft_p99_ms_chaos": chaos.get("ttft_p99_ms"),
+        "latency_p99_ms_clean": clean.get("latency_p99_ms"),
+        "latency_p99_ms_chaos": chaos.get("latency_p99_ms"),
+        "failover_recovery_ms":
+            sf.get("recent_failover_recovery_ms"),
+    }
+    return {"chaos_plan": plan, "clean": clean, "chaos": chaos,
+            "recovery": recovery, "verdict": verdict}
+
+
 def _clean_run(rec: Dict[str, Any]) -> bool:
     """A run may headline/verdict only when every request is accounted
     ok|shed — a hung or errored request silently shrinking the measured
@@ -470,6 +635,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--cluster", action="store_true",
                     help="run the tiers as actors on a local cluster "
                          "(real chunk-fabric transfers)")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline_s: requests past it "
+                         "shed with cause 'deadline' (slow clients "
+                         "exercise the edge)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="serving-fault acceptance run (implies "
+                         "--cluster): a clean replay vs the same "
+                         "replay with a scripted replica kill; records "
+                         "failover recovery impact + the zero-dropped/"
+                         "bit-identical verdict")
+    ap.add_argument("--chaos-role", default="decode",
+                    choices=["prefill", "decode"],
+                    help="which tier's replica 0 the chaos plan kills")
+    ap.add_argument("--chaos-at", default="token:30",
+                    help="kill point: 'token:K' (the replica's K-th "
+                         "served token, mid-stream) or 'request:N' "
+                         "(its N-th request); counts include the "
+                         "warm-up phase's traffic (~16 tokens)")
     ap.add_argument("--colocated-baseline", action="store_true",
                     help="also run the single-engine colocated path "
                          "for comparison")
@@ -516,7 +699,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     prompts = make_prompts(config, n_distinct=args.distinct,
                            block_size=args.block_size, seed=args.seed)
 
-    use_cluster = args.cluster
+    use_cluster = args.cluster or args.chaos
     if use_cluster:
         import ray_tpu
 
@@ -527,10 +710,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             (int(p) + int(d) for p, _, d in
              (s.partition("x") for s in args.compare_static.split(",")
               if s)), default=0)
+        # chaos mode runs >=2 decode replicas plus a self-heal
+        # replacement beside the prefill tier
+        chaos_need = (args.prefill_replicas + 1
+                      + max(2, args.decode_replicas) + 1
+                      if args.chaos else 0)
         ray_tpu.init(num_cpus=max(4, args.prefill_replicas
                                   + args.decode_replicas,
                                   args.max_prefill + args.max_decode,
-                                  sweep_max) + 2,
+                                  sweep_max, chaos_need) + 2,
                      _system_config={"log_to_driver": 0},
                      ignore_reinit_error=True)
     record: Dict[str, Any] = {
@@ -546,7 +734,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                    rate_rps=args.rate, arrival=args.arrival,
                    burst_size=args.burst_size, zipf_a=args.zipf_a,
                    slow_client_frac=args.slow_frac,
-                   token_sleep_s=args.token_sleep, seed=args.seed)
+                   token_sleep_s=args.token_sleep,
+                   deadline_s=args.deadline, seed=args.seed)
+    if args.chaos:
+        record.update(metric="servefault_chaos",
+                      decode_replicas=max(2, args.decode_replicas))
+        try:
+            record.update(_chaos_record(params, config, args, prompts,
+                                        load_kw))
+            top = record["chaos"]
+            record.update(value=top["tokens_per_sec"], unit="tokens/s",
+                          ttft_p50_ms=top["ttft_p50_ms"],
+                          ttft_p99_ms=top["ttft_p99_ms"],
+                          shed_rate=top["shed_rate"])
+        finally:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        line = json.dumps(record)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(record, f, indent=1)
+        print(line)
+        return 0 if record.get("verdict", {}).get("pass") else 1
     if args.compare_static or args.autoscale:
         from ray_tpu.serve.autoscale import default_target_p99_ms
 

@@ -41,6 +41,12 @@ Routes:
                          drain/scale_down events (serve/autoscale.py;
                          the NODE-level autoscaler stays at
                          /api/autoscaler)
+  /api/servefault        serving-plane fault tolerance: per-router
+                         failovers by phase + sheds by cause, healer
+                         deaths/replacements/breaker state, and the
+                         resilience lane's failover/replace/
+                         breaker_trip event slice (serve/disagg.py +
+                         serve/autoscale.py self-healing)
   /api/oracle            step-time oracle: roofline predictions per
                          layout (device/ici/dcn breakdown),
                          predicted-vs-measured validations (residuals,
@@ -208,6 +214,18 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def servefault(self) -> Dict[str, Any]:
+        """Serving-fault-tolerance aggregate + the resilience lane's
+        failover/replace/breaker_trip event slice (one payload so the
+        SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_servefault_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call(
+                "get_servefault_events", 100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def oracle(self) -> Dict[str, Any]:
         """Step-time-oracle aggregate + the recent event tail (one
         payload so the SPA's panel needs a single fetch)."""
@@ -334,6 +352,8 @@ class DashboardServer:
         app.router.add_get("/api/disagg", self._json_route(d.disagg))
         app.router.add_get("/api/autoscale",
                            self._json_route(d.autoscale))
+        app.router.add_get("/api/servefault",
+                           self._json_route(d.servefault))
         app.router.add_get("/api/oracle", self._json_route(d.oracle))
         app.router.add_get(
             "/api/rpc",

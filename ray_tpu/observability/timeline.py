@@ -15,7 +15,13 @@ merges and labels them:
                  counter event series for tokens/sec and MFU.
 - resilience:    pid = "resilience",      tid = event kind — instant
                  markers for preemptions, restarts, quarantines, grace
-                 checkpoints, and chaos injections (ray_tpu.resilience).
+                 checkpoints, and chaos injections (ray_tpu.resilience),
+                 plus the serving plane's recovery markers: request
+                 `failover` (serve/disagg.py replaying a request off a
+                 dead tier replica), replica `replace` and
+                 `breaker_trip` (serve/autoscale.py self-healing) —
+                 recovery events share one lane whether they heal a
+                 training gang or a serving tier.
 - weights:       pid = "weights",         tid = event kind — instant
                  markers for weight publishes, fetches, hot swaps, GC
                  and reaps (ray_tpu.weights), so a serving replica's
@@ -106,9 +112,14 @@ def resilience_trace_events(events: List[Dict[str, Any]]
         if ts is None:
             continue
         kind = str(ev.get("kind", "event"))
-        where = ev.get("node_id") or ev.get("run_id") or ev.get("name")
+        # serving-plane recovery markers name their replica/router/host
+        # the same way training markers name their node/run (explicit
+        # None checks: a chaos kill's replica index 0 is a real label)
+        where = next((ev[k] for k in ("node_id", "run_id", "name",
+                                      "replica", "router", "host")
+                      if ev.get(k) is not None), None)
         out.append({
-            "name": f"{kind}:{where}" if where else kind,
+            "name": f"{kind}:{where}" if where is not None else kind,
             "cat": "resilience", "ph": "i", "s": "g", "ts": ts * 1e6,
             "pid": "resilience", "tid": kind,
             "args": {k: v for k, v in ev.items()

@@ -26,6 +26,23 @@ Actions (all fields beyond ``action`` optional unless noted):
   :meth:`ChaosPlan.external_actions`; executed by the test harness
   (only it owns the conductor's lifecycle), not by the monkey.
 
+Serving-plane actions (consulted by the disagg tier replicas through a
+:class:`ServeChaosMonkey`, exactly-once per replica process like the
+training ops; see serve/disagg.py):
+
+- ``{"action": "kill_replica", "role": "prefill"|"decode",
+  "at": "token:K"|"request:N", "replica": R}`` — hard ``os._exit`` of
+  the matching tier replica's process. ``at=token:K`` fires when the
+  replica has served its K-th decoded token (mid-stream death — the
+  request-failover path); ``at=request:N`` fires at the start of its
+  N-th request (prefill death before the KV transfer is acked).
+  ``replica`` (default 0) is the replica's creation index within its
+  role, so one plan kills exactly one replica and the self-healer's
+  replacement (a higher index) does not re-fire.
+- ``{"action": "delay_chunk_fetch", "ms": M}`` — every ChunkFetcher
+  pull sleeps M ms first (consulted out-of-band per fetch, like
+  delay_heartbeats), stretching KV-transfer and weight-fetch latency.
+
 ``at_step`` compares against the step number being reported (the
 ``step`` metric when present, else the session's report count, both
 1-based for the first report). ``attempt`` (default 0) scopes an action
@@ -38,6 +55,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
@@ -46,7 +64,10 @@ ENV_VAR = "RAY_TPU_CHAOS_PLAN"
 
 _IN_PROCESS = ("raise", "kill", "preempt")
 _EXTERNAL = ("bounce_conductor",)
-_PASSIVE = ("delay_heartbeats",)
+_PASSIVE = ("delay_heartbeats", "delay_chunk_fetch")
+_SERVE = ("kill_replica",)
+
+_AT_RE = re.compile(r"^(token|request):(\d+)$")
 
 
 class ChaosError(RuntimeError):
@@ -61,17 +82,29 @@ class ChaosAction:
     attempt: Any = 0            # int generation, or "any"
     node: Optional[str] = None  # preempt: node id | "head" | "self"
     grace_s: Optional[float] = None
-    ms: float = 0.0             # delay_heartbeats
+    ms: float = 0.0             # delay_heartbeats / delay_chunk_fetch
+    role: Optional[str] = None  # kill_replica: prefill | decode
+    at: Optional[str] = None    # kill_replica: "token:K" | "request:N"
+    replica: int = 0            # kill_replica: creation index in role
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ChaosAction":
         action = str(d.get("action", ""))
-        known = _IN_PROCESS + _EXTERNAL + _PASSIVE
+        known = _IN_PROCESS + _EXTERNAL + _PASSIVE + _SERVE
         if action not in known:
             raise ValueError(f"unknown chaos action {action!r}; "
                              f"known: {sorted(known)}")
         if action in ("raise", "kill") and d.get("rank") is None:
             raise ValueError(f"chaos action {action!r} requires a rank")
+        if action == "kill_replica":
+            if d.get("role") not in ("prefill", "decode"):
+                raise ValueError(
+                    "chaos action 'kill_replica' requires "
+                    "role=prefill|decode")
+            if not _AT_RE.match(str(d.get("at", ""))):
+                raise ValueError(
+                    "chaos action 'kill_replica' requires "
+                    "at='token:K'|'request:N'")
         return cls(action=action,
                    at_step=int(d.get("at_step", 0)),
                    rank=(None if d.get("rank") is None
@@ -80,10 +113,20 @@ class ChaosAction:
                    node=d.get("node"),
                    grace_s=(None if d.get("grace_s") is None
                             else float(d["grace_s"])),
-                   ms=float(d.get("ms", 0.0)))
+                   ms=float(d.get("ms", 0.0)),
+                   role=d.get("role"),
+                   at=(None if d.get("at") is None else str(d["at"])),
+                   replica=int(d.get("replica", 0)))
+
+    def at_spec(self) -> Optional[tuple]:
+        """("token"|"request", N) for a kill_replica action."""
+        if not self.at:
+            return None
+        m = _AT_RE.match(self.at)
+        return (m.group(1), int(m.group(2))) if m else None
 
     def matches(self, step: int, rank: int, attempt: int) -> bool:
-        if self.action in _PASSIVE:
+        if self.action in _PASSIVE or self.action in _SERVE:
             return False  # consulted out-of-band, not stepwise
         if self.attempt != "any" and int(self.attempt) != attempt:
             return False
@@ -128,6 +171,18 @@ class ChaosPlan:
         return sum(a.ms for a in self.actions
                    if a.action == "delay_heartbeats") / 1000.0
 
+    def chunk_fetch_delay_s(self) -> float:
+        """Extra per-pull ChunkFetcher delay scripted by the plan."""
+        return sum(a.ms for a in self.actions
+                   if a.action == "delay_chunk_fetch") / 1000.0
+
+    def serve_actions(self, role: str, replica: int
+                      ) -> List[ChaosAction]:
+        """The kill_replica actions scoped to one tier replica."""
+        return [a for a in self.actions
+                if a.action == "kill_replica" and a.role == role
+                and a.replica == int(replica)]
+
     def external_actions(self, step: int, attempt: int = 0
                          ) -> List[ChaosAction]:
         """Actions the harness itself must execute at this step (e.g.
@@ -138,6 +193,7 @@ class ChaosPlan:
 
 
 _HB_DELAY_CACHE: Optional[tuple] = None  # (env spec, parsed delay)
+_CF_DELAY_CACHE: Optional[tuple] = None  # (env spec, parsed delay)
 
 
 def heartbeat_delay_s() -> float:
@@ -155,6 +211,23 @@ def heartbeat_delay_s() -> float:
     except Exception:  # noqa: BLE001
         delay = 0.0
     _HB_DELAY_CACHE = (spec, delay)
+    return delay
+
+
+def chunk_fetch_delay_s() -> float:
+    """Env-plan chunk-fetch stretch, for util.chunks.ChunkFetcher
+    (consulted once per pull — same cache discipline as the heartbeat
+    delay; parse failures count as no delay, a fetch must proceed no
+    matter what is in the env)."""
+    global _CF_DELAY_CACHE
+    spec = os.environ.get(ENV_VAR)
+    if _CF_DELAY_CACHE is not None and _CF_DELAY_CACHE[0] == spec:
+        return _CF_DELAY_CACHE[1]
+    try:
+        delay = ChaosPlan.from_spec(spec).chunk_fetch_delay_s()
+    except Exception:  # noqa: BLE001
+        delay = 0.0
+    _CF_DELAY_CACHE = (spec, delay)
     return delay
 
 
@@ -248,3 +321,93 @@ def monkey_from_spec(spec: Optional[str], rank: int = 0,
     if not plan:
         return None
     return ChaosMonkey(plan, rank=rank, attempt=attempt)
+
+
+class ServeChaosMonkey:
+    """Per-replica-process executor of a plan's kill_replica actions.
+
+    Created by a disagg tier replica (serve/disagg.py PrefillServer /
+    DecodeServer) with its role and creation index; consulted at every
+    request admission (``on_request``) and every served token
+    (``on_tokens``). Each action fires at most once — the process dies
+    with it, but the latch also guards the in-process test doubles.
+    ``at`` counts are cumulative per replica (the K-th token / N-th
+    request THIS replica serves), which is what makes a mid-stream
+    decode death deterministic under concurrent traffic."""
+
+    def __init__(self, plan: ChaosPlan, role: str, replica: int = 0,
+                 exit_fn: Callable[[int], Any] = os._exit):
+        self.role = str(role)
+        self.replica = int(replica)
+        self.actions = plan.serve_actions(self.role, self.replica)
+        self._exit = exit_fn
+        self._lock = threading.Lock()
+        self._fired: set = set()
+        self._tokens = 0
+        self._requests = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.actions)
+
+    # ------------------------------------------------------------- firing
+
+    def on_request(self) -> None:
+        """One request admitted (prefill call / decode adoption)."""
+        with self._lock:
+            self._requests += 1
+            fire = self._due_locked("request", self._requests)
+        if fire is not None:
+            self._fire(fire)
+
+    def on_tokens(self, n: int = 1) -> None:
+        """`n` more tokens served by this replica."""
+        with self._lock:
+            self._tokens += int(n)
+            fire = self._due_locked("token", self._tokens)
+        if fire is not None:
+            self._fire(fire)
+
+    def _due_locked(self, kind: str, count: int) -> Optional[ChaosAction]:
+        for idx, a in enumerate(self.actions):
+            if idx in self._fired:
+                continue
+            spec = a.at_spec()
+            if spec is not None and spec[0] == kind and count >= spec[1]:
+                self._fired.add(idx)
+                return a
+        return None
+
+    def _fire(self, a: ChaosAction) -> None:
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            if w is not None:
+                w.conductor.notify("report_resilience_event", {
+                    "kind": "chaos", "action": "kill_replica",
+                    "role": self.role, "replica": self.replica,
+                    "at": a.at, "tokens": self._tokens,
+                    "requests": self._requests})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        self._exit(137)
+
+
+def serve_monkey_from_spec(spec: Optional[str], role: str,
+                           replica: int = 0
+                           ) -> Optional[ServeChaosMonkey]:
+    """Build a serving monkey when `spec` (or, if None, the env)
+    carries kill_replica actions for this (role, replica); None when
+    no serving chaos is configured — the hot path then pays a single
+    None check per token batch."""
+    try:
+        plan = (ChaosPlan.from_env() if spec is None
+                else ChaosPlan.from_spec(spec))
+    except Exception:
+        if spec is not None:
+            raise  # an explicit plan must not be silently dropped
+        return None  # malformed env plan: serving keeps running
+    if not plan:
+        return None
+    monkey = ServeChaosMonkey(plan, role, replica)
+    return monkey if monkey else None

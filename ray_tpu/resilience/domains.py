@@ -38,6 +38,7 @@ class _DomainState:
     drain_reason: str = ""
     manual: bool = False              # operator quarantine, no decay out
     tripped: bool = False             # score crossed the threshold
+    trips: int = 0                    # not-tripped -> tripped transitions
     recent: List[Dict[str, Any]] = field(default_factory=list)
 
 
@@ -78,6 +79,8 @@ class FailureDomainTracker:
             st.score = self._decayed(st, now) + float(weight)
             st.updated = now
             if st.score >= self.threshold - 1e-9:
+                if not st.tripped:
+                    st.trips += 1  # the breaker/quarantine OPEN edge
                 st.tripped = True
             st.failures += 1
             st.last_kind = kind
@@ -139,6 +142,16 @@ class FailureDomainTracker:
                 st.tripped = False  # hysteresis exit: latch released
             return st.tripped
 
+    def trip_count(self, domain: Optional[str] = None) -> int:
+        """Quarantine-latch OPEN transitions for one domain (or summed
+        over all) — the serving self-healer reports this as its
+        circuit-breaker trip counter."""
+        with self._lock:
+            if domain is not None:
+                st = self._domains.get(domain)
+                return st.trips if st is not None else 0
+            return sum(st.trips for st in self._domains.values())
+
     def is_draining(self, domain: str) -> bool:
         now = self._clock()
         with self._lock:
@@ -170,6 +183,7 @@ class FailureDomainTracker:
             out["domains"][domain] = {
                 "score": round(self._decayed(st, now), 4),
                 "failures": st.failures,
+                "trips": st.trips,
                 "quarantined": self.is_quarantined(domain),
                 "draining": drain_left is not None and drain_left > 0,
                 "drain_remaining_s": drain_left,

@@ -541,6 +541,69 @@ def cmd_disagg(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_servefault(args) -> None:
+    """`ray_tpu servefault` — serving-plane fault-tolerance view
+    (serve/disagg.py failover + serve/autoscale.py self-healing):
+    per-router failovers by phase and sheds by attributed cause,
+    per-healer deaths/replacements/breaker state, plus the cluster
+    totals every other surface (state API, /api/servefault,
+    Prometheus, resilience-lane timeline markers) reports from the
+    same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.servefault_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    if not (st.get("routers") or st.get("healers")):
+        print("no servefault telemetry recorded (is a DisaggRouter/"
+              "DisaggAutoscaler running?)")
+        return
+    totals = st.get("totals") or {}
+    fo = totals.get("failovers") or {}
+    sheds = totals.get("sheds_by_cause") or {}
+    repl = totals.get("replacements") or {}
+    shed_txt = " ".join(f"{k}:{v}"
+                        for k, v in sorted(sheds.items())) or "none"
+    print(f"totals: failovers=prefill:{fo.get('prefill', 0)}"
+          f"/decode:{fo.get('decode', 0)} "
+          f"failed_over_requests={totals.get('failover_requests', 0)} "
+          f"sheds={sum(sheds.values())} ({shed_txt}) "
+          f"replacements=prefill:{repl.get('prefill', 0)}"
+          f"/decode:{repl.get('decode', 0)} "
+          f"breaker_trips={totals.get('breaker_trips', 0)} "
+          f"drains_reaped={totals.get('drains_reaped', 0)}")
+    for key, r in sorted((st.get("routers") or {}).items()):
+        rec = (r.get("recent_failover_recovery_ms") or {})
+        rfo = r.get("failovers") or {}
+        rsh = r.get("sheds_by_cause") or {}
+        rsh_txt = ", ".join(f"{k}:{v}" for k, v in sorted(rsh.items()))
+        print(f"  {key}: failovers=pf:{rfo.get('prefill', 0)}"
+              f"/dec:{rfo.get('decode', 0)} "
+              f"failed_over_reqs={r.get('failover_requests', 0)} "
+              "sheds={" + rsh_txt + "}"
+              + (f" recovery_p50={rec.get('p50', 0.0):.0f}ms"
+                 if rec.get("n") else ""))
+    for key, h in sorted((st.get("healers") or {}).items()):
+        d = h.get("deaths") or {}
+        rp = h.get("replacements") or {}
+        print(f"  {key}: deaths=pf:{d.get('prefill', 0)}"
+              f"/dec:{d.get('decode', 0)} "
+              f"replacements=pf:{rp.get('prefill', 0)}"
+              f"/dec:{rp.get('decode', 0)} "
+              f"blocked={h.get('replacements_blocked', 0)} "
+              f"breaker_trips={h.get('breaker_trips', 0)} "
+              f"breaker_open={h.get('breaker_open') or []} "
+              f"drains_reaped={h.get('drains_reaped', 0)}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_servefault_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_autoscale(args) -> None:
     """`ray_tpu autoscale` — serving-autoscaler view
     (serve/autoscale.py): per-loop tier targets, decision counts,
@@ -992,6 +1055,19 @@ def main(argv=None) -> None:
                     help="also print the last N disagg events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_disagg)
+
+    sp = sub.add_parser("servefault",
+                        help="serving-plane fault tolerance: request "
+                             "failovers by phase, sheds by cause, "
+                             "replica deaths/replacements, breaker "
+                             "state, recent events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N servefault events "
+                         "(the resilience lane's failover/replace/"
+                         "breaker_trip slice)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_servefault)
 
     sp = sub.add_parser("autoscale",
                         help="serving autoscaler: per-tier targets and "

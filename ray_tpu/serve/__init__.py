@@ -21,7 +21,8 @@ from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions  # noqa: F401
 from .context import get_request_context  # noqa: F401
 from .controller import ServeController
-from .disagg import DecodeServer, DisaggRouter, PrefillServer  # noqa: F401
+from .disagg import (DecodeServer, DisaggRouter,  # noqa: F401
+                     PrefillServer, ReplicaDeadError)
 from .handle import (CONTROLLER_NAME, DeploymentHandle,  # noqa: F401
                      DeploymentResponse, RequestShedError)
 from .http_util import Request, Response  # noqa: F401

@@ -40,6 +40,25 @@ Pieces (each independently testable, no cluster required):
   before the actor dies — an in-flight request is NEVER dropped by a
   scale-down.
 
+The loop also owns **tier self-healing** (the serving-plane complement
+of PR 4's gang supervision): once started it subscribes to the
+conductor's actor-death pubsub for its managed replicas. A death is NOT
+load — it bypasses the hysteresis/cooldown machinery entirely: the
+corpse is removed from the router immediately (distinct from a drain —
+no grace, its in-flight requests already failed over at the router) and
+a replacement is spawned through the tier's ``TierSpec.factory``. A
+per-host circuit breaker (the existing
+``resilience.domains.FailureDomainTracker``, threshold
+``RAY_TPU_SERVE_BREAKER_THRESHOLD`` deaths decaying over
+``RAY_TPU_SERVE_BREAKER_WINDOW_S``) stops replacing replicas that die
+repeatedly on the same host — replacing into a bad host only
+manufactures failures — and a replica that dies MID-DRAIN is reaped
+and its drain record finalized instead of leaking a ``draining`` entry
+forever. ``replace`` / ``breaker_trip`` markers land in the merged
+timeline's resilience lane beside the router's ``failover`` markers,
+and per-tier ``replacements_total`` counters feed the servefault
+surface.
+
 Surfaces (the full treatment): ``util.state.autoscaler_status()``,
 ``ray_tpu autoscale`` CLI, dashboard ``/api/autoscale`` + SPA tab, lazy
 Prometheus (``ray_tpu_autoscale_target_replicas{tier}``,
@@ -448,11 +467,35 @@ class DisaggAutoscaler:
             "scale_downs": {t: 0 for t in TIERS},
             "drains_completed": 0,
             "drains_forced": 0,
+            "drains_reaped": 0,
             "replica_seconds": {t: 0.0 for t in TIERS},
             "last_reason": {t: "" for t in TIERS},
+            "deaths": {t: 0 for t in TIERS},
+            "replacements": {t: 0 for t in TIERS},
+            "replacements_blocked": 0,
+            "breaker_trips": 0,
         }
+        # the replacement circuit breaker: the existing failure-domain
+        # tracker keyed by the replicas' HOST (machine id) — a host
+        # whose replicas die repeatedly trips the latch and stops
+        # getting replacements until the decayed score releases it
+        from ray_tpu.resilience.domains import FailureDomainTracker
+
+        self._breaker = FailureDomainTracker(
+            threshold=_env_float("RAY_TPU_SERVE_BREAKER_THRESHOLD", 3.0),
+            half_life_s=_env_float("RAY_TPU_SERVE_BREAKER_WINDOW_S",
+                                   60.0))
+        self._watching = False
+        # actor_id -> (tier, {"rid", "machine"}) for every ACTOR
+        # replica under management. Kept eagerly (watch/tick/add): by
+        # the time a death event arrives, the router's failover wrapper
+        # may already have removed the corpse from the replica set, so
+        # the death must resolve against what we KNEW, not what's left.
+        self._managed: Dict[str, Tuple[str, Dict[str, Any]]] = {}
+        self._heals: List[threading.Thread] = []
         self._last_tick: Optional[float] = None
         self._last_push = 0.0
+        self._last_sf_push = 0.0
         self._teardowns: List[threading.Thread] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -477,7 +520,8 @@ class DisaggAutoscaler:
         probes = []
         for r in reps:
             try:
-                probes.append((r, _call(r["target"], "free_slots",
+                # read-only probe, supervised by the except below
+                probes.append((r, _call(r["target"], "free_slots",  # shardlint: disable=unsupervised-actor-call
                                         block=False)))
             except Exception:  # noqa: BLE001 — replica mid-restart
                 pass
@@ -567,6 +611,8 @@ class DisaggAutoscaler:
                 break
             rid = (self.router.add_prefill(replica) if tier == "prefill"
                    else self.router.add_decode(replica))
+            if self._watching:
+                self._refresh_managed()
             self._stats["scale_ups"][tier] += 1
             autoscale_metrics()["decisions"].inc(
                 tags={"tier": tier, "direction": "up"})
@@ -623,7 +669,8 @@ class DisaggAutoscaler:
         if rep is None:
             return True
         try:
-            return int(_call(rep["target"], "stats")
+            # drain probe on a possibly-dead replica, supervised below
+            return int(_call(rep["target"], "stats")  # shardlint: disable=unsupervised-actor-call
                        .get("held_transfers", 0)) == 0
         except Exception:  # noqa: BLE001 — replica already dead
             return True
@@ -652,6 +699,11 @@ class DisaggAutoscaler:
 
     def _finalize_drain(self, d: _Draining, drained: bool) -> None:
         replica = self.router.remove(d.tier, d.rid)
+        with self._lock:
+            # the teardown below kills the actor ON PURPOSE — its DEAD
+            # event must not read as a death to heal
+            self._managed = {aid: v for aid, v in self._managed.items()
+                             if v[1]["rid"] != d.rid}
         self._stats["drains_completed" if drained
                     else "drains_forced"] += 1
         if replica is None:
@@ -690,6 +742,187 @@ class DisaggAutoscaler:
             except Exception:  # noqa: BLE001 — already gone
                 pass
 
+    # ------------------------------------------------------- self-healing
+
+    def watch(self) -> "DisaggAutoscaler":
+        """Subscribe to the conductor's actor-death pubsub for the
+        managed replicas (idempotent; ``start()`` calls it). Death
+        handling is fully event-driven — it never waits for a tick."""
+        if self._watching:
+            return self
+        self._refresh_managed()
+        w = _worker()
+        if w is not None:
+            w.subscribe_channel("actor_state", self._on_actor_state)
+            self._watching = True
+        return self
+
+    def _refresh_managed(self) -> None:
+        """Snapshot actor_id -> replica identity for every managed
+        ACTOR replica currently registered with the router."""
+        seen = []
+        for tier in TIERS:
+            for r in self.router.tier_replicas(tier):
+                aid = getattr(r.get("target"), "actor_id", None)
+                if aid:
+                    seen.append((aid, (tier, {
+                        "rid": r["rid"],
+                        "machine": r.get("machine")})))
+        with self._lock:
+            self._managed.update(seen)
+
+    def unwatch(self) -> None:
+        if not self._watching:
+            return
+        w = _worker()
+        if w is not None:
+            try:
+                w.unsubscribe_channel("actor_state",
+                                      self._on_actor_state)
+            except Exception:  # noqa: BLE001 — worker shutting down
+                pass
+        self._watching = False
+
+    def _on_actor_state(self, msg: Any) -> None:
+        if not isinstance(msg, dict) or msg.get("state") != "DEAD":
+            return
+        with self._lock:
+            found = self._managed.pop(msg.get("actor_id"), None)
+        if found is None:
+            return  # not one of ours (or a scale-down teardown we did)
+        # handle OFF the pubsub dispatch thread: replacement runs the
+        # factory (actor spawn + engine init + first compile)
+        t = threading.Thread(
+            target=self._handle_replica_death,
+            args=(found[0], found[1]), daemon=True,
+            name=f"autoscale-heal-{found[1]['rid']}")
+        t.start()
+        self._heals.append(t)
+        self._heals = [x for x in self._heals if x.is_alive()]
+
+    def _handle_replica_death(self, tier: str,
+                              rep: Dict[str, Any]) -> None:
+        """One dead managed replica: reap the corpse (and any drain
+        record it dies holding), charge the breaker, replace through
+        the tier factory unless the breaker is open. Death is NOT load
+        — none of this goes through hysteresis or cooldown."""
+        rid = rep["rid"]
+        machine = rep.get("machine") or "unknown-host"
+        self.router.remove_dead(tier, rid)
+        was_draining = False
+        with self._lock:
+            self._stats["deaths"][tier] += 1
+            still = [d for d in self._draining if d.rid != rid]
+            was_draining = len(still) != len(self._draining)
+            self._draining = still
+            if was_draining:
+                # the drain/death race: a replica that dies mid-drain
+                # must finalize its drain record, not stay "draining"
+                # forever
+                self._stats["drains_reaped"] += 1
+        death_ev = {"kind": "replica_death", "tier": tier,
+                    "replica": rid, "machine": machine,
+                    "was_draining": was_draining,
+                    "autoscaler": self.autoscaler_id}
+        _notify_event(death_ev)        # the autoscale lane
+        _notify_resilience(dict(death_ev))  # the servefault event slice
+        if was_draining:
+            _notify_event({"kind": "scale_down", "tier": tier,
+                           "replica": rid, "drained": False,
+                           "reaped": True,
+                           "autoscaler": self.autoscaler_id})
+        # breaker: decayed per-host death score through the existing
+        # failure-domain tracker. The OPEN edge comes from the
+        # tracker's own trip counter (incremented under ITS lock
+        # exactly once per transition); our lock serializes concurrent
+        # heal threads so two same-instant deaths can't both read the
+        # pre-trip count and double-report one edge.
+        from .disagg import servefault_metrics
+
+        with self._lock:
+            before = self._breaker.trip_count(machine)
+            self._breaker.record(machine, "replica_death",
+                                 detail=f"{tier}:{rid}")
+            tripped = self._breaker.trip_count(machine) > before
+            if tripped:
+                self._stats["breaker_trips"] += 1
+        if tripped:
+            servefault_metrics()["breaker_trips"].inc()
+            _notify_resilience({"kind": "breaker_trip", "host": machine,
+                                "tier": tier, "replica": rid,
+                                "score": round(
+                                    self._breaker.score(machine), 3),
+                                "autoscaler": self.autoscaler_id})
+        if was_draining:
+            # it was being removed anyway — reap, don't replace
+            self.publish_servefault(force=True)
+            self.publish_telemetry(force=True)
+            return
+        if self._breaker.is_quarantined(machine):
+            with self._lock:
+                self._stats["replacements_blocked"] += 1
+                self._stats["last_reason"][tier] = (
+                    f"replacement blocked: breaker open for {machine} "
+                    f"({self._breaker.score(machine):.1f} deaths in "
+                    f"window)")
+            self.publish_servefault(force=True)
+            return
+        self._replace(tier, rid)
+
+    def _replace(self, tier: str, dead_rid: str) -> None:
+        """Spawn a 1-for-1 replacement through the tier factory —
+        OUTSIDE the hysteresis/cooldown machinery (death is not load;
+        the tier must return to strength now, not after up_delay_s)."""
+        from .disagg import servefault_metrics
+
+        try:
+            replica = self.specs[tier].factory()
+        except Exception as e:  # noqa: BLE001 — no capacity right now
+            with self._lock:
+                self._stats["last_reason"][tier] = (
+                    f"replacement blocked: {type(e).__name__}: {e}")
+            self.publish_servefault(force=True)
+            return
+        rid = (self.router.add_prefill(replica) if tier == "prefill"
+               else self.router.add_decode(replica))
+        self._refresh_managed()
+        with self._lock:
+            self._stats["replacements"][tier] += 1
+        servefault_metrics()["replacements"].inc(tags={"tier": tier})
+        ev = {"kind": "replace", "tier": tier, "replica": rid,
+              "for": dead_rid, "autoscaler": self.autoscaler_id}
+        _notify_event(ev)
+        _notify_resilience(dict(ev))
+        self.publish_servefault(force=True)
+        self.publish_telemetry(force=True)
+
+    def servefault_stats(self) -> Dict[str, Any]:
+        """The self-healer's contribution to the servefault surface."""
+        with self._lock:
+            sf: Dict[str, Any] = {
+                "deaths": dict(self._stats["deaths"]),
+                "replacements": dict(self._stats["replacements"]),
+                "replacements_blocked":
+                    self._stats["replacements_blocked"],
+                "breaker_trips": self._stats["breaker_trips"],
+                "drains_reaped": self._stats["drains_reaped"],
+            }
+        sf.update(role="healer", autoscaler_id=self.autoscaler_id,
+                  router=self.router.router_id,
+                  breaker_open=self._breaker.excluded(),
+                  breaker_threshold=self._breaker.threshold,
+                  watching=self._watching)
+        return sf
+
+    def publish_servefault(self, force: bool = False) -> None:
+        from .disagg import _push_servefault
+
+        now = time.monotonic()
+        if not force and now - self._last_sf_push < 0.5:
+            return
+        self._last_sf_push = now
+        _push_servefault(self.autoscaler_id, self.servefault_stats())
+
     # ------------------------------------------------------------ status
 
     def status(self) -> Dict[str, Any]:
@@ -704,6 +937,12 @@ class DisaggAutoscaler:
                 "scale_downs": dict(self._stats["scale_downs"]),
                 "drains_completed": self._stats["drains_completed"],
                 "drains_forced": self._stats["drains_forced"],
+                "drains_reaped": self._stats["drains_reaped"],
+                "deaths": dict(self._stats["deaths"]),
+                "replacements": dict(self._stats["replacements"]),
+                "replacements_blocked":
+                    self._stats["replacements_blocked"],
+                "breaker_trips": self._stats["breaker_trips"],
                 "replica_seconds": {
                     t: round(v, 3) for t, v
                     in self._stats["replica_seconds"].items()},
@@ -711,6 +950,8 @@ class DisaggAutoscaler:
                 "draining": [{"tier": d.tier, "rid": d.rid}
                              for d in self._draining],
             }
+        s["breaker_open"] = self._breaker.excluded()
+        s["watching"] = self._watching
         for tier in TIERS:
             reps = self.router.tier_replicas(tier)
             s[f"{tier}_replicas"] = len(reps)
@@ -737,6 +978,8 @@ class DisaggAutoscaler:
     # -------------------------------------------------------------- loop
 
     def start(self) -> "DisaggAutoscaler":
+        self.watch()  # self-healing is event-driven, not tick-driven
+
         def loop():
             while not self._stop.wait(self.interval_s):
                 try:
@@ -753,6 +996,9 @@ class DisaggAutoscaler:
 
     def stop(self) -> None:
         self._stop.set()
+        self.unwatch()
+        for t in self._heals:
+            t.join(timeout=30.0)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         # finalize in-progress drains NOW: an abandoned draining
@@ -767,6 +1013,7 @@ class DisaggAutoscaler:
         for t in self._teardowns:
             t.join(timeout=self.drain_grace_s + 15.0)
         self.publish_telemetry(force=True)
+        self.publish_servefault(force=True)
 
 
 __all__ = ["DisaggAutoscaler", "DisaggPolicy", "ScalingPolicy",

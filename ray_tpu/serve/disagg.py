@@ -36,22 +36,49 @@ decode ticks free of head-of-line blocking:
   with a ``RequestShedError`` carrying ``retry_after_s`` — shed at the
   router, before the engine wedges.
 
-Surfaces (the full treatment): ``util.state.disagg_status()``,
-``ray_tpu disagg`` CLI, dashboard ``/api/disagg`` + SPA tab, lazy
-Prometheus (``ray_tpu_disagg_kv_bytes_total{direction}``,
+On top of dispatch the router owns **request-level fault tolerance**
+(the serving-plane failover invariant: an ACCEPTED request is never
+silently dropped — it either streams to completion or sheds with an
+attributed cause):
+
+- every request records its prompt, its sampled-token history, and a
+  per-attempt deadline; decode streams cross the actor boundary as
+  chunked pulls (``DecodeServer.start_decode``/``next_tokens``) so the
+  router always holds the tokens produced so far;
+- on decode-replica death mid-stream the router re-runs prefill with
+  the dead replica's tokens EXTENDING the prompt (the prefix cache
+  makes the replay a suffix-only prefill) on a healthy prefill replica
+  and resumes decode on a survivor — bit-identical to an uninterrupted
+  greedy run, the correctness oracle;
+- prefill death before its transfer is acked retries on another
+  prefill replica (the dead process's chunk refs die with it — no
+  leak by construction);
+- attempts are bounded (``RAY_TPU_FAILOVER_ATTEMPTS`` extra attempts,
+  default 2); exhaustion sheds with cause ``failover``, a request past
+  its ``deadline_s`` sheds with cause ``deadline``.
+
+Surfaces (the full treatment): ``util.state.disagg_status()`` +
+``util.state.servefault_status()``, ``ray_tpu disagg`` / ``ray_tpu
+servefault`` CLI, dashboard ``/api/disagg`` + ``/api/servefault`` +
+SPA tabs, lazy Prometheus (``ray_tpu_disagg_kv_bytes_total{direction}``,
 ``ray_tpu_disagg_transfers_total``, ``ray_tpu_serve_shed_total``,
-``ray_tpu_disagg_queue_depth``), and ``disagg`` instant markers in the
-merged timeline. Knobs: ``RAY_TPU_DISAGG_QUEUE_DEPTH`` (router backlog
+``ray_tpu_disagg_queue_depth``,
+``ray_tpu_servefault_failovers_total{phase}``,
+``ray_tpu_servefault_sheds_total{cause}``), ``disagg`` instant markers
+in the merged timeline plus ``failover`` markers in its resilience
+lane. Knobs: ``RAY_TPU_DISAGG_QUEUE_DEPTH`` (router backlog
 bound per decode replica, default 8), ``RAY_TPU_DISAGG_RETRY_AFTER_S``
-(shed hint, default 1.0), ``RAY_TPU_MAX_ADOPTIONS_PER_TICK`` (decode
+(shed hint, default 1.0), ``RAY_TPU_FAILOVER_ATTEMPTS`` (bounded
+failover budget, default 2), ``RAY_TPU_MAX_ADOPTIONS_PER_TICK`` (decode
 adoption cap, models/engine.py), plus the kvcache knobs on the prefill
 tier. The open-loop acceptance benchmark lives in
-``ray_tpu/bench_serve.py``.
+``ray_tpu/bench_serve.py`` (``--chaos`` for the fault-injection run).
 """
 from __future__ import annotations
 
 import itertools
 import os
+import queue
 import threading
 import time
 from collections import OrderedDict
@@ -59,10 +86,32 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ray_tpu.exceptions import ActorError, WorkerCrashedError
+
 from .autoscale import SlidingWindow
 from .handle import RequestShedError, shed_counter
 
 _SERVER_SEQ = itertools.count()
+
+# Exception shapes that mean "the replica's process is gone" (actor
+# death, worker crash, or the RPC plane losing the connection) — the
+# failover wrapper removes the corpse from the replica set and retries
+# elsewhere. Anything else is a REQUEST failure (bad KV layout, a bug):
+# it still consumes a bounded failover attempt but the replica stays.
+_DEATH_TYPES = (ActorError, WorkerCrashedError, ConnectionError,
+                EOFError, OSError)
+
+
+class ReplicaDeadError(RuntimeError):
+    """A tier-replica call failed because the replica died; carries the
+    tier/rid so the failover path can attribute and re-route."""
+
+    def __init__(self, tier: str, rid: str, cause: BaseException):
+        super().__init__(f"{tier} replica {rid} died: "
+                         f"{type(cause).__name__}: {cause}")
+        self.tier = tier
+        self.rid = rid
+        self.cause = cause
 
 # ----------------------------------------------------- prometheus (lazy)
 # Created on first component construction, never at import (the
@@ -99,6 +148,46 @@ def disagg_metrics() -> Dict[str, Any]:
     return _metrics
 
 
+# Serving-plane fault-tolerance metrics, shared with the self-healer in
+# serve/autoscale.py (one lazy group so every servefault number has one
+# Prometheus home).
+_sf_metrics: Optional[Dict[str, Any]] = None
+_sf_metrics_lock = threading.Lock()
+
+
+def servefault_metrics() -> Dict[str, Any]:
+    global _sf_metrics
+    m = _sf_metrics
+    if m is not None:
+        return m
+    with _sf_metrics_lock:
+        if _sf_metrics is None:
+            from ray_tpu.util.metrics import Counter
+
+            _sf_metrics = dict(
+                failovers=Counter(
+                    "ray_tpu_servefault_failovers_total",
+                    "request failover attempts after a tier-replica "
+                    "failure (phase=prefill|decode)",
+                    tag_keys=("phase",)),
+                sheds=Counter(
+                    "ray_tpu_servefault_sheds_total",
+                    "requests shed with an attributed cause "
+                    "(capacity|deadline|failover|draining)",
+                    tag_keys=("cause",)),
+                replacements=Counter(
+                    "ray_tpu_servefault_replacements_total",
+                    "dead tier replicas replaced by the self-healer "
+                    "(serve/autoscale.py)",
+                    tag_keys=("tier",)),
+                breaker_trips=Counter(
+                    "ray_tpu_servefault_breaker_trips_total",
+                    "replacement circuit-breaker OPEN transitions (a "
+                    "host whose replicas die repeatedly stops getting "
+                    "replacements)"))
+    return _sf_metrics
+
+
 def _worker():
     from ray_tpu._private import worker as worker_mod
 
@@ -124,6 +213,32 @@ def _push_stats(component_id: str, stats: Dict[str, Any]) -> None:
     try:
         w.conductor.notify("report_disagg_stats", w.worker_id,
                            component_id, stats)
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
+def _push_servefault(component_id: str, stats: Dict[str, Any]) -> None:
+    """Servefault snapshot -> conductor aggregate (state API, CLI,
+    /api/servefault, and the one-set-of-numbers check read it)."""
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_servefault_stats", w.worker_id,
+                           component_id, stats)
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
+def _notify_resilience(event: Dict[str, Any]) -> None:
+    """Failovers are recovery events: mirror them into the resilience
+    event log (the merged timeline's resilience lane, beside the PR-4
+    preemption/restart markers)."""
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_resilience_event", dict(event))
     except Exception:  # noqa: BLE001 — cluster shutting down
         pass
 
@@ -159,13 +274,16 @@ class PrefillServer:
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
                  retain: int = 32,
-                 server_id: Optional[str] = None):
+                 server_id: Optional[str] = None,
+                 chaos: Optional[str] = None,
+                 chaos_replica: int = 0):
         from ray_tpu.models.generate import _model_fns
         from ray_tpu.models.kvcache import (PagedKVCache,
                                             resolve_pool_config)
 
         import jax.numpy as jnp
 
+        from ray_tpu.resilience.chaos import serve_monkey_from_spec
         from ray_tpu.util.chunks import local_machine_id
 
         self.params = params
@@ -173,6 +291,10 @@ class PrefillServer:
         self.server_id = server_id or \
             f"pf-{os.getpid()}-{next(_SERVER_SEQ)}"
         self.machine = local_machine_id()
+        # scripted fault injection (resilience/chaos.py kill_replica):
+        # meaningful on ACTOR replicas — the fire is an os._exit
+        self._chaos = serve_monkey_from_spec(chaos, "prefill",
+                                             chaos_replica)
         block_size, pool_blocks = resolve_pool_config(
             config, kv_block_size, kv_pool_blocks)
         self.kv_cache: Optional[PagedKVCache] = (
@@ -210,6 +332,8 @@ class PrefillServer:
         from ray_tpu.models.engine import _prefill_with_cache
         from ray_tpu.util import chunks
 
+        if self._chaos is not None:
+            self._chaos.on_request()  # may os._exit (kill_replica)
         prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
         plen = prompt.shape[1]
         if plen < 1:
@@ -387,9 +511,12 @@ class DecodeServer:
     def __init__(self, params: Any, config: Any, *,
                  max_batch: int = 8,
                  server_id: Optional[str] = None,
+                 chaos: Optional[str] = None,
+                 chaos_replica: int = 0,
                  **engine_kw):
         from ray_tpu.models.engine import ContinuousBatchingEngine
 
+        from ray_tpu.resilience.chaos import serve_monkey_from_spec
         from ray_tpu.util.chunks import local_machine_id
 
         engine_kw.setdefault("prefix_cache", False)
@@ -399,12 +526,23 @@ class DecodeServer:
         self.server_id = server_id or \
             f"dec-{os.getpid()}-{next(_SERVER_SEQ)}"
         self.machine = local_machine_id()
+        self._chaos = serve_monkey_from_spec(chaos, "decode",
+                                             chaos_replica)
         self._lock = threading.Lock()
+        # open chunked-pull streams (start_decode/next_tokens): handle
+        # -> [TokenStream, last-activity]. Done streams clean themselves
+        # up; abandoned ones (a router that shed on deadline mid-pull)
+        # are reaped by IDLE age — every pull refreshes the stamp, so a
+        # slow client's long stream is never reaped mid-request — and a
+        # handle can never leak an engine request object forever.
+        self._streams: Dict[str, List[Any]] = {}
         self._stats = {k: 0 for k in (
             "transfers", "kv_fetched_bytes", "shm_bytes", "rpc_bytes",
             "chunks_local", "decoded_tokens")}
         self._last_push = 0.0
         disagg_metrics()
+
+    _STREAM_REAP_S = 600.0
 
     # ---------------------------------------------------------- data plane
 
@@ -412,6 +550,8 @@ class DecodeServer:
                eos_token: Optional[int], timeout_s: float):
         from ray_tpu.util import chunks
 
+        if self._chaos is not None:
+            self._chaos.on_request()  # may os._exit (kill_replica)
         desc = rec.get("kv")
         if desc is not None:
             w = _worker()
@@ -477,9 +617,82 @@ class DecodeServer:
         self._count_decoded(len(toks))
         return toks
 
+    # ------------------------------------------- chunked-pull streaming
+    # Streams cannot cross the actor boundary, but a blocking
+    # decode_from loses every already-produced token when the replica
+    # dies mid-request. The router therefore pulls tokens in bounded
+    # chunks: it always holds the history produced so far, which is
+    # exactly what the failover replay extends the prompt with.
+
+    def start_decode(self, rec: Dict[str, Any], max_new_tokens: int,
+                     eos_token: Optional[int] = None,
+                     timeout_s: float = 120.0) -> str:
+        """Adopt a transfer and open a pull handle for it. The handle's
+        first pulled token is the transfer's first token."""
+        now = time.monotonic()
+        stream = self._adopt(rec, max_new_tokens, eos_token, timeout_s)
+        hid = f"{self.server_id}-h{next(_SERVER_SEQ)}"
+        with self._lock:
+            self._streams[hid] = [stream, now]
+            for k, (_, last) in list(self._streams.items()):
+                if now - last > self._STREAM_REAP_S:
+                    del self._streams[k]  # abandoned by a dead router
+        return hid
+
+    def next_tokens(self, hid: str, max_tokens: int = 64,
+                    wait_s: float = 2.0) -> Dict[str, Any]:
+        """Pull up to `max_tokens` from an open handle: blocks up to
+        `wait_s` for the FIRST token, then drains whatever is already
+        produced. ``{"tokens": [...], "done": bool}`` — an empty pull
+        with done=False is a keep-alive (the caller owns timeout and
+        deadline policy)."""
+        from ray_tpu.models.engine import _DONE
+
+        with self._lock:
+            entry = self._streams.get(hid)
+            if entry is not None:
+                entry[1] = time.monotonic()  # the idle-reap stamp
+        if entry is None:
+            raise KeyError(f"unknown decode stream {hid!r} "
+                           f"(finished, cancelled, or reaped)")
+        req = entry[0]._req
+        toks: List[int] = []
+        done = False
+        try:
+            tok = req.out.get(timeout=max(0.0, float(wait_s)))
+            while True:
+                if tok is _DONE:
+                    done = True
+                    break
+                toks.append(int(tok))
+                if len(toks) >= max(1, int(max_tokens)):
+                    break
+                tok = req.out.get_nowait()
+        except queue.Empty:
+            pass
+        if toks:
+            # counts the tokens AND consults chaos: a scripted
+            # kill_replica at=token:K fires here, losing this pull's
+            # reply — the mid-stream death the failover path replays
+            self._count_decoded(len(toks))
+        if done:
+            with self._lock:
+                self._streams.pop(hid, None)
+            self.publish_telemetry()
+        return {"tokens": toks, "done": done}
+
+    def cancel_decode(self, hid: str) -> bool:
+        """Abandon a pull handle (router shed the request on deadline).
+        The engine finishes the slot's decode on its own — the tokens
+        are dropped, the slot frees naturally."""
+        with self._lock:
+            return self._streams.pop(hid, None) is not None
+
     def _count_decoded(self, n: int) -> None:
         with self._lock:
             self._stats["decoded_tokens"] += n
+        if self._chaos is not None:
+            self._chaos.on_tokens(n)  # may os._exit (kill_replica)
         self.publish_telemetry()
 
     # -------------------------------------------------------- control plane
@@ -605,6 +818,9 @@ class DisaggRouter:
                  max_queue_depth: Optional[int] = None,
                  retry_after_s: Optional[float] = None,
                  affinity_tokens: int = 16,
+                 failover_attempts: Optional[int] = None,
+                 failover_wait_s: float = 15.0,
+                 stream_chunk_tokens: int = 32,
                  router_id: Optional[str] = None):
         # every combination generate() cannot serve is rejected HERE,
         # not per-request after a prefill was already published
@@ -615,6 +831,11 @@ class DisaggRouter:
             raise ValueError(
                 "need a prefill+decode pair or a colocated engine")
         self._colocated = colocated
+        # the deployment SHAPE is fixed at construction: a disagg
+        # router whose whole prefill tier momentarily died waits for
+        # the self-healer's replacement (it never falls through to a
+        # colocated engine it may not have)
+        self._disagg_mode = bool(prefill)
         if max_queue_depth is None:
             max_queue_depth = int(os.environ.get(
                 "RAY_TPU_DISAGG_QUEUE_DEPTH", "8"))
@@ -623,6 +844,18 @@ class DisaggRouter:
             retry_after_s = float(os.environ.get(
                 "RAY_TPU_DISAGG_RETRY_AFTER_S", "1.0"))
         self.retry_after_s = float(retry_after_s)
+        # bounded failover budget: EXTRA attempts after the first (so
+        # the default survives any single replica failure with one
+        # retry to spare); exhaustion sheds with cause "failover"
+        if failover_attempts is None:
+            failover_attempts = int(os.environ.get(
+                "RAY_TPU_FAILOVER_ATTEMPTS", "2"))
+        self.failover_attempts = max(0, int(failover_attempts))
+        # how long a failed-over request waits for a survivor (or a
+        # self-healer replacement) when a whole tier momentarily has
+        # zero live replicas
+        self.failover_wait_s = max(0.0, float(failover_wait_s))
+        self.stream_chunk_tokens = max(1, int(stream_chunk_tokens))
         # prompts sharing their first `affinity_tokens` tokens (the
         # system prompt's first cache block) land on one prefill replica
         self.affinity_tokens = max(1, int(affinity_tokens))
@@ -649,8 +882,23 @@ class DisaggRouter:
         self._stats = {k: 0 for k in (
             "dispatched", "completed", "shed", "max_pending",
             "shm_affinity_hits", "shm_affinity_total")}
+        # serving-fault-tolerance accounting (the servefault surface):
+        # failover attempts per phase, requests that survived >= 1
+        # failover, sheds by attributed cause, corpses removed
+        self._sf = {
+            "failovers": {"prefill": 0, "decode": 0},
+            "failover_requests": 0,
+            "sheds_by_cause": {},
+            "removed_dead": {"prefill": 0, "decode": 0},
+        }
+        # recovery cost of each failover: ms from failure detection to
+        # the resumed stream's re-prefill landing (the chaos benchmark
+        # reports this window's summary as the recovery impact)
+        self._failover_win = SlidingWindow()
         self._last_push = 0.0
+        self._last_sf_push = 0.0
         disagg_metrics()
+        servefault_metrics()
 
     # ----------------------------------------------------- replica set ops
 
@@ -678,7 +926,8 @@ class DisaggRouter:
                            for r in self._decode)
         for pf in prefill:
             try:
-                _call(pf.target, "set_retention", hint, block=False)
+                # best-effort hint, supervised by the except below
+                _call(pf.target, "set_retention", hint, block=False)  # shardlint: disable=unsupervised-actor-call
             except Exception:  # noqa: BLE001 — replica mid-restart
                 pass
 
@@ -758,6 +1007,69 @@ class DisaggRouter:
                     return r.target
         return None
 
+    def remove_dead(self, tier: str, rid: str) -> bool:
+        """Remove a DEAD replica immediately — distinct from the drain
+        flow: no grace, no draining precondition (a corpse mid-drain is
+        reaped too), its in-flight requests have already failed over or
+        are about to. Called by the failover wrapper on an observed
+        death and by the serve/autoscale.py self-healer on an
+        actor-death event. Idempotent."""
+        with self._lock:
+            reps = self._tier(tier)
+            for i, r in enumerate(reps):
+                if r.rid == rid:
+                    del reps[i]
+                    self._sf["removed_dead"][tier] += 1
+                    break
+            else:
+                return False
+        self.publish_telemetry(force=True)
+        self.publish_servefault(force=True)
+        return True
+
+    # ------------------------------------------------------- failover core
+
+    def _tier_call(self, rep: _TierReplica, tier: str, method: str,
+                   *args, block: bool = True, **kw):
+        """THE supervised path for data-plane calls on a tier replica
+        (shardlint's unsupervised-actor-call rule flags bare calls that
+        bypass it): a death-shaped failure removes the corpse from the
+        replica set, emits the failover markers, and re-raises as
+        ReplicaDeadError so generate()'s bounded retry can re-route."""
+        try:
+            return _call(rep.target, method, *args, block=block, **kw)
+        except _DEATH_TYPES as e:
+            self.remove_dead(tier, rep.rid)
+            raise ReplicaDeadError(tier, rep.rid, e) from e
+
+    def _count_failover(self, phase: str, rid: str, attempt: int,
+                        detail: str) -> None:
+        with self._lock:
+            self._sf["failovers"][phase] += 1
+        servefault_metrics()["failovers"].inc(tags={"phase": phase})
+        _notify_resilience({"kind": "failover", "phase": phase,
+                            "router": self.router_id, "replica": rid,
+                            "attempt": attempt, "detail": detail[:200]})
+        self.publish_servefault()
+
+    def _shed(self, cause: str, message: str) -> RequestShedError:
+        """Count + build an attributed shed (the caller raises it):
+        every shed path reports the same one set of numbers."""
+        with self._lock:
+            self._stats["shed"] += 1
+            by = self._sf["sheds_by_cause"]
+            by[cause] = by.get(cause, 0) + 1
+        shed_counter().inc(tags={"app": "disagg",
+                                 "deployment": self.router_id})
+        servefault_metrics()["sheds"].inc(tags={"cause": cause})
+        _notify_event({"kind": "shed", "router": self.router_id,
+                       "cause": cause,
+                       "retry_after_s": self.retry_after_s})
+        self.publish_telemetry()
+        self.publish_servefault()
+        return RequestShedError(message, retry_after_s=self.retry_after_s,
+                                cause=cause)
+
     # ------------------------------------------------------------ admission
 
     def _admit_or_shed(self) -> _TierReplica:
@@ -784,26 +1096,19 @@ class DisaggRouter:
                 self._stats["dispatched"] += 1
                 self._stats["max_pending"] = max(
                     self._stats["max_pending"], pending)
-            else:
-                self._stats["shed"] += 1
         self._depth_win.add(pending)
         if not open_reps:
-            shed_counter().inc(tags={"app": "disagg",
-                                     "deployment": self.router_id})
-            _notify_event({"kind": "shed", "router": self.router_id,
-                           "pending": pending,
-                           "retry_after_s": self.retry_after_s})
-            # push the snapshot NOW (0.5s-throttled): under sustained
-            # overload nothing completes, and a completion-only push
-            # would freeze the conductor surfaces — queue depth aging
-            # out to 0 — during exactly the storm they exist to show
-            self.publish_telemetry()
-            raise RequestShedError(
+            # _shed pushes the snapshot NOW (0.5s-throttled): under
+            # sustained overload nothing completes, and a completion-
+            # only push would freeze the conductor surfaces — queue
+            # depth aging out to 0 — during exactly the storm they
+            # exist to show
+            raise self._shed(
+                "capacity",
                 f"disagg router {self.router_id}: every decode "
                 f"replica is at capacity + queue depth "
-                f"{self.max_queue_depth}; retry after "
-                f"{self.retry_after_s:.1f}s",
-                retry_after_s=self.retry_after_s)
+                f"{self.max_queue_depth} (pending {pending}); retry "
+                f"after {self.retry_after_s:.1f}s")
         if self._prefill and len(open_reps) > 1:
             # refine by live free-slot count (the decode-pick policy);
             # the in-flight estimate breaks ties and covers probe lag.
@@ -818,7 +1123,8 @@ class DisaggRouter:
 
                 import ray_tpu
 
-                probes = [(r, _call(r.target, "free_slots",
+                # read-only probe, supervised by the except below
+                probes = [(r, _call(r.target, "free_slots",  # shardlint: disable=unsupervised-actor-call
                                     block=False)) for r in open_reps]
                 # expected free slots once in-transit dispatches land:
                 # the probe already excludes EXECUTING requests, which
@@ -847,11 +1153,17 @@ class DisaggRouter:
         self.publish_telemetry()
         return rep
 
-    def _complete(self, rep: _TierReplica) -> None:
+    def _complete(self, rep: _TierReplica, ok: bool = True) -> None:
+        """Release a request's reservation; `completed` counts only
+        requests that RETURNED tokens — a shed-after-admission
+        (deadline, failover exhaustion) or an error releases the slot
+        without counting, so completed + shed + errors reconciles with
+        dispatched instead of double-counting the shed ones."""
         with self._lock:
             if rep.inflight > 0:
                 rep.inflight -= 1
-            self._stats["completed"] += 1
+            if ok:
+                self._stats["completed"] += 1
             pending = sum(r.inflight for r in self._decode)
         disagg_metrics()["queue_depth"].set(
             pending, tags={"router": self.router_id})
@@ -873,6 +1185,8 @@ class DisaggRouter:
             cands = [r for r in self._prefill if not r.draining]
             if not cands:  # every prefill draining: keep serving
                 cands = list(self._prefill)
+            if not cands:  # every prefill DEAD: caller waits/sheds
+                raise LookupError("no live prefill replica")
             local = [r for r in cands
                      if decode_machine is not None
                      and r.machine == decode_machine]
@@ -884,9 +1198,97 @@ class DisaggRouter:
                 self._stats["shm_affinity_hits"] += 1
         return rep
 
+    def _check_deadline(self, deadline: Optional[float]) -> None:
+        """Shed with cause `deadline` the moment the request outlives
+        its budget — it must never occupy a decode slot (or a failover
+        attempt) past it."""
+        if deadline is not None and time.perf_counter() > deadline:
+            raise self._shed(
+                "deadline",
+                f"disagg router {self.router_id}: request outlived its "
+                f"deadline; retry after {self.retry_after_s:.1f}s")
+
+    def _ack_transfer(self, pf: _TierReplica, rec: Dict[str, Any]
+                      ) -> None:
+        """Release the sender's chunk refs, consumed or abandoned: an
+        un-acked record pins them until the retention window overflows
+        — which on a quiet tier is never. The prefill replica may
+        itself be dead by now; then its refs died with it."""
+        try:
+            # fire-and-forget on a possibly-dead replica — failure here
+            # must not consume a failover attempt
+            _call(pf.target, "ack", rec["transfer_id"], block=False)  # shardlint: disable=unsupervised-actor-call
+        except Exception:  # noqa: BLE001 — replica already dead
+            pass
+
+    def _attempt_failed(self, phase: str, rid: str, attempt: int,
+                        err: BaseException) -> None:
+        """Account one failed attempt; sheds with cause `failover` when
+        the bounded budget is exhausted."""
+        self._count_failover(phase, rid, attempt,
+                             f"{type(err).__name__}: {err}")
+        if attempt > self.failover_attempts:
+            raise self._shed(
+                "failover",
+                f"disagg router {self.router_id}: {phase} failure on "
+                f"attempt {attempt}/{1 + self.failover_attempts} "
+                f"({type(err).__name__}: {str(err)[:160]}); failover "
+                f"budget exhausted") from err
+
+    def _pick_prefill_or_wait(self, prompt: np.ndarray,
+                              decode_machine: Optional[str],
+                              deadline: Optional[float]
+                              ) -> _TierReplica:
+        """_pick_prefill, waiting out a momentarily-empty tier (every
+        prefill replica dead, self-healer replacement in flight) up to
+        ``failover_wait_s`` before shedding with cause failover."""
+        wait_until = time.monotonic() + self.failover_wait_s
+        while True:
+            try:
+                return self._pick_prefill(prompt, decode_machine)
+            except LookupError:
+                pass
+            self._check_deadline(deadline)
+            if time.monotonic() >= wait_until:
+                raise self._shed(
+                    "failover",
+                    f"disagg router {self.router_id}: no live prefill "
+                    f"replica after {self.failover_wait_s:.0f}s")
+            time.sleep(0.25)
+
+    def _reserve_survivor(self, old: _TierReplica,
+                          deadline: Optional[float]) -> _TierReplica:
+        """Move an ACCEPTED request's reservation off a failed decode
+        replica onto a survivor. Failover never re-runs admission —
+        the request was accepted and the dead replica's slot vanished
+        with it — so the survivor is chosen by least in-flight without
+        re-checking the shed bound. Waits out a momentarily-empty tier
+        (self-healer replacement in flight) like the prefill twin. The
+        swap is atomic under the lock: `old` keeps its reservation
+        until the survivor holds one, so the caller's release-on-exit
+        always has exactly one reservation to release."""
+        wait_until = time.monotonic() + self.failover_wait_s
+        while True:
+            with self._lock:
+                cands = [r for r in self._decode if not r.draining]
+                if cands:
+                    rep = min(cands, key=lambda r: r.inflight)
+                    rep.inflight += 1
+                    if old.inflight > 0:
+                        old.inflight -= 1
+                    return rep
+            self._check_deadline(deadline)
+            if time.monotonic() >= wait_until:
+                raise self._shed(
+                    "failover",
+                    f"disagg router {self.router_id}: no live decode "
+                    f"replica after {self.failover_wait_s:.0f}s")
+            time.sleep(0.25)
+
     def generate(self, prompt_tokens, max_new_tokens: int,
                  eos_token: Optional[int] = None, *,
                  timeout_s: float = 120.0,
+                 deadline_s: Optional[float] = None,
                  on_first_token=None,
                  token_sleep_s: float = 0.0) -> List[int]:
         """One request end-to-end. `on_first_token()` (optional) fires
@@ -894,59 +1296,208 @@ class DisaggRouter:
         disaggregation — which is what the harness's TTFT measures.
         `token_sleep_s` simulates a slow client consuming the stream
         (bench_serve.py's backpressure knob): decode ticks must keep
-        serving OTHER requests while this one drains slowly."""
+        serving OTHER requests while this one drains slowly.
+        `deadline_s` bounds the request's total wall time — past it the
+        request sheds with cause ``deadline`` instead of occupying a
+        slot forever.
+
+        The failover invariant: once this method ADMITS a request, it
+        either returns the complete token list — bit-identical to an
+        uninterrupted greedy run, surviving any single tier-replica
+        death via bounded replay — or raises a RequestShedError with an
+        attributed cause. It never silently drops."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
-        rep = self._admit_or_shed()
+        deadline = (None if deadline_s is None
+                    else time.perf_counter() + float(deadline_s))
+        self._check_deadline(deadline)  # arrived already expired
+        # rep_box[0] is the decode replica currently holding this
+        # request's reservation — failover swaps it, and release-on-
+        # exit must decrement whichever replica holds it NOW (releasing
+        # the original after a swap would steal another request's
+        # reservation and leak the survivor's)
+        rep_box = [self._admit_or_shed()]
         t_admit = time.perf_counter()
+        ok = False
         try:
-            if not self._prefill:
-                out: List[int] = []
-                for tok in self._colocated.stream(prompt, max_new_tokens,
-                                                  eos_token,
-                                                  timeout_s=timeout_s):
-                    if not out:
-                        self._ttft_win.add(
-                            (time.perf_counter() - t_admit) * 1e3)
-                        if on_first_token is not None:
-                            on_first_token()
-                    out.append(tok)
-                    if token_sleep_s > 0:
-                        time.sleep(token_sleep_s)
-                return out
-            pf = self._pick_prefill(prompt, rep.machine)
+            if not self._disagg_mode:
+                out = self._generate_colocated(
+                    prompt, max_new_tokens, eos_token, timeout_s,
+                    deadline, on_first_token, token_sleep_s, t_admit)
+            else:
+                out = self._generate_disagg(
+                    rep_box, prompt, max_new_tokens, eos_token,
+                    timeout_s, deadline, on_first_token, token_sleep_s,
+                    t_admit)
+            ok = True
+            return out
+        finally:
+            self._complete(rep_box[0], ok)
+
+    def _generate_colocated(self, prompt, max_new_tokens, eos_token,
+                            timeout_s, deadline, on_first_token,
+                            token_sleep_s, t_admit) -> List[int]:
+        out: List[int] = []
+        for tok in self._colocated.stream(prompt, max_new_tokens,
+                                          eos_token,
+                                          timeout_s=timeout_s):
+            if not out:
+                self._ttft_win.add(
+                    (time.perf_counter() - t_admit) * 1e3)
+                if on_first_token is not None:
+                    on_first_token()
+            out.append(tok)
+            if token_sleep_s > 0:
+                time.sleep(token_sleep_s)
+            self._check_deadline(deadline)
+        return out
+
+    def _generate_disagg(self, rep_box, prompt, max_new_tokens,
+                         eos_token, timeout_s, deadline, on_first_token,
+                         token_sleep_s, t_admit) -> List[int]:
+        """The failover loop. `history` holds every token delivered so
+        far; a replay prefills prompt+history (a suffix-only prefill
+        thanks to the prefix cache — the dead replica's tokens EXTEND
+        the prompt) and resumes decode for the remaining budget, so the
+        concatenated stream is bit-identical to an uninterrupted greedy
+        run. `rep_box[0]` tracks the decode replica holding the
+        request's reservation across swaps; the caller releases it."""
+        history: List[int] = []
+        attempt = 0
+        first_emitted = False
+        fail_detected: Optional[float] = None
+        had_failover = False
+        while True:
+            rep = rep_box[0]
+            attempt += 1
+            self._check_deadline(deadline)
+            remaining = max_new_tokens - len(history)
+            if remaining <= 0:
+                return history  # died between last token and DONE
+            if eos_token is not None and history \
+                    and history[-1] == int(eos_token):
+                # the eos token was already delivered — the replica
+                # died between the eos pull and the done pull. The
+                # request IS complete; replaying would decode past eos
+                # and break bit-identity.
+                return history
+            replay = (np.concatenate(
+                [prompt, np.asarray(history, np.int32)])
+                if history else prompt)
+            # ---- prefill phase (retryable: nothing emitted from rec
+            # until decode pulls it)
+            pf = self._pick_prefill_or_wait(replay, rep.machine,
+                                            deadline)
             with self._lock:
                 self._pf_inflight += 1
                 pf.inflight += 1
             self._pf_inflight_win.add(self._pf_inflight)
             try:
-                rec = _call(pf.target, "prefill", prompt.tolist())
+                rec = self._tier_call(pf, "prefill", "prefill",
+                                      replay.tolist())
+            except Exception as e:  # noqa: BLE001 — dead or broken
+                fail_detected = time.perf_counter()
+                had_failover = True
+                self._attempt_failed("prefill", pf.rid, attempt, e)
+                continue
             finally:
                 with self._lock:
                     self._pf_inflight -= 1
                     if pf.inflight > 0:
                         pf.inflight -= 1
-            # the first token exists NOW — this is the TTFT the recent
-            # window (and the policy's queueing-delay signal) reads
-            self._ttft_win.add((time.perf_counter() - t_admit) * 1e3)
-            self._cache_win.add(
-                _OUTCOME_WEIGHT.get(rec.get("outcome"), 0.0))
             try:
-                if on_first_token is not None:
-                    on_first_token()  # rec carries the first token
-                toks = _call(rep.target, "decode_from", rec,
-                             max_new_tokens, eos_token, timeout_s)
-            finally:
-                # Ack even when decode failed: the transfer can never be
-                # consumed again, and an un-acked record pins the sender's
-                # chunk refs until the retention window overflows — which
-                # on a quiet tier is never.
-                _call(pf.target, "ack", rec["transfer_id"], block=False)
+                if not first_emitted:
+                    # the first token exists NOW — this is the TTFT
+                    # the recent window (and the policy's queueing-
+                    # delay signal) reads
+                    first_emitted = True
+                    self._ttft_win.add(
+                        (time.perf_counter() - t_admit) * 1e3)
+                    self._cache_win.add(
+                        _OUTCOME_WEIGHT.get(rec.get("outcome"), 0.0))
+                    if on_first_token is not None:
+                        on_first_token()
+                if fail_detected is not None:
+                    # recovery cost: failure detection -> replayed
+                    # prefill landed (the stream is about to resume)
+                    self._failover_win.add(
+                        (time.perf_counter() - fail_detected) * 1e3)
+                    fail_detected = None
+            except BaseException:
+                # a raising caller callback must not strand the
+                # just-published transfer un-acked (it would pin the
+                # sender's chunk refs forever on a quiet tier)
+                self._ack_transfer(pf, rec)
+                raise
+            # ---- decode phase: chunked pulls so the router holds the
+            # history the next replay would need
+            hid = None
+            # slow-client pacing sleeps token_sleep_s * chunk between
+            # pulls; cap the chunk so the inter-pull gap stays well
+            # inside the replica's idle-reap window (the reap stamp
+            # refreshes on every pull) — without this, pacing past
+            # _STREAM_REAP_S / chunk would reap a healthy live stream
+            chunk = self.stream_chunk_tokens
             if token_sleep_s > 0:
-                for _ in toks:
-                    time.sleep(token_sleep_s)
-            return toks
-        finally:
-            self._complete(rep)
+                chunk = max(1, min(chunk,
+                                   int(120.0 / token_sleep_s) or 1))
+            try:
+                hid = self._tier_call(rep, "decode", "start_decode",
+                                      rec, remaining, eos_token,
+                                      timeout_s)
+                last_progress = time.perf_counter()
+                while True:
+                    out = self._tier_call(
+                        rep, "decode", "next_tokens", hid, chunk,
+                        min(2.0, max(0.1, timeout_s / 4)))
+                    toks = out.get("tokens") or []
+                    if toks:
+                        history.extend(int(t) for t in toks)
+                        last_progress = time.perf_counter()
+                        if token_sleep_s > 0:
+                            time.sleep(token_sleep_s * len(toks))
+                    if out.get("done"):
+                        self._ack_transfer(pf, rec)
+                        if had_failover:
+                            with self._lock:
+                                self._sf["failover_requests"] += 1
+                            self.publish_servefault()
+                        return history
+                    try:
+                        self._check_deadline(deadline)
+                    except RequestShedError:
+                        # abandon the stream: the engine frees the slot
+                        # on its own; the transfer is still acked so
+                        # the sender's chunk refs never leak
+                        try:
+                            self._tier_call(rep, "decode",
+                                            "cancel_decode", hid,
+                                            block=False)
+                        except Exception:  # noqa: BLE001 — dead too
+                            pass
+                        self._ack_transfer(pf, rec)
+                        raise
+                    if time.perf_counter() - last_progress > timeout_s:
+                        raise TimeoutError(
+                            f"decode stream stalled > {timeout_s:.0f}s "
+                            f"on {rep.rid}")
+            except RequestShedError:
+                raise
+            except Exception as e:  # noqa: BLE001 — death or stall
+                fail_detected = time.perf_counter()
+                had_failover = True
+                if hid is not None:
+                    # a LIVE-but-stalled replica keeps its abandoned
+                    # stream (and the engine slot behind it) unless we
+                    # cancel; on a dead replica this is a no-op throw
+                    try:
+                        _call(rep.target, "cancel_decode", hid,  # shardlint: disable=unsupervised-actor-call
+                              block=False)
+                    except Exception:  # noqa: BLE001 — replica dead
+                        pass
+                self._ack_transfer(pf, rec)
+                self._attempt_failed("decode", rep.rid, attempt, e)
+                rep_box[0] = self._reserve_survivor(rep, deadline)
+                continue
 
     # ------------------------------------------------------------ telemetry
 
@@ -984,10 +1535,13 @@ class DisaggRouter:
         with self._lock:
             s: Dict[str, Any] = dict(self._stats)
             s["pending"] = sum(r.inflight for r in self._decode)
+            s["failovers"] = dict(self._sf["failovers"])
+            s["failover_requests"] = self._sf["failover_requests"]
+            s["sheds_by_cause"] = dict(self._sf["sheds_by_cause"])
             decode = list(self._decode)
             prefill = list(self._prefill)
         s.update(role="router", router_id=self.router_id,
-                 mode="disagg" if prefill else "colocated",
+                 mode="disagg" if self._disagg_mode else "colocated",
                  decode_replicas=sum(1 for r in decode
                                      if not r.draining),
                  prefill_replicas=sum(1 for r in prefill
@@ -1018,6 +1572,30 @@ class DisaggRouter:
         self._last_push = now
         _push_stats(self.router_id, self.stats())
 
+    def servefault_stats(self) -> Dict[str, Any]:
+        """The fault-tolerance snapshot this router contributes to the
+        servefault surface (state API == CLI == dashboard ==
+        Prometheus == timeline read the same numbers)."""
+        with self._lock:
+            sf: Dict[str, Any] = {
+                "failovers": dict(self._sf["failovers"]),
+                "failover_requests": self._sf["failover_requests"],
+                "sheds_by_cause": dict(self._sf["sheds_by_cause"]),
+                "removed_dead": dict(self._sf["removed_dead"]),
+            }
+        sf.update(role="router", router_id=self.router_id,
+                  recent_failover_recovery_ms=
+                  self._failover_win.summary())
+        return sf
+
+    def publish_servefault(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_sf_push < 0.5:
+            return
+        self._last_sf_push = now
+        _push_servefault(self.router_id, self.servefault_stats())
+
 
 __all__ = ["DecodeServer", "DisaggRouter", "PrefillServer",
-           "RequestShedError", "disagg_metrics"]
+           "ReplicaDeadError", "RequestShedError", "disagg_metrics",
+           "servefault_metrics"]

@@ -24,11 +24,20 @@ class RequestShedError(RuntimeError):
     """Raised by admission control instead of queueing past the knob
     (router load shedding — reject-with-retry-after, shed BEFORE the
     replica/engine wedges). ``retry_after_s`` is the client's backoff
-    hint; the HTTP proxy maps it to a 503 + Retry-After header."""
+    hint; the HTTP proxy maps it to a 503 + Retry-After header.
 
-    def __init__(self, message: str, retry_after_s: float = 1.0):
+    ``cause`` attributes the shed (the serving-fault-tolerance
+    invariant: an accepted request is never silently dropped — it
+    either completes or sheds WITH a cause): ``capacity`` (admission
+    bound), ``deadline`` (request outlived its deadline_s),
+    ``failover`` (replica deaths exhausted the bounded retry budget),
+    ``draining`` (dispatch raced a replica's grace drain)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 cause: str = "capacity"):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
+        self.cause = str(cause)
 
 
 _shed_counter = None
@@ -87,7 +96,7 @@ class DeploymentResponse:
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
-        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu.exceptions import ActorDiedError, TaskError
 
         try:
             return ray_tpu.get(self._object_ref, timeout=timeout_s)
@@ -104,6 +113,24 @@ class DeploymentResponse:
             self._router._refresh(force=True)
             retried = self._router.assign(meta, args, kwargs)
             retried._request = None  # one retry: a second death raises
+            return retried.result(timeout_s)
+        except TaskError as e:
+            # A replica that began its grace drain rejects the request
+            # before running it (replica.py _reject_if_draining) — the
+            # same raced-teardown window as a death, so retry the same
+            # way; exhausted retries surface the ATTRIBUTED shed, not
+            # the opaque TaskError wrapper.
+            shed = e.cause if isinstance(e.cause, RequestShedError) \
+                else None
+            if shed is None or shed.cause != "draining":
+                raise
+            self._mark_done()
+            if self._request is None:
+                raise shed from e
+            meta, args, kwargs = self._request
+            self._router._refresh(force=True)
+            retried = self._router.assign(meta, args, kwargs)
+            retried._request = None
             return retried.result(timeout_s)
         finally:
             self._mark_done()

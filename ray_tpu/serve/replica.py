@@ -179,6 +179,7 @@ class ReplicaActor:
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._inflight = 0
+        self._draining = False
         self._lock = threading.Lock()
         self._num_requests = 0
         self._num_errors = 0
@@ -271,10 +272,25 @@ class ReplicaActor:
         except Exception:  # noqa: BLE001 — telemetry must not fail a
             pass           # request or mask its real error
 
+    def _reject_if_draining(self) -> None:
+        """A request dispatched after this replica began its grace
+        drain raced teardown: reject it with an ATTRIBUTED cause (the
+        serving fault-tolerance invariant — never silently race the
+        actor's death) so the handle retries on a live replica."""
+        from .handle import RequestShedError
+
+        with self._lock:
+            draining = self._draining
+        if draining:
+            raise RequestShedError(
+                f"replica {self.replica_tag} is draining for shutdown",
+                retry_after_s=0.1, cause="draining")
+
     def handle_request(self, meta: Dict[str, Any], args: List[Any],
                        kwargs: Dict[str, Any]) -> Any:
         t0 = time.perf_counter()
         outcome = "ok"
+        self._reject_if_draining()
         with self._lock:
             self._inflight += 1
             self._num_requests += 1
@@ -310,6 +326,7 @@ class ReplicaActor:
 
         t0 = time.perf_counter()
         outcome, ttft, cache_label = "ok", None, None
+        self._reject_if_draining()
         with self._lock:
             self._inflight += 1
             self._num_requests += 1
@@ -386,6 +403,10 @@ class ReplicaActor:
         replica's request threads for the whole drain window)."""
         import asyncio
 
+        with self._lock:
+            # new arrivals now shed with cause "draining" instead of
+            # racing the actor's death (the handle retries elsewhere)
+            self._draining = True
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._lock:

@@ -21,12 +21,28 @@ every consumer has fetched; dropping the last ref frees the store entry.
 """
 from __future__ import annotations
 
+import os
 import pickle
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ray_tpu._private.object_store import ObjectRef
+
+# Transient pull failures worth retrying: a timed-out range fetch or a
+# connection hiccup to the owning worker. Owner-side permanent failures
+# (ObjectLostError: the chunk is gone with its process) re-raise
+# immediately — retrying cannot bring the bytes back.
+_TRANSIENT = (ConnectionError, EOFError, OSError, TimeoutError)
+
+
+def _fetch_retries() -> int:
+    try:
+        return max(0, int(os.environ.get("RAY_TPU_CHUNK_FETCH_RETRIES",
+                                         "2")))
+    except (TypeError, ValueError):
+        return 2
 
 
 def ensure_chunkable(host_arr: Any) -> np.ndarray:
@@ -79,11 +95,18 @@ class ChunkFetcher:
     def __init__(self, worker, timeout: float = 60.0,
                  on_read: Optional[Callable[[int, bool, bool],
                                             None]] = None,
-                 seed_cache: Optional[Dict[str, np.ndarray]] = None):
+                 seed_cache: Optional[Dict[str, np.ndarray]] = None,
+                 retries: Optional[int] = None):
         self._worker = worker
         self._timeout = timeout
         self._on_read = on_read
         self._machine = local_machine_id()
+        # bounded retry-with-backoff on TRANSIENT pull failures (env
+        # RAY_TPU_CHUNK_FETCH_RETRIES, default 2): a timed-out range
+        # fetch used to fail the whole consumer — KV transfer, weight
+        # fetch, activation recv — on one slow owner round-trip
+        self._retries = _fetch_retries() if retries is None \
+            else max(0, int(retries))
         # seed_cache: chunks something else already pulled (subscriber
         # prefetch) — their first use accounts as a LOCAL read
         self._cache: Dict[str, np.ndarray] = dict(seed_cache or {})
@@ -93,6 +116,7 @@ class ChunkFetcher:
         self.fetched_bytes = 0
         self.shm_bytes = 0
         self.rpc_bytes = 0
+        self.fetch_retries = 0
 
     @property
     def cache(self) -> Dict[str, np.ndarray]:
@@ -109,7 +133,29 @@ class ChunkFetcher:
                 "chunks_fetched": self.chunks_fetched,
                 "fetched_bytes": self.fetched_bytes,
                 "shm_bytes": self.shm_bytes,
-                "rpc_bytes": self.rpc_bytes}
+                "rpc_bytes": self.rpc_bytes,
+                "fetch_retries": self.fetch_retries}
+
+    def _get_with_retries(self, ref: ObjectRef) -> np.ndarray:
+        """One chunk pull with bounded exponential backoff on transient
+        failures; every consumer of the chunk fabric (KV transfer,
+        weight fetch, activation recv) gets the retry for free."""
+        from ray_tpu.resilience.chaos import chunk_fetch_delay_s
+
+        delay = chunk_fetch_delay_s()  # scripted chaos stretch
+        if delay > 0:
+            time.sleep(delay)
+        attempt = 0
+        while True:
+            try:
+                return np.asarray(self._worker.get(
+                    ref, timeout=self._timeout))
+            except _TRANSIENT:
+                if attempt >= self._retries:
+                    raise
+                attempt += 1
+                self.fetch_retries += 1
+                time.sleep(min(5.0, 0.1 * 2.0 ** (attempt - 1)))
 
     def __call__(self, entry: Dict[str, Any]) -> np.ndarray:
         oid = entry["object_id"]
@@ -125,7 +171,7 @@ class ChunkFetcher:
         was_local = self._worker.store.contains(oid)
         ref = ObjectRef(oid, locator=tuple(entry["locator"]),
                         owner=tuple(entry["locator"]))
-        arr = np.asarray(self._worker.get(ref, timeout=self._timeout))
+        arr = self._get_with_retries(ref)
         nbytes = int(entry.get("nbytes", arr.nbytes))
         # entries predating the machine field read as same-host (shm was
         # the only deployment shape those versions supported)

@@ -185,6 +185,20 @@ def disagg_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def servefault_status() -> Dict[str, Any]:
+    """Serving-plane fault-tolerance view (serve/disagg.py failover +
+    serve/autoscale.py self-healing): per-router failover counts by
+    phase, sheds by attributed cause (capacity/deadline/failover/
+    draining), corpses removed, recent failover-recovery latency;
+    per-healer replica deaths, replacements, breaker trips and open
+    hosts — plus cluster totals. The failover/replace/breaker_trip
+    instant markers live in the merged timeline's RESILIENCE lane. The
+    CLI analog is `python -m ray_tpu servefault`; the dashboard serves
+    it at /api/servefault."""
+    return _conductor().conductor.call("get_servefault_status",
+                                       timeout=10.0)
+
+
 def autoscaler_status() -> Dict[str, Any]:
     """Serving-autoscaler view (serve/autoscale.py): per-loop status
     snapshots (per-tier targets and bounds, scale-up/down decision

@@ -69,6 +69,14 @@ def pytest_configure(config):
         "module-scoped cluster with log_to_driver=0 — select with "
         "`-m autoscale`")
     config.addinivalue_line(
+        "markers", "servefault: serving-plane fault-tolerance "
+        "scenarios (serve/disagg.py request failover + "
+        "serve/autoscale.py tier self-healing + serving chaos ops): "
+        "replica-death replay bit-identity, deadline/failover shed "
+        "causes, breaker, drain/death race; everything is tier-1-safe "
+        "on CPU, cluster tests run on a module-scoped cluster with "
+        "log_to_driver=0 — select with `-m servefault`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "
