@@ -272,6 +272,14 @@ class ConductorHandler:
         # and /api/servefault with one set of numbers.
         self._servefault_stats: Dict[str, Dict[str, Any]] = {}
 
+        # Multi-tenant LoRA serving (serve/lora.py): adapter pools push
+        # paging snapshots (hits/misses/evictions/swaps, residents),
+        # routers push per-tenant request counters; page_in/evict/swap
+        # markers feed the merged timeline's `lora` lane. One aggregate
+        # feeds util.state.lora_status(), `ray_tpu lora`, /api/lora.
+        self._lora_stats: Dict[str, Dict[str, Any]] = {}
+        self._lora_events: List[Dict[str, Any]] = []
+
         # Step-time oracle (observability.roofline): predicted step-time
         # breakdowns keyed by layout + predicted-vs-measured validation
         # records (residuals, fitted calibration). One aggregate feeds
@@ -1952,6 +1960,105 @@ class ConductorHandler:
             events = list(self._resilience_events)
         kinds = self._SERVEFAULT_EVENT_KINDS
         return [e for e in events if e.get("kind") in kinds][-limit:]
+
+    # -------------------------------------------- multi-tenant LoRA
+    # Adapter pools (serve/lora.py AdapterPool — one per prefill /
+    # decode replica or colocated engine) push paging snapshots,
+    # routers push per-tenant request counters;
+    # util.state.lora_status(), `ray_tpu lora`, and /api/lora all read
+    # the same aggregate so every surface reports one set of numbers.
+
+    _LORA_STATS_KEPT = 256
+    _LORA_EVENTS_KEPT = 10_000
+
+    def report_lora_stats(self, worker_id: str, component_id: str,
+                          stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._lora_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            while len(self._lora_stats) > self._LORA_STATS_KEPT:
+                oldest = min(self._lora_stats,
+                             key=lambda k:
+                             self._lora_stats[k].get("ts", 0.0))
+                del self._lora_stats[oldest]
+
+    def get_lora_status(self) -> Dict[str, Any]:
+        """One aggregate for every lora surface: pool snapshots (pool
+        paging counters + residents), router tenant counters, plus
+        cluster totals (acquires/hits/misses/evictions/swaps/page-in
+        bytes, per-tenant request rollup)."""
+        with self._lock:
+            comps = {k: dict(v) for k, v in self._lora_stats.items()}
+        pools = {k: v for k, v in comps.items()
+                 if v.get("role") == "pool"}
+        routers = {k: v for k, v in comps.items()
+                   if v.get("role") == "router"}
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for p in pools.values():
+            for t, ts in (p.get("tenants") or {}).items():
+                agg = tenants.setdefault(
+                    t, {"hits": 0, "misses": 0, "evictions": 0,
+                        "swaps": 0, "dispatched": 0, "completed": 0,
+                        "shed": 0, "slo_misses": 0})
+                for key in ("hits", "misses", "evictions", "swaps"):
+                    agg[key] += int(ts.get(key, 0))
+        for r in routers.values():
+            for t, ts in (r.get("tenants") or {}).items():
+                agg = tenants.setdefault(
+                    t, {"hits": 0, "misses": 0, "evictions": 0,
+                        "swaps": 0, "dispatched": 0, "completed": 0,
+                        "shed": 0, "slo_misses": 0})
+                for key in ("dispatched", "completed", "shed",
+                            "slo_misses"):
+                    agg[key] += int(ts.get(key, 0))
+        acquires = sum(int(p.get("acquires", 0))
+                       for p in pools.values())
+        hits = sum(int(p.get("hits", 0)) for p in pools.values())
+        totals: Dict[str, Any] = {
+            "pools": len(pools),
+            "routers": len(routers),
+            "slots": sum(int(p.get("slots", 0))
+                         for p in pools.values()),
+            "resident": sum(int(p.get("resident", 0))
+                            for p in pools.values()),
+            "pinned": sum(int(p.get("pinned", 0))
+                          for p in pools.values()),
+            "acquires": acquires,
+            "hits": hits,
+            "misses": sum(int(p.get("misses", 0))
+                          for p in pools.values()),
+            "evictions": sum(int(p.get("evictions", 0))
+                             for p in pools.values()),
+            "swaps": sum(int(p.get("swaps", 0))
+                         for p in pools.values()),
+            "page_in_bytes": sum(int(p.get("page_in_bytes", 0))
+                                 for p in pools.values()),
+            "hit_rate": hits / acquires if acquires else 0.0,
+            "tenants": len(tenants),
+        }
+        return {"pools": pools, "routers": routers,
+                "tenants": tenants, "totals": totals}
+
+    def report_lora_event(self, event: Dict[str, Any]) -> None:
+        """page_in / evict / swap instant markers for the merged
+        timeline's lora lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._lora_events.append(event)
+            if len(self._lora_events) > self._LORA_EVENTS_KEPT:
+                del self._lora_events[
+                    :len(self._lora_events) - self._LORA_EVENTS_KEPT]
+
+    def get_lora_events(self, limit: int = 10_000
+                        ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._lora_events[-limit:]
 
     # ------------------------------------------------ serving autoscaler
     # serve/autoscale.py policy loops push status snapshots and

@@ -27,6 +27,13 @@ so broken or dependency-heavy modules still lint):
   supervised (probe loops in try/except, fire-and-forget acks) suppress
   with a justification comment.
 
+- unkeyed-tenant-cache (info): in LoRA-aware modules (anything
+  importing from serve.lora), a prefix-cache ``.lookup(...)`` without
+  a ``namespace=`` keyword. The paged KV cache keys prefixes by
+  (namespace, prompt) so KV prefilled under one tenant's adapter can
+  never serve another tenant's request; a tenant-blind lookup in a
+  multi-tenant code path silently reintroduces exactly that leak.
+
 Suppression: append `# shardlint: ok` to the flagged line, or
 `# shardlint: disable=<rule-id>` to suppress one rule on that line.
 """
@@ -298,6 +305,58 @@ def _lint_unsupervised_actor_call(tree: ast.AST, aliases: _Aliases,
     return findings
 
 
+# --------------------------------------------------- unkeyed-tenant-cache
+
+
+def _receiver_mentions_cache(expr: ast.AST) -> bool:
+    """True when the call receiver's dotted chain names a cache
+    (``kv_cache.lookup``, ``self.kv_cache.lookup``, ``cache.lookup``) —
+    the shapes a prefix-cache handle takes in this tree."""
+    while isinstance(expr, ast.Attribute):
+        if "cache" in expr.attr.lower():
+            return True
+        expr = expr.value
+    return isinstance(expr, ast.Name) and "cache" in expr.id.lower()
+
+
+def _lint_unkeyed_tenant_cache(tree: ast.AST, aliases: _Aliases,
+                               path: str) -> List[Finding]:
+    """Active only in LoRA-aware modules — anywhere that imports from
+    serve.lora (the adapter pool in scope means tenants exist in this
+    code path). There, a prefix-cache ``.lookup(...)`` without a
+    ``namespace=`` keyword hashes the prompt against the TENANT-BLIND
+    root: KV prefilled under one tenant's adapter could silently serve
+    another tenant's request. models/kvcache.py keys by (namespace,
+    prompt) precisely so lora-aware callers pass the tenant."""
+    lora_aware = any(
+        mod.endswith("lora")
+        for mod, _name in aliases.from_imports.values()
+    ) or any(mod.endswith(".lora") or mod == "lora"
+             for mod in aliases.module_alias.values())
+    if not lora_aware:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "lookup"):
+            continue
+        if not _receiver_mentions_cache(f.value):
+            continue
+        if any(kw.arg == "namespace" for kw in node.keywords):
+            continue
+        findings.append(Finding(
+            "unkeyed-tenant-cache", INFO, f"{path}:{node.lineno}",
+            "prefix-cache lookup in a LoRA-aware module without "
+            "namespace= — one tenant's cached KV could serve another "
+            "tenant's prompt",
+            "pass namespace=<tenant> (and the same namespace to the "
+            "paired commit()), or suppress with a justification when "
+            "the code path is provably single-tenant"))
+    return findings
+
+
 # ---------------------------------------------------------------- drivers
 
 
@@ -312,6 +371,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     findings = _lint_blocking_in_async(tree, aliases, path)
     findings += _lint_host_sync_in_jit(tree, aliases, path)
     findings += _lint_unsupervised_actor_call(tree, aliases, path)
+    findings += _lint_unkeyed_tenant_cache(tree, aliases, path)
     if not findings:
         return findings
     suppressed = _suppressions(source)

@@ -45,6 +45,9 @@ RULES: Dict[str, str] = {
     "unsupervised-actor-call":
         "bare call on a serve tier-replica target bypasses the "
         "failover wrapper (replica death raises unsupervised)",
+    "unkeyed-tenant-cache":
+        "prefix-cache lookup in LoRA-aware code without the tenant in "
+        "the key (one tenant's cached KV could serve another)",
     "host-sync-in-jit":
         "host synchronization (.item() / device_get / print) inside a "
         "jitted function",

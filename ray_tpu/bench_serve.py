@@ -93,6 +93,9 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
              timeout_s: float = 120.0,
              deadline_s: Optional[float] = None,
              outputs: Optional[Dict[int, List[int]]] = None,
+             tenants: Optional[Sequence[str]] = None,
+             tenant_zipf: float = 1.1,
+             samples: Optional[List[Dict[str, Any]]] = None,
              seed: int = 0) -> Dict[str, Any]:
     """Replay the open-loop schedule against `router` and return the
     benchmark record (no JSON printing — callers compose it).
@@ -100,12 +103,21 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
     cause "deadline" — slow clients exercise exactly that edge).
     `outputs`, when given, collects each completed request's token list
     by request index — the chaos harness diffs it against a clean run's
-    to prove failed-over requests stayed bit-identical."""
+    to prove failed-over requests stayed bit-identical.
+    `tenants` (multi-tenant LoRA): each request carries a tenant tag
+    drawn Zipf(`tenant_zipf`) over the list — hot tenants dominate, the
+    tail pages through the adapter pool. `samples`, when given,
+    collects one per-request dict (index, tenant, arrival offset, ttft)
+    — the publish-no-stall analysis slices these."""
     from ray_tpu.serve.handle import RequestShedError
 
     rng = np.random.default_rng(seed)
     pop = 1.0 / np.arange(1, len(prompts) + 1) ** zipf_a
     picks = rng.choice(len(prompts), size=n_requests, p=pop / pop.sum())
+    if tenants:
+        tpop = 1.0 / np.arange(1, len(tenants) + 1) ** tenant_zipf
+        tpicks = rng.choice(len(tenants), size=n_requests,
+                            p=tpop / tpop.sum())
     slow = rng.random(n_requests) < slow_client_frac
     offsets = arrival_offsets(n_requests, rate_rps, arrival, burst_size)
 
@@ -120,6 +132,7 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
     def one(i: int) -> None:
         t0 = time.perf_counter()
         first: List[float] = []
+        tenant = tenants[int(tpicks[i])] if tenants else None
         try:
             toks = router.generate(
                 prompts[int(picks[i])], max_new_tokens,
@@ -127,7 +140,8 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
                 deadline_s=deadline_s,
                 on_first_token=lambda: first.append(
                     time.perf_counter() - t0),
-                token_sleep_s=token_sleep_s if slow[i] else 0.0)
+                token_sleep_s=token_sleep_s if slow[i] else 0.0,
+                tenant=tenant)
             wall = time.perf_counter() - t0
             with lock:
                 outcomes["ok"] += 1
@@ -137,6 +151,12 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
                     ttfts.append(first[0])
                 if outputs is not None:
                     outputs[i] = list(toks)
+                if samples is not None:
+                    samples.append({
+                        "i": i, "tenant": tenant,
+                        "prompt": int(picks[i]),
+                        "offset_s": offsets[i],
+                        "ttft_ms": first[0] * 1e3 if first else None})
         except RequestShedError as e:
             # a shed WITHOUT a cause is a regression the chaos verdict
             # must catch — never default it to a legitimate cause
@@ -186,6 +206,8 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
         "arrival": arrival,
         "rate_rps": rate_rps,
         "zipf_a": zipf_a,
+        **({"tenants": len(tenants), "tenant_zipf": tenant_zipf}
+           if tenants else {}),
         "max_new_tokens": max_new_tokens,
         "slow_client_frac": slow_client_frac,
         "completed": snap["ok"],
@@ -256,9 +278,20 @@ def _tier_factories(params, config, args, use_cluster: bool,
     retain = max(32, 2 * args.decode_replicas
                  * (args.max_batch + args.queue_depth))
     pf_seq, dec_seq = it.count(), it.count()
+    # multi-tenant LoRA tiers (--tenants): cluster replicas page
+    # adapters from the weight fabric (lora=True -> subscriber-backed
+    # source; the driver publishes the tenant set up front), inline
+    # replicas from a local source seeded with the same adapters
+    lora_kw: Dict[str, Any] = {}
+    tenant_adapters = getattr(args, "_tenant_adapters", None)
+    if tenant_adapters:
+        lora_kw = dict(
+            lora=True if use_cluster else dict(tenant_adapters),
+            lora_pool_slots=args.lora_pool_slots,
+            lora_rank_max=max(args.lora_rank, 1))
     kw = dict(kv_block_size=args.block_size,
               kv_pool_blocks=args.pool_blocks, retain=retain,
-              chaos=chaos_spec)
+              chaos=chaos_spec, **lora_kw)
     if use_cluster:
         import ray_tpu
 
@@ -273,7 +306,8 @@ def _tier_factories(params, config, args, use_cluster: bool,
             a = ray_tpu.remote(DecodeServer).options(
                 max_concurrency=args.max_batch + 4).remote(
                     params, config, max_batch=args.max_batch,
-                    chaos=chaos_spec, chaos_replica=next(dec_seq))
+                    chaos=chaos_spec, chaos_replica=next(dec_seq),
+                    **lora_kw)
             ray_tpu.get(a.stats.remote(), timeout=120.0)
             return a
 
@@ -291,7 +325,7 @@ def _tier_factories(params, config, args, use_cluster: bool,
             return DecodeServer(params, config,
                                 max_batch=args.max_batch,
                                 chaos=chaos_spec,
-                                chaos_replica=next(dec_seq))
+                                chaos_replica=next(dec_seq), **lora_kw)
 
         def kill(replica):
             stop = getattr(replica, "stop", None)
@@ -440,7 +474,7 @@ def _fault_run(params, config, args, prompts, load_kw,
     scaling tick — recovery here is pure failover + replacement, never
     a load decision."""
     from ray_tpu.serve.autoscale import DisaggAutoscaler, TierSpec
-    from ray_tpu.serve.disagg import DisaggRouter
+    from ray_tpu.serve.disagg import DisaggRouter, _call
 
     pf_n = args.prefill_replicas
     dec_n = max(2, args.decode_replicas)  # failover needs a survivor
@@ -465,6 +499,15 @@ def _fault_run(params, config, args, prompts, load_kw,
     outputs: Dict[int, List[int]] = {}
     try:
         _warm(router, prompts)
+        # measurement starts HERE: zero the chaos counters so a plan's
+        # `at=request:N` / `at=token:K` means the Nth MEASURED request
+        # (Kth measured token), not warm-up traffic (PR-12 known limit)
+        for tier in ("prefill", "decode"):
+            for r in router.tier_replicas(tier):
+                try:
+                    _call(r["target"], "reset_chaos_counts")  # shardlint: disable=unsupervised-actor-call
+                except Exception:  # noqa: BLE001 — pre-reset replica
+                    pass
         warm_rt = router.stats()
         router.reset_signal_windows()
         scaler.watch()
@@ -552,6 +595,200 @@ def _chaos_record(params, config, args, prompts, load_kw
     }
     return {"chaos_plan": plan, "clean": clean, "chaos": chaos,
             "recovery": recovery, "verdict": verdict}
+
+
+def _collect_lora_pools(router) -> Dict[str, int]:
+    """Sum the tier replicas' adapter-pool counters (local objects or
+    actors) — the record's paging-amortization evidence."""
+    from ray_tpu.serve.disagg import _call
+
+    out = {k: 0 for k in ("acquires", "hits", "misses", "evictions",
+                          "swaps", "page_in_bytes", "resident")}
+    for tier in ("prefill", "decode"):
+        for r in router.tier_replicas(tier):
+            s = _call(r["target"], "stats").get("lora") or {}  # shardlint: disable=unsupervised-actor-call
+            for k in out:
+                out[k] += int(s.get(k, 0))
+    return out
+
+
+def _lora_record(params, config, args, prompts, load_kw,
+                 use_cluster: bool) -> Dict[str, Any]:
+    """The multi-tenant LoRA acceptance run (``--tenants N``): tenants
+    drawn Zipf over N adapters against pools holding fewer, one
+    mid-run adapter publish for the hottest tenant, and the four
+    verdicts the ROADMAP item names — paging amortized (hit rate high,
+    page-in bytes « per-request adapter bytes), per-tenant isolation
+    of shed/SLO counters, mixed-batch outputs bit-identical to
+    sequential per-tenant runs, and untouched tenants' TTFT flat
+    across the publish."""
+    from ray_tpu.serve.disagg import _call
+    from ray_tpu.serve.lora import (adapter_nbytes, make_lora_adapter,
+                                    publish_adapter)
+
+    tenants = [f"t{i:03d}" for i in range(args.tenants)]
+    adapters = {t: make_lora_adapter(config, args.lora_rank,
+                                     seed=1000 + i)
+                for i, t in enumerate(tenants)}
+    warm_tenant = "warmup"  # compiles the lora programs off the clock
+    adapters[warm_tenant] = make_lora_adapter(config, args.lora_rank,
+                                              seed=9999)
+    args._tenant_adapters = adapters
+    if use_cluster:
+        # the fabric is the paging source: publish the tenant set up
+        # front, replicas fetch on demand (real page-in byte
+        # accounting through the subscriber)
+        for t, a in adapters.items():
+            publish_adapter(t, a)
+    router, prefill, decode, cleanup = _build_tiers(
+        params, config, args, use_cluster)
+    pub_tenant = tenants[0]  # Zipf rank 1: the hottest tenant
+    try:
+        for p in prompts:
+            router.generate(p, 2)
+            router.generate(p, 2, tenant=warm_tenant)
+        warm_rt = router.stats()
+        warm_pools = _collect_lora_pools(router)
+        router.reset_signal_windows()
+        samples: List[Dict[str, Any]] = []
+        outputs: Dict[int, List[int]] = {}
+        publish_at_s = 0.5 * load_kw["n_requests"] / load_kw["rate_rps"]
+        pub_state: Dict[str, Any] = {}
+
+        def publisher():
+            time.sleep(publish_at_s)
+            v2 = make_lora_adapter(config, args.lora_rank, seed=7777)
+            t0 = time.perf_counter()
+            try:
+                if use_cluster:
+                    pub_state["version"] = publish_adapter(pub_tenant,
+                                                           v2)
+                else:
+                    for tier in ("prefill", "decode"):
+                        for r in router.tier_replicas(tier):
+                            pub_state["version"] = _call(
+                                r["target"], "publish_adapter",  # shardlint: disable=unsupervised-actor-call
+                                pub_tenant, v2)
+                pub_state["publish_ms"] = (time.perf_counter() - t0) \
+                    * 1e3
+            except Exception as e:  # noqa: BLE001 — recorded
+                pub_state["error"] = f"{type(e).__name__}: {e}"
+
+        th = threading.Thread(target=publisher, daemon=True)
+        th.start()
+        rec = run_load(router, prompts, tenants=tenants,
+                       tenant_zipf=args.tenant_zipf, samples=samples,
+                       outputs=outputs, **load_kw)
+        th.join(timeout=30.0)
+        st = router.stats()
+        rec["router"] = {k: st[k] - warm_rt[k] for k in
+                         ("dispatched", "completed", "shed")}
+        rec["router"]["max_pending"] = st["max_pending"]
+        pools_end = _collect_lora_pools(router)
+        pools = {k: pools_end[k] - warm_pools.get(k, 0)
+                 for k in pools_end if k != "resident"}
+        pools["resident"] = pools_end["resident"]
+        acq = pools["acquires"]
+        hit_rate = pools["hits"] / acq if acq else 0.0
+        # paging-amortization denominator: the bytes a pool-less
+        # design would move — every tenant-tagged request ships its
+        # whole adapter to both tiers
+        naive = 2 * sum(adapter_nbytes(adapters[s["tenant"]])
+                        for s in samples if s.get("tenant"))
+        # per-tenant isolation: the router's counters, straight off
+        # the lora surface
+        tstats = router.tenant_stats()
+        tstats.pop(warm_tenant, None)
+        per_tenant = {t: {k: v[k] for k in ("dispatched", "completed",
+                                            "shed", "slo_misses")}
+                      for t, v in tstats.items()}
+        isolation_ok = all(
+            v["completed"] <= v["dispatched"]
+            for v in per_tenant.values()) and sum(
+            v["dispatched"] for v in per_tenant.values()) == \
+            rec["router"]["dispatched"]
+        # mixed-batch bit-identity: re-run a sample of completed
+        # requests SEQUENTIALLY (one at a time, same tenant + prompt)
+        # and diff — greedy decode must not care about batch
+        # composition. The hot-published tenant is excluded (its
+        # adapter changed mid-run by design). The prefix caches are
+        # flushed first so the re-runs prefill CACHE-COLD: the check
+        # then independently covers the prefill path instead of
+        # replaying whatever the mixed run cached.
+        for r in router.tier_replicas("prefill"):
+            try:
+                _call(r["target"], "invalidate_prefix_cache")  # shardlint: disable=unsupervised-actor-call
+            except Exception:  # noqa: BLE001 — older replica
+                pass
+        checked = mismatched = 0
+        for s in samples:
+            if checked >= 12:
+                break
+            if s["tenant"] == pub_tenant or s["i"] not in outputs:
+                continue
+            seq = router.generate(prompts[s["prompt"]],
+                                  load_kw["max_new_tokens"],
+                                  tenant=s["tenant"])
+            checked += 1
+            if list(seq) != outputs[s["i"]]:
+                mismatched += 1
+        # publish-no-stall: untouched tenants' TTFT before vs after
+        # the publish instant
+        untouched = [s for s in samples
+                     if s["tenant"] not in (pub_tenant, None)
+                     and s["ttft_ms"] is not None]
+        before = sorted(s["ttft_ms"] for s in untouched
+                        if s["offset_s"] < publish_at_s)
+        after = sorted(s["ttft_ms"] for s in untouched
+                       if s["offset_s"] >= publish_at_s)
+        p99 = (lambda xs: round(float(np.percentile(xs, 99)), 2)
+               if xs else None)
+        p99_before, p99_after = p99(before), p99(after)
+        ttft_flat = (p99_before is not None and p99_after is not None
+                     and p99_after <= max(2.5 * p99_before,
+                                          p99_before + 250.0))
+        rec["lora"] = {
+            "tenants": len(tenants),
+            "tenant_zipf": args.tenant_zipf,
+            "pool_slots": args.lora_pool_slots,
+            "rank": args.lora_rank,
+            "adapter_nbytes": adapter_nbytes(adapters[pub_tenant]),
+            "pools": pools,
+            "hit_rate": round(hit_rate, 4),
+            "page_in_bytes": pools["page_in_bytes"],
+            "naive_per_request_adapter_bytes": naive,
+            "paging_ratio": round(pools["page_in_bytes"] / naive, 4)
+            if naive else None,
+            "per_tenant": per_tenant,
+            "publish": {
+                "tenant": pub_tenant, "at_s": publish_at_s,
+                **pub_state,
+                "untouched_ttft_p99_before_ms": p99_before,
+                "untouched_ttft_p99_after_ms": p99_after,
+            },
+            "bit_identity": {"checked": checked,
+                             "mismatched": mismatched},
+        }
+        rec["lora"]["verdict"] = {
+            "paging_amortized": (hit_rate >= 0.5
+                                 and naive > 0
+                                 and pools["page_in_bytes"] < naive),
+            "tenant_isolation": isolation_ok,
+            "mixed_batch_bit_identical": (checked > 0
+                                          and mismatched == 0),
+            "publish_no_stall": ttft_flat and "error" not in pub_state,
+        }
+        rec["lora"]["verdict"]["pass"] = all(
+            rec["lora"]["verdict"].values())
+        for tier_reps in (prefill, decode):
+            for rep in tier_reps:
+                try:
+                    _call(rep, "publish_telemetry", True)  # shardlint: disable=unsupervised-actor-call
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
+    finally:
+        cleanup()
+    return rec
 
 
 def _clean_run(rec: Dict[str, Any]) -> bool:
@@ -651,8 +888,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--chaos-at", default="token:30",
                     help="kill point: 'token:K' (the replica's K-th "
                          "served token, mid-stream) or 'request:N' "
-                         "(its N-th request); counts include the "
-                         "warm-up phase's traffic (~16 tokens)")
+                         "(its N-th request); counters reset at "
+                         "measurement start, so N/K count MEASURED "
+                         "traffic only (warm-up excluded)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant LoRA acceptance run: N tenants "
+                         "drawn Zipf over N adapters against pools "
+                         "holding --lora-pool-slots (< N shows "
+                         "paging), one mid-run adapter publish for "
+                         "the hottest tenant; records hit rate, "
+                         "page-in amortization, per-tenant isolation, "
+                         "mixed-vs-sequential bit-identity, and the "
+                         "publish-no-stall TTFT check")
+    ap.add_argument("--tenant-zipf", type=float, default=1.1,
+                    help="Zipf exponent of the tenant draw")
+    ap.add_argument("--lora-pool-slots", type=int, default=8,
+                    help="adapter-pool rows per replica (deliberately "
+                         "< --tenants so cold tenants page)")
+    ap.add_argument("--lora-rank", type=int, default=4)
     ap.add_argument("--colocated-baseline", action="store_true",
                     help="also run the single-engine colocated path "
                          "for comparison")
@@ -757,6 +1010,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                 json.dump(record, f, indent=1)
         print(line)
         return 0 if record.get("verdict", {}).get("pass") else 1
+    if args.tenants:
+        record.update(metric="lora_serve_load", tenants=args.tenants,
+                      tenant_zipf=args.tenant_zipf,
+                      lora_pool_slots=args.lora_pool_slots,
+                      lora_rank=args.lora_rank)
+        try:
+            top = _lora_record(params, config, args, prompts, load_kw,
+                               use_cluster)
+            record["lora_run"] = top
+            record.update(value=top["tokens_per_sec"],
+                          unit="tokens/s",
+                          ttft_p50_ms=top["ttft_p50_ms"],
+                          ttft_p99_ms=top["ttft_p99_ms"],
+                          shed_rate=top["shed_rate"],
+                          lora_hit_rate=top["lora"]["hit_rate"])
+        finally:
+            if use_cluster:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+        line = json.dumps(record)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(record, f, indent=1)
+        print(line)
+        return 0 if record.get("lora_run", {}).get(
+            "lora", {}).get("verdict", {}).get("pass") else 1
     if args.compare_static or args.autoscale:
         from ray_tpu.serve.autoscale import default_target_p99_ms
 

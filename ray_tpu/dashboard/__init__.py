@@ -47,6 +47,11 @@ Routes:
                          resilience lane's failover/replace/
                          breaker_trip event slice (serve/disagg.py +
                          serve/autoscale.py self-healing)
+  /api/lora              multi-tenant LoRA serving: adapter-pool
+                         paging (hits/misses/evictions/swaps,
+                         residents), per-tenant request counters,
+                         recent page_in/evict/swap events
+                         (serve/lora.py)
   /api/oracle            step-time oracle: roofline predictions per
                          layout (device/ici/dcn breakdown),
                          predicted-vs-measured validations (residuals,
@@ -226,6 +231,18 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def lora(self) -> Dict[str, Any]:
+        """Multi-tenant LoRA aggregate + the recent page_in/evict/swap
+        event tail (one payload so the SPA's panel needs a single
+        fetch)."""
+        out = self.conductor.call("get_lora_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_lora_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def oracle(self) -> Dict[str, Any]:
         """Step-time-oracle aggregate + the recent event tail (one
         payload so the SPA's panel needs a single fetch)."""
@@ -354,6 +371,7 @@ class DashboardServer:
                            self._json_route(d.autoscale))
         app.router.add_get("/api/servefault",
                            self._json_route(d.servefault))
+        app.router.add_get("/api/lora", self._json_route(d.lora))
         app.router.add_get("/api/oracle", self._json_route(d.oracle))
         app.router.add_get(
             "/api/rpc",

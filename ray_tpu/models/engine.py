@@ -59,7 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import _model_fns
+from .generate import _model_fns, merge_lora_params
 from .kvcache import PagedKVCache, resolve_pool_config
 
 _DONE = object()
@@ -91,8 +91,34 @@ def _prefill_paged(params, suffix, config, prefix_k, prefix_v):
     return logits[:, -1], ck, cv
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _prefill_paged_lora(params, suffix, config, prefix_k, prefix_v,
+                        lora):
+    """`_prefill_paged` under ONE tenant's LoRA adapter: the low-rank
+    deltas are merged into the target leaves INSIDE the jit (prefill is
+    per-request single-tenant, so the merged weights never persist —
+    only the decode tick pays the scatter-gathered per-slot form). One
+    compile per distinct (cached, suffix, rank) shape triple."""
+    params = merge_lora_params(params, config, lora)
+    fwd = _model_fns(config)[0]
+    c = prefix_k.shape[1]
+    layers = prefix_k.shape[0]
+    base_k = jnp.zeros((layers, config.max_seq_len) + prefix_k.shape[2:],
+                       prefix_k.dtype)
+    base_v = jnp.zeros_like(base_k)
+    if c:
+        base_k = base_k.at[:, :c].set(prefix_k)
+        base_v = base_v.at[:, :c].set(prefix_v)
+    cache = [{"k": base_k[layer][None], "v": base_v[layer][None]}
+             for layer in range(layers)]
+    logits, cache = fwd(params, suffix, config, cache, c)
+    ck = jnp.stack([blk["k"][0] for blk in cache])
+    cv = jnp.stack([blk["v"][0] for blk in cache])
+    return logits[:, -1], ck, cv
+
+
 def _prefill_with_cache(params, config, kv_cache, prompt, empty_prefix,
-                        event_extra=None):
+                        event_extra=None, adapter=None, namespace=None):
     """The prefill-behind-the-prefix-cache sequence shared by the
     colocated engine's `_admit_one` and the disagg `PrefillServer`:
     lookup → gather → `_prefill_paged` on the suffix → commit +
@@ -100,12 +126,18 @@ def _prefill_with_cache(params, config, kv_cache, prompt, empty_prefix,
     implementation keeps the two paths bit-identical (the disagg
     equivalence tests depend on it). Returns `(ck, cv, block_table,
     first, score, outcome, reused, suffix_len)`; the caller owns the
-    returned pins (empty list when no cache)."""
+    returned pins (empty list when no cache).
+
+    `adapter`/`namespace` (multi-tenant LoRA, serve/lora.py): prefill
+    under one tenant's adapter slice, with the prefix cache keyed by
+    (namespace, prompt) so one tenant's KV can never match
+    another's."""
     plen = prompt.shape[1]
     prompt_np = prompt[0]
     outcome, reused = "miss", 0
     if kv_cache is not None:
-        match = kv_cache.lookup(prompt_np, max_tokens=plen - 1)
+        match = kv_cache.lookup(prompt_np, max_tokens=plen - 1,
+                                namespace=namespace)
         outcome, reused = match.outcome, match.tokens
         prefix_k, prefix_v = kv_cache.gather(match)
     else:
@@ -113,12 +145,17 @@ def _prefill_with_cache(params, config, kv_cache, prompt, empty_prefix,
         prefix_k = prefix_v = empty_prefix
     cached = int(prefix_k.shape[1])
     suffix = prompt[:, cached:]
-    last_logits, ck, cv = _prefill_paged(params, suffix, config,
-                                         prefix_k, prefix_v)
+    if adapter is not None:
+        last_logits, ck, cv = _prefill_paged_lora(
+            params, suffix, config, prefix_k, prefix_v, adapter)
+    else:
+        last_logits, ck, cv = _prefill_paged(params, suffix, config,
+                                             prefix_k, prefix_v)
     table: List[Any] = []
     if kv_cache is not None:
         kv_cache.note_prefilled(suffix.shape[1])
-        table = kv_cache.commit(prompt_np, ck, cv, match)
+        table = kv_cache.commit(prompt_np, ck, cv, match,
+                                namespace=namespace)
         if match.tokens:
             event = {"kind": "prefix_hit", "outcome": outcome,
                      "reused_tokens": reused, "prompt_tokens": plen}
@@ -163,6 +200,25 @@ def _tick(params, config, cache, tokens, pos_vec):
     return cache, nxt, lp
 
 
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(2,))
+def _tick_lora(params, config, cache, tokens, pos_vec, lora):
+    """The mixed-tenant decode tick: one jitted ragged-batch step with
+    PER-SLOT adapter indices (`lora["idx"]`) gathering each slot's
+    low-rank deltas out of the resident adapter-pool stacks —
+    ``base @ x + scatter-gathered (B·A) @ x`` at the LoRA-target leaves
+    (serve/lora.py). Slots on the null adapter (index 0: zero A/B,
+    scale 0) compute a bit-identical base-only step, so mixed batches
+    never perturb base traffic. Chosen over `_tick` only when a live
+    slot actually holds an adapter; pool shapes are static, so this is
+    ONE extra compiled program per engine."""
+    logits, cache = _model_fns(config)[2](params, tokens, config, cache,
+                                          pos_vec, lora)
+    live = logits[:, :config.vocab_size].astype(jnp.float32)
+    nxt = jnp.argmax(live, axis=-1).astype(jnp.int32)
+    lp = jnp.max(live, axis=-1) - jax.nn.logsumexp(live, axis=-1)
+    return cache, nxt, lp
+
+
 class _Adoption:
     """A pending slot adoption: a prompt's prefilled KV rows computed
     elsewhere (a prefill replica) plus the first token its last-position
@@ -196,6 +252,15 @@ class _Request:
         # per-token logprob of each emitted token (same order as the
         # token stream) — the rollout score channel
         self.scores: List[float] = []
+        # multi-tenant LoRA (serve/lora.py): the tenant tag and its
+        # pinned adapter-pool slot (0 = the null/base adapter)
+        self.adapter_id: Optional[str] = None
+        self.lora_slot = 0
+        # cancel_slot() lifecycle: cancelled requests free their slot
+        # (and pins) at the next tick boundary instead of decoding to
+        # completion; finished guards double-release
+        self.cancelled = False
+        self.finished = False
 
 
 class TokenStream:
@@ -242,7 +307,8 @@ class ContinuousBatchingEngine:
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
                  max_prefills_per_tick: Optional[int] = None,
-                 max_adoptions_per_tick: Optional[int] = None):
+                 max_adoptions_per_tick: Optional[int] = None,
+                 lora_pool: Optional[Any] = None):
         # config: any family _model_fns knows (LlamaConfig, GPT2Config)
         self.params = params
         self.config = config
@@ -290,6 +356,7 @@ class ContinuousBatchingEngine:
         self.admitted = 0            # total slots admitted (both phases)
         self.prefill_admitted = 0
         self.adopted = 0
+        self.cancelled = 0           # slots freed early by cancel_slot()
         self.max_prefills_admitted_per_tick = 0
         self.max_adoptions_admitted_per_tick = 0
         self._last_stats_push = 0.0
@@ -297,8 +364,25 @@ class ContinuousBatchingEngine:
         self._pos = np.zeros(max_batch, np.int32)
         self._slot_req: List[Optional[_Request]] = [None] * max_batch
         self._free = list(range(max_batch))
+        # multi-tenant LoRA (serve/lora.py AdapterPool, duck-typed so
+        # models/ never imports serve/): per-slot adapter-pool indices
+        # (0 = null/base adapter). Adapter acquisition — including a
+        # cold page-in — happens on the SUBMITTING thread, never here,
+        # so paging one tenant's adapter can't stall another's ticks.
+        self.lora_pool = lora_pool
+        self._slot_adapter = np.zeros(max_batch, np.int32)
+        if lora_pool is not None and self.kv_cache is not None:
+            # prefix-cache namespaces are (tenant, adapter-version)
+            # stamped, so a hot-swap can never serve old-version KV —
+            # this listener only EAGERLY reclaims the superseded
+            # version's blocks (they would otherwise LRU out)
+            lora_pool.add_swap_listener(
+                lambda tenant, old, _p=lora_pool:
+                self.kv_cache.invalidate(
+                    namespace=_p.cache_namespace(tenant, old)))
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._pending_adopt: "queue.Queue[_Adoption]" = queue.Queue()
+        self._cancels = 0  # cancelled-but-unfreed request count
         self._lock = threading.Lock()
         self._next_rid = 0
         self._stopped = threading.Event()
@@ -308,31 +392,49 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------------- API
     def submit(self, prompt_tokens, max_new_tokens: int,
-               eos_token: Optional[int] = None) -> "_Request":
+               eos_token: Optional[int] = None,
+               adapter_id: Optional[str] = None) -> "_Request":
+        """`adapter_id` (multi-tenant LoRA): decode this request under
+        that tenant's adapter. The pool pin — and a cold adapter's
+        page-in — happens HERE on the caller's thread, so paging never
+        blocks the decode loop; the pin is released when the slot
+        frees (finish or cancel)."""
         prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
         if prompt.shape[1] + max_new_tokens > self.config.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        lora_slot = 0
+        if adapter_id is not None:
+            if self.lora_pool is None:
+                raise ValueError(
+                    f"request for adapter {adapter_id!r} but this "
+                    f"engine has no lora_pool (serve/lora.AdapterPool)")
+            lora_slot = self.lora_pool.acquire(adapter_id)
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
         req = _Request(rid, prompt, max_new_tokens, eos_token)
+        req.adapter_id = adapter_id
+        req.lora_slot = lora_slot
         self._pending.put(req)
         return req
 
     def stream(self, prompt_tokens, max_new_tokens: int,
                eos_token: Optional[int] = None,
-               timeout_s: float = 120.0) -> Iterator[int]:
+               timeout_s: float = 120.0,
+               adapter_id: Optional[str] = None) -> Iterator[int]:
         """Submit and yield tokens as the shared loop produces them.
         Returns a TokenStream whose ``cache_outcome`` labels the
         admission's prefix-cache result."""
-        req = self.submit(prompt_tokens, max_new_tokens, eos_token)
+        req = self.submit(prompt_tokens, max_new_tokens, eos_token,
+                          adapter_id=adapter_id)
         return TokenStream(req, timeout_s)
 
     def generate(self, prompt_tokens, max_new_tokens: int,
                  eos_token: Optional[int] = None,
-                 timeout_s: float = 120.0) -> List[int]:
+                 timeout_s: float = 120.0,
+                 adapter_id: Optional[str] = None) -> List[int]:
         return list(self.stream(prompt_tokens, max_new_tokens, eos_token,
-                                timeout_s))
+                                timeout_s, adapter_id=adapter_id))
 
     def adopt_prefill(self, prompt_len: int, first_token: int, ck, cv,
                       max_new_tokens: int,
@@ -340,6 +442,7 @@ class ContinuousBatchingEngine:
                       score: float = 0.0,
                       cache_outcome: Optional[str] = None,
                       reused_tokens: int = 0,
+                      adapter_id: Optional[str] = None,
                       timeout_s: float = 120.0) -> TokenStream:
         """Adopt a prompt whose prefill ran ELSEWHERE (a disaggregated
         prefill replica): ``ck/cv [L, prompt_len, H, hd]`` are the
@@ -379,6 +482,16 @@ class ContinuousBatchingEngine:
                 f"prefill and decode tiers must run the same model "
                 f"config")
         ck, cv = got_k, got_v
+        lora_slot = 0
+        if adapter_id is not None:
+            if self.lora_pool is None:
+                raise ValueError(
+                    f"adoption for adapter {adapter_id!r} but this "
+                    f"engine has no lora_pool (the prefill and decode "
+                    f"tiers must both be LoRA-enabled)")
+            # caller's thread, like submit(): a cold page-in here never
+            # stalls the decode loop
+            lora_slot = self.lora_pool.acquire(adapter_id)
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -386,6 +499,8 @@ class ContinuousBatchingEngine:
                        max_new_tokens, eos_token)
         req.cache_outcome = cache_outcome
         req.reused_tokens = int(reused_tokens)
+        req.adapter_id = adapter_id
+        req.lora_slot = lora_slot
         self._pending_adopt.put(_Adoption(req, plen, ck, cv,
                                           first_token, score))
         return TokenStream(req, timeout_s)
@@ -429,6 +544,35 @@ class ContinuousBatchingEngine:
             self.publish_kv_telemetry(force=True)
         for ev in events:
             ev.set()
+
+    def cancel_slot(self, stream_or_req: Any) -> bool:
+        """Cancel a live request (its TokenStream or the _Request
+        itself): the decode loop frees its slot — and releases its KV
+        pins and LoRA adapter pin — at the NEXT TICK BOUNDARY instead
+        of decoding the abandoned request to completion (the PR-12
+        deadline path used to waste every remaining tick on it). The
+        freed slot is immediately re-admittable. Returns False when the
+        request already finished (or was already cancelled); the
+        stream's consumer sees a normal end-of-stream."""
+        req = getattr(stream_or_req, "_req", stream_or_req)
+        with self._lock:
+            if req.finished or req.cancelled:
+                return False
+            req.cancelled = True
+            self._cancels += 1
+        return True
+
+    def _apply_cancels(self) -> None:
+        """Decode-loop only, between ticks: free cancelled ACTIVE slots
+        (queued cancelled requests are dropped at admission instead)."""
+        with self._lock:
+            if self._cancels == 0:
+                return
+        for req in list(self._slot_req):
+            if req is not None and req.cancelled and not req.finished:
+                self.cancelled += 1
+                self._finish(req)
+        self.publish_kv_telemetry()
 
     def stop(self) -> None:
         self._stopped.set()
@@ -474,6 +618,8 @@ class ContinuousBatchingEngine:
             prefill_calls=self.prefill_calls,
             prefill_programs=programs,
             spliced_tokens=self.spliced_tokens,
+            cancelled=self.cancelled,
+            lora=self.lora_pool is not None,
         )
         if self.kv_cache is None:
             # uncached engines still account their prefill work
@@ -517,16 +663,16 @@ class ContinuousBatchingEngine:
                 adoption = self._pending_adopt.get_nowait()
             except queue.Empty:
                 break
-            self._adopt_one(adoption)
-            adopted += 1
+            if self._adopt_one(adoption):
+                adopted += 1
         admitted = 0
         while self._free and admitted < self.max_prefills_per_tick:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            self._admit_one(req)
-            admitted += 1
+            if self._admit_one(req):
+                admitted += 1
         if adopted:
             self.max_adoptions_admitted_per_tick = max(
                 self.max_adoptions_admitted_per_tick, adopted)
@@ -536,10 +682,15 @@ class ContinuousBatchingEngine:
         if adopted or admitted:
             self.publish_kv_telemetry()
 
-    def _adopt_one(self, adoption: _Adoption) -> None:
+    def _adopt_one(self, adoption: _Adoption) -> bool:
+        req = adoption.req
+        if req.cancelled:
+            # cancelled before admission: never occupies a slot
+            self.cancelled += 1
+            self._finish(req)
+            return False
         with self._lock:
             slot = self._free.pop()
-        req = adoption.req
         plen = adoption.plen
         self._cache = _splice_slot(self._cache, adoption.ck, adoption.cv,
                                    np.int32(slot), self.config, plen)
@@ -548,18 +699,38 @@ class ContinuousBatchingEngine:
         self.adopted += 1
         req.slot = slot
         self._slot_req[slot] = req
+        self._slot_adapter[slot] = req.lora_slot
         self._tokens[slot] = adoption.first_token
         self._pos[slot] = plen
         self._emit(req, adoption.first_token, adoption.score)
+        return True
 
-    def _admit_one(self, req: _Request) -> None:
+    def _admit_one(self, req: _Request) -> bool:
+        if req.cancelled:
+            # cancelled before admission: never occupies a slot
+            self.cancelled += 1
+            self._finish(req)
+            return False
         with self._lock:
             slot = self._free.pop()
         plen = req.prompt.shape[1]
+        adapter = None
+        namespace = None
+        if self.lora_pool is not None and req.adapter_id is not None:
+            # slice + version read atomically: the (tenant, version)-
+            # stamped namespace must describe exactly the adapter this
+            # prefill computes under, even if the row hot-swaps
+            # mid-compute
+            adapter, aver = self.lora_pool.adapter_slice(
+                req.lora_slot, with_version=True)
+            namespace = self.lora_pool.cache_namespace(req.adapter_id,
+                                                       aver)
         ck, cv, table, first, score, outcome, reused, suffix_len = \
             _prefill_with_cache(self.params, self.config, self.kv_cache,
                                 req.prompt, self._empty_prefix,
-                                event_extra={"rid": req.rid})
+                                event_extra={"rid": req.rid},
+                                adapter=adapter,
+                                namespace=namespace)
         if self.kv_cache is not None:
             req.cache_outcome = outcome
             req.reused_tokens = reused
@@ -573,9 +744,33 @@ class ContinuousBatchingEngine:
         self.prefill_admitted += 1
         req.slot = slot
         self._slot_req[slot] = req
+        self._slot_adapter[slot] = req.lora_slot
         self._tokens[slot] = first
         self._pos[slot] = plen
         self._emit(req, first, score)
+        return True
+
+    def _finish(self, req: _Request) -> None:
+        """Decode-loop only: end a request's stream and free its slot,
+        KV pins, and LoRA adapter pin (normal completion, admission-
+        time cancel drop, and the tick-boundary cancel all share this
+        one path so nothing is ever released twice)."""
+        req.out.put(_DONE)
+        slot = req.slot
+        if slot is not None:
+            self._slot_req[slot] = None
+            self._slot_adapter[slot] = 0
+        if self.kv_cache is not None and req.block_table:
+            self.kv_cache.release(req.block_table)
+            req.block_table = []
+        if self.lora_pool is not None and req.adapter_id is not None:
+            self.lora_pool.release(req.adapter_id)
+        with self._lock:
+            req.finished = True
+            if slot is not None:
+                self._free.append(slot)
+            if req.cancelled:
+                self._cancels -= 1
 
     def _emit(self, req: _Request, tok: int, score: float = 0.0) -> None:
         req.scores.append(score)
@@ -583,25 +778,25 @@ class ContinuousBatchingEngine:
         req.produced += 1
         if (req.eos_token is not None and tok == req.eos_token) \
                 or req.produced >= req.max_new:
-            req.out.put(_DONE)
-            slot = req.slot
-            self._slot_req[slot] = None
-            if self.kv_cache is not None and req.block_table:
-                self.kv_cache.release(req.block_table)
-                req.block_table = []
-            with self._lock:
-                self._free.append(slot)
+            self._finish(req)
 
     def _loop(self) -> None:
         while not self._stopped.is_set():
             self._apply_pending_swap()
+            self._apply_cancels()
             self._admit()
             if all(r is None for r in self._slot_req):
                 self._stopped.wait(self.idle_sleep_s)
                 continue
-            cache, nxt, lp = _tick(self.params, self.config, self._cache,
-                                   jnp.asarray(self._tokens),
-                                   jnp.asarray(self._pos))
+            if self.lora_pool is not None and self._slot_adapter.any():
+                cache, nxt, lp = _tick_lora(
+                    self.params, self.config, self._cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                    self.lora_pool.tick_args(self._slot_adapter))
+            else:
+                cache, nxt, lp = _tick(
+                    self.params, self.config, self._cache,
+                    jnp.asarray(self._tokens), jnp.asarray(self._pos))
             self._cache = cache
             nxt_np = np.asarray(nxt)
             lp_np = np.asarray(lp)

@@ -37,6 +37,50 @@ def _model_fns(config):
     raise TypeError(f"no generation support for {type(config).__name__}")
 
 
+def lora_targets(config):
+    """The LoRA-target leaves of a model family as
+    ``((leaf_name, in_dim, out_dim), ...)`` — each names an entry of
+    every block's ``["attn"]`` sub-tree. This table is the ONE place
+    the serving stack (serve/lora.py AdapterPool, the engine's
+    mixed-tenant decode, the per-tenant online trainer) learns which
+    projections an adapter applies to, so the pool layout, the decode
+    gather, and the prefill merge can never disagree."""
+    if isinstance(config, LlamaConfig):
+        kv_dim = config.num_kv_heads * config.head_dim
+        return (("wq", config.d_model, config.d_model),
+                ("wv", config.d_model, kv_dim))
+    from .gpt2 import GPT2Config
+
+    if isinstance(config, GPT2Config):
+        return (("qkv", config.d_model, 3 * config.d_model),)
+    raise TypeError(f"no LoRA support for {type(config).__name__}")
+
+
+def merge_lora_params(params, config, lora):
+    """Base params with ONE adapter's low-rank deltas folded into the
+    target leaves: ``W + scale * (A_l @ B_l)`` per block. `lora` is the
+    single-adapter slice ``{"scale": f32 scalar, "targets": {name:
+    {"a": [L, in, r], "b": [L, r, out]}}}`` (serve/lora.py
+    ``adapter_slice``). Called INSIDE the jitted prefill, so the merged
+    leaves never persist — prefill is per-request single-tenant, only
+    the decode tick needs the scatter-gathered per-slot form."""
+    lora_targets(config)  # validates the family
+    blocks = []
+    for li, p in enumerate(params["blocks"]):
+        attn = dict(p["attn"])
+        for name, ab in lora["targets"].items():
+            w = attn[name]
+            delta = jnp.dot(ab["a"][li], ab["b"][li],
+                            preferred_element_type=jnp.float32)
+            attn[name] = w + (delta * lora["scale"]).astype(w.dtype)
+        p2 = dict(p)
+        p2["attn"] = attn
+        blocks.append(p2)
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
 def _sample_fn(vocab_size: int, temperature: float, top_k: int):
     def sample(key: jax.Array, logits: jax.Array) -> jax.Array:
         # padded vocab rows must never be sampled

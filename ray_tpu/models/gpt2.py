@@ -225,14 +225,24 @@ def _block_cached(x: jax.Array, p: Params, config: GPT2Config,
 
 
 def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
-                  cache: Params, pos_vec: jax.Array):
+                  cache: Params, pos_vec: jax.Array,
+                  lora: Optional[Dict[str, Any]] = None):
     """Single-token decode with PER-SLOT positions (continuous
-    batching) — the GPT-2 analog of llama_block_decode."""
+    batching) — the GPT-2 analog of llama_block_decode.
+
+    `lora` (optional, serve/lora.py mixed-tenant decode): this layer's
+    per-slot adapter selection for the fused qkv projection —
+    ``{"qkv": (a [B,D,r], b [B,r,3D]), "scale": [B]}`` — added to the
+    base matmul; null-adapter slots add an exact-zero delta."""
     c = config
     b = x.shape[0]
     h = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
     qkv = jnp.dot(h, p["attn"]["qkv"],
                   preferred_element_type=jnp.float32).astype(c.dtype)
+    if lora is not None:
+        from ..ops.layers import lora_delta
+
+        qkv = qkv + lora_delta(h, *lora["qkv"], lora["scale"])
     qkv = qkv + p["attn"]["qkv_b"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, 1, c.num_heads, c.head_dim)
@@ -254,14 +264,24 @@ def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
 
 
 def gpt2_decode(params: Params, tokens: jax.Array, config: GPT2Config,
-                cache: list, pos_vec: jax.Array):
+                cache: list, pos_vec: jax.Array,
+                lora: Optional[Dict[str, Any]] = None):
     """One decode step for a ragged batch: tokens [B] at per-slot
-    positions pos_vec [B]."""
+    positions pos_vec [B]. `lora` (optional): adapter-pool stacks +
+    per-slot indices ``{"idx": [B], "scale": [P], "qkv": (a [P,L,D,r],
+    b [P,L,r,3D])}`` — see llama_decode for the contract."""
     c = config
     x = params["wte"][tokens[:, None]] + params["wpe"][pos_vec][:, None]
+    sel = None
+    if lora is not None:
+        idx = lora["idx"]
+        sel = (lora["qkv"][0][idx], lora["qkv"][1][idx])
+        scale = lora["scale"][idx]
     new_cache = []
-    for p, blk in zip(params["blocks"], cache):
-        x, nc = _block_decode(x, p, c, blk, pos_vec)
+    for li, (p, blk) in enumerate(zip(params["blocks"], cache)):
+        lora_l = None if sel is None else {
+            "qkv": (sel[0][:, li], sel[1][:, li]), "scale": scale}
+        x, nc = _block_decode(x, p, c, blk, pos_vec, lora_l)
         new_cache.append(nc)
     x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     return jnp.dot(x[:, 0], params["wte"].T,

@@ -83,6 +83,20 @@ def _chain(digest: bytes, tokens: Tuple[int, ...]) -> bytes:
     return h.digest()
 
 
+def _ns_root(namespace: Optional[str]) -> bytes:
+    """The hash-chain root for a cache namespace. A multi-tenant LoRA
+    deployment keys prefixes by (tenant, prompt) — a tenant's KV is
+    computed under ITS adapter, so another tenant matching it would be
+    served wrong values silently. Deriving a per-namespace root makes
+    every digest downstream tenant-scoped; namespace=None keeps the
+    historical root, so single-tenant deployments are bit-identical."""
+    if namespace is None:
+        return _ROOT_DIGEST
+    h = hashlib.blake2b(_ROOT_DIGEST, digest_size=16)
+    h.update(str(namespace).encode())
+    return h.digest()
+
+
 # --------------------------------------------------------- device ops
 # All pool mutation is jitted with the pool donated, so XLA updates the
 # arrays in place: a block write touches O(block) bytes, never O(pool).
@@ -201,7 +215,7 @@ class PrefixMatch:
 
 class _Block:
     __slots__ = ("bid", "tokens", "filled", "ref", "last_used",
-                 "children", "index_key", "parent_bid")
+                 "children", "index_key", "parent_bid", "ns")
 
     def __init__(self, bid: int):
         self.bid = bid
@@ -215,6 +229,10 @@ class _Block:
         # last release)
         self.index_key: Optional[tuple] = None
         self.parent_bid: Optional[int] = None
+        # cache namespace (LoRA tenant) the block was committed under —
+        # invalidate(namespace=) scopes an adapter hot-swap's flush to
+        # exactly this tenant's blocks
+        self.ns: Optional[str] = None
 
 
 class PagedKVCache:
@@ -257,16 +275,20 @@ class PagedKVCache:
 
     # ------------------------------------------------------------ lookup
 
-    def lookup(self, tokens: np.ndarray, max_tokens: int) -> PrefixMatch:
+    def lookup(self, tokens: np.ndarray, max_tokens: int,
+               namespace: Optional[str] = None) -> PrefixMatch:
         """Longest cached block-aligned (+ partial tail) prefix of
         `tokens`, capped at `max_tokens` so the caller always has a
         suffix left to prefill (the last prompt position's logits feed
         the first sampled token). Matched blocks are PINNED — pair every
-        lookup with a release() of the returned/committed table."""
+        lookup with a release() of the returned/committed table.
+        `namespace` scopes the match (LoRA tenant: KV computed under
+        one tenant's adapter can never serve another — pass the SAME
+        namespace to the paired commit())."""
         tokens = np.asarray(tokens).reshape(-1)
         bs = self.block_size
         with self._lock:
-            digest = _ROOT_DIGEST
+            digest = _ns_root(namespace)
             bids: List[int] = []
             matched = 0
             while matched + bs <= max_tokens:
@@ -339,19 +361,21 @@ class PagedKVCache:
         kvcache_metrics()["prefilled_tokens"].inc(int(n_tokens))
 
     def commit(self, tokens: np.ndarray, ck, cv,
-               match: PrefixMatch) -> List[int]:
+               match: PrefixMatch,
+               namespace: Optional[str] = None) -> List[int]:
         """Insert the prompt's uncached blocks from its freshly filled
         single-sequence cache ``ck/cv [L, S, H, hd]`` and return the
         request's pinned block table (matched + inserted). Stops quietly
         when the pool is exhausted — caching is best-effort, the slot's
-        own slab copy is already correct."""
+        own slab copy is already correct. `namespace` must match the
+        paired lookup()'s."""
         tokens = np.asarray(tokens).reshape(-1)
         bs = self.block_size
         plen = len(tokens)
         n_full, tail = divmod(plen, bs)
         with self._lock:
             table = list(match.bids)
-            digest = _ROOT_DIGEST
+            digest = _ns_root(namespace)
             now = next(self._tick)
             parent: Optional[int] = None
             exhausted = False
@@ -392,19 +416,20 @@ class PagedKVCache:
                         self._pool_k, self._pool_v, np.int32(bid), bk,
                         bv)
                 self._insert_locked(bid, ("full", nxt), blk, bs, parent,
-                                    now)
+                                    now, namespace)
                 table.append(bid)
                 parent, digest = bid, nxt
             if tail and not exhausted:
                 self._commit_tail_locked(tokens, ck, cv, match, digest,
                                          parent, n_full, tail, table,
-                                         now)
+                                         now, namespace)
             util = 1.0 - len(self._free) / self.num_blocks
         kvcache_metrics()["utilization"].set(util)
         return table
 
     def _commit_tail_locked(self, tokens, ck, cv, match, digest, parent,
-                            n_full, tail, table, now) -> None:
+                            n_full, tail, table, now,
+                            namespace: Optional[str] = None) -> None:
         bs = self.block_size
         if (n_full + 1) * bs > ck.shape[1]:
             # the tail block's nominal extent crosses the cache window
@@ -445,12 +470,13 @@ class PagedKVCache:
             self._pool_k, self._pool_v = _write_block(
                 self._pool_k, self._pool_v, np.int32(bid), bk, bv)
         self._insert_locked(bid, ("partial", digest, tail_toks),
-                            tail_toks, tail, parent, now)
+                            tail_toks, tail, parent, now, namespace)
         table.append(bid)
 
     def _insert_locked(self, bid: int, index_key: tuple,
                        blk_tokens: Tuple[int, ...], filled: int,
-                       parent: Optional[int], now: int) -> None:
+                       parent: Optional[int], now: int,
+                       ns: Optional[str] = None) -> None:
         b = _Block(bid)
         b.tokens = blk_tokens
         b.filled = filled
@@ -458,6 +484,7 @@ class PagedKVCache:
         b.last_used = now
         b.index_key = index_key
         b.parent_bid = parent
+        b.ns = ns
         self._blocks[bid] = b
         if index_key[0] == "full":
             self._full_index[index_key[1]] = bid
@@ -530,21 +557,38 @@ class PagedKVCache:
             util = 1.0 - len(self._free) / self.num_blocks
         kvcache_metrics()["utilization"].set(util)
 
-    def invalidate(self) -> None:
+    def invalidate(self, namespace: Optional[str] = ...) -> None:
         """Weight swap: every cached block's KV was computed under the
         OLD params — drop the whole index so no future lookup matches
         it. In-flight slots keep their pinned (now orphaned) blocks for
-        refcount accounting only; they decode off their own slab."""
+        refcount accounting only; they decode off their own slab.
+
+        ``invalidate(namespace=tenant)`` scopes the flush to ONE cache
+        namespace (a LoRA adapter hot-swap stales exactly that tenant's
+        KV — every other tenant's blocks, and the base namespace, stay
+        cached). A namespaced chain hangs off its own root digest, so
+        the dropped blocks' parents are always in the same namespace
+        and no surviving chain loses a reachable interior."""
+        scoped = namespace is not ...
         with self._lock:
             for b in list(self._blocks.values()):
+                if scoped and b.ns != namespace:
+                    continue
                 self._drop_index_locked(b)
                 if b.ref == 0:
+                    if scoped and b.parent_bid is not None \
+                            and b.parent_bid in self._blocks:
+                        self._blocks[b.parent_bid].children -= 1
                     del self._blocks[b.bid]
                     self._free.append(b.bid)
-            for b in self._blocks.values():
-                b.children = 0
+            if not scoped:
+                for b in self._blocks.values():
+                    b.children = 0
             self._stats["invalidations"] += 1
-            self._event_locked({"kind": "invalidate"})
+            ev: Dict[str, Any] = {"kind": "invalidate"}
+            if scoped:
+                ev["namespace"] = namespace
+            self._event_locked(ev)
             util = 1.0 - len(self._free) / self.num_blocks
         kvcache_metrics()["utilization"].set(util)
 

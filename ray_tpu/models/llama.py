@@ -13,7 +13,7 @@ before attention, which XLA lowers to a broadcast (no HBM copy)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -198,15 +198,36 @@ def llama_forward(params: Params, tokens: jax.Array,
 
 def llama_block_decode(x: jax.Array, p: Params, cos: jax.Array,
                        sin: jax.Array, config: LlamaConfig,
-                       cache: Params, pos_vec: jax.Array):
+                       cache: Params, pos_vec: jax.Array,
+                       lora: Optional[Dict[str, Any]] = None):
     """Single-token decode with PER-SLOT positions (continuous batching:
     every batch slot is a different sequence at its own depth).
     x [B, 1, D]; pos_vec [B] int32. Writes each slot's new K/V at its
-    own position (scatter) and masks attention per slot."""
+    own position (scatter) and masks attention per slot.
+
+    `lora` (optional, serve/lora.py mixed-tenant decode): this layer's
+    per-slot adapter selections — ``{"wq": (a [B,D,r], b [B,r,D]),
+    "wv": (a, b), "scale": [B]}`` — added to the base projections as
+    ``base @ x + scatter-gathered (B·A) @ x``. Slots on the null
+    adapter (all-zero A/B, scale 0) add an exact-zero delta, keeping
+    the base-only math bit-identical to the lora=None path."""
     c = config
     b = x.shape[0]
     h = rms_norm(x, p["attn_norm"]["scale"])
-    q, k, v = _qkv(h, p, c)
+    if lora is None:
+        q, k, v = _qkv(h, p, c)
+    else:
+        from ..ops.layers import lora_delta
+
+        t = h.shape[1]
+        q = _mm(h, p["attn"]["wq"]) + lora_delta(
+            h, *lora["wq"], lora["scale"])
+        k = _mm(h, p["attn"]["wk"])
+        v = _mm(h, p["attn"]["wv"]) + lora_delta(
+            h, *lora["wv"], lora["scale"])
+        q = q.reshape(b, t, c.num_heads, c.head_dim)
+        k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
+        v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
     positions = pos_vec[:, None]                       # [B, 1]
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
@@ -228,16 +249,34 @@ def llama_block_decode(x: jax.Array, p: Params, cos: jax.Array,
 
 
 def llama_decode(params: Params, tokens: jax.Array, config: LlamaConfig,
-                 cache: list, pos_vec: jax.Array):
+                 cache: list, pos_vec: jax.Array,
+                 lora: Optional[Dict[str, Any]] = None):
     """One decode step for a ragged batch: tokens [B] at per-slot
     positions pos_vec [B]. Returns (logits [B, padded_vocab] fp32,
-    new_cache)."""
+    new_cache).
+
+    `lora` (optional): the adapter-pool stacks + per-slot indices —
+    ``{"idx": [B] int32, "scale": [P] f32, "wq": (a [P,L,D,r],
+    b [P,L,r,D]), "wv": (...)}`` (serve/lora.py layout). Each slot's
+    adapter is gathered out of the pool once, then every layer adds its
+    per-slot low-rank delta to the wq/wv projections."""
     c = config
     cos, sin = rope_table(c.head_dim, c.max_seq_len, c.rope_theta)
     x = params["tok_emb"][tokens[:, None]]
+    sel = None
+    if lora is not None:
+        idx = lora["idx"]
+        sel = {t: (lora[t][0][idx], lora[t][1][idx])
+               for t in ("wq", "wv")}
+        scale = lora["scale"][idx]
     new_cache = []
-    for p, blk_cache in zip(params["blocks"], cache):
-        x, nc = llama_block_decode(x, p, cos, sin, c, blk_cache, pos_vec)
+    for li, (p, blk_cache) in enumerate(zip(params["blocks"], cache)):
+        lora_l = None if sel is None else {
+            "wq": (sel["wq"][0][:, li], sel["wq"][1][:, li]),
+            "wv": (sel["wv"][0][:, li], sel["wv"][1][:, li]),
+            "scale": scale}
+        x, nc = llama_block_decode(x, p, cos, sin, c, blk_cache,
+                                   pos_vec, lora_l)
         new_cache.append(nc)
     x = rms_norm(x, params["norm_f"]["scale"])
     return jnp.dot(x[:, 0], params["lm_head"],

@@ -49,6 +49,11 @@ merges and labels them:
                  KV transfers with their shm/rpc byte split, and
                  router sheds, so cross-replica KV traffic lines up
                  against request latency and the kvcache lane.
+- lora:          pid = "lora",            tid = event kind — instant
+                 markers of multi-tenant LoRA serving (serve/lora.py):
+                 adapter page_in / evict / swap per tenant, so adapter
+                 paging lines up against the disagg lane's requests
+                 and the weights lane's publishes.
 - autoscale:     pid = "autoscale",       tid = event kind — instant
                  markers of the serving autoscaler (serve/autoscale.py):
                  scale_up / drain / scale_down per tier, so replica-set
@@ -257,6 +262,34 @@ def disagg_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def lora_trace_events(events: List[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """Instant markers for multi-tenant LoRA events (page_in, evict,
+    swap) — mirrors the kvcache track under pid "lora", so adapter
+    paging lines up against the disagg lane's request markers and the
+    weights lane's publish markers."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        if ev.get("tenant"):
+            label += f":{ev['tenant']}"
+        if ev.get("version") is not None:
+            label += f"@v{ev['version']}"
+        if ev.get("bytes") is not None:
+            label += f" {ev['bytes']}B"
+        out.append({
+            "name": label, "cat": "lora", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "lora", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def autoscale_trace_events(events: List[Dict[str, Any]]
                            ) -> List[Dict[str, Any]]:
     """Instant markers for serving-autoscaler events (scale_up, drain,
@@ -362,6 +395,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         oracle_events: Optional[
                             List[Dict[str, Any]]] = None,
                         autoscale_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        lora_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -386,6 +421,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(oracle_trace_events(oracle_events))
     if autoscale_events:
         trace.extend(autoscale_trace_events(autoscale_events))
+    if lora_events:
+        trace.extend(lora_trace_events(lora_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -442,8 +479,12 @@ def merged_timeline(filename: Optional[str] = None,
                                 timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-autoscale conductor
         asev = []
+    try:
+        lev = w.conductor.call("get_lora_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-lora conductor
+        lev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev, oev, dev, orev, asev)
+                                pev, oev, dev, orev, asev, lev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

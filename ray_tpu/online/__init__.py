@@ -38,8 +38,9 @@ rollout/publish/swap/ingest markers in the merged timeline.
 """
 from .buffer import RolloutBuffer, RolloutStream, from_rollouts  # noqa: F401
 from .loop import OnlineConfig, OnlineResult, OnlineTrainer  # noqa: F401
+from .lora import TenantLoraTrainer  # noqa: F401
 from .sampler import RolloutSampler, spawn_samplers  # noqa: F401
 
 __all__ = ["OnlineConfig", "OnlineResult", "OnlineTrainer",
            "RolloutBuffer", "RolloutSampler", "RolloutStream",
-           "from_rollouts", "spawn_samplers"]
+           "TenantLoraTrainer", "from_rollouts", "spawn_samplers"]

@@ -100,6 +100,23 @@ def _pad_batch(rollouts: List[Dict[str, Any]], seq_len: int
             "mask": mask, "versions": versions}
 
 
+def next_publish_version(name: str) -> int:
+    """The version a NEW publisher of `name` should start at:
+    publication numbering continues after whatever the registry
+    already holds, so a second trainer (or a restarted one) against a
+    live weights name never collides with an existing version. Shared
+    by OnlineTrainer's initial full publish and the per-tenant
+    TenantLoraTrainer (online/lora.py)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called before "
+                           "publishing weights")
+    return int(w.conductor.call("weights_latest_version", name,
+                                timeout=10.0) or 0) + 1
+
+
 def _distill_loss_fn(model_config) -> Callable:
     """Masked next-token CE over the completion region — the online
     distillation objective (sequence-level: imitate the sampler's
@@ -227,15 +244,7 @@ class OnlineTrainer:
         # any sampler exists, so samplers boot onto it. Numbered after
         # whatever the registry already holds under this name (a second
         # fit() against a live cluster must not collide with v1).
-        from ray_tpu._private import worker as worker_mod
-
-        w = worker_mod.global_worker
-        if w is None:
-            raise RuntimeError("ray_tpu.init() must be called before "
-                               "OnlineTrainer.fit()")
-        start_version = int(w.conductor.call(
-            "weights_latest_version", cfg.weights_name,
-            timeout=10.0) or 0) + 1
+        start_version = next_publish_version(cfg.weights_name)
         initial = gpt2_init(model_config, jax.random.PRNGKey(cfg.seed))
         wts.publish(initial, name=cfg.weights_name,
                     version=start_version)

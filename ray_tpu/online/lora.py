@@ -1,0 +1,171 @@
+"""Per-tenant online LoRA loop: adapter-only training against a frozen
+base, published as weight-fabric deltas that hot-swap into serving.
+
+The multi-tenant closing of the Podracer cycle (serve/lora.py is the
+serving half): one :class:`TenantLoraTrainer` per tenant optimizes ONLY
+its adapter's A/B leaves — the base params are a frozen closure
+constant, never touched, never republished — and publishes the adapter
+tree under ``lora/<tenant>`` every ``publish_every`` steps
+(delta publication: an adapter refresh ships only the leaves the
+optimizer moved). Every serving replica's
+:class:`~ray_tpu.serve.lora.FabricAdapterSource` sees the pubsub
+notice, marks the tenant dirty, and hot-swaps the new version between
+decode ticks — without restarting anything and without perturbing any
+OTHER tenant's in-flight requests (asserted in tests/test_lora.py).
+
+Versions continue after whatever the registry already holds
+(:func:`ray_tpu.online.loop.next_publish_version` — the same rule the
+full OnlineTrainer follows), so a restarted tenant loop never collides
+with its own history. The PPO-style objective over rollout logprob
+scores stays the recorded follow-up (ROADMAP); today's objective is
+next-token CE on whatever batches the caller feeds (distillation from
+a tenant corpus, or the tenant's own rollouts).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+def _full_forward(config) -> Callable:
+    from ray_tpu.models.llama import LlamaConfig, llama_forward
+
+    if isinstance(config, LlamaConfig):
+        return llama_forward
+    from ray_tpu.models.gpt2 import GPT2Config, gpt2_forward
+
+    if isinstance(config, GPT2Config):
+        return gpt2_forward
+    raise TypeError(f"no LoRA training support for "
+                    f"{type(config).__name__}")
+
+
+class TenantLoraTrainer:
+    """Adapter-only trainer for one tenant.
+
+    ``step(tokens)`` takes one ``[B, T] int32`` batch, runs a
+    next-token CE step whose gradients flow ONLY into the adapter's
+    A/B leaves (the base enters the jitted loss as a plain argument
+    and never receives an update), and returns the loss. ``publish()``
+    ships the current adapter to the weight fabric; ``fit()`` is the
+    step/publish cadence loop."""
+
+    def __init__(self, base_params: Any, model_config: Any, tenant: str,
+                 *, rank: int = 4, scale: float = 1.0,
+                 learning_rate: float = 1e-2, publish_every: int = 2,
+                 prefix: str = "lora/", seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.generate import (lora_targets,
+                                             merge_lora_params)
+
+        self.base_params = base_params
+        self.model_config = model_config
+        self.tenant = str(tenant)
+        self.rank = int(rank)
+        self.scale = float(scale)
+        self.publish_every = max(1, int(publish_every))
+        self.prefix = prefix
+        self.published_versions: List[int] = []
+        self.losses: List[float] = []
+        self._step = 0
+        layers = int(model_config.num_layers)
+        rng = np.random.default_rng(seed)
+        # classic LoRA init: A random, B zero — the adapter starts as
+        # an exact no-op and grows away from the base as it trains
+        self._ab: Dict[str, Dict[str, Any]] = {}
+        for name, d_in, d_out in lora_targets(model_config):
+            self._ab[name] = {
+                "a": jnp.asarray(
+                    rng.standard_normal((layers, d_in, self.rank))
+                    * 0.02, jnp.float32),
+                "b": jnp.zeros((layers, self.rank, d_out), jnp.float32),
+            }
+        self._opt = optax.adam(learning_rate)
+        self._opt_state = self._opt.init(self._ab)
+        fwd = _full_forward(model_config)
+        cfg = model_config
+        sc = jnp.float32(self.scale)
+
+        def loss_fn(ab, base, tokens):
+            merged = merge_lora_params(
+                base, cfg, {"scale": sc, "targets": ab})
+            logits = fwd(merged, tokens[:, :-1], cfg)
+            logits = logits[..., :cfg.vocab_size]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tgt = tokens[:, 1:]
+            ll = jnp.take_along_axis(logp, tgt[..., None],
+                                     axis=-1)[..., 0]
+            return -jnp.mean(ll)
+
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        @jax.jit
+        def train_step(ab, opt_state, base, tokens):
+            loss, grads = grad_fn(ab, base, tokens)
+            updates, opt_state = self._opt.update(grads, opt_state, ab)
+            return optax.apply_updates(ab, updates), opt_state, loss
+
+        self._train_step = train_step
+
+    # ------------------------------------------------------------- steps
+
+    def step(self, tokens) -> float:
+        tokens = np.asarray(tokens, np.int32)
+        self._ab, self._opt_state, loss = self._train_step(
+            self._ab, self._opt_state, self.base_params, tokens)
+        self._step += 1
+        loss = float(loss)
+        self.losses.append(loss)
+        return loss
+
+    def adapter(self) -> Dict[str, Any]:
+        """The current adapter as the host tree the serving pool pages
+        (serve/lora.py layout)."""
+        return {
+            "scale": np.float32(self.scale),
+            "targets": {name: {"a": np.asarray(ab["a"]),
+                               "b": np.asarray(ab["b"])}
+                        for name, ab in self._ab.items()},
+        }
+
+    def publish(self, *, delta: bool = True) -> int:
+        """Publish the current adapter under ``lora/<tenant>``; the
+        committed version is appended to ``published_versions``."""
+        from ray_tpu.online.loop import next_publish_version
+        from ray_tpu.serve.lora import tenant_weights_name
+        from ray_tpu.weights import publish
+
+        name = tenant_weights_name(self.tenant, self.prefix)
+        version = next_publish_version(name)
+        publish(self.adapter(), name=name, version=version,
+                delta=delta)
+        self.published_versions.append(version)
+        return version
+
+    def fit(self, batches: Iterable[Any],
+            num_steps: Optional[int] = None,
+            delta: bool = True) -> Dict[str, Any]:
+        """Run the step/publish cadence over `batches` (each a
+        ``[B, T]`` token array). Publishes every ``publish_every``
+        steps and once more at the end if steps remain unpublished."""
+        steps_since_publish = 0
+        for i, batch in enumerate(batches):
+            if num_steps is not None and i >= num_steps:
+                break
+            self.step(batch)
+            steps_since_publish += 1
+            if steps_since_publish >= self.publish_every:
+                self.publish(delta=delta)
+                steps_since_publish = 0
+        if steps_since_publish:
+            self.publish(delta=delta)
+        return {"tenant": self.tenant, "steps": self._step,
+                "losses": list(self.losses),
+                "published_versions": list(self.published_versions)}
+
+
+__all__ = ["TenantLoraTrainer"]

@@ -29,3 +29,26 @@ def rms_norm(x: jax.Array, scale: jax.Array,
     ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     return (x32 * jax.lax.rsqrt(ms + eps)
             * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def lora_delta(h: jax.Array, a: jax.Array, b: jax.Array,
+               scale: jax.Array) -> jax.Array:
+    """Per-slot scatter-gathered LoRA contribution for a ragged decode
+    batch: ``scale * (h @ A) @ B`` with a DIFFERENT adapter per batch
+    row (the cross-tenant batched-decode matmul; serve/lora.py gathers
+    A/B out of the adapter pool by each slot's adapter index before the
+    call).
+
+    ``h [B, t, d]``, ``a [B, d, r]``, ``b [B, r, o]``, ``scale [B]`` ->
+    ``[B, o or t, o]`` in ``h.dtype``. fp32 accumulation like the base
+    matmuls; rows whose adapter is the null slot (A == B == 0,
+    scale == 0) contribute an exact-zero delta, so adding it to the base
+    projection leaves those rows' values unchanged. Structured as the
+    Pallas ragged-matmul kernel candidate (grouped by adapter index) the
+    autotuner item will sweep — today it lowers to two batched einsums.
+    """
+    z = jnp.einsum("btd,bdr->btr", h, a,
+                   preferred_element_type=jnp.float32)
+    d = jnp.einsum("btr,bro->bto", z, b,
+                   preferred_element_type=jnp.float32)
+    return (d * scale[:, None, None]).astype(h.dtype)

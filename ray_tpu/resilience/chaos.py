@@ -349,6 +349,17 @@ class ServeChaosMonkey:
     def __bool__(self) -> bool:
         return bool(self.actions)
 
+    def reset_counts(self) -> None:
+        """Zero the cumulative request/token counters (NOT the fired
+        latches — an already-fired action never re-fires). bench_serve
+        calls this on every replica at measurement start, so a plan's
+        ``at=request:N`` / ``at=token:K`` counts the Nth MEASURED
+        request / Kth measured token instead of including warm-up
+        traffic (the PR-12 known limit)."""
+        with self._lock:
+            self._tokens = 0
+            self._requests = 0
+
     # ------------------------------------------------------------- firing
 
     def on_request(self) -> None:

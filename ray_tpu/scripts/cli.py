@@ -604,6 +604,60 @@ def cmd_servefault(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_lora(args) -> None:
+    """`ray_tpu lora` — multi-tenant LoRA serving view
+    (serve/lora.py): per-pool adapter-paging counters and residents,
+    per-tenant request counters, plus the cluster totals every other
+    surface (state API, /api/lora, Prometheus, `lora` timeline lane)
+    reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.lora_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    if not (st.get("pools") or st.get("routers")):
+        print("no lora telemetry recorded (is an AdapterPool-backed "
+              "replica running?)")
+        return
+    totals = st.get("totals") or {}
+    print(f"totals: pools={totals.get('pools', 0)} "
+          f"slots={totals.get('slots', 0)} "
+          f"resident={totals.get('resident', 0)} "
+          f"pinned={totals.get('pinned', 0)} "
+          f"acquires={totals.get('acquires', 0)} "
+          f"hit_rate={totals.get('hit_rate', 0.0):.2%} "
+          f"misses={totals.get('misses', 0)} "
+          f"evictions={totals.get('evictions', 0)} "
+          f"swaps={totals.get('swaps', 0)} "
+          f"page_in={totals.get('page_in_bytes', 0)}B "
+          f"tenants={totals.get('tenants', 0)}")
+    for key, p in sorted((st.get("pools") or {}).items()):
+        print(f"  pool {key}: slots={p.get('slots')} "
+              f"resident={p.get('resident')} "
+              f"pinned={p.get('pinned')} "
+              f"hits={p.get('hits')} misses={p.get('misses')} "
+              f"evictions={p.get('evictions')} "
+              f"swaps={p.get('swaps')} "
+              f"rank_max={p.get('rank_max')}")
+    tenants = st.get("tenants") or {}
+    for t, ts in sorted(tenants.items()):
+        print(f"  tenant {t}: dispatched={ts.get('dispatched', 0)} "
+              f"completed={ts.get('completed', 0)} "
+              f"shed={ts.get('shed', 0)} "
+              f"slo_misses={ts.get('slo_misses', 0)} "
+              f"pool_hits={ts.get('hits', 0)}/"
+              f"misses={ts.get('misses', 0)} "
+              f"swaps={ts.get('swaps', 0)}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_lora_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_autoscale(args) -> None:
     """`ray_tpu autoscale` — serving-autoscaler view
     (serve/autoscale.py): per-loop tier targets, decision counts,
@@ -1068,6 +1122,17 @@ def main(argv=None) -> None:
                          "breaker_trip slice)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_servefault)
+
+    sp = sub.add_parser("lora",
+                        help="multi-tenant LoRA serving: adapter-pool "
+                             "paging (hits/misses/evictions/swaps, "
+                             "residents), per-tenant request counters, "
+                             "recent page_in/evict/swap events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N lora events")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_lora)
 
     sp = sub.add_parser("autoscale",
                         help="serving autoscaler: per-tier targets and "

@@ -26,7 +26,8 @@ from .disagg import (DecodeServer, DisaggRouter,  # noqa: F401
 from .handle import (CONTROLLER_NAME, DeploymentHandle,  # noqa: F401
                      DeploymentResponse, RequestShedError)
 from .http_util import Request, Response  # noqa: F401
-from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+from .multiplex import (get_multiplexed_model_id, multiplexed,  # noqa: F401
+                        request_tenant)
 from .replica import HandleMarker
 
 

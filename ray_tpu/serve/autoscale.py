@@ -306,12 +306,23 @@ class DisaggPolicy:
         depth_p99 = signals.get("queue_depth_p99")
         cap = max(1, int(signals.get("decode_cap_per_replica", 1)))
         capacity = current * cap
+        if depth_p99 is not None and depth_p99 > capacity:
+            # PROPORTIONAL scale step for deep backlogs (the PR-11
+            # follow-on): ±1 per decision chases a burst one cooldown
+            # at a time — when the backlog exceeds 2x one replica's
+            # capacity, jump straight to the replica count that holds
+            # it (ceil(backlog / capacity_per_replica); TierSpec
+            # bounds clamp at apply time, hysteresis still gates)
+            desired = current + 1
+            if depth_p99 > 2 * cap:
+                desired = max(desired, -(-int(depth_p99) // cap))
+            return desired, (
+                f"backlog p99 {depth_p99:.0f} past tier capacity "
+                f"{capacity}"
+                + (f" (proportional step -> {desired})"
+                   if desired > current + 1 else ""))
         if free_p50 is not None and free_p50 <= 0:
             return current + 1, "decode slots exhausted (free p50 = 0)"
-        if depth_p99 is not None and depth_p99 > capacity:
-            return current + 1, (
-                f"backlog p99 {depth_p99:.0f} past tier capacity "
-                f"{capacity}")
         # slot DEMAND, not just engine-busy slots: a slow client drains
         # its stream long after the engine slot freed, but it still
         # occupies the router's admission bound — the thing a removed

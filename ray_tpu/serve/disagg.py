@@ -88,7 +88,7 @@ import numpy as np
 
 from ray_tpu.exceptions import ActorError, WorkerCrashedError
 
-from .autoscale import SlidingWindow
+from .autoscale import SlidingWindow, default_target_p99_ms
 from .handle import RequestShedError, shed_counter
 
 _SERVER_SEQ = itertools.count()
@@ -100,6 +100,42 @@ _SERVER_SEQ = itertools.count()
 # it still consumes a bounded failover attempt but the replica stays.
 _DEATH_TYPES = (ActorError, WorkerCrashedError, ConnectionError,
                 EOFError, OSError)
+
+
+def _is_pool_exhausted(e: BaseException) -> bool:
+    """An adapter-pool-exhausted failure (serve/lora.py
+    LoraPoolExhausted) — matched by name because the exception may
+    arrive re-wrapped across the actor boundary. It is a CAPACITY
+    condition (every pool row pinned by in-flight requests), not a
+    replica fault: the router sheds cause=capacity immediately instead
+    of burning failover attempts replaying it onto the same full
+    pools."""
+    return "LoraPoolExhausted" in repr(e)
+
+
+# Deterministic tenant-CONFIGURATION failures (unknown tenant, adapter
+# rank over the pool ceiling, tenant tag against a pool-less replica):
+# retrying cannot help — affinity re-routes to the same healthy
+# replica and the error reproduces — and shedding would mislabel a
+# client/operator mistake as a serving fault. The router re-raises
+# them to the caller as the ValueError they are. Substring-matched
+# because they may arrive re-wrapped across the actor boundary.
+_LORA_CONFIG_ERRORS = ("no adapter registered for tenant",
+                       "exceeds the pool's rank_max",
+                       "has no lora_pool",
+                       "has no adapter pool",
+                       "does not fit this model's target")
+
+
+def _is_lora_config_error(e: BaseException) -> bool:
+    r = repr(e)
+    if any(m in r for m in _LORA_CONFIG_ERRORS):
+        return True
+    # fabric source, tenant never published: the subscriber's registry
+    # miss. Matched in two pieces (quoting around the name varies with
+    # repr nesting across the actor boundary), scoped to lora/* names
+    # so unrelated weight fetches keep their failover semantics.
+    return "no committed version" in r and "lora/" in r
 
 
 class ReplicaDeadError(RuntimeError):
@@ -276,7 +312,10 @@ class PrefillServer:
                  retain: int = 32,
                  server_id: Optional[str] = None,
                  chaos: Optional[str] = None,
-                 chaos_replica: int = 0):
+                 chaos_replica: int = 0,
+                 lora: Any = None,
+                 lora_pool_slots: Optional[int] = None,
+                 lora_rank_max: Optional[int] = None):
         from ray_tpu.models.generate import _model_fns
         from ray_tpu.models.kvcache import (PagedKVCache,
                                             resolve_pool_config)
@@ -285,6 +324,8 @@ class PrefillServer:
 
         from ray_tpu.resilience.chaos import serve_monkey_from_spec
         from ray_tpu.util.chunks import local_machine_id
+
+        from .lora import build_pool
 
         self.params = params
         self.config = config
@@ -301,6 +342,20 @@ class PrefillServer:
             PagedKVCache(config, block_size=block_size,
                          num_blocks=pool_blocks)
             if prefix_cache else None)
+        # multi-tenant LoRA (serve/lora.py): prefill runs under each
+        # request's tenant adapter, so the prefill tier pages adapters
+        # exactly like the decode tier; an adapter hot-swap flushes
+        # that tenant's (namespace-keyed) prefix-cache entries
+        self.lora_pool = build_pool(config, lora, slots=lora_pool_slots,
+                                    rank_max=lora_rank_max)
+        if self.lora_pool is not None and self.kv_cache is not None:
+            # namespaces are (tenant, version)-stamped — correctness
+            # never needs this flush; it eagerly reclaims the
+            # superseded version's blocks
+            self.lora_pool.add_swap_listener(
+                lambda tenant, old, _p=self.lora_pool:
+                self.kv_cache.invalidate(
+                    namespace=_p.cache_namespace(tenant, old)))
         probe = _model_fns(config)[1](config, 1, max_len=1)
         shape = probe[0]["k"].shape  # [1, 1, H, hd]
         self._empty_prefix = jnp.zeros(
@@ -326,9 +381,14 @@ class PrefillServer:
 
     # ---------------------------------------------------------- data plane
 
-    def prefill(self, prompt_tokens) -> Dict[str, Any]:
+    def prefill(self, prompt_tokens,
+                tenant: Optional[str] = None) -> Dict[str, Any]:
         """Prefill one prompt (suffix-only on a cache hit) and publish
-        its KV rows. Returns the transfer record for a DecodeServer."""
+        its KV rows. Returns the transfer record for a DecodeServer.
+        `tenant` (multi-tenant LoRA): prefill under that tenant's
+        adapter — paged through this server's pool — with the prefix
+        cache keyed by (tenant, prompt); the record carries the tag so
+        the decode tier adopts under the same adapter."""
         from ray_tpu.models.engine import _prefill_with_cache
         from ray_tpu.util import chunks
 
@@ -338,9 +398,28 @@ class PrefillServer:
         plen = prompt.shape[1]
         if plen < 1:
             raise ValueError("empty prompt")
-        ck, cv, table, first, score, outcome, reused, suffix_len = \
-            _prefill_with_cache(self.params, self.config, self.kv_cache,
-                                prompt, self._empty_prefix)
+        adapter = None
+        namespace = None
+        if tenant is not None:
+            if self.lora_pool is None:
+                raise ValueError(
+                    f"request for tenant {tenant!r} but this prefill "
+                    f"server has no adapter pool (lora= ctor arg)")
+            adapter, aver = self.lora_pool.adapter_slice(
+                self.lora_pool.acquire(tenant), with_version=True)
+            namespace = self.lora_pool.cache_namespace(tenant, aver)
+        try:
+            ck, cv, table, first, score, outcome, reused, suffix_len = \
+                _prefill_with_cache(self.params, self.config,
+                                    self.kv_cache, prompt,
+                                    self._empty_prefix, adapter=adapter,
+                                    namespace=namespace)
+        finally:
+            if adapter is not None:
+                # the adapter pin covers exactly the prefill compute;
+                # refcount-0 adapters stay resident for the next
+                # request (the pool's LRU owns reclamation)
+                self.lora_pool.release(tenant)
         if self.kv_cache is not None:
             # pins drop NOW: the KV is exported below, and refcount-0
             # blocks stay cached for the next prompt's lookup
@@ -357,6 +436,8 @@ class PrefillServer:
             "outcome": outcome, "reused_tokens": int(reused),
             "prefill_server": self.server_id,
         }
+        if tenant is not None:
+            rec["tenant"] = tenant
         nbytes = int(kv_k.nbytes + kv_v.nbytes)
         w = _worker()
         if w is not None:
@@ -411,7 +492,46 @@ class PrefillServer:
         """Registration record for a router: identity + host (the
         decode-side placement-affinity input)."""
         return {"server_id": self.server_id, "role": "prefill",
-                "machine": self.machine}
+                "machine": self.machine,
+                "lora": self.lora_pool is not None}
+
+    def publish_adapter(self, tenant: str,
+                        adapter: Dict[str, Any]) -> int:
+        """Publish/replace a tenant's adapter on this replica's LOCAL
+        source (actor-friendly — the in-process twin of a weight-fabric
+        publish; fabric-backed pools take publishes through
+        serve.lora.publish_adapter instead). The pool sees the tenant
+        dirty and hot-swaps on the next acquire."""
+        if self.lora_pool is None:
+            raise ValueError("this prefill server has no adapter pool")
+        return int(self.lora_pool.source.publish(tenant, adapter))
+
+    def refresh_adapter(self, tenant: str) -> bool:
+        """Force the resident adapter to the newest published version
+        now (the dirty flag does it lazily on the next request)."""
+        if self.lora_pool is None:
+            return False
+        return self.lora_pool.refresh(tenant)
+
+    def reset_chaos_counts(self) -> bool:
+        """Zero the chaos monkey's request/token counters so a
+        `kill_replica at=request:N` plan counts from the MEASURED
+        phase, not from warm-up traffic (bench_serve calls this at
+        measurement start)."""
+        if self._chaos is not None:
+            self._chaos.reset_counts()
+        return self._chaos is not None
+
+    def invalidate_prefix_cache(self) -> bool:
+        """Drop the whole prefix index (every namespace). bench_serve's
+        bit-identity verdict calls it before the sequential re-runs so
+        they re-prefill cache-cold — the re-check then covers the
+        prefill path too, instead of replaying whatever the mixed run
+        cached."""
+        if self.kv_cache is None:
+            return False
+        self.kv_cache.invalidate()
+        return True
 
     def prepare_for_shutdown(self, timeout_s: float = 30.0) -> bool:
         """Grace drain (the serve/replica.py shape, reused by autoscale
@@ -440,6 +560,8 @@ class PrefillServer:
         s["server_id"] = self.server_id
         if self.kv_cache is not None:
             s["prefix_cache"] = self.kv_cache.stats()
+        if self.lora_pool is not None:
+            s["lora"] = self.lora_pool.stats()
         return s
 
     def kv_stats(self) -> Dict[str, Any]:
@@ -461,6 +583,8 @@ class PrefillServer:
             return
         self._last_push = now
         _push_stats(self.server_id, self.stats())
+        if self.lora_pool is not None:
+            self.lora_pool.publish_telemetry(force=force)
         w = _worker()
         if w is None:
             if self.kv_cache is not None:
@@ -513,13 +637,25 @@ class DecodeServer:
                  server_id: Optional[str] = None,
                  chaos: Optional[str] = None,
                  chaos_replica: int = 0,
+                 lora: Any = None,
+                 lora_pool_slots: Optional[int] = None,
+                 lora_rank_max: Optional[int] = None,
                  **engine_kw):
         from ray_tpu.models.engine import ContinuousBatchingEngine
 
         from ray_tpu.resilience.chaos import serve_monkey_from_spec
         from ray_tpu.util.chunks import local_machine_id
 
+        from .lora import build_pool
+
         engine_kw.setdefault("prefix_cache", False)
+        # multi-tenant LoRA: the decode tick applies each slot's
+        # adapter, so the decode tier pages adapters through its own
+        # pool (the engine pins at adoption, releases at slot-free)
+        self.lora_pool = build_pool(config, lora, slots=lora_pool_slots,
+                                    rank_max=lora_rank_max)
+        if self.lora_pool is not None:
+            engine_kw.setdefault("lora_pool", self.lora_pool)
         self.engine = ContinuousBatchingEngine(params, config,
                                                max_batch=max_batch,
                                                **engine_kw)
@@ -577,6 +713,7 @@ class DecodeServer:
             max_new_tokens, eos_token, score=rec.get("score", 0.0),
             cache_outcome=rec.get("outcome"),
             reused_tokens=rec.get("reused_tokens", 0),
+            adapter_id=rec.get("tenant"),
             timeout_s=timeout_s)
         with self._lock:
             self._stats["transfers"] += 1
@@ -632,11 +769,18 @@ class DecodeServer:
         now = time.monotonic()
         stream = self._adopt(rec, max_new_tokens, eos_token, timeout_s)
         hid = f"{self.server_id}-h{next(_SERVER_SEQ)}"
+        reaped: List[Any] = []
         with self._lock:
             self._streams[hid] = [stream, now]
-            for k, (_, last) in list(self._streams.items()):
+            for k, (st, last) in list(self._streams.items()):
                 if now - last > self._STREAM_REAP_S:
                     del self._streams[k]  # abandoned by a dead router
+                    reaped.append(st)
+        for st in reaped:
+            # the abandoned request must not decode to completion —
+            # same early-free as cancel_decode (KV + adapter pins drop
+            # at the next tick boundary)
+            self.engine.cancel_slot(st)
         return hid
 
     def next_tokens(self, hid: str, max_tokens: int = 64,
@@ -682,11 +826,18 @@ class DecodeServer:
         return {"tokens": toks, "done": done}
 
     def cancel_decode(self, hid: str) -> bool:
-        """Abandon a pull handle (router shed the request on deadline).
-        The engine finishes the slot's decode on its own — the tokens
-        are dropped, the slot frees naturally."""
+        """Abandon a pull handle (router shed the request on deadline
+        or failed it over): the engine CANCELS the slot — it frees,
+        with its KV pins and adapter pin, at the next tick boundary
+        instead of decoding the abandoned request to completion (the
+        PR-12 known limit: those ticks were pure waste). The freed
+        slot is immediately re-admittable."""
         with self._lock:
-            return self._streams.pop(hid, None) is not None
+            entry = self._streams.pop(hid, None)
+        if entry is None:
+            return False
+        self.engine.cancel_slot(entry[0])
+        return True
 
     def _count_decoded(self, n: int) -> None:
         with self._lock:
@@ -718,7 +869,26 @@ class DecodeServer:
         (the decode-side placement-affinity anchor)."""
         return {"server_id": self.server_id, "role": "decode",
                 "capacity": self.engine.max_batch,
-                "machine": self.machine}
+                "machine": self.machine,
+                "lora": self.lora_pool is not None}
+
+    def publish_adapter(self, tenant: str,
+                        adapter: Dict[str, Any]) -> int:
+        """Local-source adapter publish (see PrefillServer twin)."""
+        if self.lora_pool is None:
+            raise ValueError("this decode server has no adapter pool")
+        return int(self.lora_pool.source.publish(tenant, adapter))
+
+    def refresh_adapter(self, tenant: str) -> bool:
+        if self.lora_pool is None:
+            return False
+        return self.lora_pool.refresh(tenant)
+
+    def reset_chaos_counts(self) -> bool:
+        """Zero the chaos monkey's counters (see PrefillServer twin)."""
+        if self._chaos is not None:
+            self._chaos.reset_counts()
+        return self._chaos is not None
 
     def prepare_for_shutdown(self, timeout_s: float = 30.0) -> bool:
         """Grace drain (the serve/replica.py shape, reused by autoscale
@@ -742,7 +912,10 @@ class DecodeServer:
                  capacity=self.engine.max_batch,
                  free_slots=self.engine.free_slots,
                  adopted=self.engine.adopted,
+                 cancelled=self.engine.cancelled,
                  prefill_programs=self.prefill_programs())
+        if self.lora_pool is not None:
+            s["lora"] = self.lora_pool.stats()
         return s
 
     def publish_telemetry(self, force: bool = False) -> None:
@@ -751,6 +924,8 @@ class DecodeServer:
             return
         self._last_push = now
         _push_stats(self.server_id, self.stats())
+        if self.lora_pool is not None:
+            self.lora_pool.publish_telemetry(force=force)
         # the engine's own kvcache push carries the adoption counters
         # to the kvcache surface (per-phase truthfulness)
         self.engine.publish_kv_telemetry(force=True)
@@ -769,16 +944,17 @@ class _TierReplica:
     bookkeeping."""
 
     __slots__ = ("target", "rid", "cap", "inflight", "draining",
-                 "machine")
+                 "machine", "lora")
 
     def __init__(self, target: Any, rid: str, cap: int,
-                 machine: Optional[str] = None):
+                 machine: Optional[str] = None, lora: bool = False):
         self.target = target
         self.rid = rid
         self.cap = int(cap)
         self.inflight = 0
         self.draining = False
         self.machine = machine
+        self.lora = bool(lora)
 
     def snapshot(self) -> Dict[str, Any]:
         return {"rid": self.rid, "target": self.target, "cap": self.cap,
@@ -881,7 +1057,18 @@ class DisaggRouter:
         self._pf_inflight = 0
         self._stats = {k: 0 for k in (
             "dispatched", "completed", "shed", "max_pending",
-            "shm_affinity_hits", "shm_affinity_total")}
+            "shm_affinity_hits", "shm_affinity_total",
+            "tenant_affinity_hits", "tenant_affinity_total")}
+        # multi-tenant LoRA (serve/lora.py): per-tenant shed/SLO/
+        # latency isolation — one tenant's overload or failure must
+        # never read as another's. LRU-capped so a tenant sweep can't
+        # grow the router without bound; the SLO line is the same
+        # TTFT target the autoscale policy chases.
+        self._tenant_stats: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
+        self._tenant_decode: "OrderedDict[str, str]" = OrderedDict()
+        self._tenant_cap = 512
+        self._tenant_slo_ms = default_target_p99_ms()
         # serving-fault-tolerance accounting (the servefault surface):
         # failover attempts per phase, requests that survived >= 1
         # failover, sheds by attributed cause, corpses removed
@@ -912,7 +1099,8 @@ class DisaggRouter:
         cap = int(info.get("capacity")
                   or (_call(target, "capacity") if tier == "decode"
                       else 0))
-        return _TierReplica(target, rid, cap, info.get("machine"))
+        return _TierReplica(target, rid, cap, info.get("machine"),
+                            bool(info.get("lora")))
 
     def _push_retention_hint(self) -> None:
         """Every admissible request can be in flight at once and
@@ -950,6 +1138,18 @@ class DisaggRouter:
         self._push_retention_hint()
         self.publish_telemetry(force=True)
         return rep.rid
+
+    def _lora_enabled(self) -> bool:
+        """Whether this deployment can serve tenant-tagged requests:
+        any tier replica advertised an adapter pool (describe()'s
+        `lora` field), or the colocated engine holds one. Live — a
+        LoRA-enabled replica added mid-traffic enables the tenant
+        default from then on."""
+        if self._colocated is not None and \
+                getattr(self._colocated, "lora_pool", None) is not None:
+            return True
+        with self._lock:
+            return any(r.lora for r in self._prefill + self._decode)
 
     def _tier(self, tier: str) -> List[_TierReplica]:
         if tier not in ("prefill", "decode"):
@@ -1052,13 +1252,32 @@ class DisaggRouter:
                             "attempt": attempt, "detail": detail[:200]})
         self.publish_servefault()
 
-    def _shed(self, cause: str, message: str) -> RequestShedError:
+    def _tenant_rec_locked(self, tenant: str) -> Dict[str, Any]:
+        rec = self._tenant_stats.get(tenant)
+        if rec is None:
+            rec = {"dispatched": 0, "completed": 0, "shed": 0,
+                   "sheds_by_cause": {}, "slo_misses": 0,
+                   "ttft": SlidingWindow(), "latency": SlidingWindow()}
+            self._tenant_stats[tenant] = rec
+            while len(self._tenant_stats) > self._tenant_cap:
+                self._tenant_stats.popitem(last=False)
+        self._tenant_stats.move_to_end(tenant)
+        return rec
+
+    def _shed(self, cause: str, message: str,
+              tenant: Optional[str] = None) -> RequestShedError:
         """Count + build an attributed shed (the caller raises it):
-        every shed path reports the same one set of numbers."""
+        every shed path reports the same one set of numbers. `tenant`
+        charges the shed to that tenant's isolated counters too."""
         with self._lock:
             self._stats["shed"] += 1
             by = self._sf["sheds_by_cause"]
             by[cause] = by.get(cause, 0) + 1
+            if tenant is not None:
+                trec = self._tenant_rec_locked(tenant)
+                trec["shed"] += 1
+                tby = trec["sheds_by_cause"]
+                tby[cause] = tby.get(cause, 0) + 1
         shed_counter().inc(tags={"app": "disagg",
                                  "deployment": self.router_id})
         servefault_metrics()["sheds"].inc(tags={"cause": cause})
@@ -1072,7 +1291,8 @@ class DisaggRouter:
 
     # ------------------------------------------------------------ admission
 
-    def _admit_or_shed(self) -> _TierReplica:
+    def _admit_or_shed(self,
+                       tenant: Optional[str] = None) -> _TierReplica:
         """Reserve a decode replica or shed. Sheds when EVERY active
         replica's in-flight estimate has reached capacity +
         max_queue_depth — the bound that keeps queue depth finite
@@ -1082,7 +1302,14 @@ class DisaggRouter:
         would let N racing callers all pass the check before any
         reserves, exceeding the bound by N-1); shed-side metrics and
         the conductor notify run after release so overload never
-        serializes healthy admissions behind a socket write."""
+        serializes healthy admissions behind a socket write.
+
+        `tenant` adds TENANT-AFFINITY beside the load policy: the
+        replica that served this tenant last already holds its adapter
+        resident (serve/lora.py pool), so it is preferred while it has
+        admission headroom — a cross-replica spray would page the same
+        adapter into every pool."""
+        affinity_hit = False
         with self._lock:
             open_reps = [r for r in self._decode if not r.draining
                          and r.inflight < r.cap + self.max_queue_depth]
@@ -1091,6 +1318,16 @@ class DisaggRouter:
                 # probe-free first cut: least estimated in-flight,
                 # reserved NOW so the bound holds under concurrency
                 rep = min(open_reps, key=lambda r: r.inflight)
+                if tenant is not None:
+                    self._stats["tenant_affinity_total"] += 1
+                    want = self._tenant_decode.get(tenant)
+                    for r in open_reps:
+                        if r.rid == want:
+                            rep = r
+                            affinity_hit = True
+                            self._stats["tenant_affinity_hits"] += 1
+                            break
+                    self._tenant_rec_locked(tenant)["dispatched"] += 1
                 rep.inflight += 1
                 pending += 1
                 self._stats["dispatched"] += 1
@@ -1108,8 +1345,8 @@ class DisaggRouter:
                 f"disagg router {self.router_id}: every decode "
                 f"replica is at capacity + queue depth "
                 f"{self.max_queue_depth} (pending {pending}); retry "
-                f"after {self.retry_after_s:.1f}s")
-        if self._prefill and len(open_reps) > 1:
+                f"after {self.retry_after_s:.1f}s", tenant)
+        if self._prefill and len(open_reps) > 1 and not affinity_hit:
             # refine by live free-slot count (the decode-pick policy);
             # the in-flight estimate breaks ties and covers probe lag.
             # The probes are ISSUED before any is awaited so N actor
@@ -1148,12 +1385,26 @@ class DisaggRouter:
                         rep.inflight -= 1
                         best.inflight += 1
                         rep = best
+        if tenant is not None:
+            # record the replica that will ACTUALLY serve (after the
+            # probe refinement above) — it's the one paging the
+            # tenant's adapter, so it's the one affinity must point at
+            self._note_tenant_decode(tenant, rep.rid)
         disagg_metrics()["queue_depth"].set(
             pending, tags={"router": self.router_id})
         self.publish_telemetry()
         return rep
 
-    def _complete(self, rep: _TierReplica, ok: bool = True) -> None:
+    def _note_tenant_decode(self, tenant: str, rid: str) -> None:
+        with self._lock:
+            self._tenant_decode[tenant] = rid
+            self._tenant_decode.move_to_end(tenant)
+            while len(self._tenant_decode) > self._tenant_cap:
+                self._tenant_decode.popitem(last=False)
+
+    def _complete(self, rep: _TierReplica, ok: bool = True, *,
+                  tenant: Optional[str] = None,
+                  wall_ms: Optional[float] = None) -> None:
         """Release a request's reservation; `completed` counts only
         requests that RETURNED tokens — a shed-after-admission
         (deadline, failover exhaustion) or an error releases the slot
@@ -1164,6 +1415,11 @@ class DisaggRouter:
                 rep.inflight -= 1
             if ok:
                 self._stats["completed"] += 1
+                if tenant is not None:
+                    trec = self._tenant_rec_locked(tenant)
+                    trec["completed"] += 1
+                    if wall_ms is not None:
+                        trec["latency"].add(wall_ms)
             pending = sum(r.inflight for r in self._decode)
         disagg_metrics()["queue_depth"].set(
             pending, tags={"router": self.router_id})
@@ -1172,15 +1428,20 @@ class DisaggRouter:
     # ------------------------------------------------------------- dispatch
 
     def _pick_prefill(self, prompt: np.ndarray,
-                      decode_machine: Optional[str]) -> _TierReplica:
+                      decode_machine: Optional[str],
+                      tenant: Optional[str] = None) -> _TierReplica:
         """Prefix-cache affinity WITHIN the host-local subset: among
         prefill replicas co-located with the chosen decode replica (so
         the KV transfer rides shm, never RPC), the prompt's first cache
         block hashes to one stable choice; with no co-located replica
         the hash falls back to the whole active set. On one host the
         subset IS the whole set, so single-host affinity (and
-        bit-identity) is unchanged."""
-        head = tuple(int(t) for t in prompt[:self.affinity_tokens])
+        bit-identity) is unchanged. The TENANT joins the hash beside
+        the prompt head: a tenant's prompts land on the replica that
+        already holds its adapter (and its namespace-keyed KV) — the
+        tenant-affinity half of the multi-tenant routing policy."""
+        head = (tenant,) + tuple(
+            int(t) for t in prompt[:self.affinity_tokens])
         with self._lock:
             cands = [r for r in self._prefill if not r.draining]
             if not cands:  # every prefill draining: keep serving
@@ -1198,7 +1459,8 @@ class DisaggRouter:
                 self._stats["shm_affinity_hits"] += 1
         return rep
 
-    def _check_deadline(self, deadline: Optional[float]) -> None:
+    def _check_deadline(self, deadline: Optional[float],
+                        tenant: Optional[str] = None) -> None:
         """Shed with cause `deadline` the moment the request outlives
         its budget — it must never occupy a decode slot (or a failover
         attempt) past it."""
@@ -1206,7 +1468,8 @@ class DisaggRouter:
             raise self._shed(
                 "deadline",
                 f"disagg router {self.router_id}: request outlived its "
-                f"deadline; retry after {self.retry_after_s:.1f}s")
+                f"deadline; retry after {self.retry_after_s:.1f}s",
+                tenant)
 
     def _ack_transfer(self, pf: _TierReplica, rec: Dict[str, Any]
                       ) -> None:
@@ -1221,8 +1484,33 @@ class DisaggRouter:
         except Exception:  # noqa: BLE001 — replica already dead
             pass
 
+    def _shed_pool_exhausted(self, phase: str,
+                             tenant: Optional[str],
+                             e: BaseException) -> RequestShedError:
+        """The one adapter-pool-exhausted shed (colocated submit,
+        prefill, and decode paths all raise through here): a CAPACITY
+        condition, attributed to the tenant, never a failover."""
+        return self._shed(
+            "capacity",
+            f"disagg router {self.router_id}: {phase} adapter pool "
+            f"exhausted (every row pinned); retry after "
+            f"{self.retry_after_s:.1f}s", tenant)
+
+    def _check_request_fault(self, tenant: Optional[str],
+                             e: BaseException) -> None:
+        """Classify a data-plane failure that is NOT a replica death:
+        tenant-configuration errors re-raise to the caller (retrying
+        reproduces them; shedding would mislabel a client mistake as a
+        serving fault), everything else returns so the bounded
+        failover budget applies."""
+        if _is_lora_config_error(e):
+            raise ValueError(
+                f"tenant {tenant!r} is misconfigured for this "
+                f"deployment: {str(e)[:240]}") from e
+
     def _attempt_failed(self, phase: str, rid: str, attempt: int,
-                        err: BaseException) -> None:
+                        err: BaseException,
+                        tenant: Optional[str] = None) -> None:
         """Account one failed attempt; sheds with cause `failover` when
         the bounded budget is exhausted."""
         self._count_failover(phase, rid, attempt,
@@ -1233,11 +1521,12 @@ class DisaggRouter:
                 f"disagg router {self.router_id}: {phase} failure on "
                 f"attempt {attempt}/{1 + self.failover_attempts} "
                 f"({type(err).__name__}: {str(err)[:160]}); failover "
-                f"budget exhausted") from err
+                f"budget exhausted", tenant) from err
 
     def _pick_prefill_or_wait(self, prompt: np.ndarray,
                               decode_machine: Optional[str],
-                              deadline: Optional[float]
+                              deadline: Optional[float],
+                              tenant: Optional[str] = None
                               ) -> _TierReplica:
         """_pick_prefill, waiting out a momentarily-empty tier (every
         prefill replica dead, self-healer replacement in flight) up to
@@ -1245,19 +1534,23 @@ class DisaggRouter:
         wait_until = time.monotonic() + self.failover_wait_s
         while True:
             try:
-                return self._pick_prefill(prompt, decode_machine)
+                return self._pick_prefill(prompt, decode_machine,
+                                          tenant)
             except LookupError:
                 pass
-            self._check_deadline(deadline)
+            self._check_deadline(deadline, tenant)
             if time.monotonic() >= wait_until:
                 raise self._shed(
                     "failover",
                     f"disagg router {self.router_id}: no live prefill "
-                    f"replica after {self.failover_wait_s:.0f}s")
+                    f"replica after {self.failover_wait_s:.0f}s",
+                    tenant)
             time.sleep(0.25)
 
     def _reserve_survivor(self, old: _TierReplica,
-                          deadline: Optional[float]) -> _TierReplica:
+                          deadline: Optional[float],
+                          tenant: Optional[str] = None
+                          ) -> _TierReplica:
         """Move an ACCEPTED request's reservation off a failed decode
         replica onto a survivor. Failover never re-runs admission —
         the request was accepted and the dead replica's slot vanished
@@ -1276,13 +1569,21 @@ class DisaggRouter:
                     rep.inflight += 1
                     if old.inflight > 0:
                         old.inflight -= 1
-                    return rep
-            self._check_deadline(deadline)
+                else:
+                    rep = None
+            if rep is not None:
+                if tenant is not None:
+                    # failover moved the request (and its adapter
+                    # page-in) to the survivor — affinity follows
+                    self._note_tenant_decode(tenant, rep.rid)
+                return rep
+            self._check_deadline(deadline, tenant)
             if time.monotonic() >= wait_until:
                 raise self._shed(
                     "failover",
                     f"disagg router {self.router_id}: no live decode "
-                    f"replica after {self.failover_wait_s:.0f}s")
+                    f"replica after {self.failover_wait_s:.0f}s",
+                    tenant)
             time.sleep(0.25)
 
     def generate(self, prompt_tokens, max_new_tokens: int,
@@ -1290,7 +1591,8 @@ class DisaggRouter:
                  timeout_s: float = 120.0,
                  deadline_s: Optional[float] = None,
                  on_first_token=None,
-                 token_sleep_s: float = 0.0) -> List[int]:
+                 token_sleep_s: float = 0.0,
+                 tenant: Optional[str] = None) -> List[int]:
         """One request end-to-end. `on_first_token()` (optional) fires
         the moment the first token exists — at prefill completion under
         disaggregation — which is what the harness's TTFT measures.
@@ -1301,59 +1603,108 @@ class DisaggRouter:
         request sheds with cause ``deadline`` instead of occupying a
         slot forever.
 
+        `tenant` (multi-tenant LoRA, serve/lora.py): serve the request
+        under that tenant's adapter — tenant-affinity placement,
+        (tenant, prompt)-keyed prefix cache, per-tenant shed/SLO/
+        latency counters. Defaults to the current serve request's
+        multiplexed-model-id (serve/multiplex.py), so a multiplexed
+        deployment is tenant-tagged with no extra plumbing.
+
         The failover invariant: once this method ADMITS a request, it
         either returns the complete token list — bit-identical to an
         uninterrupted greedy run, surviving any single tier-replica
         death via bounded replay — or raises a RequestShedError with an
         attributed cause. It never silently drops."""
+        if tenant is None and self._lora_enabled():
+            # the implicit multiplexed-model-id default applies ONLY to
+            # LoRA-enabled deployments: a plain multiplexed deployment
+            # routing through a pool-less router must keep working
+            # exactly as before (an EXPLICIT tenant= on a pool-less
+            # tier still fails loudly — that is a misconfiguration)
+            from .multiplex import request_tenant
+
+            tenant = request_tenant()
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         deadline = (None if deadline_s is None
                     else time.perf_counter() + float(deadline_s))
-        self._check_deadline(deadline)  # arrived already expired
+        self._check_deadline(deadline, tenant)  # arrived already expired
         # rep_box[0] is the decode replica currently holding this
         # request's reservation — failover swaps it, and release-on-
         # exit must decrement whichever replica holds it NOW (releasing
         # the original after a swap would steal another request's
         # reservation and leak the survivor's)
-        rep_box = [self._admit_or_shed()]
+        rep_box = [self._admit_or_shed(tenant)]
         t_admit = time.perf_counter()
         ok = False
         try:
             if not self._disagg_mode:
                 out = self._generate_colocated(
                     prompt, max_new_tokens, eos_token, timeout_s,
-                    deadline, on_first_token, token_sleep_s, t_admit)
+                    deadline, on_first_token, token_sleep_s, t_admit,
+                    tenant)
             else:
                 out = self._generate_disagg(
                     rep_box, prompt, max_new_tokens, eos_token,
                     timeout_s, deadline, on_first_token, token_sleep_s,
-                    t_admit)
+                    t_admit, tenant)
             ok = True
             return out
         finally:
-            self._complete(rep_box[0], ok)
+            self._complete(rep_box[0], ok, tenant=tenant,
+                           wall_ms=(time.perf_counter() - t_admit)
+                           * 1e3)
+
+    def _record_tenant_ttft(self, tenant: Optional[str],
+                            ttft_ms: float) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            rec = self._tenant_rec_locked(tenant)
+            rec["ttft"].add(ttft_ms)
+            if ttft_ms > self._tenant_slo_ms:
+                rec["slo_misses"] += 1
 
     def _generate_colocated(self, prompt, max_new_tokens, eos_token,
                             timeout_s, deadline, on_first_token,
-                            token_sleep_s, t_admit) -> List[int]:
+                            token_sleep_s, t_admit,
+                            tenant=None) -> List[int]:
+        try:
+            stream = self._colocated.stream(prompt, max_new_tokens,
+                                            eos_token,
+                                            timeout_s=timeout_s,
+                                            adapter_id=tenant)
+        except Exception as e:  # noqa: BLE001 — submit-time failure
+            if _is_pool_exhausted(e):
+                raise self._shed_pool_exhausted("colocated", tenant,
+                                                e) from e
+            raise
         out: List[int] = []
-        for tok in self._colocated.stream(prompt, max_new_tokens,
-                                          eos_token,
-                                          timeout_s=timeout_s):
-            if not out:
-                self._ttft_win.add(
-                    (time.perf_counter() - t_admit) * 1e3)
-                if on_first_token is not None:
-                    on_first_token()
-            out.append(tok)
-            if token_sleep_s > 0:
-                time.sleep(token_sleep_s)
-            self._check_deadline(deadline)
+        try:
+            for tok in stream:
+                if not out:
+                    ttft = (time.perf_counter() - t_admit) * 1e3
+                    self._ttft_win.add(ttft)
+                    self._record_tenant_ttft(tenant, ttft)
+                    if on_first_token is not None:
+                        on_first_token()
+                out.append(tok)
+                if token_sleep_s > 0:
+                    time.sleep(token_sleep_s)
+                self._check_deadline(deadline, tenant)
+        except RequestShedError:
+            # deadline shed mid-stream: cancel the engine slot so the
+            # abandoned request stops burning ticks (freed + pins
+            # released at the next tick boundary)
+            cancel = getattr(self._colocated, "cancel_slot", None)
+            if callable(cancel):
+                cancel(stream)
+            raise
         return out
 
     def _generate_disagg(self, rep_box, prompt, max_new_tokens,
                          eos_token, timeout_s, deadline, on_first_token,
-                         token_sleep_s, t_admit) -> List[int]:
+                         token_sleep_s, t_admit,
+                         tenant=None) -> List[int]:
         """The failover loop. `history` holds every token delivered so
         far; a replay prefills prompt+history (a suffix-only prefill
         thanks to the prefix cache — the dead replica's tokens EXTEND
@@ -1369,7 +1720,7 @@ class DisaggRouter:
         while True:
             rep = rep_box[0]
             attempt += 1
-            self._check_deadline(deadline)
+            self._check_deadline(deadline, tenant)
             remaining = max_new_tokens - len(history)
             if remaining <= 0:
                 return history  # died between last token and DONE
@@ -1386,18 +1737,23 @@ class DisaggRouter:
             # ---- prefill phase (retryable: nothing emitted from rec
             # until decode pulls it)
             pf = self._pick_prefill_or_wait(replay, rep.machine,
-                                            deadline)
+                                            deadline, tenant)
             with self._lock:
                 self._pf_inflight += 1
                 pf.inflight += 1
             self._pf_inflight_win.add(self._pf_inflight)
             try:
                 rec = self._tier_call(pf, "prefill", "prefill",
-                                      replay.tolist())
+                                      replay.tolist(), tenant)
             except Exception as e:  # noqa: BLE001 — dead or broken
+                if _is_pool_exhausted(e):
+                    raise self._shed_pool_exhausted("prefill", tenant,
+                                                    e) from e
+                self._check_request_fault(tenant, e)
                 fail_detected = time.perf_counter()
                 had_failover = True
-                self._attempt_failed("prefill", pf.rid, attempt, e)
+                self._attempt_failed("prefill", pf.rid, attempt, e,
+                                     tenant)
                 continue
             finally:
                 with self._lock:
@@ -1410,8 +1766,9 @@ class DisaggRouter:
                     # the recent window (and the policy's queueing-
                     # delay signal) reads
                     first_emitted = True
-                    self._ttft_win.add(
-                        (time.perf_counter() - t_admit) * 1e3)
+                    ttft = (time.perf_counter() - t_admit) * 1e3
+                    self._ttft_win.add(ttft)
+                    self._record_tenant_ttft(tenant, ttft)
                     self._cache_win.add(
                         _OUTCOME_WEIGHT.get(rec.get("outcome"), 0.0))
                     if on_first_token is not None:
@@ -1463,7 +1820,7 @@ class DisaggRouter:
                             self.publish_servefault()
                         return history
                     try:
-                        self._check_deadline(deadline)
+                        self._check_deadline(deadline, tenant)
                     except RequestShedError:
                         # abandon the stream: the engine frees the slot
                         # on its own; the transfer is still acked so
@@ -1483,6 +1840,15 @@ class DisaggRouter:
             except RequestShedError:
                 raise
             except Exception as e:  # noqa: BLE001 — death or stall
+                if _is_pool_exhausted(e):
+                    self._ack_transfer(pf, rec)
+                    raise self._shed_pool_exhausted("decode", tenant,
+                                                    e) from e
+                try:
+                    self._check_request_fault(tenant, e)
+                except ValueError:
+                    self._ack_transfer(pf, rec)
+                    raise
                 fail_detected = time.perf_counter()
                 had_failover = True
                 if hid is not None:
@@ -1495,8 +1861,10 @@ class DisaggRouter:
                     except Exception:  # noqa: BLE001 — replica dead
                         pass
                 self._ack_transfer(pf, rec)
-                self._attempt_failed("decode", rep.rid, attempt, e)
-                rep_box[0] = self._reserve_survivor(rep, deadline)
+                self._attempt_failed("decode", rep.rid, attempt, e,
+                                     tenant)
+                rep_box[0] = self._reserve_survivor(rep, deadline,
+                                                    tenant)
                 continue
 
     # ------------------------------------------------------------ telemetry
@@ -1554,6 +1922,13 @@ class DisaggRouter:
         if s["shm_affinity_total"]:
             s["shm_affinity_hit_rate"] = round(
                 s["shm_affinity_hits"] / s["shm_affinity_total"], 4)
+        if s["tenant_affinity_total"]:
+            s["tenant_affinity_hit_rate"] = round(
+                s["tenant_affinity_hits"] / s["tenant_affinity_total"],
+                4)
+        tenants = self.tenant_stats()
+        if tenants:
+            s["tenants"] = tenants
         # recent trailing-window summaries beside the lifetime counters
         # (`serve status`/CLI show both; the autoscale policy reads the
         # same derivation through signals())
@@ -1565,12 +1940,50 @@ class DisaggRouter:
         }
         return s
 
+    def tenant_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant isolated counters (dispatched/completed/shed by
+        cause/SLO misses + recent TTFT/latency windows) — the router's
+        contribution to the lora surface, and the bench's isolation
+        evidence."""
+        with self._lock:
+            out: Dict[str, Dict[str, Any]] = {}
+            for t, rec in self._tenant_stats.items():
+                out[t] = {
+                    "dispatched": rec["dispatched"],
+                    "completed": rec["completed"],
+                    "shed": rec["shed"],
+                    "sheds_by_cause": dict(rec["sheds_by_cause"]),
+                    "slo_misses": rec["slo_misses"],
+                    "ttft_ms": rec["ttft"].summary(),
+                    "latency_ms": rec["latency"].summary(),
+                }
+        return out
+
     def publish_telemetry(self, force: bool = False) -> None:
         now = time.monotonic()
         if not force and now - self._last_push < 0.5:
             return
         self._last_push = now
         _push_stats(self.router_id, self.stats())
+        tenants = self.tenant_stats()
+        if tenants:
+            # the router's tenant counters ride the lora surface too,
+            # beside the pools' paging stats (one aggregate, every
+            # surface reads the same numbers)
+            w = _worker()
+            if w is not None:
+                try:
+                    w.conductor.notify(
+                        "report_lora_stats", w.worker_id,
+                        self.router_id,
+                        {"role": "router", "router_id": self.router_id,
+                         "tenant_affinity_hits":
+                             self._stats["tenant_affinity_hits"],
+                         "tenant_affinity_total":
+                             self._stats["tenant_affinity_total"],
+                         "tenants": tenants})
+                except Exception:  # noqa: BLE001 — shutting down
+                    pass
 
     def servefault_stats(self) -> Dict[str, Any]:
         """The fault-tolerance snapshot this router contributes to the

@@ -22,6 +22,20 @@ def get_multiplexed_model_id() -> str:
     return get_request_context().multiplexed_model_id
 
 
+def request_tenant() -> Optional[str]:
+    """The current serve request's multiplexed-model-id, reused as the
+    multi-tenant LoRA tenant tag (serve/lora.py): a deployment that
+    already routes per-model via ``@serve.multiplexed`` gets per-tenant
+    adapter serving with no new request plumbing —
+    ``DisaggRouter.generate`` defaults its ``tenant=`` to this. None
+    outside a request context or when the request carries no id."""
+    try:
+        mid = get_request_context().multiplexed_model_id
+    except Exception:  # noqa: BLE001 — no request context here
+        return None
+    return mid or None
+
+
 class _ModelCache:
     def __init__(self, loader: Callable[[Any, str], Any], max_models: int):
         self._loader = loader

@@ -185,6 +185,19 @@ def disagg_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def lora_status() -> Dict[str, Any]:
+    """Multi-tenant LoRA serving view (serve/lora.py): per-pool
+    adapter-paging snapshots (slots, residents, hits/misses/evictions/
+    hot-swaps, page-in bytes), per-router tenant request counters
+    (dispatched/completed/shed/SLO misses with recent TTFT/latency
+    windows), a per-tenant rollup, and cluster totals. The CLI analog
+    is `python -m ray_tpu lora`; the dashboard serves it at
+    /api/lora; page_in/evict/swap markers ride the merged timeline's
+    `lora` lane."""
+    return _conductor().conductor.call("get_lora_status",
+                                      timeout=10.0)
+
+
 def servefault_status() -> Dict[str, Any]:
     """Serving-plane fault-tolerance view (serve/disagg.py failover +
     serve/autoscale.py self-healing): per-router failover counts by

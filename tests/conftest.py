@@ -77,6 +77,14 @@ def pytest_configure(config):
         "on CPU, cluster tests run on a module-scoped cluster with "
         "log_to_driver=0 — select with `-m servefault`")
     config.addinivalue_line(
+        "markers", "lora: multi-tenant LoRA serving scenarios "
+        "(serve/lora.py paged adapter pool + cross-tenant batched "
+        "decode + tenant-aware routing): pool refcount/LRU units, "
+        "mixed-batch and base-slot bit-identity, tenant KV isolation, "
+        "hot-swap and page-in no-stall checks; everything is "
+        "tier-1-safe on CPU, cluster tests run on a module-scoped "
+        "log_to_driver=0 cluster — select with `-m lora`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "

@@ -147,6 +147,65 @@ def test_unsupervised_actor_call_rule_fires():
             if f.rule == "unsupervised-actor-call"] == []
 
 
+def test_lora_modules_are_lint_covered():
+    """Multi-tenant LoRA serving (serve/lora.py, online/lora.py) and
+    the modules it rewired (models/engine.py, serve/disagg.py,
+    bench_serve.py) are inside the self-lint set and carry zero error
+    findings — and zero unkeyed-tenant-cache findings after
+    suppressions (every prefix-cache lookup in lora-aware code passes
+    the tenant namespace)."""
+    for rel in (os.path.join("serve", "lora.py"),
+                os.path.join("online", "lora.py"),
+                os.path.join("models", "engine.py"),
+                os.path.join("serve", "disagg.py"),
+                "bench_serve.py"):
+        path = os.path.join(PACKAGE_ROOT, rel)
+        assert os.path.exists(path), rel
+        findings = lint_path(path)
+        assert errors(findings) == [], rel
+        unkeyed = [f for f in findings
+                   if f.rule == "unkeyed-tenant-cache"]
+        assert unkeyed == [], (rel, [str(f) for f in unkeyed])
+
+
+def test_unkeyed_tenant_cache_rule_fires():
+    """The rule catches a seeded violation: a LoRA-aware module (it
+    imports from serve.lora) doing a tenant-blind prefix-cache lookup
+    — and honors suppressions, namespace= keywords, and stays silent
+    in modules without serve.lora in scope."""
+    from ray_tpu.analysis.astlint import lint_source
+
+    src = (
+        "from ray_tpu.serve.lora import AdapterPool\n"
+        "def bad(kv_cache, toks):\n"
+        "    return kv_cache.lookup(toks, max_tokens=7)\n"
+        "def bad2(self, toks):\n"
+        "    return self.kv_cache.lookup(toks, max_tokens=7)\n"
+        "def fine(kv_cache, toks, tenant):\n"
+        "    return kv_cache.lookup(toks, max_tokens=7, "
+        "namespace=tenant)\n"
+        "def unrelated(registry):\n"
+        "    return registry.lookup('x')  # not a cache receiver\n"
+    )
+    found = [f for f in lint_source(src, "seeded.py")
+             if f.rule == "unkeyed-tenant-cache"]
+    assert len(found) == 2, [str(f) for f in found]
+    assert all(f.severity == "info" for f in found)
+    # a justified suppression silences it
+    suppressed = src.replace(
+        "return kv_cache.lookup(toks, max_tokens=7)",
+        "return kv_cache.lookup(toks, max_tokens=7)"
+        "  # shardlint: disable=unkeyed-tenant-cache")
+    left = [f for f in lint_source(suppressed, "seeded.py")
+            if f.rule == "unkeyed-tenant-cache"]
+    assert len(left) == 1
+    # ...and the rule is inert without serve.lora in scope
+    other = ("def f(kv_cache, toks):\n"
+             "    return kv_cache.lookup(toks, max_tokens=7)\n")
+    assert [f for f in lint_source(other, "other.py")
+            if f.rule == "unkeyed-tenant-cache"] == []
+
+
 def test_driver_entry_is_clean_too():
     repo_root = os.path.dirname(PACKAGE_ROOT)
     entry = os.path.join(repo_root, "__graft_entry__.py")
