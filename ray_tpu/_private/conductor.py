@@ -1624,7 +1624,9 @@ class ConductorHandler:
         "lookups", "hits", "partial_hits", "misses", "reused_tokens",
         "prefilled_tokens", "spliced_tokens", "inserted_blocks",
         "evictions", "cow_copies", "invalidations", "admitted",
-        "prefill_admitted", "adopted", "prefill_calls")
+        "prefill_admitted", "adopted", "prefill_calls",
+        "spec_proposed", "spec_accepted", "spec_verify_ticks",
+        "spec_emitted_tokens")
 
     def report_kvcache_stats(self, worker_id: str, engine_id: str,
                              stats: Dict[str, Any]) -> None:
@@ -1652,6 +1654,33 @@ class ConductorHandler:
         totals["token_reuse_rate"] = (totals["reused_tokens"] / seen
                                       if seen else 0.0)
         return {"engines": engines, "totals": totals}
+
+    def get_speculation_stats(self) -> Dict[str, Any]:
+        """The speculative-decoding slice of the kvcache snapshots
+        (engines embed their spec counters in the same kv_stats push —
+        ONE report channel, so util.state.speculation_stats(),
+        `ray_tpu speculate`, /api/speculation, and Prometheus can never
+        disagree with the kvcache surface). Engines that never enabled
+        speculation are filtered out of `engines` but an all-zero
+        totals dict is still returned."""
+        with self._lock:
+            snaps = {k: dict(v) for k, v in self._kvcache_stats.items()}
+        engines = {k: {
+            "engine_id": v.get("engine_id"),
+            "speculate_k": v.get("speculate_k", 0),
+            "spec_proposed": v.get("spec_proposed", 0),
+            "spec_accepted": v.get("spec_accepted", 0),
+            "spec_verify_ticks": v.get("spec_verify_ticks", 0),
+            "spec_emitted_tokens": v.get("spec_emitted_tokens", 0),
+            "acceptance_rate": v.get("acceptance_rate", 0.0),
+            "tokens_per_verify": v.get("tokens_per_verify", 0.0),
+            "kv_int8": v.get("kv_int8", False),
+            "ts": v.get("ts"),
+        } for k, v in snaps.items() if v.get("speculate_k")}
+        from ray_tpu.util.state import speculation_totals
+
+        return {"engines": engines,
+                "totals": speculation_totals(engines)}
 
     def report_kvcache_event(self, event: Dict[str, Any]) -> None:
         """Prefix-hit / evict / invalidate instant markers for the

@@ -34,6 +34,16 @@ so broken or dependency-heavy modules still lint):
   never serve another tenant's request; a tenant-blind lookup in a
   multi-tenant code path silently reintroduces exactly that leak.
 
+- undonated-pool-write (warning): a write into a pool-named device
+  stack — ``<pool>.at[...].set/add(...)`` or
+  ``dynamic_update_slice(<pool>, ...)`` — OUTSIDE a function jitted
+  with ``donate_argnums``. The repo's pool discipline
+  (models/kvcache.py, serve/lora.py) is that every mutation of a
+  ``[L, num_blocks, ...]`` / ``[slots, ...]``-shaped pool goes through
+  a donated jit so XLA updates O(row) in place; an undonated write
+  copies the WHOLE pool per call — invisible at toy sizes, wrong at
+  64-slot x 32-layer production scale.
+
 Suppression: append `# shardlint: ok` to the flagged line, or
 `# shardlint: disable=<rule-id>` to suppress one rule on that line.
 """
@@ -357,6 +367,72 @@ def _lint_unkeyed_tenant_cache(tree: ast.AST, aliases: _Aliases,
     return findings
 
 
+# --------------------------------------------------- undonated-pool-write
+
+
+def _is_donating_jit(dec: ast.AST, aliases: _Aliases) -> bool:
+    """True for decorators that jit WITH donation:
+    ``functools.partial(jax.jit, donate_argnums=...)`` or
+    ``jax.jit(..., donate_argnums=...)`` (donate_argnames counts)."""
+    if not isinstance(dec, ast.Call) or not _is_jax_jit(dec, aliases):
+        return False
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in dec.keywords)
+
+
+def _mentions_pool(expr: ast.AST) -> bool:
+    """The receiver's dotted/subscripted chain names a pool
+    (``self._pool_k``, ``pool_k``, ``pools["a"]``) — the shapes a
+    device block/adapter pool takes in this tree."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        if isinstance(expr, ast.Attribute):
+            if "pool" in expr.attr.lower():
+                return True
+        expr = expr.value
+    return isinstance(expr, ast.Name) and "pool" in expr.id.lower()
+
+
+def _lint_undonated_pool_write(tree: ast.AST, aliases: _Aliases,
+                               path: str) -> List[Finding]:
+    donated: Set[int] = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) and any(
+                _is_donating_jit(d, aliases) for d in fn.decorator_list):
+            donated.update(id(n) for n in ast.walk(fn))
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in donated:
+            continue
+        f = node.func
+        # <pool>.at[...].set(...) / .add(...): a copying scatter update
+        if isinstance(f, ast.Attribute) and f.attr in ("set", "add") \
+                and isinstance(f.value, ast.Subscript) \
+                and isinstance(f.value.value, ast.Attribute) \
+                and f.value.value.attr == "at" \
+                and _mentions_pool(f.value.value.value):
+            findings.append(Finding(
+                "undonated-pool-write", WARNING, f"{path}:{node.lineno}",
+                f"pool write via .at[...].{f.attr}() outside a donated "
+                "jit copies the whole pool per call",
+                "route the write through a donated-jit helper "
+                "(donate_argnums on the pool) dispatched under the "
+                "pool lock — the models/kvcache.py write discipline"))
+            continue
+        # dynamic_update_slice(<pool>, ...): same copy, lax spelling
+        is_dus = (isinstance(f, ast.Attribute)
+                  and f.attr == "dynamic_update_slice") or (
+            isinstance(f, ast.Name) and f.id == "dynamic_update_slice")
+        if is_dus and node.args and _mentions_pool(node.args[0]):
+            findings.append(Finding(
+                "undonated-pool-write", WARNING, f"{path}:{node.lineno}",
+                "dynamic_update_slice on a pool outside a donated jit "
+                "copies the whole pool per call",
+                "wrap the update in a donated-jit helper "
+                "(donate_argnums on the pool) so XLA lowers it to an "
+                "in-place O(row) write"))
+    return findings
+
+
 # ---------------------------------------------------------------- drivers
 
 
@@ -372,6 +448,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     findings += _lint_host_sync_in_jit(tree, aliases, path)
     findings += _lint_unsupervised_actor_call(tree, aliases, path)
     findings += _lint_unkeyed_tenant_cache(tree, aliases, path)
+    findings += _lint_undonated_pool_write(tree, aliases, path)
     if not findings:
         return findings
     suppressed = _suppressions(source)

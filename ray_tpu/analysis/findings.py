@@ -48,6 +48,9 @@ RULES: Dict[str, str] = {
     "unkeyed-tenant-cache":
         "prefix-cache lookup in LoRA-aware code without the tenant in "
         "the key (one tenant's cached KV could serve another)",
+    "undonated-pool-write":
+        "write into a pool-shaped device stack outside a donated jit "
+        "(copies the whole pool per write instead of O(row) in place)",
     "host-sync-in-jit":
         "host synchronization (.item() / device_get / print) inside a "
         "jitted function",

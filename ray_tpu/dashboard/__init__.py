@@ -23,6 +23,11 @@ Routes:
   /api/kvcache           paged KV prefix cache: per-engine stats +
                          totals (hit rates, pool utilization) and
                          recent prefix-hit/evict events
+  /api/speculation       speculative decoding: per-engine draft
+                         proposal/acceptance counters, tokens-per-
+                         verify, int8-KV flag, and the kvcache lane's
+                         spec_accept/spec_reject marker slice
+                         (models/engine.py)
   /api/pipeline          MPMD pipelines: stage registry + per-stage
                          bubble fraction / channel bytes and recent
                          pipeline events (ray_tpu.mpmd)
@@ -171,6 +176,20 @@ class _ClusterData:
         try:
             out["events"] = self.conductor.call("get_kvcache_events",
                                                 100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
+    def speculation(self) -> Dict[str, Any]:
+        """Speculative-decoding aggregate + the kvcache lane's
+        spec_accept/spec_reject marker slice (one payload so the SPA's
+        panel needs a single fetch)."""
+        out = self.conductor.call("get_speculation_stats", timeout=10.0)
+        try:
+            events = self.conductor.call("get_kvcache_events", 10_000,
+                                         timeout=5.0)
+            out["events"] = [e for e in events if str(
+                e.get("kind", "")).startswith("spec_")][-100:]
         except Exception:  # noqa: BLE001 — older conductor
             out["events"] = []
         return out
@@ -364,6 +383,8 @@ class DashboardServer:
             "/api/weights",
             self._json_route(lambda: d.simple("get_weight_versions")))
         app.router.add_get("/api/kvcache", self._json_route(d.kvcache))
+        app.router.add_get("/api/speculation",
+                           self._json_route(d.speculation))
         app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
         app.router.add_get("/api/online", self._json_route(d.online))
         app.router.add_get("/api/disagg", self._json_route(d.disagg))

@@ -39,6 +39,32 @@ splices are cheap relative to prefills) and its own counters
 ``prefill_admitted`` / ``max_prefills_admitted_per_tick``), so the
 kvcache CLI/dashboard numbers stay truthful in both modes.
 
+Speculative decoding (``speculate_k`` / ``RAY_TPU_SPECULATE_K``): the
+tick loop above is one-token-per-step per slot — serving throughput
+pinned to sequential forward passes even though the verify side is
+embarrassingly batchable. With speculation on, a PROMPT-LOOKUP
+proposer drafts up to k tokens per slot between ticks (no draft model,
+no extra compile): first from the paged prefix index's exact token
+chains (``PagedKVCache.propose`` — drafting from cache is nearly
+free), then from the most recent match of the slot's own trailing
+n-gram in its context. The engine then verifies all k in ONE batched
+forward — ``_tick`` is shape-polymorphic from seqlen-1 to seqlen-(k+1)
+per slot (the model families' ``*_decode`` take tokens [B] or
+[B, k+1] with per-slot base positions) — and accepts the longest
+prefix of the draft that agrees with the greedy argmax chain. Greedy
+bit-identity to the unspeculated engine is the correctness oracle: an
+accepted token IS the token sequential decode would have produced, and
+a rejected draft's KV rows need no copy-back — per-position masking
+keeps them invisible until the real decode overwrites them, and the
+only pooled state drafting touches is read-only (proposals pin
+nothing; the request's block refcounts alone govern pool reclamation,
+so rejection rolls back by refcount, never by copy).
+Surfaces: ``util.state.speculation_stats()``, ``ray_tpu speculate``,
+``/api/speculation``, lazy Prometheus
+(``ray_tpu_spec_proposed_total`` / ``_accepted_total`` /
+``ray_tpu_spec_acceptance_rate``), and spec_accept / spec_reject
+instant markers in the merged timeline's kvcache lane.
+
 Per-request token queues make it the natural producer for Serve's
 streaming path; `ContinuousBatchingEngine` is thread-safe for
 concurrent submit/iterate from replica request threads. The streamed
@@ -53,7 +79,8 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +91,50 @@ from .kvcache import PagedKVCache, resolve_pool_config
 
 _DONE = object()
 _ENGINE_SEQ = itertools.count()
+_SPEC_EVENTS_KEPT = 512
+
+
+def default_speculate_k() -> int:
+    """The ``RAY_TPU_SPECULATE_K`` env default (0 = speculation off)
+    every engine owner resolves through."""
+    try:
+        return max(0, int(os.environ.get("RAY_TPU_SPECULATE_K", "0")))
+    except ValueError:
+        return 0
+
+
+# ----------------------------------------------------- prometheus (lazy)
+# Created on first speculating engine, never at import (the kvcache /
+# lora pattern — rebound ONCE to a complete dict).
+
+_spec_metrics: Optional[Dict[str, Any]] = None
+_spec_metrics_lock = threading.Lock()
+
+
+def spec_metrics() -> Dict[str, Any]:
+    global _spec_metrics
+    m = _spec_metrics
+    if m is not None:
+        return m
+    with _spec_metrics_lock:
+        if _spec_metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            _spec_metrics = dict(
+                proposed=Counter(
+                    "ray_tpu_spec_proposed_total",
+                    "draft tokens proposed to the verify pass"),
+                accepted=Counter(
+                    "ray_tpu_spec_accepted_total",
+                    "draft tokens accepted (greedy-agreeing prefix)"),
+                acceptance_rate=Gauge(
+                    "ray_tpu_spec_acceptance_rate",
+                    "lifetime accepted/proposed draft-token ratio per "
+                    "engine (counters are process-global; the gauge is "
+                    "engine-tagged so co-resident engines can't "
+                    "last-writer-wins each other)",
+                    tag_keys=("engine",)))
+    return _spec_metrics
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -190,9 +261,18 @@ def _splice_slot(cache, ck, cv, slot, config, plen):
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(2,))
 def _tick(params, config, cache, tokens, pos_vec):
+    """One decode step — shape-polymorphic over the token axis:
+    tokens [B] is the classic one-token tick; tokens [B, k+1] is the
+    speculative VERIFY pass (column 0 each slot's last token, columns
+    1..k its drafted continuation — slots with a shorter/no draft pad
+    by repeating column 0; padded rows are never accepted and their
+    KV rows stay masked until overwritten). jit specializes per shape,
+    and the verify's row j is bit-identical to j sequential one-token
+    ticks — the accept rule's whole contract, shared math by
+    construction because this IS the same function."""
     logits, cache = _model_fns(config)[2](params, tokens, config, cache,
                                           pos_vec)
-    live = logits[:, :config.vocab_size].astype(jnp.float32)
+    live = logits[..., :config.vocab_size].astype(jnp.float32)
     nxt = jnp.argmax(live, axis=-1).astype(jnp.int32)
     # per-slot logprob of the chosen (greedy = max-logit) token — the
     # rollout score stream (ray_tpu.online samplers record it per token)
@@ -210,10 +290,12 @@ def _tick_lora(params, config, cache, tokens, pos_vec, lora):
     scale 0) compute a bit-identical base-only step, so mixed batches
     never perturb base traffic. Chosen over `_tick` only when a live
     slot actually holds an adapter; pool shapes are static, so this is
-    ONE extra compiled program per engine."""
+    ONE extra compiled program per engine. Shape-polymorphic like
+    `_tick`: tokens [B, k+1] is the speculative verify pass, with the
+    adapter deltas applied at every position."""
     logits, cache = _model_fns(config)[2](params, tokens, config, cache,
                                           pos_vec, lora)
-    live = logits[:, :config.vocab_size].astype(jnp.float32)
+    live = logits[..., :config.vocab_size].astype(jnp.float32)
     nxt = jnp.argmax(live, axis=-1).astype(jnp.int32)
     lp = jnp.max(live, axis=-1) - jax.nn.logsumexp(live, axis=-1)
     return cache, nxt, lp
@@ -252,6 +334,24 @@ class _Request:
         # per-token logprob of each emitted token (same order as the
         # token stream) — the rollout score channel
         self.scores: List[float] = []
+        # the KNOWN token context (prompt + emitted) the speculative
+        # proposer drafts from — empty for adoptions whose transfer
+        # didn't carry the prompt (drafting then waits for history).
+        # ctx_has_prompt marks that ctx[:plen] really IS the prompt:
+        # the output-memory key is (adapter, prompt), and a promptless
+        # adoption's first plen EMITTED tokens must neither store under
+        # nor match such a key (it would evict genuine hot-prompt
+        # chains from the capped LRU)
+        self.ctx: List[int] = []
+        self.ctx_has_prompt = False
+        # incremental n-gram index over ctx for the self-lookup draft
+        # fallback: {n-gram tuple: latest start position of an
+        # occurrence ending BEFORE the current tail}. Amortized O(1)
+        # per emitted token — a per-tick backward rescan would be
+        # O(len(ctx)^2) over a long generation. `ng_indexed` = ctx
+        # positions whose ending n-grams are already in.
+        self.ngram_last: Dict[tuple, int] = {}
+        self.ng_indexed = 0
         # multi-tenant LoRA (serve/lora.py): the tenant tag and its
         # pinned adapter-pool slot (0 = the null/base adapter)
         self.adapter_id: Optional[str] = None
@@ -308,7 +408,11 @@ class ContinuousBatchingEngine:
                  kv_pool_blocks: Optional[int] = None,
                  max_prefills_per_tick: Optional[int] = None,
                  max_adoptions_per_tick: Optional[int] = None,
-                 lora_pool: Optional[Any] = None):
+                 lora_pool: Optional[Any] = None,
+                 speculate_k: Optional[int] = None,
+                 draft_source: Optional[Callable[[List[int], int],
+                                                 List[int]]] = None,
+                 kv_int8: Optional[bool] = None):
         # config: any family _model_fns knows (LlamaConfig, GPT2Config)
         self.params = params
         self.config = config
@@ -338,12 +442,43 @@ class ContinuousBatchingEngine:
             max_adoptions_per_tick = int(os.environ.get(
                 "RAY_TPU_MAX_ADOPTIONS_PER_TICK", "4"))
         self.max_adoptions_per_tick = max(1, int(max_adoptions_per_tick))
+        if kv_int8 is None:
+            from .kvcache import kv_int8_default
+
+            kv_int8 = kv_int8_default()
+        self.kv_int8 = bool(kv_int8)
         block_size, pool_blocks = resolve_pool_config(
-            config, kv_block_size, kv_pool_blocks, slots=max_batch)
+            config, kv_block_size, kv_pool_blocks, slots=max_batch,
+            int8=self.kv_int8)
         self.kv_cache: Optional[PagedKVCache] = (
             PagedKVCache(config, block_size=block_size,
-                         num_blocks=pool_blocks)
+                         num_blocks=pool_blocks, int8=self.kv_int8)
             if prefix_cache else None)
+        # speculative decoding (module docstring): k drafted tokens per
+        # slot verified in one widened tick; 0 = the classic loop.
+        # `draft_source(ctx, k) -> tokens` overrides the prompt-lookup
+        # proposer (tests script full/partial/zero acceptance with it).
+        if speculate_k is None:
+            speculate_k = default_speculate_k()
+        self.speculate_k = max(0, int(speculate_k))
+        self.draft_source = draft_source
+        # cross-request output memory: greedy decode under fixed
+        # weights is a FUNCTION of (adapter, prompt), so a finished
+        # request's token chain is a near-perfect draft for the next
+        # request with the same prompt — the Zipf-hot-prompt case the
+        # serving replay is made of. Wrong-by-staleness entries cost
+        # acceptance, never correctness (the verify pass is the only
+        # accept authority); a weight swap clears it anyway.
+        self._output_memory: "OrderedDict[tuple, List[int]]" = \
+            OrderedDict()
+        self._output_memory_cap = 128
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_verify_ticks = 0
+        self.spec_emitted = 0       # tokens emitted by DRAFTING slots
+        self._spec_events: List[Dict[str, Any]] = []
+        if self.speculate_k:
+            spec_metrics()  # lazy registration before the first tick
         shape = self._cache[0]["k"].shape  # [maxB, S, H, hd]
         self._empty_prefix = jnp.zeros(
             (len(self._cache), 0) + shape[2:], self._cache[0]["k"].dtype)
@@ -413,6 +548,8 @@ class ContinuousBatchingEngine:
             rid = self._next_rid
             self._next_rid += 1
         req = _Request(rid, prompt, max_new_tokens, eos_token)
+        req.ctx = [int(t) for t in prompt[0]]
+        req.ctx_has_prompt = True
         req.adapter_id = adapter_id
         req.lora_slot = lora_slot
         self._pending.put(req)
@@ -443,6 +580,7 @@ class ContinuousBatchingEngine:
                       cache_outcome: Optional[str] = None,
                       reused_tokens: int = 0,
                       adapter_id: Optional[str] = None,
+                      prompt_tokens: Optional[List[int]] = None,
                       timeout_s: float = 120.0) -> TokenStream:
         """Adopt a prompt whose prefill ran ELSEWHERE (a disaggregated
         prefill replica): ``ck/cv [L, prompt_len, H, hd]`` are the
@@ -452,7 +590,12 @@ class ContinuousBatchingEngine:
         full-cache copy) and this engine NEVER runs a prefill program
         for the request, so a decode replica's `_prefill_paged` compile
         cache stays flat. Returns the request's TokenStream, whose
-        first yielded token is `first_token`."""
+        first yielded token is `first_token`. `prompt_tokens`
+        (optional) hands the speculative proposer the prompt's actual
+        tokens — the transfer record carries them under disaggregation
+        so decode-side drafting sees the same context the colocated
+        engine would; without them drafting starts from the emitted
+        history alone (correctness unaffected)."""
         plen = int(prompt_len)
         if plen < 1:
             raise ValueError("prompt_len must be >= 1")
@@ -497,6 +640,9 @@ class ContinuousBatchingEngine:
             self._next_rid += 1
         req = _Request(rid, np.zeros((1, plen), np.int32),
                        max_new_tokens, eos_token)
+        if prompt_tokens is not None:
+            req.ctx = [int(t) for t in prompt_tokens]
+            req.ctx_has_prompt = True
         req.cache_outcome = cache_outcome
         req.reused_tokens = int(reused_tokens)
         req.adapter_id = adapter_id
@@ -538,7 +684,10 @@ class ContinuousBatchingEngine:
         self.swap_count += 1
         # every cached block's KV was computed under the old weights:
         # drop the prefix index so no post-swap admission matches it
-        # (in-flight slots decode off their own slab copy, unaffected)
+        # (in-flight slots decode off their own slab copy, unaffected).
+        # The speculative output memory is stale the same way — keeping
+        # it would only burn verify width on rejected drafts.
+        self._output_memory.clear()
         if self.kv_cache is not None:
             self.kv_cache.invalidate()
             self.publish_kv_telemetry(force=True)
@@ -621,11 +770,32 @@ class ContinuousBatchingEngine:
             cancelled=self.cancelled,
             lora=self.lora_pool is not None,
         )
+        s.update(self.speculation_stats())
         if self.kv_cache is None:
             # uncached engines still account their prefill work
             s.setdefault("prefilled_tokens", self.prefilled_tokens)
             s.setdefault("reused_tokens", 0)
         return s
+
+    def speculation_stats(self) -> Dict[str, Any]:
+        """The speculative-decoding snapshot every surface reports —
+        embedded in kv_stats() so one conductor push feeds
+        util.state.speculation_stats(), `ray_tpu speculate`,
+        /api/speculation, and Prometheus with the same numbers."""
+        proposed = self.spec_proposed
+        ticks = self.spec_verify_ticks
+        return {
+            "speculate_k": self.speculate_k,
+            "spec_proposed": proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_verify_ticks": ticks,
+            "spec_emitted_tokens": self.spec_emitted,
+            "acceptance_rate": (self.spec_accepted / proposed
+                                if proposed else 0.0),
+            "tokens_per_verify": (self.spec_emitted / ticks
+                                  if ticks else 0.0),
+            "kv_int8": self.kv_int8,
+        }
 
     def publish_kv_telemetry(self, force: bool = False) -> None:
         """Best-effort push of kv_stats + pending timeline events to the
@@ -641,6 +811,7 @@ class ContinuousBatchingEngine:
         if w is None:
             if self.kv_cache is not None:
                 self.kv_cache.drain_events()  # keep the buffer bounded
+            self._drain_spec_events()
             return
         try:
             w.conductor.notify("report_kvcache_stats", w.worker_id,
@@ -649,10 +820,16 @@ class ContinuousBatchingEngine:
                 for ev in self.kv_cache.drain_events():
                     ev.setdefault("engine", self.engine_id)
                     w.conductor.notify("report_kvcache_event", ev)
+            # spec_accept/spec_reject markers ride the kvcache timeline
+            # lane — the engine buffers them itself because a decode
+            # replica (prefix cache disabled) has no kv_cache to carry
+            # events through
+            for ev in self._drain_spec_events():
+                w.conductor.notify("report_kvcache_event", ev)
         except Exception:  # noqa: BLE001 — cluster shutting down
             pass
 
-    # ------------------------------------------------------------ loop
+    # ------------------------------------------------------- admission
     def _admit(self) -> None:
         # adoptions first (disaggregated decode: splices, no prefill
         # program), then prefill admissions — each against its own
@@ -755,6 +932,17 @@ class ContinuousBatchingEngine:
         KV pins, and LoRA adapter pin (normal completion, admission-
         time cancel drop, and the tick-boundary cancel all share this
         one path so nothing is ever released twice)."""
+        if self.speculate_k and not req.cancelled \
+                and req.ctx_has_prompt:
+            plen = req.prompt.shape[1]
+            if len(req.ctx) > plen:
+                # remember (adapter, prompt) -> full greedy chain for
+                # the cross-request proposer (decode-loop-only state)
+                key = (req.adapter_id, tuple(req.ctx[:plen]))
+                self._output_memory[key] = list(req.ctx)
+                self._output_memory.move_to_end(key)
+                while len(self._output_memory) > self._output_memory_cap:
+                    self._output_memory.popitem(last=False)
         req.out.put(_DONE)
         slot = req.slot
         if slot is not None:
@@ -773,12 +961,161 @@ class ContinuousBatchingEngine:
                 self._cancels -= 1
 
     def _emit(self, req: _Request, tok: int, score: float = 0.0) -> None:
+        req.ctx.append(int(tok))
         req.scores.append(score)
         req.out.put(tok)
         req.produced += 1
         if (req.eos_token is not None and tok == req.eos_token) \
                 or req.produced >= req.max_new:
             self._finish(req)
+
+    # ------------------------------------------------------- speculation
+
+    def _propose(self, req: _Request, k: int) -> List[int]:
+        """Draft up to `k` tokens continuing `req.ctx` — the prompt-
+        lookup proposer: exact chains from the paged prefix index
+        first (nearly free; strongest when many requests share
+        prompts), then the most recent earlier occurrence of the
+        context's own trailing n-gram (decode loops repeat themselves).
+        Drafts pin nothing and may be arbitrarily wrong — the verify
+        pass is the only accept authority."""
+        if self.draft_source is not None:
+            return [int(t) for t in self.draft_source(req.ctx, k)][:k]
+        ctx = req.ctx
+        plen = req.prompt.shape[1]
+        if req.ctx_has_prompt and len(ctx) >= plen:
+            # cross-request memory first: a finished request with the
+            # SAME (adapter, prompt) decoded this exact greedy chain —
+            # acceptance is ~total unless the weights moved
+            mem = self._output_memory.get(
+                (req.adapter_id, tuple(ctx[:plen])))
+            if mem is not None and len(mem) > len(ctx) \
+                    and mem[:len(ctx)] == ctx:
+                return mem[len(ctx):len(ctx) + k]
+        if self.kv_cache is not None and req.adapter_id is None:
+            # tenant requests draft from history only: their chains
+            # live under a (tenant, version) namespace this loop does
+            # not re-derive per tick
+            draft = self.kv_cache.propose(ctx, k)
+            if draft:
+                return draft
+        # self n-gram lookup over the incremental index: fold in the
+        # n-grams ending at positions < L-1 (the tail's own occurrence
+        # must stay OUT of the index so a hit is always an EARLIER one)
+        ng = req.ngram_last
+        ll = len(ctx)
+        for end in range(req.ng_indexed, ll - 1):
+            for n in (2, 3):
+                if end + 1 >= n:
+                    start = end + 1 - n
+                    ng[tuple(ctx[start:end + 1])] = start
+        req.ng_indexed = max(req.ng_indexed, ll - 1)
+        for n in (3, 2):
+            if ll <= n:
+                continue
+            start = ng.get(tuple(ctx[-n:]))
+            if start is not None:
+                return ctx[start + n:start + n + k]
+        return []
+
+    def _collect_drafts(self) -> Dict[int, List[int]]:
+        drafts: Dict[int, List[int]] = {}
+        for slot, req in enumerate(self._slot_req):
+            if req is None or req.cancelled:
+                continue
+            # never draft past the request's budget: tokens beyond it
+            # would be verified and thrown away
+            budget = req.max_new - req.produced - 1
+            if budget <= 0:
+                continue
+            d = self._propose(req, min(self.speculate_k, budget))
+            if d:
+                drafts[slot] = d
+        return drafts
+
+    def _spec_event(self, ev: Dict[str, Any]) -> None:
+        ev.setdefault("ts", time.time())
+        ev.setdefault("engine", self.engine_id)
+        with self._lock:
+            self._spec_events.append(ev)
+            if len(self._spec_events) > _SPEC_EVENTS_KEPT:
+                del self._spec_events[:len(self._spec_events)
+                                      - _SPEC_EVENTS_KEPT]
+
+    def _drain_spec_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._spec_events = self._spec_events, []
+        return out
+
+    def _spec_tick(self, drafts: Dict[int, List[int]],
+                   lora_live: bool) -> None:
+        """One widened verify tick: feed [last_token, draft...] per
+        slot, emit the greedy chain's longest agreement. Slots without
+        a draft pad by repeating their last token — their column-0
+        output is bit-identical to the plain tick's, so mixed batches
+        cost one program and zero correctness."""
+        k = self.speculate_k
+        toks = np.repeat(self._tokens[:, None], k + 1, axis=1)
+        for slot, d in drafts.items():
+            toks[slot, 1:1 + len(d)] = d
+        tok_dev = jnp.asarray(toks)
+        pos_dev = jnp.asarray(self._pos)
+        if lora_live:
+            cache, nxt, lp = self.lora_pool.dispatch_tick(
+                lambda la: _tick_lora(
+                    self.params, self.config, self._cache, tok_dev,
+                    pos_dev, la),
+                self._slot_adapter)
+        else:
+            cache, nxt, lp = _tick(
+                self.params, self.config, self._cache, tok_dev, pos_dev)
+        self._cache = cache
+        nxt_np = np.asarray(nxt)
+        lp_np = np.asarray(lp)
+        self.spec_verify_ticks += 1
+        m = spec_metrics()
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            proposal = drafts.get(slot, ())
+            tok = int(nxt_np[slot, 0])
+            self._pos[slot] += 1
+            self._tokens[slot] = tok
+            self._emit(req, tok, float(lp_np[slot, 0]))
+            accepted = 0
+            for i, d in enumerate(proposal):
+                # accept d_i only while it equals the greedy chain's
+                # last token AND the request is still live (eos /
+                # budget finish must stop the stream exactly where the
+                # sequential engine would)
+                if req.finished or int(d) != tok:
+                    break
+                tok = int(nxt_np[slot, i + 1])
+                self._pos[slot] += 1
+                self._tokens[slot] = tok
+                self._emit(req, tok, float(lp_np[slot, i + 1]))
+                accepted += 1
+            if proposal:
+                # spec_emitted counts DRAFTING slots only, so
+                # tokens-per-verify measures the speculation gain (an
+                # undrafted slot's base token would make the metric
+                # scale with batch width, not acceptance)
+                self.spec_emitted += 1 + accepted
+                self.spec_proposed += len(proposal)
+                self.spec_accepted += accepted
+                m["proposed"].inc(len(proposal))
+                if accepted:
+                    m["accepted"].inc(accepted)
+                self._spec_event({
+                    "kind": "spec_accept" if accepted else "spec_reject",
+                    "rid": req.rid, "slot": slot,
+                    "proposed": len(proposal), "accepted": accepted})
+        if self.spec_proposed:
+            m["acceptance_rate"].set(
+                self.spec_accepted / self.spec_proposed,
+                tags={"engine": self.engine_id})
+
+    # ------------------------------------------------------------ loop
 
     def _loop(self) -> None:
         while not self._stopped.is_set():
@@ -788,11 +1125,20 @@ class ContinuousBatchingEngine:
             if all(r is None for r in self._slot_req):
                 self._stopped.wait(self.idle_sleep_s)
                 continue
-            if self.lora_pool is not None and self._slot_adapter.any():
-                cache, nxt, lp = _tick_lora(
-                    self.params, self.config, self._cache,
-                    jnp.asarray(self._tokens), jnp.asarray(self._pos),
-                    self.lora_pool.tick_args(self._slot_adapter))
+            lora_live = (self.lora_pool is not None
+                         and bool(self._slot_adapter.any()))
+            drafts = (self._collect_drafts() if self.speculate_k
+                      else {})
+            if drafts:
+                self._spec_tick(drafts, lora_live)
+                continue
+            if lora_live:
+                cache, nxt, lp = self.lora_pool.dispatch_tick(
+                    lambda la: _tick_lora(
+                        self.params, self.config, self._cache,
+                        jnp.asarray(self._tokens),
+                        jnp.asarray(self._pos), la),
+                    self._slot_adapter)
             else:
                 cache, nxt, lp = _tick(
                     self.params, self.config, self._cache,

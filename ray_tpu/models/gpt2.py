@@ -227,15 +227,18 @@ def _block_cached(x: jax.Array, p: Params, config: GPT2Config,
 def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
                   cache: Params, pos_vec: jax.Array,
                   lora: Optional[Dict[str, Any]] = None):
-    """Single-token decode with PER-SLOT positions (continuous
-    batching) — the GPT-2 analog of llama_block_decode.
+    """Ragged-batch decode with PER-SLOT positions (continuous
+    batching) — the GPT-2 analog of llama_block_decode. x [B, t, D];
+    pos_vec [B] is each slot's BASE position (t == 1: the classic
+    one-token tick; t == k+1: the speculative verify pass — see
+    llama_block_decode for the masking contract the oracle rests on).
 
     `lora` (optional, serve/lora.py mixed-tenant decode): this layer's
     per-slot adapter selection for the fused qkv projection —
     ``{"qkv": (a [B,D,r], b [B,r,3D]), "scale": [B]}`` — added to the
     base matmul; null-adapter slots add an exact-zero delta."""
     c = config
-    b = x.shape[0]
+    b, t = x.shape[0], x.shape[1]
     h = layer_norm(x, p["ln_1"]["scale"], p["ln_1"]["bias"])
     qkv = jnp.dot(h, p["attn"]["qkv"],
                   preferred_element_type=jnp.float32).astype(c.dtype)
@@ -245,21 +248,24 @@ def _block_decode(x: jax.Array, p: Params, config: GPT2Config,
         qkv = qkv + lora_delta(h, *lora["qkv"], lora["scale"])
     qkv = qkv + p["attn"]["qkv_b"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, 1, c.num_heads, c.head_dim)
-    k = k.reshape(b, 1, c.num_heads, c.head_dim)
-    v = v.reshape(b, 1, c.num_heads, c.head_dim)
+    q = q.reshape(b, t, c.num_heads, c.head_dim)
+    k = k.reshape(b, t, c.num_heads, c.head_dim)
+    v = v.reshape(b, t, c.num_heads, c.head_dim)
     rows = jnp.arange(b)
-    ck = cache["k"].at[rows, pos_vec].set(k[:, 0].astype(cache["k"].dtype))
-    cv = cache["v"].at[rows, pos_vec].set(v[:, 0].astype(cache["v"].dtype))
+    positions = pos_vec[:, None] + jnp.arange(t)[None, :]   # [B, t]
+    ck = cache["k"].at[rows[:, None], positions].set(
+        k.astype(cache["k"].dtype))
+    cv = cache["v"].at[rows[:, None], positions].set(
+        v.astype(cache["v"].dtype))
     s = ck.shape[1]
     scores = jnp.einsum("bthd,bshd->bhts", q, ck,
                         preferred_element_type=jnp.float32)
     scores = scores / (c.head_dim ** 0.5)
     col = jnp.arange(s)[None, None, None, :]
-    visible = col <= pos_vec[:, None, None, None]
+    visible = col <= positions[:, None, :, None]
     scores = jnp.where(visible, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    a = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(b, 1, c.d_model)
+    a = jnp.einsum("bhts,bshd->bthd", probs, cv).reshape(b, t, c.d_model)
     return _mlp_res(_attn_proj_res(x, a, p, c), p, c), {"k": ck, "v": cv}
 
 
@@ -267,11 +273,20 @@ def gpt2_decode(params: Params, tokens: jax.Array, config: GPT2Config,
                 cache: list, pos_vec: jax.Array,
                 lora: Optional[Dict[str, Any]] = None):
     """One decode step for a ragged batch: tokens [B] at per-slot
-    positions pos_vec [B]. `lora` (optional): adapter-pool stacks +
-    per-slot indices ``{"idx": [B], "scale": [P], "qkv": (a [P,L,D,r],
-    b [P,L,r,3D])}`` — see llama_decode for the contract."""
+    positions pos_vec [B] ([B, q] is the speculative verify form —
+    logits come back [B, q, padded_vocab]; see llama_decode). `lora`
+    (optional): adapter-pool stacks + per-slot indices ``{"idx": [B],
+    "scale": [P], "qkv": (a [P,L,D,r], b [P,L,r,3D])}`` — see
+    llama_decode for the contract."""
     c = config
-    x = params["wte"][tokens[:, None]] + params["wpe"][pos_vec][:, None]
+    ragged = tokens.ndim == 1
+    if ragged:
+        x = params["wte"][tokens[:, None]] \
+            + params["wpe"][pos_vec][:, None]
+    else:
+        positions = pos_vec[:, None] + jnp.arange(
+            tokens.shape[1])[None, :]
+        x = params["wte"][tokens] + params["wpe"][positions]
     sel = None
     if lora is not None:
         idx = lora["idx"]
@@ -284,7 +299,9 @@ def gpt2_decode(params: Params, tokens: jax.Array, config: GPT2Config,
         x, nc = _block_decode(x, p, c, blk, pos_vec, lora_l)
         new_cache.append(nc)
     x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
-    return jnp.dot(x[:, 0], params["wte"].T,
+    if ragged:
+        x = x[:, 0]
+    return jnp.dot(x, params["wte"].T,
                    preferred_element_type=jnp.float32), new_cache
 
 

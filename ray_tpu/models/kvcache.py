@@ -34,13 +34,36 @@ softmax contributes exact zeros for unwritten rows), and because a
 weight swap invalidates the whole index — stale-generation KV is never
 matched again (in-flight slots keep decoding off their own slab copy).
 
+**int8 blocks** (``RAY_TPU_KV_INT8=1`` or the ``int8=`` ctor arg): the
+pool stores K/V as int8 with per-block-CHANNEL fp32 scales (amax over
+the block's token rows, one scale per (layer, head, head_dim) channel
+— the channel-wise shape that keeps RoPE'd K's per-dim dynamic range).
+Quantize-on-commit and dequantize-on-gather are donated jits, O(block)
+in place like every other pool mutation, so the HALVED bytes per block
+buy a doubled default pool (``resolve_pool_config`` sizes 2x blocks
+when int8 is on and the pool wasn't pinned explicitly) — bigger decode
+batches and higher prefix-cache residency for the same HBM. Everything
+OUTSIDE the pool stays bit-exact: gather hands back fp KV in the cache
+dtype and the suffix prefill / splice / decode path is unchanged; the
+quantization error itself is bounded by the rtol equivalence test in
+tests/test_speculate.py.
+
+**Drafting from cache** (``propose()``): the index's hash chains store
+EXACT token tuples, so the longest chain extending a request's current
+context IS a free speculative draft — no draft model, no extra
+compile. The engine's prompt-lookup proposer (models/engine.py) reads
+it; proposals are never pinned (a wrong draft is rejected by the
+verify pass, so correctness never depends on what propose returns).
+
 Surfaces (the full treatment every subsystem gets):
 ``util.state.kv_cache_stats()``, ``ray_tpu kvcache``, dashboard
 ``/api/kvcache``, lazy-init Prometheus counters/gauges (no pusher on
-import), and prefix-hit / evict instant markers in the merged timeline.
-Knobs: ``RAY_TPU_KV_CACHE`` (enable, default 1),
+import; the pool-utilization gauge reads the int8-doubled block count
+when int8 is on), and prefix-hit / evict instant markers in the merged
+timeline. Knobs: ``RAY_TPU_KV_CACHE`` (enable, default 1),
 ``RAY_TPU_KV_BLOCK_SIZE`` (default 16), ``RAY_TPU_KV_POOL_BLOCKS``
-(default: one decode slab's worth, ``max_batch * ceil(S/block)``).
+(default: one decode slab's worth, ``max_batch * ceil(S/block)``;
+doubled under int8), ``RAY_TPU_KV_INT8`` (default 0).
 """
 from __future__ import annotations
 
@@ -60,20 +83,33 @@ _ROOT_DIGEST = b"ray_tpu-kv-root"
 _EVENTS_KEPT = 512
 
 
+def kv_int8_default() -> bool:
+    """The ``RAY_TPU_KV_INT8`` env default every pool owner (the
+    colocated engine, the disagg prefill tier) resolves through."""
+    return os.environ.get("RAY_TPU_KV_INT8", "0") == "1"
+
+
 def resolve_pool_config(config: Any,
                         block_size: Optional[int] = None,
                         pool_blocks: Optional[int] = None, *,
-                        slots: int = 4) -> Tuple[int, int]:
+                        slots: int = 4,
+                        int8: bool = False) -> Tuple[int, int]:
     """Resolve ``(block_size, pool_blocks)`` from explicit args, the
     ``RAY_TPU_KV_BLOCK_SIZE`` / ``RAY_TPU_KV_POOL_BLOCKS`` env knobs, or
     the ``slots * ceil(max_seq_len / block_size)`` sizing default — the
     ONE implementation every pool owner (the colocated engine, the
-    disaggregated prefill tier) defaults through."""
+    disaggregated prefill tier) defaults through. Under ``int8`` a
+    DEFAULTED pool doubles its block count — int8 blocks cost half the
+    bytes, so the same HBM budget holds twice the prefixes (an explicit
+    block count, arg or env, is always honored as-is)."""
     bs = int(block_size
              or os.environ.get("RAY_TPU_KV_BLOCK_SIZE", "16"))
     pb = int(pool_blocks
-             or int(os.environ.get("RAY_TPU_KV_POOL_BLOCKS", "0"))
-             or slots * (-(-config.max_seq_len // bs)))
+             or int(os.environ.get("RAY_TPU_KV_POOL_BLOCKS", "0")))
+    if not pb:
+        pb = slots * (-(-config.max_seq_len // bs))
+        if int8:
+            pb *= 2
     return bs, pb
 
 
@@ -138,6 +174,80 @@ def _gather_prefix(pool_k, pool_v, bids, ntok):
     k = k.reshape((ll, n * bs) + k.shape[3:])[:, :ntok]
     v = v.reshape((ll, n * bs) + v.shape[3:])[:, :ntok]
     return k, v
+
+
+# int8 pool twins: per-block-CHANNEL symmetric quantization — one fp32
+# scale per (layer, head, head_dim) channel, amax'd over the block's
+# token rows. Same donation discipline as the fp ops: a commit touches
+# O(block) bytes of the int8 pool + scale pool, never O(pool).
+
+def _quantize(blk):
+    """[L, bs, H, hd] float -> (int8 same shape, f32 scale
+    [L, 1, H, hd]). amax==0 channels take scale 1 so 0/0 never NaNs
+    (their rows quantize to exact 0 either way)."""
+    f = blk.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _write_block_q(pool_k, pool_v, sk, sv, bid, blk_k, blk_v):
+    """Quantize-on-commit: pool [L,N,bs,H,hd] int8 + scales
+    [L,N,1,H,hd] f32 <- blk [L,bs,H,hd] at block row `bid`."""
+    qk, sck = _quantize(blk_k)
+    qv, scv = _quantize(blk_v)
+    at = (0, bid, 0, 0, 0)
+    return (jax.lax.dynamic_update_slice(pool_k, qk[:, None], at),
+            jax.lax.dynamic_update_slice(pool_v, qv[:, None], at),
+            jax.lax.dynamic_update_slice(sk, sck[:, None], at),
+            jax.lax.dynamic_update_slice(sv, scv[:, None], at))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _cow_extend_block_q(pool_k, pool_v, sk, sv, dst, src, blk_k, blk_v,
+                        filled_old):
+    """int8 copy-on-write: dequantize the SHARED block's rows
+    ``< filled_old``, merge with the freshly prefilled rows, requantize
+    the merged block (its own channel scales) into `dst`."""
+    sizes = (pool_k.shape[0], 1) + pool_k.shape[2:]
+    ssizes = (sk.shape[0], 1) + sk.shape[2:]
+    row = jnp.arange(pool_k.shape[2])[None, :, None, None]
+
+    def _old(pool, scales):
+        q = jax.lax.dynamic_slice(pool, (0, src, 0, 0, 0), sizes)[:, 0]
+        s = jax.lax.dynamic_slice(scales, (0, src, 0, 0, 0),
+                                  ssizes)[:, 0]
+        return q.astype(jnp.float32) * s
+
+    merged_k = jnp.where(row < filled_old, _old(pool_k, sk),
+                         blk_k.astype(jnp.float32))
+    merged_v = jnp.where(row < filled_old, _old(pool_v, sv),
+                         blk_v.astype(jnp.float32))
+    qk, sck = _quantize(merged_k)
+    qv, scv = _quantize(merged_v)
+    at = (0, dst, 0, 0, 0)
+    return (jax.lax.dynamic_update_slice(pool_k, qk[:, None], at),
+            jax.lax.dynamic_update_slice(pool_v, qv[:, None], at),
+            jax.lax.dynamic_update_slice(sk, sck[:, None], at),
+            jax.lax.dynamic_update_slice(sv, scv[:, None], at))
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _gather_prefix_q(pool_k, pool_v, sk, sv, bids, ntok, dtype):
+    """Dequant-on-gather: assemble a matched prefix out of the int8
+    pool back into the cache dtype — downstream (suffix prefill,
+    splice, decode) sees ordinary fp KV, so everything outside the
+    quantized pool stays bit-exact plumbing."""
+    def _deq(pool, scales):
+        q = jnp.take(pool, bids, axis=1)       # [L, n, bs, H, hd]
+        s = jnp.take(scales, bids, axis=1)     # [L, n, 1, H, hd]
+        x = (q.astype(jnp.float32) * s).astype(dtype)
+        ll, n, bs = x.shape[0], x.shape[1], x.shape[2]
+        return x.reshape((ll, n * bs) + x.shape[3:])[:, :ntok]
+
+    return _deq(pool_k, sk), _deq(pool_v, sv)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -215,7 +325,8 @@ class PrefixMatch:
 
 class _Block:
     __slots__ = ("bid", "tokens", "filled", "ref", "last_used",
-                 "children", "index_key", "parent_bid", "ns")
+                 "children", "index_key", "parent_bid", "parent_digest",
+                 "ns")
 
     def __init__(self, bid: int):
         self.bid = bid
@@ -229,6 +340,10 @@ class _Block:
         # last release)
         self.index_key: Optional[tuple] = None
         self.parent_bid: Optional[int] = None
+        # the chain digest this block EXTENDS — the forward-walk key
+        # the draft proposer follows (propose()); partial blocks reuse
+        # their index key's parent digest
+        self.parent_digest: Optional[bytes] = None
         # cache namespace (LoRA tenant) the block was committed under —
         # invalidate(namespace=) scopes an adapter hot-swap's flush to
         # exactly this tenant's blocks
@@ -241,7 +356,8 @@ class PagedKVCache:
     Thread-safe; in practice only the engine's decode thread mutates it
     while stats/snapshot readers come from anywhere."""
 
-    def __init__(self, config: Any, *, block_size: int, num_blocks: int):
+    def __init__(self, config: Any, *, block_size: int, num_blocks: int,
+                 int8: Optional[bool] = None):
         from .generate import _model_fns
 
         if block_size < 1 or num_blocks < 1:
@@ -252,10 +368,16 @@ class PagedKVCache:
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.dtype = probe[0]["k"].dtype
+        self.int8 = kv_int8_default() if int8 is None else bool(int8)
         shape = (self.layers, self.num_blocks, self.block_size, heads,
                  head_dim)
-        self._pool_k = jnp.zeros(shape, self.dtype)
-        self._pool_v = jnp.zeros(shape, self.dtype)
+        pool_dtype = jnp.int8 if self.int8 else self.dtype
+        self._pool_k = jnp.zeros(shape, pool_dtype)
+        self._pool_v = jnp.zeros(shape, pool_dtype)
+        if self.int8:
+            sshape = (self.layers, self.num_blocks, 1, heads, head_dim)
+            self._scale_k = jnp.zeros(sshape, jnp.float32)
+            self._scale_v = jnp.zeros(sshape, jnp.float32)
         self._empty_k = jnp.zeros((self.layers, 0, heads, head_dim),
                                   self.dtype)
         self._lock = threading.Lock()
@@ -264,6 +386,10 @@ class PagedKVCache:
         self._full_index: Dict[bytes, int] = {}
         self._partial_index: Dict[bytes,
                                   Dict[Tuple[int, ...], int]] = {}
+        # forward-walk index for the draft proposer: chain digest ->
+        # {tokens: bid} of the FULL blocks extending it (partial tails
+        # are already forward-indexed by _partial_index)
+        self._children: Dict[bytes, Dict[Tuple[int, ...], int]] = {}
         self._tick = itertools.count(1)
         self._events: List[Dict[str, Any]] = []
         self._stats: Dict[str, int] = {
@@ -350,10 +476,99 @@ class PagedKVCache:
             # disaggregated prefill tier runs prefills in parallel).
             # Same-device stream order makes the dispatch itself the
             # only critical section; the compute overlaps freely.
+            if self.int8:
+                return _gather_prefix_q(self._pool_k, self._pool_v,
+                                        self._scale_k, self._scale_v,
+                                        bids, match.tokens, self.dtype)
             return _gather_prefix(self._pool_k, self._pool_v, bids,
                                   match.tokens)
 
+    # ----------------------------------------------------------- propose
+
+    def propose(self, tokens, k: int,
+                namespace: Optional[str] = None) -> List[int]:
+        """Draft up to `k` tokens CONTINUING `tokens` off the prefix
+        index's exact token chains (prompt-lookup speculative decoding,
+        models/engine.py): walk the chain matching the context's
+        block-aligned prefix, then follow the full-block children (and
+        finally any partial tail) whose tokens extend the context's
+        remainder. Returns [] when no cached chain extends the context.
+        Nothing is pinned — a wrong draft is simply rejected by the
+        verify pass, so correctness never depends on this answer."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        n = len(tokens)
+        out: List[int] = []
+        with self._lock:
+            digest = _ns_root(namespace)
+            matched = 0
+            while matched + bs <= n:
+                blk = tuple(int(t) for t in tokens[matched:matched + bs])
+                nxt = _chain(digest, blk)
+                bid = self._full_index.get(nxt)
+                if bid is None or self._blocks[bid].tokens != blk:
+                    break
+                digest = nxt
+                matched += bs
+            rem = tuple(int(t) for t in tokens[matched:])
+            if len(rem) >= bs:
+                return []  # context diverged from every cached chain
+            while len(out) < k:
+                kids = self._children.get(digest, {})
+                step = None
+                for toks, bid in kids.items():
+                    if toks[:len(rem)] == rem and len(toks) > len(rem):
+                        step = (toks, bid)
+                        break
+                if step is None:
+                    break
+                toks, bid = step
+                out.extend(toks[len(rem):])
+                key = self._blocks[bid].index_key
+                if key is None or key[0] != "full":
+                    break
+                digest, rem = key[1], ()
+            if len(out) < k:
+                # the longest partial tail extending what's left
+                best: Tuple[int, ...] = ()
+                for toks in self._partial_index.get(digest, {}):
+                    if toks[:len(rem)] == rem and len(toks) > len(rem) \
+                            and len(toks) > len(best):
+                        best = toks
+                if best:
+                    out.extend(best[len(rem):])
+        return out[:k]
+
     # ------------------------------------------------------------ commit
+
+    def _write_locked(self, bid: int, bk, bv) -> None:
+        """One block write under the lock — the int8 pool quantizes on
+        commit (donated, O(block) in place either way)."""
+        if self.int8:
+            (self._pool_k, self._pool_v, self._scale_k,
+             self._scale_v) = _write_block_q(
+                self._pool_k, self._pool_v, self._scale_k,
+                self._scale_v, np.int32(bid), bk, bv)
+        else:
+            self._pool_k, self._pool_v = _write_block(
+                self._pool_k, self._pool_v, np.int32(bid), bk, bv)
+
+    def _cow_locked(self, dst: int, src: int, bk, bv,
+                    filled_old: int) -> None:
+        """Copy-on-write merge under the lock (int8: dequant the shared
+        rows, merge, requantize the widened block)."""
+        if self.int8:
+            (self._pool_k, self._pool_v, self._scale_k,
+             self._scale_v) = _cow_extend_block_q(
+                self._pool_k, self._pool_v, self._scale_k,
+                self._scale_v, np.int32(dst), np.int32(src), bk, bv,
+                np.int32(filled_old))
+        else:
+            self._pool_k, self._pool_v = _cow_extend_block(
+                self._pool_k, self._pool_v, np.int32(dst),
+                np.int32(src), bk, bv, np.int32(filled_old))
+        self._stats["cow_copies"] += 1
+        kvcache_metrics()["cow_copies"].inc()
 
     def note_prefilled(self, n_tokens: int) -> None:
         with self._lock:
@@ -405,18 +620,12 @@ class PagedKVCache:
                     # position and this prompt widens it to a full
                     # block: copy-on-write (the original stays indexed
                     # for future shorter matches)
-                    self._pool_k, self._pool_v = _cow_extend_block(
-                        self._pool_k, self._pool_v, np.int32(bid),
-                        np.int32(match.partial_bid), bk, bv,
-                        np.int32(match.partial_len))
-                    self._stats["cow_copies"] += 1
-                    kvcache_metrics()["cow_copies"].inc()
+                    self._cow_locked(bid, match.partial_bid, bk, bv,
+                                     match.partial_len)
                 else:
-                    self._pool_k, self._pool_v = _write_block(
-                        self._pool_k, self._pool_v, np.int32(bid), bk,
-                        bv)
+                    self._write_locked(bid, bk, bv)
                 self._insert_locked(bid, ("full", nxt), blk, bs, parent,
-                                    now, namespace)
+                                    now, namespace, digest)
                 table.append(bid)
                 parent, digest = bid, nxt
             if tail and not exhausted:
@@ -460,23 +669,20 @@ class PagedKVCache:
         if tail_partial is not None:
             # extending a SHARED cached block: copy-on-write — the old
             # entry stays indexed for future shorter matches
-            self._pool_k, self._pool_v = _cow_extend_block(
-                self._pool_k, self._pool_v, np.int32(bid),
-                np.int32(tail_partial), bk, bv,
-                np.int32(match.partial_len))
-            self._stats["cow_copies"] += 1
-            kvcache_metrics()["cow_copies"].inc()
+            self._cow_locked(bid, tail_partial, bk, bv,
+                             match.partial_len)
         else:
-            self._pool_k, self._pool_v = _write_block(
-                self._pool_k, self._pool_v, np.int32(bid), bk, bv)
+            self._write_locked(bid, bk, bv)
         self._insert_locked(bid, ("partial", digest, tail_toks),
-                            tail_toks, tail, parent, now, namespace)
+                            tail_toks, tail, parent, now, namespace,
+                            digest)
         table.append(bid)
 
     def _insert_locked(self, bid: int, index_key: tuple,
                        blk_tokens: Tuple[int, ...], filled: int,
                        parent: Optional[int], now: int,
-                       ns: Optional[str] = None) -> None:
+                       ns: Optional[str] = None,
+                       parent_digest: Optional[bytes] = None) -> None:
         b = _Block(bid)
         b.tokens = blk_tokens
         b.filled = filled
@@ -484,10 +690,14 @@ class PagedKVCache:
         b.last_used = now
         b.index_key = index_key
         b.parent_bid = parent
+        b.parent_digest = parent_digest
         b.ns = ns
         self._blocks[bid] = b
         if index_key[0] == "full":
             self._full_index[index_key[1]] = bid
+            if parent_digest is not None:
+                self._children.setdefault(parent_digest,
+                                          {})[blk_tokens] = bid
         else:
             self._partial_index.setdefault(index_key[1],
                                            {})[index_key[2]] = bid
@@ -528,6 +738,12 @@ class PagedKVCache:
             return
         if key[0] == "full":
             self._full_index.pop(key[1], None)
+            if b.parent_digest is not None:
+                kids = self._children.get(b.parent_digest)
+                if kids is not None:
+                    kids.pop(b.tokens, None)
+                    if not kids:
+                        del self._children[b.parent_digest]
         else:
             by_tok = self._partial_index.get(key[1])
             if by_tok is not None:
@@ -623,6 +839,15 @@ class PagedKVCache:
                 cached_blocks=cached,
                 pinned_blocks=pinned,
                 pool_utilization=1.0 - len(self._free) / self.num_blocks,
+                int8=self.int8,
+                # bytes-per-block capacity factor vs the fp pool — the
+                # "effective pool doubled" evidence every surface (and
+                # the bench record) reports
+                capacity_factor=2 if self.int8 else 1,
+                pool_bytes=int(self._pool_k.nbytes + self._pool_v.nbytes
+                               + ((self._scale_k.nbytes
+                                   + self._scale_v.nbytes)
+                                  if self.int8 else 0)),
             )
         looked = s["lookups"]
         s["hit_rate"] = ((s["hits"] + s["partial_hits"]) / looked

@@ -200,10 +200,19 @@ def llama_block_decode(x: jax.Array, p: Params, cos: jax.Array,
                        sin: jax.Array, config: LlamaConfig,
                        cache: Params, pos_vec: jax.Array,
                        lora: Optional[Dict[str, Any]] = None):
-    """Single-token decode with PER-SLOT positions (continuous batching:
-    every batch slot is a different sequence at its own depth).
-    x [B, 1, D]; pos_vec [B] int32. Writes each slot's new K/V at its
-    own position (scatter) and masks attention per slot.
+    """Ragged-batch decode with PER-SLOT positions (continuous
+    batching: every batch slot is a different sequence at its own
+    depth). x [B, t, D]; pos_vec [B] int32 is each slot's BASE
+    position — slot b's token j lands at pos_vec[b] + j. t == 1 is the
+    classic one-token tick; t == k+1 is the speculative VERIFY pass
+    (models/engine.py), which scores a slot's k drafted tokens in one
+    forward. Each new K/V row is scattered at its own position and
+    attention is masked per (slot, query position), so query j sees
+    exactly the rows a sequential j-step decode would — the
+    bit-identity the speculation oracle rests on. Rows past a query's
+    position stay invisible, which is also why rejected draft rows
+    need no rollback: they are overwritten before any later query can
+    see them.
 
     `lora` (optional, serve/lora.py mixed-tenant decode): this layer's
     per-slot adapter selections — ``{"wq": (a [B,D,r], b [B,r,D]),
@@ -212,14 +221,13 @@ def llama_block_decode(x: jax.Array, p: Params, cos: jax.Array,
     adapter (all-zero A/B, scale 0) add an exact-zero delta, keeping
     the base-only math bit-identical to the lora=None path."""
     c = config
-    b = x.shape[0]
+    b, t = x.shape[0], x.shape[1]
     h = rms_norm(x, p["attn_norm"]["scale"])
     if lora is None:
         q, k, v = _qkv(h, p, c)
     else:
         from ..ops.layers import lora_delta
 
-        t = h.shape[1]
         q = _mm(h, p["attn"]["wq"]) + lora_delta(
             h, *lora["wq"], lora["scale"])
         k = _mm(h, p["attn"]["wk"])
@@ -228,22 +236,24 @@ def llama_block_decode(x: jax.Array, p: Params, cos: jax.Array,
         q = q.reshape(b, t, c.num_heads, c.head_dim)
         k = k.reshape(b, t, c.num_kv_heads, c.head_dim)
         v = v.reshape(b, t, c.num_kv_heads, c.head_dim)
-    positions = pos_vec[:, None]                       # [B, 1]
+    positions = pos_vec[:, None] + jnp.arange(t)[None, :]   # [B, t]
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     rows = jnp.arange(b)
-    ck = cache["k"].at[rows, pos_vec].set(k[:, 0].astype(cache["k"].dtype))
-    cv = cache["v"].at[rows, pos_vec].set(v[:, 0].astype(cache["v"].dtype))
+    ck = cache["k"].at[rows[:, None], positions].set(
+        k.astype(cache["k"].dtype))
+    cv = cache["v"].at[rows[:, None], positions].set(
+        v.astype(cache["v"].dtype))
     kk, vv = _repeat_kv(ck, cv, c)
     s = kk.shape[1]
     scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                         preferred_element_type=jnp.float32)
     scores = scores / (c.head_dim ** 0.5)
     col = jnp.arange(s)[None, None, None, :]
-    visible = col <= pos_vec[:, None, None, None]
+    visible = col <= positions[:, None, :, None]
     scores = jnp.where(visible, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    a = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(b, 1, c.d_model)
+    a = jnp.einsum("bhts,bshd->bthd", probs, vv).reshape(b, t, c.d_model)
     x = x + _mm(a, p["attn"]["wo"])
     return _mlp_res(x, p), {"k": ck, "v": cv}
 
@@ -253,7 +263,12 @@ def llama_decode(params: Params, tokens: jax.Array, config: LlamaConfig,
                  lora: Optional[Dict[str, Any]] = None):
     """One decode step for a ragged batch: tokens [B] at per-slot
     positions pos_vec [B]. Returns (logits [B, padded_vocab] fp32,
-    new_cache).
+    new_cache). tokens [B, q] is the speculative VERIFY form: slot b's
+    q tokens land at positions pos_vec[b]..pos_vec[b]+q-1 and the
+    logits come back [B, q, padded_vocab] — position j's row is what a
+    sequential decode would have produced after feeding tokens[:, :j+1]
+    (models/engine.py accepts the longest agreeing draft prefix off
+    it).
 
     `lora` (optional): the adapter-pool stacks + per-slot indices —
     ``{"idx": [B] int32, "scale": [P] f32, "wq": (a [P,L,D,r],
@@ -262,7 +277,8 @@ def llama_decode(params: Params, tokens: jax.Array, config: LlamaConfig,
     per-slot low-rank delta to the wq/wv projections."""
     c = config
     cos, sin = rope_table(c.head_dim, c.max_seq_len, c.rope_theta)
-    x = params["tok_emb"][tokens[:, None]]
+    ragged = tokens.ndim == 1
+    x = params["tok_emb"][tokens[:, None] if ragged else tokens]
     sel = None
     if lora is not None:
         idx = lora["idx"]
@@ -279,7 +295,9 @@ def llama_decode(params: Params, tokens: jax.Array, config: LlamaConfig,
                                    pos_vec, lora_l)
         new_cache.append(nc)
     x = rms_norm(x, params["norm_f"]["scale"])
-    return jnp.dot(x[:, 0], params["lm_head"],
+    if ragged:
+        x = x[:, 0]
+    return jnp.dot(x, params["lm_head"],
                    preferred_element_type=jnp.float32), new_cache
 
 

@@ -368,6 +368,47 @@ def cmd_kvcache(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_speculate(args) -> None:
+    """`ray_tpu speculate` — speculative-decoding view (models/engine):
+    per-engine draft proposal/acceptance counters, tokens-per-verify
+    and acceptance rate plus the cluster totals every other surface
+    (state API, /api/speculation, Prometheus, the kvcache timeline
+    lane's spec markers) reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.speculation_stats(getattr(args, "engine", None))
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    engines = st.get("engines") or {}
+    totals = st.get("totals") or {}
+    if not engines:
+        print("no speculation telemetry recorded (is an engine running "
+              "with speculate_k > 0 / RAY_TPU_SPECULATE_K set?)")
+        return
+    print(f"totals: proposed={totals.get('spec_proposed', 0)} "
+          f"accepted={totals.get('spec_accepted', 0)} "
+          f"acceptance={totals.get('acceptance_rate', 0.0):.2%} "
+          f"verify_ticks={totals.get('spec_verify_ticks', 0)} "
+          f"tokens/verify={totals.get('tokens_per_verify', 0.0):.2f}")
+    for key, s in sorted(engines.items()):
+        print(f"  {key}: k={s.get('speculate_k', 0)} "
+              f"proposed={s.get('spec_proposed', 0)} "
+              f"accepted={s.get('spec_accepted', 0)} "
+              f"acceptance={s.get('acceptance_rate', 0.0):.2%} "
+              f"tokens/verify={s.get('tokens_per_verify', 0.0):.2f} "
+              f"int8_kv={'on' if s.get('kv_int8') else 'off'}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_kvcache_events", 10_000,
+                                  timeout=10.0)
+        spec = [e for e in events
+                if str(e.get("kind", "")).startswith("spec_")]
+        _print_event_tail(spec[-args.events:], args.events)
+
+
 def cmd_pipeline(args) -> None:
     """`ray_tpu pipeline` — MPMD pipeline view (ray_tpu.mpmd): per-
     pipeline stage registry + per-stage run stats (bubble fraction,
@@ -1077,6 +1118,18 @@ def main(argv=None) -> None:
                     help="also print the last N cache events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_kvcache)
+
+    sp = sub.add_parser("speculate",
+                        help="speculative decoding: per-engine draft "
+                             "proposal/acceptance counters, "
+                             "tokens-per-verify, int8-KV flag")
+    sp.add_argument("--engine", help="filter to one engine id")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N spec_accept/"
+                         "spec_reject markers")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_speculate)
 
     sp = sub.add_parser("pipeline",
                         help="MPMD pipelines: stage registry, per-stage "

@@ -327,9 +327,13 @@ class DisaggPolicy:
         # its stream long after the engine slot freed, but it still
         # occupies the router's admission bound — the thing a removed
         # replica would shrink. Take the worse of the two recent views.
+        # current > 0 (not > 1): at current == 1 the condition reduces
+        # to demand == 0, i.e. a truly idle tier may drain to ZERO —
+        # the ScalingPolicy's min_replicas floor (1 everywhere except
+        # an explicit scale-to-zero tier) clamps it back otherwise.
         demand = max((v for v in (busy_p99, depth_p99)
                       if v is not None), default=None)
-        if current > 1 and demand is not None \
+        if current > 0 and demand is not None \
                 and demand <= self.low_util * (current - 1) * cap:
             return current - 1, (
                 f"slot demand p99 {demand:.1f} fits in {current - 1} "
@@ -341,10 +345,13 @@ class DisaggPolicy:
         ttft_p99 = signals.get("ttft_p99_ms")
         hit_rate = signals.get("cache_hit_rate")
         inflight_p99 = signals.get("prefill_inflight_p99")
-        if current > 1 and ttft_p99 is None and inflight_p99 is None:
+        if current > 0 and ttft_p99 is None and inflight_p99 is None:
             # missing evidence never scales UP — but for a tier above
             # its floor, a request window with no samples at all IS the
             # evidence: nothing has needed prefill for a whole window
+            # (current > 0 so a scale-to-zero tier drains its last
+            # replica on the same evidence; min_replicas clamps
+            # everyone else at 1)
             return current - 1, "tier idle (no requests in the window)"
         if ttft_p99 is not None and ttft_p99 > self.target_p99_ms:
             return current + 1, (
@@ -485,7 +492,15 @@ class DisaggAutoscaler:
             "replacements": {t: 0 for t in TIERS},
             "replacements_blocked": 0,
             "breaker_trips": 0,
+            "wakeups": {t: 0 for t in TIERS},
         }
+        # scale-to-zero (min_replicas=0 on a TierSpec): an idle tier
+        # drains to ZERO replicas, and the router calls the waker on
+        # the first arrival — an immediate factory scale-up OUTSIDE
+        # hysteresis (absence is not load), single-flight per tier
+        self._waking: Dict[str, bool] = {t: False for t in TIERS}
+        if any(self.specs[t].policy.min_replicas == 0 for t in TIERS):
+            router.set_tier_waker(self._wake_tier)
         # the replacement circuit breaker: the existing failure-domain
         # tracker keyed by the replicas' HOST (machine id) — a host
         # whose replicas die repeatedly trips the latch and stops
@@ -634,18 +649,76 @@ class DisaggAutoscaler:
             actions.append(ev)
         return actions
 
+    # ------------------------------------------------------ scale to zero
+
+    def _wake_tier(self, tier: str) -> bool:
+        """The router's first-arrival-to-an-empty-tier hook: spawn one
+        replica through the tier factory NOW (no hysteresis, no
+        cooldown — the request is already waiting on it), off the
+        arrival's thread, single-flight per tier. Returns whether a
+        wake is coming — the router only WAITS on a True answer; a
+        False keeps the pre-existing empty-tier behavior (immediate
+        shed / self-healer wait). ONLY a min_replicas=0 tier wakes
+        this way: a tier with a floor is empty because its replicas
+        DIED, and respawning it from the traffic path would bypass the
+        self-healer's per-host circuit breaker — exactly the
+        repeatedly-dying-host churn the breaker exists to stop."""
+        if tier not in self.specs \
+                or self.specs[tier].policy.min_replicas != 0:
+            return False
+        with self._lock:
+            if self._waking.get(tier):
+                return True  # a wake is already in flight
+            self._waking[tier] = True
+
+        def run() -> None:
+            try:
+                if self._active_count(tier) > 0:
+                    return  # raced another wake / a tick scale-up
+                try:
+                    replica = self.specs[tier].factory()
+                except Exception as e:  # noqa: BLE001 — no capacity
+                    with self._lock:
+                        self._stats["last_reason"][tier] = (
+                            f"wake blocked: {type(e).__name__}: {e}")
+                    return
+                rid = (self.router.add_prefill(replica)
+                       if tier == "prefill"
+                       else self.router.add_decode(replica))
+                if self._watching:
+                    self._refresh_managed()
+                with self._lock:
+                    self._stats["wakeups"][tier] += 1
+                autoscale_metrics()["decisions"].inc(
+                    tags={"tier": tier, "direction": "up"})
+                _notify_event({"kind": "scale_from_zero", "tier": tier,
+                               "replica": rid,
+                               "autoscaler": self.autoscaler_id})
+                self.publish_telemetry(force=True)
+            finally:
+                with self._lock:
+                    self._waking[tier] = False
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"autoscale-wake-{tier}").start()
+        return True
+
     # --------------------------------------------------------- scale down
 
     def _scale_down(self, tier: str, n: int, target: int, reason: str,
                     now: float) -> List[Dict[str, Any]]:
         """Begin draining the newest active replicas (never below the
         initial set's oldest — newest-first mirrors the Serve
-        controller's pending-first scale-down)."""
+        controller's pending-first scale-down). A min_replicas=0 tier
+        may drain its LAST replica (allow_empty): the attached waker
+        makes the empty tier serveable again on the next arrival."""
         actions = []
+        allow_empty = self.specs[tier].policy.min_replicas == 0
         active = [r for r in self.router.tier_replicas(tier)
                   if not r["draining"]]
         for r in list(reversed(active))[:n]:
-            if not self.router.begin_drain(tier, r["rid"]):
+            if not self.router.begin_drain(tier, r["rid"],
+                                           allow_empty=allow_empty):
                 continue
             self._draining.append(
                 _Draining(tier, r["rid"], now, self.drain_grace_s))
@@ -957,6 +1030,7 @@ class DisaggAutoscaler:
                 "replica_seconds": {
                     t: round(v, 3) for t, v
                     in self._stats["replica_seconds"].items()},
+                "wakeups": dict(self._stats["wakeups"]),
                 "last_reason": dict(self._stats["last_reason"]),
                 "draining": [{"tier": d.tier, "rid": d.rid}
                              for d in self._draining],

@@ -82,7 +82,7 @@ import queue
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -309,6 +309,7 @@ class PrefillServer:
                  prefix_cache: bool = True,
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
+                 kv_int8: Optional[bool] = None,
                  retain: int = 32,
                  server_id: Optional[str] = None,
                  chaos: Optional[str] = None,
@@ -318,6 +319,7 @@ class PrefillServer:
                  lora_rank_max: Optional[int] = None):
         from ray_tpu.models.generate import _model_fns
         from ray_tpu.models.kvcache import (PagedKVCache,
+                                            kv_int8_default,
                                             resolve_pool_config)
 
         import jax.numpy as jnp
@@ -336,11 +338,17 @@ class PrefillServer:
         # meaningful on ACTOR replicas — the fire is an os._exit
         self._chaos = serve_monkey_from_spec(chaos, "prefill",
                                              chaos_replica)
+        # int8 KV blocks (models/kvcache.py): halve the pool's bytes
+        # per block -> doubled default pool -> higher prefix residency
+        # on the tier that actually owns prefix reuse
+        if kv_int8 is None:
+            kv_int8 = kv_int8_default()
+        self.kv_int8 = bool(kv_int8)
         block_size, pool_blocks = resolve_pool_config(
-            config, kv_block_size, kv_pool_blocks)
+            config, kv_block_size, kv_pool_blocks, int8=self.kv_int8)
         self.kv_cache: Optional[PagedKVCache] = (
             PagedKVCache(config, block_size=block_size,
-                         num_blocks=pool_blocks)
+                         num_blocks=pool_blocks, int8=self.kv_int8)
             if prefix_cache else None)
         # multi-tenant LoRA (serve/lora.py): prefill runs under each
         # request's tenant adapter, so the prefill tier pages adapters
@@ -435,6 +443,12 @@ class PrefillServer:
             "plen": plen, "first_token": first, "score": score,
             "outcome": outcome, "reused_tokens": int(reused),
             "prefill_server": self.server_id,
+            # the prompt's actual tokens ride the (metadata) record so
+            # the decode tier's speculative proposer drafts from the
+            # same context the colocated engine would — tiny next to
+            # the KV payload, and the adopting engine's n-gram lookup
+            # is useless over the zero placeholder prompt otherwise
+            "prompt_tokens": [int(t) for t in prompt[0]],
         }
         if tenant is not None:
             rec["tenant"] = tenant
@@ -714,6 +728,7 @@ class DecodeServer:
             cache_outcome=rec.get("outcome"),
             reused_tokens=rec.get("reused_tokens", 0),
             adapter_id=rec.get("tenant"),
+            prompt_tokens=rec.get("prompt_tokens"),
             timeout_s=timeout_s)
         with self._lock:
             self._stats["transfers"] += 1
@@ -914,6 +929,8 @@ class DecodeServer:
                  adopted=self.engine.adopted,
                  cancelled=self.engine.cancelled,
                  prefill_programs=self.prefill_programs())
+        if self.engine.speculate_k:
+            s["speculation"] = self.engine.speculation_stats()
         if self.lora_pool is not None:
             s["lora"] = self.lora_pool.stats()
         return s
@@ -1058,7 +1075,13 @@ class DisaggRouter:
         self._stats = {k: 0 for k in (
             "dispatched", "completed", "shed", "max_pending",
             "shm_affinity_hits", "shm_affinity_total",
-            "tenant_affinity_hits", "tenant_affinity_total")}
+            "tenant_affinity_hits", "tenant_affinity_total",
+            "tier_wakeups")}
+        # scale-from-zero hook (serve/autoscale.py): called with the
+        # tier name when an arrival finds that tier EMPTY — the
+        # autoscaler's waker spawns a replica through the tier factory
+        # outside hysteresis, and the arrival waits for it
+        self._tier_waker: Optional[Callable[[str], None]] = None
         # multi-tenant LoRA (serve/lora.py): per-tenant shed/SLO/
         # latency isolation — one tenant's overload or failure must
         # never read as another's. LRU-capped so a tenant sweep can't
@@ -1139,6 +1162,36 @@ class DisaggRouter:
         self.publish_telemetry(force=True)
         return rep.rid
 
+    def set_tier_waker(self,
+                       fn: Optional[Callable[[str], bool]]) -> None:
+        """Attach the scale-from-zero hook (serve/autoscale.py): called
+        with the tier name ("prefill"|"decode") when a request arrives
+        to an EMPTY tier; returns whether a wake was actually initiated
+        (only a min_replicas=0 tier wakes — for any other tier the
+        arrival must keep the pre-existing behavior: shed immediately
+        on decode, or wait for the self-healer on prefill). Must be
+        non-blocking — the waker spawns its replica off-thread while
+        the arrival waits."""
+        self._tier_waker = fn
+
+    def _wake_tier(self, tier: str) -> bool:
+        """Fire the waker; True only when it reports a wake is coming —
+        the caller's cue to wait for the replica instead of shedding.
+        Bookkeeping (counter + event) only on actual wakes."""
+        waker = self._tier_waker
+        if waker is None:
+            return False
+        try:
+            woke = bool(waker(tier))
+        except Exception:  # noqa: BLE001 — treat as no wake coming
+            return False
+        if woke:
+            with self._lock:
+                self._stats["tier_wakeups"] += 1
+            _notify_event({"kind": "tier_wake",
+                           "router": self.router_id, "tier": tier})
+        return woke
+
     def _lora_enabled(self) -> bool:
         """Whether this deployment can serve tenant-tagged requests:
         any tier replica advertised an adapter pool (describe()'s
@@ -1160,17 +1213,22 @@ class DisaggRouter:
         with self._lock:
             return [r.snapshot() for r in self._tier(tier)]
 
-    def begin_drain(self, tier: str, rid: str) -> bool:
+    def begin_drain(self, tier: str, rid: str, *,
+                    allow_empty: bool = False) -> bool:
         """Stop dispatching to one replica; its in-flight requests keep
         running and its KV transfers still get acked. Refuses to drain
-        the LAST active replica of a tier (the router must stay able to
-        serve). Returns whether the drain started."""
+        the LAST active replica of a tier (the router must stay able
+        to serve) unless ``allow_empty`` — the scale-to-zero path,
+        where the attached tier waker makes an empty tier serveable
+        again on the next arrival. Returns whether the drain
+        started."""
         with self._lock:
             reps = self._tier(tier)
             active = [r for r in reps if not r.draining]
             for r in reps:
                 if r.rid == rid and not r.draining:
-                    if len(active) <= 1:
+                    if len(active) <= 1 and not (
+                            allow_empty and self._tier_waker is not None):
                         return False
                     r.draining = True
                     break
@@ -1291,8 +1349,8 @@ class DisaggRouter:
 
     # ------------------------------------------------------------ admission
 
-    def _admit_or_shed(self,
-                       tenant: Optional[str] = None) -> _TierReplica:
+    def _admit_or_shed(self, tenant: Optional[str] = None,
+                       deadline: Optional[float] = None) -> _TierReplica:
         """Reserve a decode replica or shed. Sheds when EVERY active
         replica's in-flight estimate has reached capacity +
         max_queue_depth — the bound that keeps queue depth finite
@@ -1308,33 +1366,61 @@ class DisaggRouter:
         replica that served this tenant last already holds its adapter
         resident (serve/lora.py pool), so it is preferred while it has
         admission headroom — a cross-replica spray would page the same
-        adapter into every pool."""
+        adapter into every pool.
+
+        Scale-from-zero (serve/autoscale.py min_replicas=0): when the
+        decode tier is EMPTY (drained to zero, not merely full) and a
+        tier waker is attached, the FIRST arrival is the scale-up
+        signal — the waker spawns a replica through the tier factory
+        and this admission waits up to ``failover_wait_s`` for it to
+        register instead of shedding. A full-but-live tier still sheds
+        immediately (that is load, not absence)."""
         affinity_hit = False
-        with self._lock:
-            open_reps = [r for r in self._decode if not r.draining
-                         and r.inflight < r.cap + self.max_queue_depth]
-            pending = sum(r.inflight for r in self._decode)
+        wake_until: Optional[float] = None
+        while True:
+            with self._lock:
+                open_reps = [r for r in self._decode if not r.draining
+                             and r.inflight < r.cap
+                             + self.max_queue_depth]
+                pending = sum(r.inflight for r in self._decode)
+                if open_reps:
+                    # probe-free first cut: least estimated in-flight,
+                    # reserved NOW so the bound holds under concurrency
+                    rep = min(open_reps, key=lambda r: r.inflight)
+                    if tenant is not None:
+                        self._stats["tenant_affinity_total"] += 1
+                        want = self._tenant_decode.get(tenant)
+                        for r in open_reps:
+                            if r.rid == want:
+                                rep = r
+                                affinity_hit = True
+                                self._stats["tenant_affinity_hits"] += 1
+                                break
+                        self._tenant_rec_locked(
+                            tenant)["dispatched"] += 1
+                    rep.inflight += 1
+                    pending += 1
+                    self._stats["dispatched"] += 1
+                    self._stats["max_pending"] = max(
+                        self._stats["max_pending"], pending)
+                tier_empty = not any(not r.draining
+                                     for r in self._decode)
             if open_reps:
-                # probe-free first cut: least estimated in-flight,
-                # reserved NOW so the bound holds under concurrency
-                rep = min(open_reps, key=lambda r: r.inflight)
-                if tenant is not None:
-                    self._stats["tenant_affinity_total"] += 1
-                    want = self._tenant_decode.get(tenant)
-                    for r in open_reps:
-                        if r.rid == want:
-                            rep = r
-                            affinity_hit = True
-                            self._stats["tenant_affinity_hits"] += 1
-                            break
-                    self._tenant_rec_locked(tenant)["dispatched"] += 1
-                rep.inflight += 1
-                pending += 1
-                self._stats["dispatched"] += 1
-                self._stats["max_pending"] = max(
-                    self._stats["max_pending"], pending)
-        self._depth_win.add(pending)
-        if not open_reps:
+                self._depth_win.add(pending)
+                break
+            if tier_empty and self._tier_waker is not None:
+                # one wake attempt per admission; the wait engages only
+                # when the waker reports a replica is actually coming
+                # (min_replicas=0 tier) — a dead min_replicas>=1 tier
+                # keeps the pre-existing immediate shed
+                if wake_until is None and self._wake_tier("decode"):
+                    wake_until = time.monotonic() + self.failover_wait_s
+                if wake_until is not None \
+                        and time.monotonic() < wake_until:
+                    self._check_deadline(deadline, tenant)
+                    time.sleep(0.1)
+                    continue
+            self._depth_win.add(pending)
             # _shed pushes the snapshot NOW (0.5s-throttled): under
             # sustained overload nothing completes, and a completion-
             # only push would freeze the conductor surfaces — queue
@@ -1529,15 +1615,20 @@ class DisaggRouter:
                               tenant: Optional[str] = None
                               ) -> _TierReplica:
         """_pick_prefill, waiting out a momentarily-empty tier (every
-        prefill replica dead, self-healer replacement in flight) up to
-        ``failover_wait_s`` before shedding with cause failover."""
+        prefill replica dead — self-healer replacement in flight — or
+        drained to zero: the first LookupError fires the scale-from-
+        zero waker) up to ``failover_wait_s`` before shedding with
+        cause failover."""
         wait_until = time.monotonic() + self.failover_wait_s
+        woke = False
         while True:
             try:
                 return self._pick_prefill(prompt, decode_machine,
                                           tenant)
             except LookupError:
-                pass
+                if not woke:
+                    self._wake_tier("prefill")
+                    woke = True
             self._check_deadline(deadline, tenant)
             if time.monotonic() >= wait_until:
                 raise self._shed(
@@ -1633,7 +1724,7 @@ class DisaggRouter:
         # exit must decrement whichever replica holds it NOW (releasing
         # the original after a swap would steal another request's
         # reservation and leak the survivor's)
-        rep_box = [self._admit_or_shed(tenant)]
+        rep_box = [self._admit_or_shed(tenant, deadline)]
         t_admit = time.perf_counter()
         ok = False
         try:

@@ -15,9 +15,16 @@ The pieces, and where each lives:
   write its row), evicting the least-recently-used UNPINNED row under
   pressure; pinned rows are never evicted. Acquisition runs on the
   SUBMITTING thread (models/engine.py submit/adopt_prefill), so a cold
-  tenant's page-in can never stall another tenant's decode tick; pool
-  writes are plain (non-donated) row updates that rebind the stacks,
-  so an in-flight tick keeps reading the arrays it captured.
+  tenant's page-in can never stall another tenant's decode tick. Pool
+  row writes are DONATED jits — O(row) in place, never an O(pool)
+  stack copy (the models/kvcache.py write discipline; at 64 slots x
+  32 layers a copying write moves the whole pool per page-in). The
+  donation is tick-safe the same way the kvcache's is: every read of
+  the stacks (the decode tick via ``dispatch_tick``, the prefill
+  merge's ``adapter_slice``) and every donated write dispatches under
+  the pool lock, so same-device stream order makes dispatch the only
+  critical section while the compute overlaps freely. shardlint's
+  ``undonated-pool-write`` rule guards the discipline.
 - **Cross-tenant batched decode** (models/engine.py ``_tick_lora`` +
   the model families' ``*_decode(lora=)``): one decode tick serves
   mixed tenants via per-slot adapter indices gathering each slot's
@@ -126,6 +133,33 @@ def _worker():
     from ray_tpu._private import worker as worker_mod
 
     return worker_mod.global_worker
+
+
+# -------------------------------------------------- donated row writes
+
+_row_write_jit = None
+
+
+def _row_write():
+    """The ONE donated pool-row writer (lazy so importing serve.lora
+    never touches jax): ``write(stack, row, leaf)`` lowers to an
+    in-place O(leaf) update of ``stack[row]`` with the stack donated —
+    one compiled program per stack shape, shared by every A/B leaf and
+    the scale vector. Callers must hold the pool lock across the
+    dispatch (see AdapterPool)."""
+    global _row_write_jit
+    if _row_write_jit is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def write(stack, row, leaf):
+            return jax.lax.dynamic_update_slice(
+                stack, leaf[None], (row,) + (0,) * leaf.ndim)
+
+        _row_write_jit = write
+    return _row_write_jit
 
 
 # ------------------------------------------------------- host adapters
@@ -467,14 +501,18 @@ class AdapterPool:
 
     def _write_row_locked(self, row: int,
                           adapter: Dict[str, Any]) -> None:
-        """Write one adapter into pool row `row`. Plain (non-donated)
-        row updates REBIND the stacks: an in-flight tick keeps reading
-        the arrays it captured at dispatch, so the swap lands between
-        ticks by construction — no donation hazard, at the cost of an
-        O(pool) copy per page-in (tiny next to the fetch; the Pallas
-        ragged-matmul follow-up owns the in-place version)."""
+        """Write one adapter into pool row `row` through the DONATED
+        row writer — an in-place O(row) update per leaf, never an
+        O(pool) stack copy (the ROADMAP's 64-slot x 32-layer scale
+        bug). Caller holds the pool lock: every stack read (the tick's
+        ``dispatch_tick``, the prefill merge's ``adapter_slice``)
+        dispatches under the same lock, so the donation can never
+        invalidate an array a concurrent reader is about to hand to
+        XLA — same-device stream order serializes the rest."""
         import jax.numpy as jnp
 
+        write = _row_write()
+        rw = np.int32(row)
         layers = len_blocks(self.config)
         for name, d_in, d_out in self.targets:
             a = self._pad(np.asarray(adapter["targets"][name]["a"]), 2)
@@ -485,14 +523,16 @@ class AdapterPool:
                     f"adapter leaf {name!r} shaped a={a.shape} "
                     f"b={b.shape} does not fit this model's target "
                     f"({layers}, {d_in}->{d_out})")
-            self._a[name] = self._a[name].at[row].set(
-                jnp.asarray(a, self.dtype))
-            self._b[name] = self._b[name].at[row].set(
-                jnp.asarray(b, self.dtype))
+            self._a[name] = write(self._a[name], rw,
+                                  jnp.asarray(a, self.dtype))
+            self._b[name] = write(self._b[name], rw,
+                                  jnp.asarray(b, self.dtype))
         # ravel()[0]: the fabric's 0-d -> 1-d chunk promotion may hand
         # the scale back as a [1] array
-        self._scale = self._scale.at[row].set(
-            float(np.asarray(adapter.get("scale", 1.0)).ravel()[0]))
+        self._scale = write(
+            self._scale, rw,
+            jnp.asarray(float(np.asarray(adapter.get("scale", 1.0))
+                              .ravel()[0]), jnp.float32))
 
     # ------------------------------------------------------------ paging
 
@@ -594,8 +634,9 @@ class AdapterPool:
                                         "row": row})
                 r = _Resident(tenant, row)
                 self._by_tenant[tenant] = r
-            # the write dispatches under the lock; rebinding (not
-            # donating) the stacks makes it tick-boundary safe
+            # the DONATED write dispatches under the lock — the same
+            # lock every stack read dispatches under, so stream order
+            # makes the in-place update tick-safe
             self._write_row_locked(r.row, adapter)
             r.version = int(version)
             r.rank = rank
@@ -672,21 +713,38 @@ class AdapterPool:
 
     # -------------------------------------------------------- device API
 
-    def tick_args(self, slot_adapter: np.ndarray) -> Dict[str, Any]:
-        """The mixed-tenant decode tick's `lora` argument: per-slot pool
-        rows + the stacks (models/llama.py ``llama_decode(lora=)``
-        layout). A plain read — the stacks are rebound, never donated,
-        so whatever this captures stays valid for the whole tick."""
+    def _tick_args_locked(self, slot_adapter: np.ndarray
+                          ) -> Dict[str, Any]:
         import jax.numpy as jnp
 
-        with self._lock:
-            out: Dict[str, Any] = {
-                "idx": jnp.asarray(slot_adapter, jnp.int32),
-                "scale": self._scale,
-            }
-            for name, _, _ in self.targets:
-                out[name] = (self._a[name], self._b[name])
+        out: Dict[str, Any] = {
+            "idx": jnp.asarray(slot_adapter, jnp.int32),
+            "scale": self._scale,
+        }
+        for name, _, _ in self.targets:
+            out[name] = (self._a[name], self._b[name])
         return out
+
+    def dispatch_tick(self, fn: Callable[[Dict[str, Any]], Any],
+                      slot_adapter: np.ndarray) -> Any:
+        """Build the mixed-tenant tick's `lora` argument (per-slot pool
+        rows + the stacks, models/llama.py ``llama_decode(lora=)``
+        layout) and dispatch ``fn(args)`` UNDER the pool lock. Pool-row
+        writes are donated jits dispatched under this same lock, so a
+        page-in racing a tick can never donate away an array the tick
+        is about to hand to XLA — dispatch is the only critical
+        section (the kvcache gather/commit discipline); the tick's
+        compute still overlaps page-in fetches freely."""
+        with self._lock:
+            return fn(self._tick_args_locked(slot_adapter))
+
+    def tick_args(self, slot_adapter: np.ndarray) -> Dict[str, Any]:
+        """Snapshot of the tick argument for INSPECTION (tests,
+        debugging). Dispatching a jit on these references outside
+        ``dispatch_tick`` races the donated row writes — the engine
+        always goes through ``dispatch_tick``."""
+        with self._lock:
+            return self._tick_args_locked(slot_adapter)
 
     def adapter_slice(self, row: int, with_version: bool = False):
         """ONE adapter's device arrays (for the single-tenant prefill

@@ -144,6 +144,48 @@ def kv_cache_stats(engine: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def speculation_totals(engines: Dict[str, Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """The ONE speculation rollup (counter sums + acceptance rate +
+    tokens-per-verify) — shared by the conductor's
+    get_speculation_stats and this module's engine filter so a new
+    counter can never make the filtered view disagree with the
+    cluster-wide one."""
+    totals: Dict[str, Any] = {
+        k: sum(int(e.get(k, 0)) for e in engines.values())
+        for k in ("spec_proposed", "spec_accepted",
+                  "spec_verify_ticks", "spec_emitted_tokens")}
+    totals["acceptance_rate"] = (
+        totals["spec_accepted"] / totals["spec_proposed"]
+        if totals["spec_proposed"] else 0.0)
+    totals["tokens_per_verify"] = (
+        totals["spec_emitted_tokens"] / totals["spec_verify_ticks"]
+        if totals["spec_verify_ticks"] else 0.0)
+    totals["engines"] = len(engines)
+    return totals
+
+
+def speculation_stats(engine: Optional[str] = None) -> Dict[str, Any]:
+    """Speculative-decoding view (models/engine.py): per-engine draft
+    counters (proposed/accepted, verify ticks, tokens-per-verify,
+    acceptance rate, the int8-KV flag) plus cluster totals. Rides the
+    SAME conductor snapshots as kv_cache_stats() — one report channel,
+    one set of numbers. The CLI analog is `python -m ray_tpu
+    speculate`; the dashboard serves it at /api/speculation;
+    spec_accept/spec_reject markers ride the merged timeline's kvcache
+    lane. `engine` filters to one engine id."""
+    out = _conductor().conductor.call("get_speculation_stats",
+                                      timeout=10.0)
+    if engine is not None:
+        engines = {k: v for k, v in out.get("engines", {}).items()
+                   if v.get("engine_id") == engine}
+        # totals must describe the FILTERED view, or the one engine
+        # shown disagrees with the summary printed beside it
+        out = {"engines": engines,
+               "totals": speculation_totals(engines)}
+    return out
+
+
 def pipeline_status(name: Optional[str] = None) -> Dict[str, Any]:
     """MPMD pipeline view (ray_tpu.mpmd): per-pipeline stage registry
     (formed flag, per-stage slice/worker identity), per-stage run stats

@@ -85,6 +85,15 @@ def pytest_configure(config):
         "tier-1-safe on CPU, cluster tests run on a module-scoped "
         "log_to_driver=0 cluster — select with `-m lora`")
     config.addinivalue_line(
+        "markers", "speculate: speculative decoding + int8 KV "
+        "scenarios (models/engine.py verify ticks + models/kvcache.py "
+        "quantized pool): greedy bit-identity vs the unspeculated "
+        "engine (full/partial/zero acceptance), refcount rollback "
+        "leak-freedom, int8 pool equivalence + capacity doubling, "
+        "disagg + LoRA mixed-batch paths; everything is tier-1-safe "
+        "on CPU, the e2e surface check runs on a module-scoped "
+        "log_to_driver=0 cluster — select with `-m speculate`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "

@@ -226,6 +226,45 @@ def test_shardlint_suppression_comment():
     assert len(fs) == 1 and "x.py:5" in fs[0].location
 
 
+def test_undonated_pool_write_rule():
+    """Seeded violations: copying writes into pool-named stacks — the
+    .at[].set form and the bare dynamic_update_slice form — are
+    flagged, while the same update inside a donate_argnums jit (the
+    kvcache/lora write discipline) is exempt, donation-less jits
+    included."""
+    src = ("import functools\n"
+           "import jax\n"
+           "class Pool:\n"
+           "    def write(self, bid, blk):\n"
+           "        self._pool_k = self._pool_k.at[bid].set(blk)\n"
+           "        self._pool_v = jax.lax.dynamic_update_slice(\n"
+           "            self._pool_v, blk, (0, bid))\n"
+           "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+           "def _ok(pool_k, bid, blk):\n"
+           "    return jax.lax.dynamic_update_slice(pool_k, blk,\n"
+           "                                        (0, bid))\n"
+           "@functools.partial(jax.jit)\n"
+           "def _undonated(pool_k, bid, blk):\n"
+           "    return jax.lax.dynamic_update_slice(pool_k, blk,\n"
+           "                                        (0, bid))\n")
+    fs = [f for f in lint_source(src, "x.py")
+          if f.rule == "undonated-pool-write"]
+    assert {f.location for f in fs} == {"x.py:5", "x.py:6", "x.py:14"}
+    assert all(f.severity == "warning" for f in fs)
+    # non-pool receivers are not the rule's business
+    clean = ("def f(cache, blk):\n"
+             "    return cache.at[0].set(blk)\n")
+    assert lint_source(clean, "y.py") == []
+
+
+def test_undonated_pool_write_suppression():
+    src = ("class P:\n"
+           "    def w(self, b):\n"
+           "        self._pool_k = self._pool_k.at[0].set(b)"
+           "  # shardlint: disable=undonated-pool-write\n")
+    assert lint_source(src, "x.py") == []
+
+
 # ------------------------------------------- dryrun layouts analyze clean
 
 

@@ -220,6 +220,59 @@ def test_tier_spec_bounds_cap_any_policy(model):
                     stop()
 
 
+def test_scale_to_zero_and_first_arrival_wake(model):
+    """min_replicas=0 (the PR-11 follow-on): an idle decode tier
+    drains all the way to ZERO replicas through the normal grace flow,
+    and the FIRST arrival afterwards triggers an immediate factory
+    scale-up through the router's tier waker — the request is served,
+    never shed. Absence is not load: the wake bypasses hysteresis."""
+    import numpy as np
+
+    router, prefill, decode = _tiers(model, max_batch=2, queue_depth=2)
+    scaler = DisaggAutoscaler(
+        router,
+        prefill=TierSpec(lambda: PrefillServer(model, CFG,
+                                               kv_block_size=BS,
+                                               kv_pool_blocks=32),
+                         min_replicas=1, max_replicas=2),
+        decode=TierSpec(lambda: DecodeServer(model, CFG, max_batch=2),
+                        min_replicas=0, max_replicas=2,
+                        down_delay_s=1.0, cooldown_s=0.5),
+        interval_s=3600, drain_grace_s=5.0,
+        autoscaler_id="scale-to-zero-test")
+    try:
+        now = time.monotonic()
+        acts = []
+        for i in range(10):  # idle ticks past down_delay + drain
+            acts += scaler.tick(now + i * 1.0)
+        deadline = time.monotonic() + 15.0
+        while router.tier_replicas("decode") and \
+                time.monotonic() < deadline:
+            acts += scaler.tick(time.monotonic() + 20.0)
+            time.sleep(0.1)
+        assert router.tier_replicas("decode") == [], acts
+        assert any(a["kind"] == "drain" and a["tier"] == "decode"
+                   for a in acts)
+        # first arrival: the waker spawns a replica and the request
+        # completes instead of shedding cause=capacity
+        prompt = np.random.default_rng(5).integers(
+            1, CFG.vocab_size, 10).tolist()
+        out = router.generate(prompt, 6)
+        assert len(out) == 6
+        assert len(router.tier_replicas("decode")) == 1
+        assert scaler.status()["wakeups"]["decode"] == 1
+        assert router.stats()["tier_wakeups"] == 1
+        # the prefill tier (min 1) never dropped below its floor
+        assert len(router.tier_replicas("prefill")) >= 1
+    finally:
+        scaler.stop()
+        for tier in ("prefill", "decode"):
+            for r in router.tier_replicas(tier):
+                stop = getattr(r["target"], "stop", None)
+                if callable(stop):
+                    stop()
+
+
 # ------------------------------------------------- closed loop, real tiers
 
 def test_scale_up_on_burst_admits_immediately(model):

@@ -101,6 +101,37 @@ def test_pool_refcount_lru_pin_evict(tiny, adapters):
     assert pool.acquire("t0") == r0  # still a hit
 
 
+def test_pool_row_writes_are_donated_in_place(tiny, adapters):
+    """The ROADMAP LoRA follow-up (c): a page-in writes O(row) IN
+    PLACE through a donated jit — never an O(pool) stack copy. The
+    donation is observable: the pre-write stack buffer is deleted
+    (donated into the write) and the post-write stack reuses the same
+    device buffer. A copying `.at[row].set` would leave the old array
+    alive and allocate a fresh pool (and trips shardlint's
+    undonated-pool-write rule anyway)."""
+    from ray_tpu.serve.lora import AdapterPool, LocalAdapterSource
+
+    cfg, _ = tiny
+    pool = AdapterPool(cfg, slots=2,
+                       source=LocalAdapterSource(dict(adapters)))
+    name = pool.targets[0][0]
+    pool.acquire("t0")  # first page-in: the stacks settle
+    before_a = pool._a[name]
+    before_scale = pool._scale
+    ptr_a = before_a.unsafe_buffer_pointer()
+    pool.acquire("t1")  # second page-in writes another row
+    assert before_a.is_deleted()       # donated, not copied
+    assert before_scale.is_deleted()
+    assert pool._a[name].unsafe_buffer_pointer() == ptr_a  # in place
+    # content is still per-row correct: t0's row survived t1's write
+    sl = pool.adapter_slice(pool.acquire("t0"))
+    import numpy as np
+
+    got = np.asarray(sl["targets"][name]["a"], np.float32)
+    want = np.asarray(adapters["t0"]["targets"][name]["a"], np.float32)
+    assert np.allclose(got[..., :want.shape[-1]], want, atol=1e-2)
+
+
 def test_pool_rank_ceiling(tiny, adapters):
     from ray_tpu.serve.lora import (AdapterPool, LocalAdapterSource,
                                     make_lora_adapter)

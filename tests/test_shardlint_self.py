@@ -206,6 +206,37 @@ def test_unkeyed_tenant_cache_rule_fires():
             if f.rule == "unkeyed-tenant-cache"] == []
 
 
+def test_speculation_modules_are_lint_covered():
+    """The speculative-decoding + int8-KV modules (models/engine.py,
+    models/kvcache.py, serve/lora.py after the donated-write rework)
+    are inside the self-lint set, carry zero error findings, and —
+    pool-write discipline — zero `undonated-pool-write` findings after
+    suppressions: every pool mutation goes through a donated jit."""
+    from ray_tpu.analysis import lint_path as lp
+
+    for rel in (os.path.join("models", "engine.py"),
+                os.path.join("models", "kvcache.py"),
+                os.path.join("serve", "lora.py"),
+                os.path.join("serve", "disagg.py"),
+                "bench_serve.py"):
+        path = os.path.join(PACKAGE_ROOT, rel)
+        assert os.path.exists(path), rel
+        findings = lp(path)
+        assert errors(findings) == [], rel
+        undonated = [f for f in findings
+                     if f.rule == "undonated-pool-write"]
+        assert undonated == [], (rel, [str(f) for f in undonated])
+
+
+def test_undonated_pool_write_zero_across_package():
+    """No module in the whole package writes a pool outside a donated
+    jit (after justified suppressions) — the rule that keeps the
+    kvcache/adapter-pool O(row) write discipline from regressing."""
+    found = [f for f in lint_path(PACKAGE_ROOT)
+             if f.rule == "undonated-pool-write"]
+    assert found == [], [str(f) for f in found]
+
+
 def test_driver_entry_is_clean_too():
     repo_root = os.path.dirname(PACKAGE_ROOT)
     entry = os.path.join(repo_root, "__graft_entry__.py")
