@@ -280,6 +280,15 @@ class ConductorHandler:
         self._lora_stats: Dict[str, Dict[str, Any]] = {}
         self._lora_events: List[Dict[str, Any]] = []
 
+        # HTTP front door (serve/gateway.py): gateway replicas push
+        # request/class/code counters + TTFT windows; QoS gates and
+        # routers push accept/first_byte/preempt/rate_limit/disconnect
+        # markers for the merged timeline's `gateway` lane. One
+        # aggregate feeds util.state.gateway_status(), `ray_tpu
+        # gateway`, and /api/gateway.
+        self._gateway_stats: Dict[str, Dict[str, Any]] = {}
+        self._gateway_events: List[Dict[str, Any]] = []
+
         # Step-time oracle (observability.roofline): predicted step-time
         # breakdowns keyed by layout + predicted-vs-measured validation
         # records (residuals, fitted calibration). One aggregate feeds
@@ -1902,6 +1911,79 @@ class ConductorHandler:
                           ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._disagg_events[-limit:]
+
+    # ------------------------------------------------ HTTP front door
+    # Gateway replicas (serve/gateway.py) push request counters by
+    # priority class and status code plus TTFT windows; the QoS gate
+    # and routers push instant markers (accept / first_byte / preempt /
+    # rate_limit / disconnect) for the merged timeline's gateway lane.
+    # util.state.gateway_status(), `ray_tpu gateway`, and the dashboard
+    # /api/gateway all read the same aggregate.
+
+    _GATEWAY_EVENTS_KEPT = 10_000
+    _GATEWAY_STATS_KEPT = 64
+
+    def report_gateway_stats(self, worker_id: str, component_id: str,
+                             stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._gateway_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            while len(self._gateway_stats) > self._GATEWAY_STATS_KEPT:
+                oldest = min(self._gateway_stats,
+                             key=lambda k:
+                             self._gateway_stats[k].get("ts", 0.0))
+                del self._gateway_stats[oldest]
+
+    def get_gateway_status(self) -> Dict[str, Any]:
+        """One aggregate for every gateway surface: per-replica
+        snapshots plus cluster totals (requests by outcome, per-class
+        accept/complete/shed/disconnect split, status-code histogram,
+        preemptions)."""
+        with self._lock:
+            gateways = {k: dict(v)
+                        for k, v in self._gateway_stats.items()}
+        by_class: Dict[str, Dict[str, int]] = {}
+        by_code: Dict[str, int] = {}
+        for g in gateways.values():
+            for cls, row in (g.get("by_class") or {}).items():
+                agg = by_class.setdefault(cls, {})
+                for k, v in row.items():
+                    agg[k] = agg.get(k, 0) + int(v)
+            for code, n in (g.get("by_code") or {}).items():
+                by_code[code] = by_code.get(code, 0) + int(n)
+        totals: Dict[str, Any] = {
+            "gateways": len(gateways),
+            "by_class": by_class,
+            "by_code": by_code,
+        }
+        for key in ("accepted", "completed", "streamed", "tokens_out",
+                    "rate_limited", "sheds", "disconnects", "errors",
+                    "preemptions"):
+            totals[key] = sum(int(g.get(key, 0))
+                              for g in gateways.values())
+        return {"gateways": gateways, "totals": totals}
+
+    def report_gateway_event(self, event: Dict[str, Any]) -> None:
+        """accept / first_byte / preempt / rate_limit / disconnect
+        instant markers for the merged timeline's gateway lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._gateway_events.append(event)
+            if len(self._gateway_events) > self._GATEWAY_EVENTS_KEPT:
+                del self._gateway_events[
+                    :len(self._gateway_events)
+                    - self._GATEWAY_EVENTS_KEPT]
+
+    def get_gateway_events(self, limit: int = 10_000
+                           ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._gateway_events[-limit:]
 
     # ------------------------------------------ serving fault tolerance
     # Disagg routers (failover/shed accounting) and self-healers

@@ -44,6 +44,19 @@ so broken or dependency-heavy modules still lint):
   copies the WHOLE pool per call — invisible at toy sizes, wrong at
   64-slot x 32-layer production scale.
 
+- sync-io-in-gateway-handler (info): in aiohttp-serving modules
+  (anything importing aiohttp — the HTTP front door in
+  serve/gateway.py, the dashboard, serve proxies), a synchronous
+  decode call — ``<anything>.generate(...)`` or
+  ``<anything>.decode_from(...)`` — lexically inside an ``async def``.
+  A router/engine decode blocks for the request's ENTIRE decode
+  (seconds), freezing every concurrent SSE stream on that gateway's
+  single event loop; the gateway discipline is to run decodes on the
+  executor (a nested sync ``def work():`` is its own scope and is not
+  flagged) and bridge tokens back through the loop. ``time.sleep`` in
+  the same position is already the blocking-in-async ERROR. Advisory:
+  a provably-instant call suppresses with a justification comment.
+
 Suppression: append `# shardlint: ok` to the flagged line, or
 `# shardlint: disable=<rule-id>` to suppress one rule on that line.
 """
@@ -367,6 +380,49 @@ def _lint_unkeyed_tenant_cache(tree: ast.AST, aliases: _Aliases,
     return findings
 
 
+# -------------------------------------------- sync-io-in-gateway-handler
+
+
+_SYNC_DECODE_ATTRS = ("generate", "decode_from")
+
+
+def _lint_sync_io_in_gateway_handler(tree: ast.AST, aliases: _Aliases,
+                                     path: str) -> List[Finding]:
+    """Active only in aiohttp-serving modules — importing aiohttp means
+    async HTTP handlers share one event loop here. There, a synchronous
+    decode call (``router.generate(...)``, ``server.decode_from(...)``)
+    lexically inside an ``async def`` holds the loop for the whole
+    decode: every other stream on the gateway stalls. Nested sync defs
+    (the executor-offload idiom) are their own scope via
+    _iter_scope_calls and stay clean."""
+    aiohttp_aware = any(mod == "aiohttp" or mod.startswith("aiohttp.")
+                        for mod in aliases.module_alias.values()) or any(
+        mod == "aiohttp" or mod.startswith("aiohttp.")
+        for mod, _name in aliases.from_imports.values())
+    if not aiohttp_aware:
+        return []
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for call in _iter_scope_calls(fn):
+            f = call.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _SYNC_DECODE_ATTRS):
+                continue
+            findings.append(Finding(
+                "sync-io-in-gateway-handler", INFO,
+                f"{path}:{call.lineno}",
+                f"synchronous .{f.attr}() inside "
+                f"'async def {fn.name}' holds the gateway event loop "
+                "for the whole decode — every concurrent stream "
+                "stalls",
+                "run the decode on the executor (nested sync def + "
+                "run_in_executor / ThreadPoolExecutor.submit) and "
+                "bridge tokens back via call_soon_threadsafe"))
+    return findings
+
+
 # --------------------------------------------------- undonated-pool-write
 
 
@@ -448,6 +504,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     findings += _lint_host_sync_in_jit(tree, aliases, path)
     findings += _lint_unsupervised_actor_call(tree, aliases, path)
     findings += _lint_unkeyed_tenant_cache(tree, aliases, path)
+    findings += _lint_sync_io_in_gateway_handler(tree, aliases, path)
     findings += _lint_undonated_pool_write(tree, aliases, path)
     if not findings:
         return findings

@@ -54,6 +54,10 @@ RULES: Dict[str, str] = {
     "host-sync-in-jit":
         "host synchronization (.item() / device_get / print) inside a "
         "jitted function",
+    "sync-io-in-gateway-handler":
+        "synchronous decode call (.generate(...) / .decode_from(...)) "
+        "inside an async HTTP handler freezes every stream on the "
+        "gateway's event loop",
 }
 
 

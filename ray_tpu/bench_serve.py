@@ -605,6 +605,272 @@ def _chaos_record(params, config, args, prompts, load_kw
             "recovery": recovery, "verdict": verdict}
 
 
+# --------------------------------------------------------- HTTP front door
+
+
+def _http_sse_drain(resp, t0: float) -> Dict[str, Any]:
+    """Drain one SSE completions stream off the real socket: returns
+    {text, ttft_s, finish, frames}. The concatenated deltas ARE the
+    response — the bit-identity check compares them against the
+    engine oracle verbatim."""
+    text = ""
+    ttft: Optional[float] = None
+    finish: Optional[str] = None
+    frames = 0
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[len(b"data: "):]
+        if payload == b"[DONE]":
+            break
+        obj = json.loads(payload)
+        if "error" in obj:
+            raise RuntimeError(str(obj["error"].get("message",
+                                                    "stream error")))
+        frames += 1
+        choice = obj["choices"][0]
+        delta = choice.get("text") or ""
+        if delta and ttft is None:
+            ttft = time.perf_counter() - t0
+        text += delta
+        if choice.get("finish_reason"):
+            finish = choice["finish_reason"]
+    return {"text": text, "ttft_s": ttft, "finish": finish,
+            "frames": frames}
+
+
+def _http_record(params, config, args, prompts) -> Dict[str, Any]:
+    """The front-door acceptance run: a mixed interactive+batch storm
+    over REAL sockets against serve/gateway.py.
+
+    Shape: `--http-max-batch` long batch decodes grab every engine slot
+    at t=0 (slow clients — `token_sleep_s` pacing rides the request
+    body); surplus batch arrivals land on the full system and shed with
+    an attributed cause; interactive requests arrive mid-decode and
+    must PREEMPT a batch slot (cancel + replay-with-history) to hold
+    their TTFT SLO. Every completed response — including the preempted-
+    then-resumed batch streams — must be bit-identical to a serial
+    engine-oracle decode of the same prompt, which is what makes the
+    preemption path oracle-checked rather than best-effort."""
+    import dataclasses
+    import http.client
+
+    import jax
+
+    from ray_tpu.models.engine import ContinuousBatchingEngine
+    from ray_tpu.models.llama import llama_init
+    from ray_tpu.serve.disagg import DisaggRouter
+    from ray_tpu.serve.gateway import GatewayServer
+    from ray_tpu.serve.qos import QosGate
+
+    # The preemption window is the ENGINE's production time for a batch
+    # stream, so the batch budget needs headroom past tiny()'s 128-token
+    # horizon (llama has no learned positions — same seed, same weights)
+    cfg = dataclasses.replace(
+        config, max_seq_len=max(config.max_seq_len,
+                                args.http_batch_new + 2 * args.block_size
+                                + 32))
+    params = llama_init(cfg, jax.random.PRNGKey(args.seed))
+    prompts = make_prompts(cfg, n_distinct=args.distinct,
+                           block_size=args.block_size, seed=args.seed)
+
+    engine = ContinuousBatchingEngine(params, cfg,
+                                      max_batch=args.http_max_batch)
+    router = DisaggRouter(colocated=engine, max_queue_depth=0)
+    gw = GatewayServer(router, model="bench",
+                       vocab_size=cfg.vocab_size,
+                       qos=QosGate(router=router),
+                       max_tokens_cap=args.http_batch_new)
+    host, port = gw.ready()
+
+    n_fill = args.http_max_batch
+    n_extra = max(0, args.http_batch - n_fill)
+    n_inter = args.http_interactive
+    rng = np.random.default_rng(args.seed)
+    pop = 1.0 / np.arange(1, len(prompts) + 1) ** args.zipf_a
+    picks = rng.choice(len(prompts), size=n_fill + n_extra + n_inter,
+                       p=pop / pop.sum())
+
+    # serial engine oracle BEFORE the storm: one uninterrupted greedy
+    # decode per (prompt, budget) — doubles as compile warm-up, so the
+    # measured TTFTs are steady-state
+    oracle: Dict[Any, str] = {}
+    for i in range(n_fill + n_extra + n_inter):
+        budget = (args.http_interactive_new if i >= n_fill + n_extra
+                  else args.http_batch_new)
+        key = (int(picks[i]), budget)
+        if key not in oracle:
+            toks = engine.generate(prompts[int(picks[i])], budget)
+            oracle[key] = " ".join(str(int(t)) for t in toks)
+
+    plan: List[Dict[str, Any]] = []
+    for i in range(n_fill):
+        plan.append({"i": i, "cls": "batch", "offset": 0.0,
+                     "budget": args.http_batch_new,
+                     "pace": args.token_sleep})
+    for i in range(n_extra):
+        plan.append({"i": n_fill + i, "cls": "batch",
+                     "offset": 0.4 + 0.05 * i,
+                     "budget": args.http_batch_new, "pace": 0.0})
+    for i in range(n_inter):
+        plan.append({"i": n_fill + n_extra + i, "cls": "interactive",
+                     "offset": 0.9 + 0.7 * i,
+                     "budget": args.http_interactive_new, "pace": 0.0})
+
+    lock = threading.Lock()
+    results: List[Dict[str, Any]] = []
+
+    def one(req: Dict[str, Any]) -> None:
+        time.sleep(req["offset"])
+        pidx = int(picks[req["i"]])
+        body = json.dumps({
+            "model": "bench", "prompt": prompts[pidx],
+            "max_tokens": req["budget"], "stream": True,
+            "priority": req["cls"],
+            "token_sleep_s": req["pace"]})
+        t0 = time.perf_counter()
+        rec: Dict[str, Any] = {"i": req["i"], "class": req["cls"],
+                               "prompt": pidx, "budget": req["budget"]}
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=180)
+            conn.request("POST", "/v1/completions", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            rec["status"] = resp.status
+            if resp.status == 200:
+                out = _http_sse_drain(resp, t0)
+                rec["outcome"] = "ok"
+                rec["text"] = out["text"]
+                rec["ttft_ms"] = (round(out["ttft_s"] * 1e3, 2)
+                                  if out["ttft_s"] is not None else None)
+                rec["finish"] = out["finish"]
+            else:
+                rec["outcome"] = "shed" if resp.status in (429, 503) \
+                    else "error"
+                rec["cause"] = (resp.headers.get("X-Shed-Cause")
+                                or "unattributed")
+                try:
+                    err = json.loads(resp.read() or b"{}")
+                    if rec["cause"] == "unattributed":
+                        rec["cause"] = err.get("error", {}).get(
+                            "code") or "unattributed"
+                except Exception:  # noqa: BLE001 — cause is best-effort
+                    pass
+            conn.close()
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            rec["outcome"] = "error"
+            rec["cause"] = f"{type(e).__name__}: {str(e)[:120]}"
+        rec["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        with lock:
+            results.append(rec)
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=one, args=(r,), daemon=True)
+               for r in plan]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=240)
+    wall = time.perf_counter() - t_start
+
+    router.publish_telemetry(force=True)
+    gw.publish_telemetry(force=True)
+    rt = router.stats()
+    kv = engine.kv_stats()
+    gw_stats = gw.stats()
+    gw.stop()
+    engine.stop()
+
+    with lock:
+        rows = list(results)
+    by_class: Dict[str, Dict[str, Any]] = {}
+    mismatches: List[Dict[str, Any]] = []
+    for cls in ("interactive", "batch"):
+        sub = [r for r in rows if r["class"] == cls]
+        ttfts = sorted(r["ttft_ms"] for r in sub
+                       if r.get("ttft_ms") is not None)
+        lats = sorted(r["latency_ms"] for r in sub)
+        pct = (lambda xs, p: round(float(np.percentile(xs, p)), 2)
+               if xs else None)
+        by_class[cls] = {
+            "n": len(sub),
+            "completed": sum(1 for r in sub if r.get("outcome") == "ok"),
+            "shed": sum(1 for r in sub if r.get("outcome") == "shed"),
+            "errors": sum(1 for r in sub
+                          if r.get("outcome") == "error"),
+            "shed_causes": {},
+            "ttft_p50_ms": pct(ttfts, 50),
+            "ttft_p99_ms": pct(ttfts, 99),
+            "latency_p50_ms": pct(lats, 50),
+            "latency_p99_ms": pct(lats, 99),
+        }
+        for r in sub:
+            if r.get("outcome") == "shed":
+                c = r.get("cause") or "unattributed"
+                sc = by_class[cls]["shed_causes"]
+                sc[c] = sc.get(c, 0) + 1
+    for r in rows:
+        if r.get("outcome") != "ok":
+            continue
+        want = oracle[(r["prompt"], r["budget"])]
+        if r["text"] != want:
+            mismatches.append({"i": r["i"], "class": r["class"],
+                               "prompt": r["prompt"],
+                               "got_len": len(r["text"]),
+                               "want_len": len(want)})
+
+    inter, batch = by_class["interactive"], by_class["batch"]
+    total = len(rows)
+    verdict: Dict[str, Any] = {
+        "accounted": (sum(c["n"] for c in by_class.values())
+                      == len(plan) == total),
+        "bit_identity": not mismatches,
+        "interactive_ttft_slo_ms": args.http_slo_ms,
+        "interactive_ttft_slo": (
+            inter["ttft_p99_ms"] is not None
+            and inter["ttft_p99_ms"] <= args.http_slo_ms),
+        "interactive_all_served": (
+            inter["completed"] == inter["n"] and inter["shed"] == 0),
+        "batch_absorbs": (
+            batch["shed"] >= (1 if n_extra else 0)
+            and "unattributed" not in batch["shed_causes"]
+            and inter["shed"] == 0),
+        "preemptions_observed": int(rt.get("preemptions", 0)) >= 1,
+        "preempted_resumed": int(rt.get("preempted_requests", 0)) >= 1,
+        "no_errors": all(c["errors"] == 0 for c in by_class.values()),
+    }
+    verdict["pass"] = all(
+        verdict[k] for k in ("accounted", "bit_identity",
+                             "interactive_ttft_slo",
+                             "interactive_all_served", "batch_absorbs",
+                             "preemptions_observed", "preempted_resumed",
+                             "no_errors"))
+    rec: Dict[str, Any] = {
+        "n_requests": total,
+        "wall_s": round(wall, 3),
+        "by_class": by_class,
+        "preemptions": int(rt.get("preemptions", 0)),
+        "preempted_requests": int(rt.get("preempted_requests", 0)),
+        "router_sheds_by_cause": dict(rt.get("sheds_by_cause") or {}),
+        "engine_cancels_by_reason": dict(
+            kv.get("cancelled_by_reason") or {}),
+        "gateway": {k: gw_stats.get(k) for k in
+                    ("accepted", "completed", "streamed", "tokens_out",
+                     "rate_limited", "sheds", "disconnects", "errors",
+                     "by_class", "by_code", "ttft_ms")},
+        "requests": [{k: v for k, v in r.items() if k != "text"}
+                     for r in sorted(rows, key=lambda r: r["i"])],
+        "verdict": verdict,
+    }
+    if mismatches:
+        rec["mismatches"] = mismatches[:5]
+    return rec
+
+
 def _collect_lora_pools(router) -> Dict[str, int]:
     """Sum the tier replicas' adapter-pool counters (local objects or
     actors) — the record's paging-amortization evidence."""
@@ -1053,6 +1319,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="per-request deadline_s: requests past it "
                          "shed with cause 'deadline' (slow clients "
                          "exercise the edge)")
+    ap.add_argument("--http", action="store_true",
+                    help="mixed interactive+batch storm over real "
+                         "sockets against the OpenAI-compatible "
+                         "gateway (serve/gateway.py): batch decodes "
+                         "fill every slot, surplus batch sheds with an "
+                         "attributed cause, interactive arrivals "
+                         "preempt and must hold the TTFT SLO; every "
+                         "completed stream is checked bit-identical "
+                         "against a serial engine oracle")
+    ap.add_argument("--http-max-batch", type=int, default=3,
+                    help="engine slots in --http mode (all of them "
+                         "are seized by batch fillers at t=0)")
+    ap.add_argument("--http-batch", type=int, default=5,
+                    help="total batch requests in --http mode; the "
+                         "surplus past --http-max-batch arrives on a "
+                         "full system and must shed")
+    ap.add_argument("--http-interactive", type=int, default=3,
+                    help="interactive probes in --http mode, arriving "
+                         "mid-decode so they must preempt")
+    ap.add_argument("--http-batch-new", type=int, default=600,
+                    help="batch decode budget in --http mode; sets "
+                         "the engine-production window preemption "
+                         "must land inside")
+    ap.add_argument("--http-interactive-new", type=int, default=24,
+                    help="interactive decode budget in --http mode")
+    ap.add_argument("--http-slo-ms", type=float, default=2000.0,
+                    help="interactive TTFT p99 SLO the --http verdict "
+                         "enforces")
     ap.add_argument("--chaos", action="store_true",
                     help="serving-fault acceptance run (implies "
                          "--cluster): a clean replay vs the same "
@@ -1186,6 +1480,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the spec/int8 modes flow None through to resolve_pool_config
         # so the int8 doubling is the real mechanism, not the harness
         args.pool_blocks = 64
+    if args.http:
+        record.update(metric="gateway_http_load",
+                      max_batch=args.http_max_batch,
+                      queue_depth=0,
+                      slo_ms=args.http_slo_ms)
+        try:
+            record.update(_http_record(params, config, args, prompts))
+            inter = record["by_class"]["interactive"]
+            record.update(value=inter["ttft_p99_ms"], unit="ms",
+                          ttft_p50_ms=inter["ttft_p50_ms"],
+                          ttft_p99_ms=inter["ttft_p99_ms"],
+                          shed_rate=(record["by_class"]["batch"]["shed"]
+                                     / max(1, record["n_requests"])))
+        finally:
+            if use_cluster:
+                import ray_tpu
+
+                ray_tpu.shutdown()
+        line = json.dumps(record)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(record, f, indent=1)
+        print(line)
+        return 0 if record.get("verdict", {}).get("pass") else 1
     if args.speculate:
         record.update(metric="speculative_decode_load",
                       speculate_k=args.speculate,

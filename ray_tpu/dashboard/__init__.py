@@ -57,6 +57,12 @@ Routes:
                          residents), per-tenant request counters,
                          recent page_in/evict/swap events
                          (serve/lora.py)
+  /api/gateway           HTTP front door: per-replica request counters
+                         by priority class and status code, recent
+                         TTFT per class, QoS admissions, batch-slot
+                         preemptions, recent accept/first_byte/
+                         preempt/rate_limit/disconnect events
+                         (serve/gateway.py + serve/qos.py)
   /api/oracle            step-time oracle: roofline predictions per
                          layout (device/ici/dcn breakdown),
                          predicted-vs-measured validations (residuals,
@@ -262,6 +268,18 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def gateway(self) -> Dict[str, Any]:
+        """HTTP front-door aggregate + the recent accept/first_byte/
+        preempt/rate_limit/disconnect event tail (one payload so the
+        SPA's panel needs a single fetch)."""
+        out = self.conductor.call("get_gateway_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_gateway_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def oracle(self) -> Dict[str, Any]:
         """Step-time-oracle aggregate + the recent event tail (one
         payload so the SPA's panel needs a single fetch)."""
@@ -393,6 +411,7 @@ class DashboardServer:
         app.router.add_get("/api/servefault",
                            self._json_route(d.servefault))
         app.router.add_get("/api/lora", self._json_route(d.lora))
+        app.router.add_get("/api/gateway", self._json_route(d.gateway))
         app.router.add_get("/api/oracle", self._json_route(d.oracle))
         app.router.add_get(
             "/api/rpc",

@@ -358,8 +358,11 @@ class _Request:
         self.lora_slot = 0
         # cancel_slot() lifecycle: cancelled requests free their slot
         # (and pins) at the next tick boundary instead of decoding to
-        # completion; finished guards double-release
+        # completion; finished guards double-release. cancel_reason
+        # attributes the cancel (deadline | disconnect | preempt |
+        # failover | idle_reap) in the engine's accounting.
         self.cancelled = False
+        self.cancel_reason: Optional[str] = None
         self.finished = False
 
 
@@ -492,6 +495,10 @@ class ContinuousBatchingEngine:
         self.prefill_admitted = 0
         self.adopted = 0
         self.cancelled = 0           # slots freed early by cancel_slot()
+        # the same count split by the caller-supplied cancel reason
+        # (deadline | disconnect | preempt | failover | idle_reap |
+        # unspecified) — a QoS preemption must never read as a shed
+        self.cancelled_by_reason: Dict[str, int] = {}
         self.max_prefills_admitted_per_tick = 0
         self.max_adoptions_admitted_per_tick = 0
         self._last_stats_push = 0.0
@@ -694,7 +701,8 @@ class ContinuousBatchingEngine:
         for ev in events:
             ev.set()
 
-    def cancel_slot(self, stream_or_req: Any) -> bool:
+    def cancel_slot(self, stream_or_req: Any,
+                    reason: Optional[str] = None) -> bool:
         """Cancel a live request (its TokenStream or the _Request
         itself): the decode loop frees its slot — and releases its KV
         pins and LoRA adapter pin — at the NEXT TICK BOUNDARY instead
@@ -702,12 +710,16 @@ class ContinuousBatchingEngine:
         deadline path used to waste every remaining tick on it). The
         freed slot is immediately re-admittable. Returns False when the
         request already finished (or was already cancelled); the
-        stream's consumer sees a normal end-of-stream."""
+        stream's consumer sees a normal end-of-stream. `reason`
+        attributes the cancel in ``cancelled_by_reason`` (the QoS
+        preemption path tags ``preempt`` so its cancels never read as
+        sheds)."""
         req = getattr(stream_or_req, "_req", stream_or_req)
         with self._lock:
             if req.finished or req.cancelled:
                 return False
             req.cancelled = True
+            req.cancel_reason = reason
             self._cancels += 1
         return True
 
@@ -719,9 +731,15 @@ class ContinuousBatchingEngine:
                 return
         for req in list(self._slot_req):
             if req is not None and req.cancelled and not req.finished:
-                self.cancelled += 1
+                self._count_cancel(req)
                 self._finish(req)
         self.publish_kv_telemetry()
+
+    def _count_cancel(self, req: "_Request") -> None:
+        key = req.cancel_reason or "unspecified"
+        self.cancelled += 1
+        self.cancelled_by_reason[key] = \
+            self.cancelled_by_reason.get(key, 0) + 1
 
     def stop(self) -> None:
         self._stopped.set()
@@ -768,6 +786,7 @@ class ContinuousBatchingEngine:
             prefill_programs=programs,
             spliced_tokens=self.spliced_tokens,
             cancelled=self.cancelled,
+            cancelled_by_reason=dict(self.cancelled_by_reason),
             lora=self.lora_pool is not None,
         )
         s.update(self.speculation_stats())
@@ -863,7 +882,7 @@ class ContinuousBatchingEngine:
         req = adoption.req
         if req.cancelled:
             # cancelled before admission: never occupies a slot
-            self.cancelled += 1
+            self._count_cancel(req)
             self._finish(req)
             return False
         with self._lock:
@@ -885,7 +904,7 @@ class ContinuousBatchingEngine:
     def _admit_one(self, req: _Request) -> bool:
         if req.cancelled:
             # cancelled before admission: never occupies a slot
-            self.cancelled += 1
+            self._count_cancel(req)
             self._finish(req)
             return False
         with self._lock:

@@ -54,6 +54,13 @@ merges and labels them:
                  adapter page_in / evict / swap per tenant, so adapter
                  paging lines up against the disagg lane's requests
                  and the weights lane's publishes.
+- gateway:       pid = "gateway",         tid = event kind — instant
+                 markers of the HTTP front door (serve/gateway.py +
+                 serve/qos.py): request accepts, first bytes (TTFT),
+                 batch-slot preemptions, rate-limit rejections, and
+                 client disconnects per priority class, so ingress
+                 pressure reads against the disagg lane's shed markers
+                 and the lora lane's tenant paging.
 - autoscale:     pid = "autoscale",       tid = event kind — instant
                  markers of the serving autoscaler (serve/autoscale.py):
                  scale_up / drain / scale_down per tier, so replica-set
@@ -290,6 +297,35 @@ def lora_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def gateway_trace_events(events: List[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    """Instant markers for HTTP front-door events (accept, first_byte,
+    preempt, rate_limit, disconnect) — mirrors the disagg track under
+    pid "gateway", so ingress pressure and preemptions read against
+    the router's shed/transfer markers and the lora lane's tenant
+    paging."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        if ev.get("class"):
+            label += f":{ev['class']}"
+        if ev.get("tenant"):
+            label += f"@{ev['tenant']}"
+        if ev.get("ttft_ms") is not None:
+            label += f" {ev['ttft_ms']}ms"
+        out.append({
+            "name": label, "cat": "gateway", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "gateway", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def autoscale_trace_events(events: List[Dict[str, Any]]
                            ) -> List[Dict[str, Any]]:
     """Instant markers for serving-autoscaler events (scale_up, drain,
@@ -397,6 +433,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         autoscale_events: Optional[
                             List[Dict[str, Any]]] = None,
                         lora_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        gateway_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -423,6 +461,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(autoscale_trace_events(autoscale_events))
     if lora_events:
         trace.extend(lora_trace_events(lora_events))
+    if gateway_events:
+        trace.extend(gateway_trace_events(gateway_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -483,8 +523,12 @@ def merged_timeline(filename: Optional[str] = None,
         lev = w.conductor.call("get_lora_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-lora conductor
         lev = []
+    try:
+        gev = w.conductor.call("get_gateway_events", limit, timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-gateway conductor
+        gev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev, oev, dev, orev, asev, lev)
+                                pev, oev, dev, orev, asev, lev, gev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

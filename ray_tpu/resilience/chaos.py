@@ -39,6 +39,14 @@ training ops; see serve/disagg.py):
   ``replica`` (default 0) is the replica's creation index within its
   role, so one plan kills exactly one replica and the self-healer's
   replacement (a higher index) does not re-fire.
+- ``{"action": "drop_connection", "at": "token:K"|"request:N",
+  "replica": R}`` — the HTTP gateway (serve/gateway.py) hard-aborts
+  the CLIENT socket of the request that crosses the K-th served token
+  (or at admission of the N-th request): a deterministic mid-stream
+  client disconnect, proving the disconnect-reap path (decode
+  cancelled, ``shed cause=disconnect``). ``role`` defaults to
+  ``gateway``; the gateway replica dies with nothing — only the
+  connection does (its monkey gets a flag-latching exit_fn).
 - ``{"action": "delay_chunk_fetch", "ms": M}`` — every ChunkFetcher
   pull sleeps M ms first (consulted out-of-band per fetch, like
   delay_heartbeats), stretching KV-transfer and weight-fetch latency.
@@ -65,7 +73,7 @@ ENV_VAR = "RAY_TPU_CHAOS_PLAN"
 _IN_PROCESS = ("raise", "kill", "preempt")
 _EXTERNAL = ("bounce_conductor",)
 _PASSIVE = ("delay_heartbeats", "delay_chunk_fetch")
-_SERVE = ("kill_replica",)
+_SERVE = ("kill_replica", "drop_connection")
 
 _AT_RE = re.compile(r"^(token|request):(\d+)$")
 
@@ -104,6 +112,16 @@ class ChaosAction:
             if not _AT_RE.match(str(d.get("at", ""))):
                 raise ValueError(
                     "chaos action 'kill_replica' requires "
+                    "at='token:K'|'request:N'")
+        if action == "drop_connection":
+            if d.get("role") not in (None, "gateway"):
+                raise ValueError(
+                    "chaos action 'drop_connection' fires at the "
+                    "gateway (role=gateway or omitted)")
+            d = dict(d, role="gateway")
+            if not _AT_RE.match(str(d.get("at", ""))):
+                raise ValueError(
+                    "chaos action 'drop_connection' requires "
                     "at='token:K'|'request:N'")
         return cls(action=action,
                    at_step=int(d.get("at_step", 0)),
@@ -178,9 +196,10 @@ class ChaosPlan:
 
     def serve_actions(self, role: str, replica: int
                       ) -> List[ChaosAction]:
-        """The kill_replica actions scoped to one tier replica."""
+        """The serving-plane actions (kill_replica / drop_connection)
+        scoped to one tier or gateway replica."""
         return [a for a in self.actions
-                if a.action == "kill_replica" and a.role == role
+                if a.action in _SERVE and a.role == role
                 and a.replica == int(replica)]
 
     def external_actions(self, step: int, attempt: int = 0
@@ -395,7 +414,7 @@ class ServeChaosMonkey:
             w = worker_mod.global_worker
             if w is not None:
                 w.conductor.notify("report_resilience_event", {
-                    "kind": "chaos", "action": "kill_replica",
+                    "kind": "chaos", "action": a.action,
                     "role": self.role, "replica": self.replica,
                     "at": a.at, "tokens": self._tokens,
                     "requests": self._requests})
@@ -405,12 +424,16 @@ class ServeChaosMonkey:
 
 
 def serve_monkey_from_spec(spec: Optional[str], role: str,
-                           replica: int = 0
+                           replica: int = 0,
+                           exit_fn: Callable[[int], Any] = os._exit
                            ) -> Optional[ServeChaosMonkey]:
     """Build a serving monkey when `spec` (or, if None, the env)
-    carries kill_replica actions for this (role, replica); None when
-    no serving chaos is configured — the hot path then pays a single
-    None check per token batch."""
+    carries serving actions for this (role, replica); None when no
+    serving chaos is configured — the hot path then pays a single
+    None check per token batch. `exit_fn` is what firing does: tier
+    replicas keep the default hard exit; the gateway passes a
+    flag-latching fn so a drop_connection kills one SOCKET, not the
+    ingress process."""
     try:
         plan = (ChaosPlan.from_env() if spec is None
                 else ChaosPlan.from_spec(spec))
@@ -420,5 +443,5 @@ def serve_monkey_from_spec(spec: Optional[str], role: str,
         return None  # malformed env plan: serving keeps running
     if not plan:
         return None
-    monkey = ServeChaosMonkey(plan, role, replica)
+    monkey = ServeChaosMonkey(plan, role, replica, exit_fn)
     return monkey if monkey else None

@@ -645,6 +645,66 @@ def cmd_servefault(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_gateway(args) -> None:
+    """`ray_tpu gateway` — HTTP front-door view (serve/gateway.py):
+    per-replica request counters split by priority class and status
+    code, recent TTFT per class, QoS admission/rejection, batch-slot
+    preemptions, plus the cluster totals every other surface (state
+    API, /api/gateway, Prometheus, `gateway` timeline lane) reports
+    from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.gateway_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    if not st.get("gateways"):
+        print("no gateway telemetry recorded (is a GatewayServer "
+              "running?)")
+        return
+    totals = st.get("totals") or {}
+    code_txt = " ".join(
+        f"{k}:{v}"
+        for k, v in sorted((totals.get("by_code") or {}).items())) \
+        or "none"
+    print(f"totals: gateways={totals.get('gateways', 0)} "
+          f"accepted={totals.get('accepted', 0)} "
+          f"completed={totals.get('completed', 0)} "
+          f"(streamed {totals.get('streamed', 0)}) "
+          f"tokens_out={totals.get('tokens_out', 0)} "
+          f"rate_limited={totals.get('rate_limited', 0)} "
+          f"sheds={totals.get('sheds', 0)} "
+          f"disconnects={totals.get('disconnects', 0)} "
+          f"preemptions={totals.get('preemptions', 0)} "
+          f"codes=({code_txt})")
+    for cls, row in sorted((totals.get("by_class") or {}).items()):
+        print(f"  class {cls}: accepted={row.get('accepted', 0)} "
+              f"completed={row.get('completed', 0)} "
+              f"shed={row.get('shed', 0)} "
+              f"disconnects={row.get('disconnects', 0)}")
+    for key, g in sorted((st.get("gateways") or {}).items()):
+        ttft = g.get("ttft_ms") or {}
+        ttft_txt = " ".join(
+            f"{c}_p99={w.get('p99', 0.0):.0f}ms"
+            for c, w in sorted(ttft.items()) if w.get("n"))
+        print(f"  {key}: {g.get('host')}:{g.get('port')} "
+              f"models={','.join(g.get('models') or [])} "
+              f"accepted={g.get('accepted', 0)} "
+              f"completed={g.get('completed', 0)} "
+              f"disconnects={g.get('disconnects', 0)} "
+              f"sheds={g.get('sheds', 0)} "
+              f"rate_limited={g.get('rate_limited', 0)} "
+              f"preemptions={g.get('preemptions', 0)}"
+              + (f" {ttft_txt}" if ttft_txt else ""))
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_gateway_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_lora(args) -> None:
     """`ray_tpu lora` — multi-tenant LoRA serving view
     (serve/lora.py): per-pool adapter-paging counters and residents,
@@ -1175,6 +1235,19 @@ def main(argv=None) -> None:
                          "breaker_trip slice)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_servefault)
+
+    sp = sub.add_parser("gateway",
+                        help="HTTP front door: per-replica request "
+                             "counters by priority class and status "
+                             "code, recent TTFT, QoS admissions, "
+                             "batch-slot preemptions, recent events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N gateway events "
+                         "(accept/first_byte/preempt/rate_limit/"
+                         "disconnect markers)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_gateway)
 
     sp = sub.add_parser("lora",
                         help="multi-tenant LoRA serving: adapter-pool "

@@ -23,11 +23,14 @@ from .context import get_request_context  # noqa: F401
 from .controller import ServeController
 from .disagg import (DecodeServer, DisaggRouter,  # noqa: F401
                      PrefillServer, ReplicaDeadError)
+from .gateway import GatewayServer  # noqa: F401
 from .handle import (CONTROLLER_NAME, DeploymentHandle,  # noqa: F401
                      DeploymentResponse, RequestShedError)
 from .http_util import Request, Response  # noqa: F401
 from .multiplex import (get_multiplexed_model_id, multiplexed,  # noqa: F401
                         request_tenant)
+from .qos import (BATCH, INTERACTIVE, QosGate,  # noqa: F401
+                  TenantPolicy, TokenBucket)
 from .replica import HandleMarker
 
 

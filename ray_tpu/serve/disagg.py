@@ -795,7 +795,7 @@ class DecodeServer:
             # the abandoned request must not decode to completion —
             # same early-free as cancel_decode (KV + adapter pins drop
             # at the next tick boundary)
-            self.engine.cancel_slot(st)
+            self.engine.cancel_slot(st, "idle_reap")
         return hid
 
     def next_tokens(self, hid: str, max_tokens: int = 64,
@@ -840,18 +840,22 @@ class DecodeServer:
             self.publish_telemetry()
         return {"tokens": toks, "done": done}
 
-    def cancel_decode(self, hid: str) -> bool:
-        """Abandon a pull handle (router shed the request on deadline
-        or failed it over): the engine CANCELS the slot — it frees,
-        with its KV pins and adapter pin, at the next tick boundary
-        instead of decoding the abandoned request to completion (the
-        PR-12 known limit: those ticks were pure waste). The freed
-        slot is immediately re-admittable."""
+    def cancel_decode(self, hid: str,
+                      reason: Optional[str] = None) -> bool:
+        """Abandon a pull handle (router shed the request on deadline,
+        failed it over, or PREEMPTED it for an interactive request):
+        the engine CANCELS the slot — it frees, with its KV pins and
+        adapter pin, at the next tick boundary instead of decoding the
+        abandoned request to completion (the PR-12 known limit: those
+        ticks were pure waste). The freed slot is immediately
+        re-admittable. `reason` tags the engine's cancel accounting
+        (``cancelled_by_reason``) so a preemption never reads as a
+        deadline shed."""
         with self._lock:
             entry = self._streams.pop(hid, None)
         if entry is None:
             return False
-        self.engine.cancel_slot(entry[0])
+        self.engine.cancel_slot(entry[0], reason)
         return True
 
     def _count_decoded(self, n: int) -> None:
@@ -986,6 +990,34 @@ class _TierReplica:
 _OUTCOME_WEIGHT = {"hit": 1.0, "partial": 0.5, "miss": 0.0}
 
 
+class _PreemptSlot:
+    """One PREEMPTIBLE in-flight request (priority class ``batch``,
+    serve/qos.py) as the admission path sees it. Registered for the
+    request's whole lifetime; ``cancel_fn`` is armed only while a
+    decode stream is actually live (it cancels that stream's engine
+    slot, reason-tagged ``preempt``). An interactive arrival that finds
+    every decode slot taken picks the victim with the FEWEST delivered
+    tokens — the cheapest replay — marks it ``preempted`` under the
+    router lock, and fires the cancel outside it. The victim's pull
+    loop notices (its stream ends early, or errors) and resumes
+    through the SAME replay-with-history path as a replica-death
+    failover: prompt+history re-prefills (a suffix-only prefill thanks
+    to the prefix cache) and decode continues for the remaining
+    budget, so the greedy bit-identity oracle covers preemption for
+    free."""
+
+    __slots__ = ("key", "tenant", "rep", "tokens", "preempted",
+                 "cancel_fn")
+
+    def __init__(self, key: int, tenant: Optional[str] = None):
+        self.key = key
+        self.tenant = tenant
+        self.rep: Optional[_TierReplica] = None
+        self.tokens = 0
+        self.preempted = False
+        self.cancel_fn: Optional[Callable[[], None]] = None
+
+
 class DisaggRouter:
     """Dispatch + admission control over a prefill tier and a decode
     tier (each a sequence of in-process servers or actor handles).
@@ -1076,7 +1108,13 @@ class DisaggRouter:
             "dispatched", "completed", "shed", "max_pending",
             "shm_affinity_hits", "shm_affinity_total",
             "tenant_affinity_hits", "tenant_affinity_total",
-            "tier_wakeups")}
+            "tier_wakeups", "preemptions", "preempted_requests")}
+        # QoS preemption (serve/qos.py classes): batch-class requests
+        # register here while in flight; an interactive arrival that
+        # finds every slot taken cancels the cheapest one and rides
+        # its freed slot — the victim resumes via the failover replay
+        self._preempt_seq = itertools.count()
+        self._preempt_reg: Dict[int, _PreemptSlot] = {}
         # scale-from-zero hook (serve/autoscale.py): called with the
         # tier name when an arrival finds that tier EMPTY — the
         # autoscaler's waker spawns a replica through the tier factory
@@ -1350,7 +1388,8 @@ class DisaggRouter:
     # ------------------------------------------------------------ admission
 
     def _admit_or_shed(self, tenant: Optional[str] = None,
-                       deadline: Optional[float] = None) -> _TierReplica:
+                       deadline: Optional[float] = None,
+                       priority: Optional[str] = None) -> _TierReplica:
         """Reserve a decode replica or shed. Sheds when EVERY active
         replica's in-flight estimate has reached capacity +
         max_queue_depth — the bound that keeps queue depth finite
@@ -1374,15 +1413,37 @@ class DisaggRouter:
         signal — the waker spawns a replica through the tier factory
         and this admission waits up to ``failover_wait_s`` for it to
         register instead of shedding. A full-but-live tier still sheds
-        immediately (that is load, not absence)."""
+        immediately (that is load, not absence).
+
+        `priority` (serve/qos.py classes): an ``interactive`` arrival
+        that finds every replica full PREEMPTS the cheapest registered
+        batch-class request instead of shedding — it rides the
+        victim's replica (deliberately one reservation past the bound:
+        the parked victim keeps its own reservation while it waits to
+        resume, so nothing leaks when both complete). The victim
+        resumes through the failover replay, bit-identical."""
         affinity_hit = False
         wake_until: Optional[float] = None
         while True:
+            victim: Optional[_PreemptSlot] = None
             with self._lock:
                 open_reps = [r for r in self._decode if not r.draining
                              and r.inflight < r.cap
                              + self.max_queue_depth]
                 pending = sum(r.inflight for r in self._decode)
+                if not open_reps and priority == "interactive":
+                    victim = self._pick_victim_locked()
+                    if victim is not None:
+                        rep = victim.rep
+                        rep.inflight += 1
+                        pending += 1
+                        self._stats["dispatched"] += 1
+                        self._stats["preemptions"] += 1
+                        self._stats["max_pending"] = max(
+                            self._stats["max_pending"], pending)
+                        if tenant is not None:
+                            self._tenant_rec_locked(
+                                tenant)["dispatched"] += 1
                 if open_reps:
                     # probe-free first cut: least estimated in-flight,
                     # reserved NOW so the bound holds under concurrency
@@ -1405,6 +1466,13 @@ class DisaggRouter:
                         self._stats["max_pending"], pending)
                 tier_empty = not any(not r.draining
                                      for r in self._decode)
+            if victim is not None:
+                # cancel fires OUTSIDE the lock (it's an RPC); the
+                # probe refinement below is naturally skipped — the
+                # preemptor must ride exactly the slot it just freed
+                self._fire_preemption(victim)
+                self._depth_win.add(pending)
+                break
             if open_reps:
                 self._depth_win.add(pending)
                 break
@@ -1480,6 +1548,83 @@ class DisaggRouter:
             pending, tags={"router": self.router_id})
         self.publish_telemetry()
         return rep
+
+    # ----------------------------------------------------- qos preemption
+
+    def _preempt_register(self, priority: Optional[str],
+                          tenant: Optional[str]
+                          ) -> Optional[_PreemptSlot]:
+        """Make a batch-class request visible to interactive
+        admission. Non-batch (and unclassified) requests return None —
+        they are never preemption victims."""
+        if priority != "batch":
+            return None
+        slot = _PreemptSlot(next(self._preempt_seq), tenant)
+        with self._lock:
+            self._preempt_reg[slot.key] = slot
+        return slot
+
+    def _preempt_unregister(self, slot: Optional[_PreemptSlot]) -> None:
+        if slot is None:
+            return
+        with self._lock:
+            self._preempt_reg.pop(slot.key, None)
+
+    def _pick_victim_locked(self) -> Optional[_PreemptSlot]:
+        """Cheapest-replay victim: the live batch stream with the
+        fewest delivered tokens (its replay re-prefills the least
+        history). Caller holds the router lock; marking ``preempted``
+        here makes the pick exactly-once under racing interactive
+        arrivals."""
+        cands = [s for s in self._preempt_reg.values()
+                 if s.cancel_fn is not None and s.rep is not None
+                 and not s.preempted]
+        if not cands:
+            return None
+        victim = min(cands, key=lambda s: s.tokens)
+        victim.preempted = True
+        return victim
+
+    def _fire_preemption(self, victim: _PreemptSlot) -> None:
+        """Cancel the victim's live decode stream (reason-tagged
+        ``preempt`` down in the engine) and count the preemption into
+        the gateway surface. The victim's pull loop notices its stream
+        ending early and resumes via replay-with-history; its
+        reservation never moves, so slot accounting stays balanced
+        when both requests complete."""
+        fn = victim.cancel_fn
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — victim mid-teardown
+                pass
+        try:
+            from .qos import gateway_metrics, push_gateway_event
+
+            gateway_metrics()["preemptions"].inc()
+            push_gateway_event({"kind": "preempt",
+                                "router": self.router_id,
+                                "victim_tenant": victim.tenant,
+                                "tokens_done": victim.tokens})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        _notify_event({"kind": "preempt", "router": self.router_id,
+                       "victim_tenant": victim.tenant})
+        self.publish_telemetry()
+
+    def _check_abort(self, deadline: Optional[float],
+                     tenant: Optional[str] = None,
+                     cancel_event: Any = None) -> None:
+        """_check_deadline plus the gateway's client-disconnect
+        signal: a set cancel_event sheds with cause ``disconnect`` —
+        an abandoned decode must stop burning ticks for a socket
+        nobody reads."""
+        self._check_deadline(deadline, tenant)
+        if cancel_event is not None and cancel_event.is_set():
+            raise self._shed(
+                "disconnect",
+                f"disagg router {self.router_id}: client disconnected "
+                f"mid-request; decode cancelled", tenant)
 
     def _note_tenant_decode(self, tenant: str, rid: str) -> None:
         with self._lock:
@@ -1683,7 +1828,10 @@ class DisaggRouter:
                  deadline_s: Optional[float] = None,
                  on_first_token=None,
                  token_sleep_s: float = 0.0,
-                 tenant: Optional[str] = None) -> List[int]:
+                 tenant: Optional[str] = None,
+                 priority: Optional[str] = None,
+                 on_tokens=None,
+                 cancel_event: Any = None) -> List[int]:
         """One request end-to-end. `on_first_token()` (optional) fires
         the moment the first token exists — at prefill completion under
         disaggregation — which is what the harness's TTFT measures.
@@ -1705,7 +1853,24 @@ class DisaggRouter:
         either returns the complete token list — bit-identical to an
         uninterrupted greedy run, surviving any single tier-replica
         death via bounded replay — or raises a RequestShedError with an
-        attributed cause. It never silently drops."""
+        attributed cause. It never silently drops.
+
+        QoS (serve/qos.py, the HTTP front door): `priority` names the
+        request's class — ``"batch"`` registers it as a preemption
+        victim candidate, ``"interactive"`` lets it preempt a batch
+        stream when every slot is taken (the victim resumes via the
+        failover replay, bit-identical; the preemptor rides the freed
+        slot). `on_tokens(list)` streams each delivered chunk to the
+        caller as it lands (the gateway's SSE bridge). `cancel_event`
+        (a threading.Event) aborts the request with shed cause
+        ``disconnect`` when set — the gateway sets it when the HTTP
+        client goes away. All three default to None: in-process
+        callers are byte-for-byte unaffected."""
+        if priority is not None and priority not in ("interactive",
+                                                     "batch"):
+            raise ValueError(
+                f"unknown priority class {priority!r}; expected "
+                f"'interactive' or 'batch'")
         if tenant is None and self._lora_enabled():
             # the implicit multiplexed-model-id default applies ONLY to
             # LoRA-enabled deployments: a plain multiplexed deployment
@@ -1724,23 +1889,25 @@ class DisaggRouter:
         # exit must decrement whichever replica holds it NOW (releasing
         # the original after a swap would steal another request's
         # reservation and leak the survivor's)
-        rep_box = [self._admit_or_shed(tenant, deadline)]
+        rep_box = [self._admit_or_shed(tenant, deadline, priority)]
         t_admit = time.perf_counter()
+        pslot = self._preempt_register(priority, tenant)
         ok = False
         try:
             if not self._disagg_mode:
                 out = self._generate_colocated(
                     prompt, max_new_tokens, eos_token, timeout_s,
                     deadline, on_first_token, token_sleep_s, t_admit,
-                    tenant)
+                    tenant, pslot, on_tokens, cancel_event, rep_box)
             else:
                 out = self._generate_disagg(
                     rep_box, prompt, max_new_tokens, eos_token,
                     timeout_s, deadline, on_first_token, token_sleep_s,
-                    t_admit, tenant)
+                    t_admit, tenant, pslot, on_tokens, cancel_event)
             ok = True
             return out
         finally:
+            self._preempt_unregister(pslot)
             self._complete(rep_box[0], ok, tenant=tenant,
                            wall_ms=(time.perf_counter() - t_admit)
                            * 1e3)
@@ -1757,61 +1924,142 @@ class DisaggRouter:
 
     def _generate_colocated(self, prompt, max_new_tokens, eos_token,
                             timeout_s, deadline, on_first_token,
-                            token_sleep_s, t_admit,
-                            tenant=None) -> List[int]:
-        try:
-            stream = self._colocated.stream(prompt, max_new_tokens,
-                                            eos_token,
-                                            timeout_s=timeout_s,
-                                            adapter_id=tenant)
-        except Exception as e:  # noqa: BLE001 — submit-time failure
-            if _is_pool_exhausted(e):
-                raise self._shed_pool_exhausted("colocated", tenant,
-                                                e) from e
-            raise
-        out: List[int] = []
-        try:
-            for tok in stream:
-                if not out:
-                    ttft = (time.perf_counter() - t_admit) * 1e3
-                    self._ttft_win.add(ttft)
-                    self._record_tenant_ttft(tenant, ttft)
-                    if on_first_token is not None:
-                        on_first_token()
-                out.append(tok)
-                if token_sleep_s > 0:
-                    time.sleep(token_sleep_s)
-                self._check_deadline(deadline, tenant)
-        except RequestShedError:
-            # deadline shed mid-stream: cancel the engine slot so the
-            # abandoned request stops burning ticks (freed + pins
-            # released at the next tick boundary)
-            cancel = getattr(self._colocated, "cancel_slot", None)
-            if callable(cancel):
-                cancel(stream)
-            raise
-        return out
+                            token_sleep_s, t_admit, tenant=None,
+                            pslot=None, on_tokens=None,
+                            cancel_event=None,
+                            rep_box=None) -> List[int]:
+        """Single-engine path — now a replay LOOP mirroring
+        _generate_disagg: a preempted batch stream ends early at the
+        engine's tick boundary (cancelled slots drain through _DONE)
+        and resumes here from prompt+history for the remaining budget,
+        bit-identical under greedy decode."""
+        history: List[int] = []
+        first_emitted = False
+        had_preempt = False
+        while True:
+            remaining = max_new_tokens - len(history)
+            if remaining <= 0:
+                break
+            if eos_token is not None and history \
+                    and history[-1] == int(eos_token):
+                break  # complete before the cancel landed
+            replay = (np.concatenate(
+                [prompt, np.asarray(history, np.int32)])
+                if history else prompt)
+            try:
+                stream = self._colocated.stream(replay, remaining,
+                                                eos_token,
+                                                timeout_s=timeout_s,
+                                                adapter_id=tenant)
+            except Exception as e:  # noqa: BLE001 — submit-time failure
+                if _is_pool_exhausted(e):
+                    raise self._shed_pool_exhausted("colocated", tenant,
+                                                    e) from e
+                raise
+            if pslot is not None:
+                # arm preemption for the live stream: the cancel is
+                # reason-tagged so engine accounting attributes it
+                with self._lock:
+                    pslot.rep = rep_box[0] if rep_box else None
+                    pslot.cancel_fn = (
+                        lambda s=stream: self._colocated.cancel_slot(
+                            s, "preempt"))
+            try:
+                for tok in stream:
+                    if not first_emitted:
+                        first_emitted = True
+                        ttft = (time.perf_counter() - t_admit) * 1e3
+                        self._ttft_win.add(ttft)
+                        self._record_tenant_ttft(tenant, ttft)
+                        if on_first_token is not None:
+                            on_first_token()
+                    history.append(tok)
+                    if pslot is not None:
+                        pslot.tokens = len(history)
+                    if on_tokens is not None:
+                        try:
+                            on_tokens([tok])
+                        except Exception:  # noqa: BLE001 — caller's
+                            pass
+                    if token_sleep_s > 0:
+                        time.sleep(token_sleep_s)
+                    self._check_abort(deadline, tenant, cancel_event)
+            except RequestShedError as e:
+                # deadline/disconnect shed mid-stream: cancel the
+                # engine slot so the abandoned request stops burning
+                # ticks (freed + pins released at the tick boundary)
+                cancel = getattr(self._colocated, "cancel_slot", None)
+                if callable(cancel):
+                    cancel(stream, getattr(e, "cause", None))
+                raise
+            finally:
+                if pslot is not None:
+                    with self._lock:
+                        pslot.cancel_fn = None
+            if pslot is not None and pslot.preempted \
+                    and len(history) < max_new_tokens \
+                    and not (eos_token is not None and history
+                             and history[-1] == int(eos_token)):
+                # the stream ended early because an interactive
+                # request took the slot — resume, don't return short
+                with self._lock:
+                    pslot.preempted = False
+                had_preempt = True
+                time.sleep(0.1)  # let the preemptor actually land
+                continue
+            break
+        if had_preempt:
+            with self._lock:
+                self._stats["preempted_requests"] += 1
+        return history
 
     def _generate_disagg(self, rep_box, prompt, max_new_tokens,
                          eos_token, timeout_s, deadline, on_first_token,
-                         token_sleep_s, t_admit,
-                         tenant=None) -> List[int]:
+                         token_sleep_s, t_admit, tenant=None,
+                         pslot=None, on_tokens=None,
+                         cancel_event=None) -> List[int]:
         """The failover loop. `history` holds every token delivered so
         far; a replay prefills prompt+history (a suffix-only prefill
         thanks to the prefix cache — the dead replica's tokens EXTEND
         the prompt) and resumes decode for the remaining budget, so the
         concatenated stream is bit-identical to an uninterrupted greedy
         run. `rep_box[0]` tracks the decode replica holding the
-        request's reservation across swaps; the caller releases it."""
+        request's reservation across swaps; the caller releases it.
+
+        A QoS preemption (`pslot` marked preempted, its stream
+        cancelled under it) rides the SAME loop: the victim's pull
+        ends early — done short of budget from an in-flight pull, or
+        KeyError once the handle is popped — and the next iteration
+        replays exactly like a failover, without consuming a failover
+        attempt or moving the reservation."""
         history: List[int] = []
         attempt = 0
         first_emitted = False
         fail_detected: Optional[float] = None
         had_failover = False
+        had_preempt = False
+
+        def _preempt_resume() -> bool:
+            """True exactly once per fired preemption: the stream
+            ended early because an interactive request took the slot
+            (not death, not completion) — resume, don't fail over."""
+            nonlocal had_preempt
+            if pslot is None or not pslot.preempted:
+                return False
+            if len(history) >= max_new_tokens or (
+                    eos_token is not None and history
+                    and history[-1] == int(eos_token)):
+                return False  # complete anyway; nothing to resume
+            with self._lock:
+                pslot.preempted = False
+            had_preempt = True
+            time.sleep(0.1)  # let the preemptor actually land
+            return True
+
         while True:
             rep = rep_box[0]
             attempt += 1
-            self._check_deadline(deadline, tenant)
+            self._check_abort(deadline, tenant, cancel_event)
             remaining = max_new_tokens - len(history)
             if remaining <= 0:
                 return history  # died between last token and DONE
@@ -1892,6 +2140,17 @@ class DisaggRouter:
                 hid = self._tier_call(rep, "decode", "start_decode",
                                       rec, remaining, eos_token,
                                       timeout_s)
+                if pslot is not None:
+                    # arm preemption for the LIVE stream only: an
+                    # interactive arrival cancels exactly this handle
+                    # (reason-tagged so engine accounting attributes
+                    # it) and rides the freed slot
+                    with self._lock:
+                        pslot.rep = rep
+                        pslot.cancel_fn = (
+                            lambda r=rep, h=hid: _call(  # shardlint: disable=unsupervised-actor-call
+                                r.target, "cancel_decode", h,
+                                "preempt", block=False))
                 last_progress = time.perf_counter()
                 while True:
                     out = self._tier_call(
@@ -1900,25 +2159,45 @@ class DisaggRouter:
                     toks = out.get("tokens") or []
                     if toks:
                         history.extend(int(t) for t in toks)
+                        if pslot is not None:
+                            pslot.tokens = len(history)
+                        if on_tokens is not None:
+                            # the gateway's SSE bridge; its bugs (or a
+                            # closed queue) must not kill the decode
+                            try:
+                                on_tokens([int(t) for t in toks])
+                            except Exception:  # noqa: BLE001
+                                pass
                         last_progress = time.perf_counter()
                         if token_sleep_s > 0:
                             time.sleep(token_sleep_s * len(toks))
                     if out.get("done"):
                         self._ack_transfer(pf, rec)
+                        if _preempt_resume():
+                            # the cancel landed mid-pull: this "done"
+                            # is the cancelled slot draining, not
+                            # completion — replay from history
+                            break
                         if had_failover:
                             with self._lock:
                                 self._sf["failover_requests"] += 1
                             self.publish_servefault()
+                        if had_preempt:
+                            with self._lock:
+                                self._stats[
+                                    "preempted_requests"] += 1
                         return history
                     try:
-                        self._check_deadline(deadline, tenant)
-                    except RequestShedError:
+                        self._check_abort(deadline, tenant,
+                                          cancel_event)
+                    except RequestShedError as e:
                         # abandon the stream: the engine frees the slot
                         # on its own; the transfer is still acked so
                         # the sender's chunk refs never leak
                         try:
                             self._tier_call(rep, "decode",
                                             "cancel_decode", hid,
+                                            getattr(e, "cause", None),
                                             block=False)
                         except Exception:  # noqa: BLE001 — dead too
                             pass
@@ -1931,6 +2210,14 @@ class DisaggRouter:
             except RequestShedError:
                 raise
             except Exception as e:  # noqa: BLE001 — death or stall
+                if _preempt_resume():
+                    # not a fault: the pull handle vanished because an
+                    # interactive request took the slot (cancel_decode
+                    # pops it -> this KeyError). Resume WITHOUT
+                    # consuming a failover attempt or moving the
+                    # reservation — the replica is alive.
+                    self._ack_transfer(pf, rec)
+                    continue
                 if _is_pool_exhausted(e):
                     self._ack_transfer(pf, rec)
                     raise self._shed_pool_exhausted("decode", tenant,
@@ -1948,7 +2235,7 @@ class DisaggRouter:
                     # cancel; on a dead replica this is a no-op throw
                     try:
                         _call(rep.target, "cancel_decode", hid,  # shardlint: disable=unsupervised-actor-call
-                              block=False)
+                              "failover", block=False)
                     except Exception:  # noqa: BLE001 — replica dead
                         pass
                 self._ack_transfer(pf, rec)
@@ -1957,6 +2244,10 @@ class DisaggRouter:
                 rep_box[0] = self._reserve_survivor(rep, deadline,
                                                     tenant)
                 continue
+            finally:
+                if pslot is not None:
+                    with self._lock:
+                        pslot.cancel_fn = None
 
     # ------------------------------------------------------------ telemetry
 

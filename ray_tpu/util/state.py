@@ -240,6 +240,19 @@ def lora_status() -> Dict[str, Any]:
                                       timeout=10.0)
 
 
+def gateway_status() -> Dict[str, Any]:
+    """HTTP front-door view (serve/gateway.py): per-replica request
+    counters split by priority class (interactive/batch accepted/
+    completed/shed/disconnects) and status code, recent TTFT windows
+    per class, QoS gate admission/rejection stats, batch-slot
+    preemptions — plus cluster totals. The CLI analog is `python -m
+    ray_tpu gateway`; the dashboard serves it at /api/gateway; the
+    accept/first_byte/preempt/rate_limit/disconnect markers ride the
+    merged timeline's `gateway` lane."""
+    return _conductor().conductor.call("get_gateway_status",
+                                       timeout=10.0)
+
+
 def servefault_status() -> Dict[str, Any]:
     """Serving-plane fault-tolerance view (serve/disagg.py failover +
     serve/autoscale.py self-healing): per-router failover counts by

@@ -94,6 +94,16 @@ def pytest_configure(config):
         "on CPU, the e2e surface check runs on a module-scoped "
         "log_to_driver=0 cluster — select with `-m speculate`")
     config.addinivalue_line(
+        "markers", "gateway: OpenAI-compatible HTTP front-door "
+        "scenarios (serve/gateway.py + serve/qos.py over REAL "
+        "sockets): protocol errors as OpenAI error bodies, per-tenant "
+        "token-bucket 429s with Retry-After, SSE-vs-non-streaming "
+        "parity bit-identical to the engine oracle, interactive-"
+        "preempts-batch resume identity, client-disconnect reaping, "
+        "deadline propagation; everything is tier-1-safe on CPU, the "
+        "telemetry surface check runs on a module-scoped "
+        "log_to_driver=0 cluster — select with `-m gateway`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "

@@ -237,6 +237,64 @@ def test_undonated_pool_write_zero_across_package():
     assert found == [], [str(f) for f in found]
 
 
+def test_gateway_modules_are_lint_covered():
+    """The HTTP front door (serve/gateway.py, serve/qos.py) and the
+    other aiohttp-serving modules its rule activates in
+    (dashboard/__init__.py) are inside the self-lint set, carry zero
+    error findings, and — event-loop discipline — zero
+    `sync-io-in-gateway-handler` findings after suppressions: every
+    decode in an async handler rides the executor."""
+    for rel in (os.path.join("serve", "gateway.py"),
+                os.path.join("serve", "qos.py"),
+                os.path.join("dashboard", "__init__.py"),
+                os.path.join("serve", "disagg.py")):
+        path = os.path.join(PACKAGE_ROOT, rel)
+        assert os.path.exists(path), rel
+        findings = lint_path(path)
+        assert errors(findings) == [], rel
+        sync_io = [f for f in findings
+                   if f.rule == "sync-io-in-gateway-handler"]
+        assert sync_io == [], (rel, [str(f) for f in sync_io])
+
+
+def test_sync_io_in_gateway_handler_rule_fires():
+    """The rule catches a seeded violation: an aiohttp module calling
+    .generate()/.decode_from() synchronously inside an async handler —
+    and honors suppressions, leaves nested executor defs alone, and
+    stays silent in modules that never import aiohttp."""
+    from ray_tpu.analysis.astlint import lint_source
+
+    src = (
+        "import aiohttp\n"
+        "from aiohttp import web\n"
+        "async def handler(request):\n"
+        "    out = router.generate(prompt, 16)\n"
+        "    kv = server.decode_from(rec)\n"
+        "    def work():\n"
+        "        return router.generate(prompt, 16)  # executor scope\n"
+        "    return web.json_response(out)\n"
+        "def sync_handler(request):\n"
+        "    return router.generate(prompt, 16)  # not async\n"
+    )
+    found = [f for f in lint_source(src, "seeded.py")
+             if f.rule == "sync-io-in-gateway-handler"]
+    assert len(found) == 2, [str(f) for f in found]
+    assert all(f.severity == "info" for f in found)
+    # a justified suppression silences it
+    suppressed = src.replace(
+        "    kv = server.decode_from(rec)",
+        "    kv = server.decode_from(rec)"
+        "  # shardlint: disable=sync-io-in-gateway-handler")
+    left = [f for f in lint_source(suppressed, "seeded.py")
+            if f.rule == "sync-io-in-gateway-handler"]
+    assert len(left) == 1
+    # ...and the rule is inert without aiohttp in scope
+    other = ("async def handler(request):\n"
+             "    return router.generate(prompt, 16)\n")
+    assert [f for f in lint_source(other, "other.py")
+            if f.rule == "sync-io-in-gateway-handler"] == []
+
+
 def test_driver_entry_is_clean_too():
     repo_root = os.path.dirname(PACKAGE_ROOT)
     entry = os.path.join(repo_root, "__graft_entry__.py")
