@@ -578,7 +578,9 @@ class ConductorHandler:
                       node: Optional[NodeRecord] = None) -> WorkerRecord:
         """Start a worker (reference: WorkerPool PopWorker spawn path,
         worker_pool.h:343). Head/accounting nodes spawn locally; agent
-        nodes get an RPC to their NodeAgent (the raylet-equivalent)."""
+        nodes get an RPC to their NodeAgent (the raylet-equivalent).
+        Caller holds self._lock — the lease loop cv-waits for the new
+        worker to register back."""
         from .worker_spawn import spawn_worker_process
 
         worker_id = WorkerID().hex()
@@ -3162,72 +3164,76 @@ class ConductorHandler:
                 state = pickle.load(f)
         except (OSError, pickle.UnpicklingError, EOFError):
             return
-        head = self._nodes[self._head_node_id]
-        self._kv = {ns: dict(d) for ns, d in state.get("kv", {}).items()}
-        self._named_actors = dict(state.get("named_actors", {}))
-        now = time.monotonic()
-        # PGs first: live actors scheduled inside one hold the PG's
-        # synthetic `_pg_<id>_<k>` keys, which must exist to re-charge.
-        # Head-assigned bundles re-reserve now; bundles assigned to agent
-        # nodes re-reserve when their node re-registers
-        # (_reapply_pg_reservations from register_node).
-        old_head = state.get("head_node_id")
-        for pg in state.get("pgs", []):
-            if pg.state != "CREATED":
-                continue
-            if not getattr(pg, "assignments", None):
-                pg.assignments = [self._head_node_id] * len(pg.bundles)
-            else:
-                pg.assignments = [
-                    self._head_node_id if nid == old_head else nid
-                    for nid in pg.assignments]
-            for b, nid in zip(pg.bundles, pg.assignments):
-                if nid != self._head_node_id:
+        # Restore runs from __init__, before the serving threads
+        # start, but the same records are later mutated under the
+        # lock; take it here too so every mutation site is covered.
+        with self._lock:
+            head = self._nodes[self._head_node_id]
+            self._kv = {ns: dict(d) for ns, d in state.get("kv", {}).items()}
+            self._named_actors = dict(state.get("named_actors", {}))
+            now = time.monotonic()
+            # PGs first: live actors scheduled inside one hold the PG's
+            # synthetic `_pg_<id>_<k>` keys, which must exist to re-charge.
+            # Head-assigned bundles re-reserve now; bundles assigned to agent
+            # nodes re-reserve when their node re-registers
+            # (_reapply_pg_reservations from register_node).
+            old_head = state.get("head_node_id")
+            for pg in state.get("pgs", []):
+                if pg.state != "CREATED":
                     continue
-                self._acquire_resources(head, b)
-                for k, v in b.items():
-                    pk = f"_pg_{pg.pg_id}_{k}"
-                    head.total[pk] = head.total.get(pk, 0) + v
-                    head.available[pk] = head.available.get(pk, 0) + v
-            self._pgs[pg.pg_id] = pg
-        for rec in state.get("actors", []):
-            self._actors[rec.actor_id] = rec
-            if rec.state in ("ALIVE", "RESTARTING") and rec.worker_id:
-                # mirror lease_worker: a PG-scheduled actor's lease holds
-                # the bundle's prefixed keys, NOT head general capacity
-                if rec.placement_group_id:
-                    held = {f"_pg_{rec.placement_group_id}_{k}": v
-                            for k, v in rec.resources.items()}
+                if not getattr(pg, "assignments", None):
+                    pg.assignments = [self._head_node_id] * len(pg.bundles)
                 else:
-                    held = dict(rec.resources)
-                w = WorkerRecord(worker_id=rec.worker_id,
-                                 node_id=self._head_node_id,
-                                 address=rec.address, state="ACTOR",
-                                 resources=held,
-                                 lease_node_id=self._head_node_id,
-                                 restored_at=now)
-                self._workers[w.worker_id] = w
-                self._acquire_resources(head, held)
-        wstate = state.get("weights") or {}
-        self._weights_committed = {
-            n: {int(v): m for v, m in bv.items()}
-            for n, bv in (wstate.get("committed") or {}).items()}
-        for p in wstate.get("pending") or []:
-            # fresh TTL clock: `started` is monotonic and does not
-            # survive a restart; the reaper ages them out from now
-            self._weights_pending[(p["name"], int(p["version"]))] = {
-                "fragments": dict(p["fragments"]),
-                "num_hosts": int(p["num_hosts"]),
-                "run_id": p.get("run_id", ""), "step": p.get("step"),
-                "started": now}
-        for jid, meta in state.get("jobs", {}).items():
-            meta = dict(meta, proc=None)
-            if meta.get("status") == "RUNNING":
-                # the job driver was orphaned by the crash; we can no
-                # longer supervise it
-                meta["status"] = "FAILED"
-                meta["end_time"] = meta.get("end_time") or time.time()
-            self._jobs[jid] = meta
+                    pg.assignments = [
+                        self._head_node_id if nid == old_head else nid
+                        for nid in pg.assignments]
+                for b, nid in zip(pg.bundles, pg.assignments):
+                    if nid != self._head_node_id:
+                        continue
+                    self._acquire_resources(head, b)
+                    for k, v in b.items():
+                        pk = f"_pg_{pg.pg_id}_{k}"
+                        head.total[pk] = head.total.get(pk, 0) + v
+                        head.available[pk] = head.available.get(pk, 0) + v
+                self._pgs[pg.pg_id] = pg
+            for rec in state.get("actors", []):
+                self._actors[rec.actor_id] = rec
+                if rec.state in ("ALIVE", "RESTARTING") and rec.worker_id:
+                    # mirror lease_worker: a PG-scheduled actor's lease holds
+                    # the bundle's prefixed keys, NOT head general capacity
+                    if rec.placement_group_id:
+                        held = {f"_pg_{rec.placement_group_id}_{k}": v
+                                for k, v in rec.resources.items()}
+                    else:
+                        held = dict(rec.resources)
+                    w = WorkerRecord(worker_id=rec.worker_id,
+                                     node_id=self._head_node_id,
+                                     address=rec.address, state="ACTOR",
+                                     resources=held,
+                                     lease_node_id=self._head_node_id,
+                                     restored_at=now)
+                    self._workers[w.worker_id] = w
+                    self._acquire_resources(head, held)
+            wstate = state.get("weights") or {}
+            self._weights_committed = {
+                n: {int(v): m for v, m in bv.items()}
+                for n, bv in (wstate.get("committed") or {}).items()}
+            for p in wstate.get("pending") or []:
+                # fresh TTL clock: `started` is monotonic and does not
+                # survive a restart; the reaper ages them out from now
+                self._weights_pending[(p["name"], int(p["version"]))] = {
+                    "fragments": dict(p["fragments"]),
+                    "num_hosts": int(p["num_hosts"]),
+                    "run_id": p.get("run_id", ""), "step": p.get("step"),
+                    "started": now}
+            for jid, meta in state.get("jobs", {}).items():
+                meta = dict(meta, proc=None)
+                if meta.get("status") == "RUNNING":
+                    # the job driver was orphaned by the crash; we can no
+                    # longer supervise it
+                    meta["status"] = "FAILED"
+                    meta["end_time"] = meta.get("end_time") or time.time()
+                self._jobs[jid] = meta
 
     # --------------------------------------------------------------- monitor
 

@@ -75,7 +75,9 @@ def _plugins() -> Dict[str, RuntimeEnvPlugin]:
     driver's register_plugin call (reference RAY_RUNTIME_ENV_PLUGINS,
     runtime_env/plugin.py:40)."""
     global _ENV_PLUGINS_LOADED
-    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    from ray_tpu.util import envknobs
+
+    spec = envknobs.get_str("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
     if spec and spec != _ENV_PLUGINS_LOADED:
         import importlib
 

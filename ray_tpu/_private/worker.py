@@ -1039,7 +1039,9 @@ class Worker:
                 self.conductor.notify("report_task_events", batch)
             except ConnectionLost:
                 pass
-        if os.environ.get("RAY_TPU_TRACING") == "1":
+        from ray_tpu.util import envknobs
+
+        if envknobs.get_str("RAY_TPU_TRACING") == "1":
             from ray_tpu.util import tracing
 
             spans = tracing.drain()

@@ -8,6 +8,8 @@ import signal
 import sys
 import time
 
+from ray_tpu.util import envknobs
+
 
 def _bound_chips():
     """TPU chips this process was bound to at spawn (the conductor set
@@ -80,7 +82,7 @@ def main() -> None:
         try:
             ok = w.conductor.call(
                 "register_worker", worker_id, w.address, os.getpid(),
-                os.environ.get("RAY_TPU_NODE_ID"), chips, timeout=5.0)
+                envknobs.get_str("RAY_TPU_NODE_ID"), chips, timeout=5.0)
             if ok is False:
                 # conductor rebound our chips to another worker while we
                 # were partitioned — we must not touch the TPU again

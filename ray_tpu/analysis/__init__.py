@@ -15,7 +15,13 @@ catches:
   bytes-over-DCN estimate per layout (`collectives`, jaxpr inspection
   against an `AbstractMesh`);
 - event-loop stalls: blocking calls inside `async def` actor/serve
-  methods, and host syncs inside jitted functions (`astlint`).
+  methods, and host syncs inside jitted functions (`astlint`);
+- cross-module invariants (`invariants`): lock-discipline races
+  (a `self._*` attr mutated both under `with self._lock` and bare),
+  conductor↔CLI↔dashboard↔metrics↔timeline surface-parity drift,
+  the env-knob registry (`RAY_TPU_*` reads — hot-path re-parses,
+  inconsistent defaults, undocumented knobs), and jitted pool updaters
+  missing `donate_argnums`.
 
 Surfaces: `python -m ray_tpu analyze` (CLI), the dryrun path in
 `__graft_entry__.py` (every hybrid layout is linted before it runs), and
@@ -41,6 +47,18 @@ from .findings import (  # noqa: F401
     sort_findings,
 )
 from .astlint import lint_file, lint_path, lint_source  # noqa: F401
+from .invariants import (  # noqa: F401
+    PARITY_WAIVERS,
+    SURFACE_ALIASES,
+    analyze_invariants,
+    check_env_knobs,
+    check_surface_parity,
+    collect_env_reads,
+    discover_subsystems,
+    format_knob_table,
+    knob_table,
+    scan_env_reads,
+)
 from .pipelines import (  # noqa: F401
     BUBBLE_WARN_FRACTION,
     PIPELINE_SCHEDULES,

@@ -79,7 +79,8 @@ _BLOCKING_ATTRS: Dict[Tuple[str, str], str] = {
 }
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*shardlint:\s*(ok|disable=([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*))")
+    r"#\s*shardlint:\s*"
+    r"(ok(?:=[a-z0-9-]+)?|disable=([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*))")
 
 
 def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
@@ -89,7 +90,10 @@ def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
         m = _SUPPRESS_RE.search(line)
         if not m:
             continue
-        if m.group(1) == "ok":
+        if m.group(1).startswith("ok"):
+            # `ok` and the tagged `ok=<reason>` form (e.g. ok=lock-free)
+            # both suppress every rule on the line; the tag is the
+            # human-readable justification, not a rule filter.
             out[i] = None
         else:
             out[i] = {r.strip() for r in m.group(2).split(",")}
@@ -506,6 +510,12 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     findings += _lint_unkeyed_tenant_cache(tree, aliases, path)
     findings += _lint_sync_io_in_gateway_handler(tree, aliases, path)
     findings += _lint_undonated_pool_write(tree, aliases, path)
+    # the per-file halves of the cross-module invariant engine
+    # (shardlint v2): lock-discipline races and the donation auditor
+    from . import invariants
+
+    findings += invariants.lint_lock_discipline(tree, path)
+    findings += invariants.lint_donation_audit(tree, aliases, path)
     if not findings:
         return findings
     suppressed = _suppressions(source)

@@ -58,6 +58,27 @@ RULES: Dict[str, str] = {
         "synchronous decode call (.generate(...) / .decode_from(...)) "
         "inside an async HTTP handler freezes every stream on the "
         "gateway's event loop",
+    "lock-discipline":
+        "in a lock-using class, a self._* attribute mutated both "
+        "under `with self._lock` and outside it — a data race "
+        "candidate, both sites cited",
+    "surface-parity":
+        "a conductor subsystem missing part of the full surface "
+        "treatment (state accessor == CLI == dashboard == Prometheus "
+        "== timeline lane)",
+    "env-knob-inconsistent-default":
+        "one RAY_TPU_* knob parsed with different literal defaults at "
+        "different sites",
+    "env-knob-hot-path":
+        "RAY_TPU_* knob parsed inside a loop / per-tick path without "
+        "the cached-env pattern",
+    "env-knob-undocumented":
+        "RAY_TPU_* knob read in code but absent from the README knob "
+        "table",
+    "undonated-jit-pool-arg":
+        "jitted function updates a pool/cache/slab-shaped argument "
+        "without donate_argnums (O(pool) copy per call instead of "
+        "O(row) in place)",
 }
 
 

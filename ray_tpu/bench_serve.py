@@ -940,8 +940,8 @@ def _lora_record(params, config, args, prompts, load_kw,
                 else:
                     for tier in ("prefill", "decode"):
                         for r in router.tier_replicas(tier):
-                            pub_state["version"] = _call(
-                                r["target"], "publish_adapter",  # shardlint: disable=unsupervised-actor-call
+                            pub_state["version"] = _call(  # shardlint: disable=unsupervised-actor-call
+                                r["target"], "publish_adapter",
                                 pub_tenant, v2)
                 pub_state["publish_ms"] = (time.perf_counter() - t0) \
                     * 1e3

@@ -97,10 +97,9 @@ _SPEC_EVENTS_KEPT = 512
 def default_speculate_k() -> int:
     """The ``RAY_TPU_SPECULATE_K`` env default (0 = speculation off)
     every engine owner resolves through."""
-    try:
-        return max(0, int(os.environ.get("RAY_TPU_SPECULATE_K", "0")))
-    except ValueError:
-        return 0
+    from ray_tpu.util import envknobs
+
+    return max(0, envknobs.get_int("RAY_TPU_SPECULATE_K", 0))
 
 
 # ----------------------------------------------------- prometheus (lazy)
@@ -432,18 +431,21 @@ class ContinuousBatchingEngine:
         self._cache = _model_fns(config)[1](config, max_batch)
         # paged KV prefix cache (models/kvcache.py); RAY_TPU_KV_* env
         # knobs supply defaults, constructor args win
+        from ray_tpu.util import envknobs
+
         if prefix_cache is None:
-            prefix_cache = os.environ.get("RAY_TPU_KV_CACHE", "1") != "0"
+            prefix_cache = envknobs.get_str(
+                "RAY_TPU_KV_CACHE", "1") != "0"
         if max_prefills_per_tick is None:
-            max_prefills_per_tick = int(os.environ.get(
-                "RAY_TPU_MAX_PREFILLS_PER_TICK", "1"))
+            max_prefills_per_tick = envknobs.get_int(
+                "RAY_TPU_MAX_PREFILLS_PER_TICK", 1)
         self.max_prefills_per_tick = max(1, int(max_prefills_per_tick))
         # adoptions (disaggregated decode) are capped per-phase: a
         # splice is O(prompt) and never compiles a prefill program, so
         # its default budget is looser than the prefill cap
         if max_adoptions_per_tick is None:
-            max_adoptions_per_tick = int(os.environ.get(
-                "RAY_TPU_MAX_ADOPTIONS_PER_TICK", "4"))
+            max_adoptions_per_tick = envknobs.get_int(
+                "RAY_TPU_MAX_ADOPTIONS_PER_TICK", 4)
         self.max_adoptions_per_tick = max(1, int(max_adoptions_per_tick))
         if kv_int8 is None:
             from .kvcache import kv_int8_default

@@ -86,7 +86,9 @@ _EVENTS_KEPT = 512
 def kv_int8_default() -> bool:
     """The ``RAY_TPU_KV_INT8`` env default every pool owner (the
     colocated engine, the disagg prefill tier) resolves through."""
-    return os.environ.get("RAY_TPU_KV_INT8", "0") == "1"
+    from ray_tpu.util import envknobs
+
+    return envknobs.get_str("RAY_TPU_KV_INT8", "0") == "1"
 
 
 def resolve_pool_config(config: Any,
@@ -102,10 +104,12 @@ def resolve_pool_config(config: Any,
     DEFAULTED pool doubles its block count — int8 blocks cost half the
     bytes, so the same HBM budget holds twice the prefixes (an explicit
     block count, arg or env, is always honored as-is)."""
+    from ray_tpu.util import envknobs
+
     bs = int(block_size
-             or os.environ.get("RAY_TPU_KV_BLOCK_SIZE", "16"))
+             or envknobs.get_int("RAY_TPU_KV_BLOCK_SIZE", 16))
     pb = int(pool_blocks
-             or int(os.environ.get("RAY_TPU_KV_POOL_BLOCKS", "0")))
+             or envknobs.get_int("RAY_TPU_KV_POOL_BLOCKS", 0))
     if not pb:
         pb = slots * (-(-config.max_seq_len // bs))
         if int8:

@@ -61,6 +61,14 @@ merges and labels them:
                  client disconnects per priority class, so ingress
                  pressure reads against the disagg lane's shed markers
                  and the lora lane's tenant paging.
+- speculation:   pid = "speculation",     tid = event kind — instant
+                 markers for speculative-decoding verify outcomes
+                 (models/engine.py): spec_accept / spec_reject with the
+                 accepted/proposed split per verify tick. The engine
+                 pushes them through the kvcache event channel (ONE
+                 report path), and the merge splits the spec_* slice
+                 into its own lane so acceptance reads against the
+                 kvcache and gateway tracks.
 - autoscale:     pid = "autoscale",       tid = event kind — instant
                  markers of the serving autoscaler (serve/autoscale.py):
                  scale_up / drain / scale_down per tier, so replica-set
@@ -171,9 +179,9 @@ def kvcache_trace_events(events: List[Dict[str, Any]]
     out: List[Dict[str, Any]] = []
     for ev in events:
         ts = ev.get("ts")
-        if ts is None:
-            continue
         kind = str(ev.get("kind", "event"))
+        if ts is None or kind.startswith("spec_"):
+            continue  # spec_* markers render on the speculation lane
         label = kind
         if ev.get("outcome"):
             label += f":{ev['outcome']}"
@@ -182,6 +190,31 @@ def kvcache_trace_events(events: List[Dict[str, Any]]
         out.append({
             "name": label, "cat": "kvcache", "ph": "i", "s": "g",
             "ts": ts * 1e6, "pid": "kvcache", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
+def speculation_trace_events(events: List[Dict[str, Any]]
+                             ) -> List[Dict[str, Any]]:
+    """Instant markers for speculative-decoding verify outcomes — the
+    spec_* slice of the kvcache event channel (engines push spec_accept
+    / spec_reject through the same report_kvcache_event path), rendered
+    under its own pid "speculation" so acceptance reads as a lane
+    instead of noise in the prefix-cache track."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        kind = str(ev.get("kind", "event"))
+        if ts is None or not kind.startswith("spec_"):
+            continue
+        label = kind
+        if ev.get("proposed") is not None:
+            label += f" {ev.get('accepted', 0)}/{ev['proposed']}"
+        out.append({
+            "name": label, "cat": "speculation", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "speculation", "tid": kind,
             "args": {k: v for k, v in ev.items()
                      if k != "ts" and v is not None},
         })
@@ -449,6 +482,7 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(weight_trace_events(weight_events))
     if kvcache_events:
         trace.extend(kvcache_trace_events(kvcache_events))
+        trace.extend(speculation_trace_events(kvcache_events))
     if pipeline_events:
         trace.extend(pipeline_trace_events(pipeline_events))
     if online_events:

@@ -959,6 +959,26 @@ def _format_predictions(preds: dict) -> str:
     return "\n".join(lines)
 
 
+def _nearest_readme(root: str) -> "str | None":
+    """README.md beside the analyzed tree or up to two levels above it
+    (the package dir's README lives at the repo root) — feeds the
+    env-knob-undocumented check; None skips that rule."""
+    d = os.path.abspath(root)
+    for _ in range(3):
+        cand = os.path.join(d, "README.md")
+        if os.path.exists(cand):
+            try:
+                with open(cand) as f:
+                    return f.read()
+            except OSError:
+                return None
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
 def _run_analyze(args) -> None:
     from ray_tpu import analysis
 
@@ -975,6 +995,18 @@ def _run_analyze(args) -> None:
         if not os.path.exists(p):
             raise SystemExit(f"no such file or directory: {p}")
         findings.extend(analysis.lint_path(p))
+    want_knobs = getattr(args, "knob_table", False)
+    knob_rows = None
+    if getattr(args, "invariants", False) or want_knobs:
+        for p in paths:
+            root = p if os.path.isdir(p) else (os.path.dirname(p) or ".")
+            if getattr(args, "invariants", False):
+                findings.extend(analysis.analyze_invariants(
+                    root, readme_text=_nearest_readme(root)))
+            if want_knobs:
+                rows = analysis.knob_table(
+                    analysis.collect_env_reads(root))
+                knob_rows = (knob_rows or []) + rows
     predict = getattr(args, "predict_step_time", False)
     predictions = None
     if args.layouts or predict:
@@ -1004,17 +1036,23 @@ def _run_analyze(args) -> None:
                        analysis.sort_findings(findings)]
     if args.json:
         # plain --json keeps the historical bare findings list; the
-        # predictions ride in a wrapper object only when asked for
-        if predictions is not None:
-            print(json.dumps({"findings": sorted_findings,
-                              "predicted_step_time": predictions},
-                             indent=2))
+        # predictions / knob table ride in a wrapper object only when
+        # asked for
+        if predictions is not None or knob_rows is not None:
+            payload = {"findings": sorted_findings}
+            if predictions is not None:
+                payload["predicted_step_time"] = predictions
+            if knob_rows is not None:
+                payload["env_knobs"] = knob_rows
+            print(json.dumps(payload, indent=2))
         else:
             print(json.dumps(sorted_findings, indent=2))
     else:
         print(analysis.format_report(findings))
         if predictions is not None:
             print(_format_predictions(predictions))
+        if knob_rows is not None:
+            print(analysis.format_knob_table(knob_rows))
     worst = analysis.max_severity(findings)
     order = list(analysis.SEVERITIES)
     if findings and order.index(worst) <= order.index(args.fail_on):
@@ -1300,6 +1338,14 @@ def main(argv=None) -> None:
                     help="also print the step-time oracle's roofline "
                          "prediction (device/ici/dcn breakdown) per "
                          "built-in dryrun layout")
+    sp.add_argument("--invariants", action="store_true",
+                    help="also run the cross-module invariant engine "
+                         "(lock discipline, surface parity, env-knob "
+                         "registry, donation audit)")
+    sp.add_argument("--knob-table", action="store_true",
+                    help="print the canonical RAY_TPU_* env-knob table "
+                         "from the registry (markdown; rides the JSON "
+                         "wrapper as env_knobs with --json)")
     sp.add_argument("--json", action="store_true",
                     help="machine-readable findings")
     sp.add_argument("--fail-on", choices=["error", "warning", "info"],

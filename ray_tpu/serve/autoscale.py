@@ -595,7 +595,8 @@ class DisaggAutoscaler:
             # — a caller-supplied policy (its own clamps, or a test
             # stand-in) must not scale past what the tier may hold
             target = self.specs[tier].policy.clamp(target)
-            self._stats["last_reason"][tier] = reason
+            with self._lock:  # _stats is shared with the wake/death threads
+                self._stats["last_reason"][tier] = reason
             m["target"].set(target, tags={"tier": tier})
             if target > current[tier]:
                 actions.extend(self._scale_up(
@@ -616,7 +617,8 @@ class DisaggAutoscaler:
             m = autoscale_metrics()
             for tier in TIERS:
                 live = len(self.router.tier_replicas(tier))
-                self._stats["replica_seconds"][tier] += live * dt
+                with self._lock:
+                    self._stats["replica_seconds"][tier] += live * dt
                 if live:
                     m["replica_seconds"].inc(live * dt,
                                              tags={"tier": tier})
@@ -632,14 +634,16 @@ class DisaggAutoscaler:
                 replica = self.specs[tier].factory()
             except Exception as e:  # noqa: BLE001 — no capacity yet:
                 # hold the target; the next tick retries
-                self._stats["last_reason"][tier] = \
-                    f"scale-up blocked: {type(e).__name__}: {e}"
+                with self._lock:
+                    self._stats["last_reason"][tier] = (
+                        f"scale-up blocked: {type(e).__name__}: {e}")
                 break
             rid = (self.router.add_prefill(replica) if tier == "prefill"
                    else self.router.add_decode(replica))
             if self._watching:
                 self._refresh_managed()
-            self._stats["scale_ups"][tier] += 1
+            with self._lock:
+                self._stats["scale_ups"][tier] += 1
             autoscale_metrics()["decisions"].inc(
                 tags={"tier": tier, "direction": "up"})
             ev = {"kind": "scale_up", "tier": tier, "replica": rid,
@@ -720,9 +724,10 @@ class DisaggAutoscaler:
             if not self.router.begin_drain(tier, r["rid"],
                                            allow_empty=allow_empty):
                 continue
-            self._draining.append(
-                _Draining(tier, r["rid"], now, self.drain_grace_s))
-            self._stats["scale_downs"][tier] += 1
+            with self._lock:
+                self._draining.append(
+                    _Draining(tier, r["rid"], now, self.drain_grace_s))
+                self._stats["scale_downs"][tier] += 1
             autoscale_metrics()["decisions"].inc(
                 tags={"tier": tier, "direction": "down"})
             ev = {"kind": "drain", "tier": tier, "replica": r["rid"],
@@ -766,8 +771,10 @@ class DisaggAutoscaler:
         prepare_for_shutdown still runs, off the tick thread, so even
         the forced path waits out stragglers up to its own timeout
         before the actor dies)."""
+        with self._lock:
+            pending = list(self._draining)
         still: List[_Draining] = []
-        for d in self._draining:
+        for d in pending:
             drained = self._replica_drained(d)
             if not drained and now < d.grace_deadline:
                 still.append(d)
@@ -779,7 +786,13 @@ class DisaggAutoscaler:
                   "autoscaler": self.autoscaler_id}
             _notify_event(ev)
             actions.append(ev)
-        self._draining = still
+        finalized = [d for d in pending if d not in still]
+        with self._lock:
+            # drop only what this pass finalized: the death watcher may
+            # have reaped records (and _scale_down added new ones) while
+            # the drain probes above ran off-lock
+            self._draining = [d for d in self._draining
+                              if d not in finalized]
 
     def _finalize_drain(self, d: _Draining, drained: bool) -> None:
         replica = self.router.remove(d.tier, d.rid)
@@ -788,8 +801,8 @@ class DisaggAutoscaler:
             # event must not read as a death to heal
             self._managed = {aid: v for aid, v in self._managed.items()
                              if v[1]["rid"] != d.rid}
-        self._stats["drains_completed" if drained
-                    else "drains_forced"] += 1
+            self._stats["drains_completed" if drained
+                        else "drains_forced"] += 1
         if replica is None:
             return
         # replica-side teardown runs OFF the tick thread: a forced

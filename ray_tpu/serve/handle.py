@@ -410,8 +410,9 @@ class Router:
         return int(lim["max_ongoing_requests"]) + int(queued)
 
     def _raise_shed(self, bound: int) -> None:
-        retry = float(os.environ.get("RAY_TPU_SERVE_RETRY_AFTER_S",
-                                     "1.0"))
+        from ray_tpu.util import envknobs
+
+        retry = envknobs.get_float("RAY_TPU_SERVE_RETRY_AFTER_S", 1.0)
         shed_counter().inc(tags={"app": self._app,
                                  "deployment": self._deployment})
         raise RequestShedError(

@@ -1,0 +1,92 @@
+"""Cached ``RAY_TPU_*`` environment-knob accessors.
+
+The runtime's ~40 knobs were historically parsed ad hoc at call sites
+— an environ probe plus ``int()``/``float()`` (and its try/except) on
+every read, including per-tick paths like the metrics pusher. This
+module is the ONE cached parse: each accessor memoizes the parsed
+value keyed on the *raw* environment string, so
+
+- a hot loop pays one dict probe + string compare per read, never a
+  re-parse;
+- a live process stays retunable (and monkeypatching tests keep
+  working): changing the env var changes the raw string, which misses
+  the memo and re-parses.
+
+Unparseable values fall back to the call-site default instead of
+raising — a typo'd knob must not take down a worker at an arbitrary
+read site. Env names and semantics are unchanged from the historical
+call-site parses; shardlint's env-knob registry (``ray_tpu analyze
+--invariants``) recognizes ``get_*("RAY_TPU_X", default)`` calls as
+cached reads and folds them into the canonical knob table.
+
+Stdlib-only: imported by worker bootstrap paths where jax may be
+absent or broken.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# knob name -> (raw env string at parse time, parsed value)
+_memo: Dict[str, Tuple[Optional[str], Any]] = {}
+_lock = threading.Lock()
+
+_TRUTHY = frozenset({"1", "true", "yes", "on"})
+_FALSY = frozenset({"0", "false", "no", "off", ""})
+
+
+def _resolve(name: str, default: Any, parse: Callable[[str], Any]) -> Any:
+    raw = os.environ.get(name)
+    with _lock:
+        hit = _memo.get(name)
+        if hit is not None and hit[0] == raw:
+            return hit[1]
+    if raw is None:
+        val = default
+    else:
+        try:
+            val = parse(raw)
+        except (TypeError, ValueError):
+            val = default
+    with _lock:
+        _memo[name] = (raw, val)
+    return val
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The raw string (memoized like the rest for uniformity)."""
+    return _resolve(name, default, str)
+
+
+def get_int(name: str, default: int = 0) -> int:
+    return _resolve(name, default, int)
+
+
+def get_float(name: str, default: float = 0.0) -> float:
+    return _resolve(name, default, float)
+
+
+def _parse_bool(raw: str) -> bool:
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(raw)
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    """1/true/yes/on and 0/false/no/off (case-insensitive); anything
+    else falls back to the default. Knobs with historical exact-match
+    semantics (``== "1"`` / ``!= "0"``) keep those via get_str."""
+    return _resolve(name, default, _parse_bool)
+
+
+def clear() -> None:
+    """Drop the memo (tests that replace os.environ wholesale)."""
+    with _lock:
+        _memo.clear()
+
+
+__all__ = ["get_str", "get_int", "get_float", "get_bool", "clear"]

@@ -15,12 +15,12 @@ _DEFAULT_PUSH_INTERVAL_S = 2.0
 
 def _push_interval() -> float:
     """Registry push cadence; RAY_TPU_METRICS_INTERVAL_S overrides (read
-    per tick so a live process can be retuned)."""
-    try:
-        v = float(os.environ.get("RAY_TPU_METRICS_INTERVAL_S", ""))
-        return v if v > 0 else _DEFAULT_PUSH_INTERVAL_S
-    except ValueError:
-        return _DEFAULT_PUSH_INTERVAL_S
+    per tick so a live process can be retuned — the envknobs memo makes
+    the per-tick read a dict probe, not a re-parse)."""
+    from ray_tpu.util import envknobs
+
+    v = envknobs.get_float("RAY_TPU_METRICS_INTERVAL_S", 2.0)
+    return v if v > 0 else _DEFAULT_PUSH_INTERVAL_S
 
 
 class _Registry:

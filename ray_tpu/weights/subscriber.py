@@ -326,7 +326,8 @@ class WeightSubscriber:
         return out
 
     def close(self) -> None:
-        self._prefetched = None
+        with self._pf_lock:
+            self._prefetched = None
         try:
             self._worker.unsubscribe_channel("weights",
                                              self._on_weights_msg)

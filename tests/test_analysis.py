@@ -429,3 +429,299 @@ def test_replica_drain_is_async():
         return await replica.prepare_for_shutdown(timeout_s=0.2)
 
     assert asyncio.run(run()) is True
+
+
+# ------------------------------------- cross-module invariants (v2 rules)
+
+
+def test_lock_discipline_rule_fires():
+    """Seeded race: one attribute mutated under `with self._lock` in one
+    method and bare in another — the finding cites BOTH sites."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._n = 0\n"
+           "    def locked(self):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n"
+           "    def racy(self):\n"
+           "        self._n += 1\n")
+    fs = [f for f in lint_source(src, "x.py")
+          if f.rule == "lock-discipline"]
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert fs[0].location == "x.py:10"   # the unlocked site
+    assert "x.py:8" in fs[0].message     # ... citing the locked one
+
+
+def test_lock_discipline_constructor_and_convention_exempt():
+    """Clean-after-fix shapes: __init__ writes (no concurrent aliases
+    yet), `_locked`-suffixed helpers, and "caller holds self._lock"
+    docstrings all count as disciplined — zero findings."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._n = 0\n"
+           "    def _bump_locked(self):\n"
+           "        self._n += 1\n"
+           "    def helper(self):\n"
+           "        \"\"\"Caller holds self._lock.\"\"\"\n"
+           "        self._n += 1\n"
+           "    def locked(self):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n")
+    assert [f for f in lint_source(src, "x.py")
+            if f.rule == "lock-discipline"] == []
+
+
+def test_lock_discipline_condition_alias_counts_as_locked():
+    """`with self._cv:` (a Condition wrapping the lock) and a local
+    Condition alias are both the lock for discipline purposes."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.RLock()\n"
+           "        self._cv = threading.Condition(self._lock)\n"
+           "        self._n = 0\n"
+           "    def a(self):\n"
+           "        with self._cv:\n"
+           "            self._n += 1\n"
+           "    def b(self):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n")
+    assert [f for f in lint_source(src, "x.py")
+            if f.rule == "lock-discipline"] == []
+
+
+def test_lock_discipline_suppression():
+    """A deliberate lock-free write silences with `ok=lock-free`."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._n = 0\n"
+           "    def locked(self):\n"
+           "        with self._lock:\n"
+           "            self._n += 1\n"
+           "    def racy(self):\n"
+           "        self._n += 1  # shardlint: ok=lock-free\n")
+    assert [f for f in lint_source(src, "x.py")
+            if f.rule == "lock-discipline"] == []
+
+
+def test_undonated_jit_pool_arg_rule():
+    """Donation auditor: a jitted function updating a pool-shaped ARG
+    without donate_argnums is an O(pool)-copy warning; the donated twin
+    is clean."""
+    src = ("import functools\n"
+           "import jax\n"
+           "@jax.jit\n"
+           "def write(pool, bid, blk):\n"
+           "    return pool.at[bid].set(blk)\n"
+           "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+           "def write_ok(pool, bid, blk):\n"
+           "    return pool.at[bid].set(blk)\n")
+    fs = [f for f in lint_source(src, "x.py")
+          if f.rule == "undonated-jit-pool-arg"]
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert fs[0].location == "x.py:5" and "'pool'" in fs[0].message
+    # non-poolish args are not the rule's business even in a bare jit
+    clean = ("import jax\n"
+             "@jax.jit\n"
+             "def f(state, x):\n"
+             "    return state.at[0].set(x)\n")
+    assert [f for f in lint_source(clean, "y.py")
+            if f.rule == "undonated-jit-pool-arg"] == []
+
+
+def test_undonated_jit_pool_arg_suppression():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def write(pool, bid, blk):\n"
+           "    return pool.at[bid].set(blk)"
+           "  # shardlint: disable=undonated-jit-pool-arg\n")
+    assert [f for f in lint_source(src, "x.py")
+            if f.rule == "undonated-jit-pool-arg"] == []
+
+
+def _rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+def test_env_knob_registry_rules(tmp_path):
+    """Seeded violations for all three env-knob rules: a hot-loop parse
+    without caching, two sites with different literal defaults, and a
+    knob missing from the README text."""
+    from ray_tpu.analysis import analyze_invariants
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import os\n"
+        "def tick(stop):\n"
+        "    while not stop.wait(1):\n"
+        "        t = float(os.environ.get('RAY_TPU_T_INTERVAL', '1.0'))\n")
+    (pkg / "b.py").write_text(
+        "import os\n"
+        "T = os.environ.get('RAY_TPU_T_INTERVAL', '2.0')\n")
+    fs = analyze_invariants(str(pkg), readme_text="no knobs here")
+    assert _rule_ids(fs) == {"env-knob-hot-path",
+                             "env-knob-inconsistent-default",
+                             "env-knob-undocumented"}
+    assert all(f.severity == "warning" for f in fs)
+    # documented + consistent + cached accessor: all three rules clean
+    (pkg / "a.py").write_text(
+        "from ray_tpu.util import envknobs\n"
+        "def tick(stop):\n"
+        "    while not stop.wait(1):\n"
+        "        t = envknobs.get_float('RAY_TPU_T_INTERVAL', 1.0)\n")
+    (pkg / "b.py").write_text("")
+    fs = analyze_invariants(str(pkg),
+                            readme_text="| `RAY_TPU_T_INTERVAL` |")
+    assert fs == []
+
+
+def test_env_knob_lru_cached_reader_is_cold(tmp_path):
+    """An lru_cache'd reader is the other accepted cached-env shape."""
+    from ray_tpu.analysis import analyze_invariants
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import functools, os\n"
+        "@functools.lru_cache\n"
+        "def interval():\n"
+        "    return float(os.environ.get('RAY_TPU_T_INTERVAL', '1.0'))\n"
+        "def tick(stop):\n"
+        "    while not stop.wait(1):\n"
+        "        t = interval()\n")
+    fs = analyze_invariants(str(pkg),
+                            readme_text="| `RAY_TPU_T_INTERVAL` |")
+    assert fs == []
+
+
+def test_env_knob_suppression(tmp_path):
+    """Per-line suppressions silence invariant findings at the cited
+    site, exactly like the per-file rules."""
+    from ray_tpu.analysis import analyze_invariants
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import os\n"
+        "def tick(stop):\n"
+        "    while not stop.wait(1):\n"
+        "        t = float(os.environ.get('RAY_TPU_T_INTERVAL', '1.0'))"
+        "  # shardlint: disable=env-knob-hot-path\n")
+    fs = analyze_invariants(str(pkg),
+                            readme_text="| `RAY_TPU_T_INTERVAL` |")
+    assert fs == []
+
+
+def _write_surface_tree(root, timeline_src):
+    """A minimal ray_tpu-shaped tree with one conductor subsystem
+    ('widget') and every surface except whatever timeline_src omits."""
+    for rel, src in {
+        "_private/conductor.py":
+            "class Handler:\n"
+            "    def report_widget_stats(self, s):\n"
+            "        pass\n"
+            "    def get_widget_stats(self):\n"
+            "        return {}\n",
+        "util/state.py": "def widget_status():\n    return {}\n",
+        "scripts/cli.py":
+            "def build(sub):\n"
+            "    sp = sub.add_parser('widget')\n",
+        "dashboard/__init__.py": "ROUTE = '/api/widget'\n",
+        "observability/timeline.py": timeline_src,
+        "util/metrics.py": "FAMILY = \"ray_tpu_widget_requests\"\n",
+    }.items():
+        dest = root / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(src)
+
+
+def test_surface_parity_fires_and_passes(tmp_path):
+    """Seeded violation: a conductor subsystem with every surface BUT
+    the timeline lane errors naming the missing surface; adding the
+    lane clears it."""
+    from ray_tpu.analysis import check_surface_parity
+
+    pkg = tmp_path / "pkg"
+    _write_surface_tree(pkg, "def unrelated():\n    return []\n")
+    fs = check_surface_parity(str(pkg))
+    assert len(fs) == 1 and fs[0].rule == "surface-parity"
+    assert fs[0].severity == "error"
+    assert "'widget'" in fs[0].message and "no timeline" in fs[0].message
+    assert "conductor.py:2" in fs[0].location
+
+    _write_surface_tree(
+        pkg, "def widget_trace_events(evs):\n    return []\n")
+    assert check_surface_parity(str(pkg)) == []
+
+
+def test_surface_parity_suppression(tmp_path):
+    """`# shardlint: disable=surface-parity` on the conductor method
+    waives one subsystem (the documented alternative to a
+    PARITY_WAIVERS entry)."""
+    from ray_tpu.analysis import analyze_invariants
+
+    pkg = tmp_path / "pkg"
+    _write_surface_tree(pkg, "def unrelated():\n    return []\n")
+    conductor = pkg / "_private" / "conductor.py"
+    conductor.write_text(
+        "class Handler:\n"
+        "    def report_widget_stats(self, s):"
+        "  # shardlint: disable=surface-parity\n"
+        "        pass\n")
+    assert analyze_invariants(str(pkg), readme_text="") == []
+
+
+def test_envknobs_accessor_caches_and_retunes(monkeypatch):
+    """util/envknobs: the parse is memoized on the raw string — same
+    raw returns the cached value, a changed env re-parses (live
+    retuning and monkeypatching tests both keep working), and a bad
+    value falls back to the call-site default."""
+    from ray_tpu.util import envknobs
+
+    monkeypatch.setenv("RAY_TPU_TEST_KNOB", "3")
+    assert envknobs.get_int("RAY_TPU_TEST_KNOB", 7) == 3
+    monkeypatch.setenv("RAY_TPU_TEST_KNOB", "5")
+    assert envknobs.get_int("RAY_TPU_TEST_KNOB", 7) == 5
+    monkeypatch.setenv("RAY_TPU_TEST_KNOB", "not-an-int")
+    assert envknobs.get_int("RAY_TPU_TEST_KNOB", 7) == 7
+    monkeypatch.delenv("RAY_TPU_TEST_KNOB")
+    assert envknobs.get_int("RAY_TPU_TEST_KNOB", 7) == 7
+    monkeypatch.setenv("RAY_TPU_TEST_BOOL", "yes")
+    assert envknobs.get_bool("RAY_TPU_TEST_BOOL") is True
+    monkeypatch.setenv("RAY_TPU_TEST_BOOL", "off")
+    assert envknobs.get_bool("RAY_TPU_TEST_BOOL", True) is False
+
+
+def test_cli_analyze_invariants_and_knob_table(tmp_path, capsys):
+    """`analyze --invariants` folds cross-module findings into the
+    report and exit code; `--knob-table --json` rides the wrapper
+    object as env_knobs."""
+    import json
+
+    from ray_tpu.scripts.cli import main
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import os\n"
+        "A = os.environ.get('RAY_TPU_T_KNOB', '1')\n"
+        "B = os.environ.get('RAY_TPU_T_KNOB', '2')\n")
+    with pytest.raises(SystemExit):
+        main(["analyze", "--invariants", "--fail-on", "warning",
+              str(pkg)])
+    out = capsys.readouterr().out
+    assert "env-knob-inconsistent-default" in out
+
+    main(["analyze", "--invariants", "--knob-table", "--json",
+          "--fail-on", "error", str(pkg)])
+    payload = json.loads(capsys.readouterr().out)
+    assert [r["knob"] for r in payload["env_knobs"]] == ["RAY_TPU_T_KNOB"]
+    assert any(f["rule"] == "env-knob-inconsistent-default"
+               for f in payload["findings"])

@@ -422,11 +422,16 @@ def test_all_surfaces_report_consistent_numbers(spec_cluster, capsys):
         if line.startswith("ray_tpu_spec_accepted_total{"))
     assert accepted_total >= local["spec_accepted"]
 
-    # merged timeline: the spec markers ride the kvcache lane
+    # merged timeline: the spec markers get their own speculation lane
+    # (they ride the kvcache event channel but render separately)
     trace = state.timeline(merged=True)
-    markers = [e for e in trace if e.get("cat") == "kvcache"
+    markers = [e for e in trace if e.get("cat") == "speculation"
                and e.get("args", {}).get("engine") == eng.engine_id
                and e.get("tid", "").startswith("spec_")]
     assert markers
-    assert all(m["ph"] == "i" and m["pid"] == "kvcache"
+    assert all(m["ph"] == "i" and m["pid"] == "speculation"
                for m in markers)
+    # ...and they no longer double-render on the kvcache lane
+    assert not any(e.get("cat") == "kvcache"
+                   and e.get("tid", "").startswith("spec_")
+                   for e in trace)
