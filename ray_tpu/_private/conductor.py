@@ -289,6 +289,17 @@ class ConductorHandler:
         self._gateway_stats: Dict[str, Dict[str, Any]] = {}
         self._gateway_events: List[Dict[str, Any]] = []
 
+        # Per-request flight recorder (observability/requests.py):
+        # stores push retention/outcome counters + compact latency
+        # summaries (p99 attribution population) and each KEPT trace
+        # rides the event log so `ray_tpu requests --trace <id>` and
+        # the merged timeline's `requests` lane can replay a request's
+        # phase spans. One aggregate feeds
+        # util.state.requesttrace_status(), `ray_tpu requests`, and
+        # /api/requesttrace.
+        self._requesttrace_stats: Dict[str, Dict[str, Any]] = {}
+        self._requesttrace_events: List[Dict[str, Any]] = []
+
         # Step-time oracle (observability.roofline): predicted step-time
         # breakdowns keyed by layout + predicted-vs-measured validation
         # records (residuals, fitted calibration). One aggregate feeds
@@ -1986,6 +1997,116 @@ class ConductorHandler:
                            ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._gateway_events[-limit:]
+
+    # ------------------------------------------ per-request flight recorder
+    # RequestTraceStores (observability/requests.py) push retention /
+    # outcome counters plus a compact per-request summary window (the
+    # unbiased p99-attribution population); every KEPT full trace rides
+    # the event log as a kind="trace" record so `ray_tpu requests
+    # --trace <id>` and the merged timeline's `requests` lane replay
+    # its phase spans. Remote tier hops (actor-mode prefill/decode)
+    # push kind="phase" child records under the same request id.
+    # util.state.requesttrace_status(), `ray_tpu requests`, and
+    # /api/requesttrace all read the same aggregate.
+
+    _REQTRACE_EVENTS_KEPT = 10_000
+    _REQTRACE_STATS_KEPT = 64
+
+    def report_requesttrace_stats(self, worker_id: str,
+                                  component_id: str,
+                                  stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._requesttrace_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            while len(self._requesttrace_stats) \
+                    > self._REQTRACE_STATS_KEPT:
+                oldest = min(self._requesttrace_stats,
+                             key=lambda k:
+                             self._requesttrace_stats[k].get("ts", 0.0))
+                del self._requesttrace_stats[oldest]
+
+    def get_requesttrace_status(self) -> Dict[str, Any]:
+        """One aggregate for every request-trace surface: per-store
+        snapshots, cluster totals (completed/kept/dropped, outcome
+        tally, replay + preempt counts), the cluster-wide slowest
+        list, and a p99-attribution report recomputed over the merged
+        per-component summary windows so the tail owner is named from
+        the whole population, not one process's slice."""
+        with self._lock:
+            stores = {k: dict(v)
+                      for k, v in self._requesttrace_stats.items()}
+        totals: Dict[str, Any] = {"stores": len(stores)}
+        for key in ("completed", "kept", "dropped", "replayed_requests",
+                    "preempted_requests"):
+            totals[key] = sum(int(s.get(key, 0))
+                              for s in stores.values())
+        outcomes: Dict[str, int] = {}
+        slowest: List[Dict[str, Any]] = []
+        merged_recent: List[Dict[str, Any]] = []
+        for s in stores.values():
+            for k, v in (s.get("outcomes") or {}).items():
+                outcomes[k] = outcomes.get(k, 0) + int(v)
+            slowest.extend(s.get("slowest") or [])
+            merged_recent.extend(s.get("recent") or [])
+        totals["outcomes"] = outcomes
+        totals["slowest_ms"] = max(
+            [float(s.get("slowest_ms", 0.0)) for s in stores.values()],
+            default=0.0)
+        slowest.sort(key=lambda r: float(r.get("total_ms") or 0.0),
+                     reverse=True)
+        from ray_tpu.observability.requests import p99_attribution
+
+        return {"stores": stores, "totals": totals,
+                "slowest": slowest[:32],
+                "attribution": p99_attribution(merged_recent)}
+
+    def report_requesttrace_event(self, event: Dict[str, Any]) -> None:
+        """kind="trace" kept-trace records (full phase breakdowns) and
+        kind="phase" remote child spans for the merged timeline's
+        requests lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._requesttrace_events.append(event)
+            if len(self._requesttrace_events) \
+                    > self._REQTRACE_EVENTS_KEPT:
+                del self._requesttrace_events[
+                    :len(self._requesttrace_events)
+                    - self._REQTRACE_EVENTS_KEPT]
+
+    def get_requesttrace_events(self, limit: int = 10_000
+                                ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._requesttrace_events[-limit:]
+
+    def get_request_trace(self, request_id: str
+                          ) -> Optional[Dict[str, Any]]:
+        """Replay one request's full trace: the newest kept
+        kind="trace" record under the id, with any kind="phase" child
+        records remote tiers pushed merged in (attempt-tagged, so
+        failover replays read as child spans under the same id)."""
+        rid = str(request_id)
+        with self._lock:
+            events = list(self._requesttrace_events)
+        trace = None
+        for ev in reversed(events):
+            if ev.get("kind") == "trace" \
+                    and str(ev.get("request_id")) == rid:
+                trace = dict(ev)
+                break
+        if trace is None:
+            return None
+        remote = [dict(ev) for ev in events
+                  if ev.get("kind") == "phase"
+                  and str(ev.get("request_id")) == rid]
+        if remote:
+            trace["remote_phases"] = remote
+        return trace
 
     # ------------------------------------------ serving fault tolerance
     # Disagg routers (failover/shed accounting) and self-healers

@@ -57,6 +57,17 @@ so broken or dependency-heavy modules still lint):
   the same position is already the blocking-in-async ERROR. Advisory:
   a provably-instant call suppresses with a justification comment.
 
+- unpropagated-request-context (info): in modules importing the
+  request-trace API (observability/requests.py), a cross-tier serve
+  dispatch — ``_tier_call(<replica>, <tier>, "prefill"/"start_decode",
+  ...)`` or ``_call(<target>, "prefill"/"start_decode", ...)`` — inside
+  a function scope that never touches the trace API. The flight
+  recorder attributes tail latency per phase ONLY for hops recorded
+  under the request's id; a serve dispatch from a trace-blind scope
+  drops the context, so that hop's time silently vanishes from the
+  p99-attribution report. Advisory: dispatches that are genuinely
+  requestless (warmup, health probes) suppress with a justification.
+
 Suppression: append `# shardlint: ok` to the flagged line, or
 `# shardlint: disable=<rule-id>` to suppress one rule on that line.
 """
@@ -493,6 +504,99 @@ def _lint_undonated_pool_write(tree: ast.AST, aliases: _Aliases,
     return findings
 
 
+# ------------------------------------------- unpropagated-request-context
+
+
+_SERVE_DISPATCH_METHODS = ("prefill", "start_decode")
+
+
+def _reqtrace_aliases(aliases: _Aliases) -> Set[str]:
+    """Local names bound to the request-trace API: ``from
+    ray_tpu.observability import requests as reqtrace`` and ``import
+    ray_tpu.observability.requests [as x]`` spellings."""
+    names: Set[str] = set()
+    for local, (mod, orig) in aliases.from_imports.items():
+        if orig == "requests" and mod.endswith("observability"):
+            names.add(local)
+        if mod.endswith("observability.requests"):
+            names.add(local)
+    for local, mod in aliases.module_alias.items():
+        if mod.endswith("observability.requests"):
+            names.add(local)
+    return names
+
+
+def _scope_references(fn: ast.AST, names: Set[str]) -> bool:
+    """True when `fn`'s own execution scope (not nested defs) loads any
+    of `names` — the trace API is in play on this code path."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Name) and node.id in names:
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _serve_dispatch_method(call: ast.Call) -> Optional[str]:
+    """The string-literal serve method a cross-tier dispatch targets,
+    or None when `call` is not one. Shapes:
+    ``self._tier_call(rep, tier, "prefill", ...)`` (method is the
+    third arg) and ``_call(target, "start_decode", ...)`` (second)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "_tier_call":
+        idx = 2
+    elif isinstance(f, ast.Name) and f.id in ("_tier_call", "_call"):
+        idx = 2 if f.id == "_tier_call" else 1
+    else:
+        return None
+    if len(call.args) <= idx:
+        return None
+    arg = call.args[idx]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and arg.value in _SERVE_DISPATCH_METHODS:
+        return arg.value
+    return None
+
+
+def _lint_unpropagated_request_context(tree: ast.AST, aliases: _Aliases,
+                                       path: str) -> List[Finding]:
+    """Active only in modules importing the request-trace API — a
+    module that never imports observability/requests.py has opted out
+    of tracing wholesale, which is a different (cross-module) story;
+    this rule catches the sharper bug of a TRACED module with one
+    untraced dispatch path."""
+    rt_names = _reqtrace_aliases(aliases)
+    if not rt_names:
+        return []
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _scope_references(fn, rt_names):
+            continue
+        for call in _iter_scope_calls(fn):
+            method = _serve_dispatch_method(call)
+            if method is None:
+                continue
+            findings.append(Finding(
+                "unpropagated-request-context", INFO,
+                f"{path}:{call.lineno}",
+                f"cross-tier '{method}' dispatch in trace-blind scope "
+                f"'{fn.name}' — this module records request traces, "
+                "but this hop drops the context, so its time vanishes "
+                "from the p99 phase attribution",
+                "record the hop under the active trace "
+                "(reqtrace.phase(...) around the dispatch, or "
+                "push_remote_phase from the callee), or suppress with "
+                "a justification when the dispatch is genuinely "
+                "requestless (warmup, health probes)"))
+    return findings
+
+
 # ---------------------------------------------------------------- drivers
 
 
@@ -510,6 +614,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     findings += _lint_unkeyed_tenant_cache(tree, aliases, path)
     findings += _lint_sync_io_in_gateway_handler(tree, aliases, path)
     findings += _lint_undonated_pool_write(tree, aliases, path)
+    findings += _lint_unpropagated_request_context(tree, aliases, path)
     # the per-file halves of the cross-module invariant engine
     # (shardlint v2): lock-discipline races and the donation auditor
     from . import invariants

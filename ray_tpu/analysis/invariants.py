@@ -539,6 +539,9 @@ SURFACE_ALIASES: Dict[Tuple[str, str], Tuple[str, ...]] = {
     # recovery markers share one lane whether they heal a training gang
     # or a serving tier (see observability/timeline.py docstring)
     ("servefault", "timeline"): ("resilience",),
+    # the flight recorder's metric family abbreviates to reqtrace
+    # (ray_tpu_reqtrace_phase_ms etc — observability/requests.py)
+    ("requesttrace", "prometheus"): ("reqtrace",),
 }
 
 _SURFACE_FILES = {

@@ -109,7 +109,14 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
     tail pages through the adapter pool. `samples`, when given,
     collects one per-request dict (index, tenant, arrival offset, ttft)
     — the publish-no-stall analysis slices these."""
+    from ray_tpu.observability import requests as reqtrace
     from ray_tpu.serve.handle import RequestShedError
+
+    # flight-recorder window start: the record embeds the p99
+    # attribution and slowest-request phase breakdowns computed over
+    # ONLY this run's traces (warm-up traffic is excluded by seq)
+    trace_store = reqtrace.store() if reqtrace.enabled() else None
+    trace_seq0 = trace_store.seq() if trace_store is not None else 0
 
     rng = np.random.default_rng(seed)
     pop = 1.0 / np.arange(1, len(prompts) + 1) ** zipf_a
@@ -229,6 +236,27 @@ def run_load(router, prompts: Sequence[Sequence[int]], *,
         rec["hung"] = hung
     if err_samples:
         rec["error_samples"] = err_samples
+    if trace_store is not None:
+        # per-request tail attribution over this run's traces: which
+        # phase owns the p50->p99 gap, plus the five slowest requests'
+        # full phase breakdowns — the BENCH_* record names the tail
+        # owner instead of just reporting that a tail exists
+        run_traces = trace_store.summaries_since(trace_seq0)
+        if run_traces:
+            slowest = sorted(run_traces,
+                             key=lambda s: -s.get("total_ms", 0.0))[:5]
+            rec["request_trace"] = {
+                "n_traced": len(run_traces),
+                "p99_attribution": reqtrace.p99_attribution(run_traces),
+                "slowest": [
+                    {"request_id": s.get("request_id"),
+                     "total_ms": round(s.get("total_ms", 0.0), 2),
+                     "outcome": s.get("outcome"),
+                     "attempts": s.get("attempts", 1),
+                     "phase_ms": {k: round(v, 2) for k, v in
+                                  (s.get("phase_ms") or {}).items()}}
+                    for s in slowest],
+            }
     return rec
 
 

@@ -68,6 +68,11 @@ Routes:
                          predicted-vs-measured validations (residuals,
                          fitted calibration), recent prediction/
                          validation events (observability.roofline)
+  /api/requesttrace      per-request flight recorder: completed/kept/
+                         dropped totals, outcome tally, p99 phase
+                         attribution (tail owner), slowest requests
+                         with per-phase latency breakdowns, recent
+                         kept-trace events (observability.requests)
   /api/actors/{id}       actor drill-down (record, worker, recent task
                          events, store stats)
 """
@@ -291,6 +296,20 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def requesttrace(self) -> Dict[str, Any]:
+        """Per-request flight-recorder aggregate (totals, p99
+        attribution, slowest requests with phase breakdowns) + the
+        recent kept-trace event tail (one payload so the SPA's panel
+        needs a single fetch)."""
+        out = self.conductor.call("get_requesttrace_status",
+                                  timeout=10.0)
+        try:
+            out["events"] = self.conductor.call(
+                "get_requesttrace_events", 100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def actor_detail(self, actor_id: str) -> Dict[str, Any]:
         """One actor's record + its worker + its recent task events —
         the actors-table drill-down."""
@@ -413,6 +432,8 @@ class DashboardServer:
         app.router.add_get("/api/lora", self._json_route(d.lora))
         app.router.add_get("/api/gateway", self._json_route(d.gateway))
         app.router.add_get("/api/oracle", self._json_route(d.oracle))
+        app.router.add_get("/api/requesttrace",
+                           self._json_route(d.requesttrace))
         app.router.add_get(
             "/api/rpc",
             self._json_route(lambda: d.simple("get_rpc_stats")))

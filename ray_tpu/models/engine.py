@@ -326,6 +326,11 @@ class _Request:
         self.eos_token = eos_token
         self.out: "queue.Queue" = queue.Queue()
         self.produced = 0
+        # per-request speculation tally (engine-wide counters can't
+        # attribute accepts to one request) — the flight recorder's
+        # decode_steady span reads these off the final chunked pull
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.slot: Optional[int] = None
         self.cache_outcome: Optional[str] = None  # hit|partial|miss
         self.reused_tokens = 0
@@ -1124,6 +1129,8 @@ class ContinuousBatchingEngine:
                 self.spec_emitted += 1 + accepted
                 self.spec_proposed += len(proposal)
                 self.spec_accepted += accepted
+                req.spec_proposed += len(proposal)
+                req.spec_accepted += accepted
                 m["proposed"].inc(len(proposal))
                 if accepted:
                     m["accepted"].inc(accepted)

@@ -79,6 +79,16 @@ merges and labels them:
                  that draws the analytic roofline under the measured
                  train-step markers, plus instant validation markers
                  carrying the fitted calibration and residuals.
+- requests:      pid = "requests",       tid = the request id prefix —
+                 one REAL "X" span per recorded phase of a kept request
+                 trace (observability.requests): qos_admission ->
+                 queue_reserve -> prefill -> kv_transfer ->
+                 decode_first_token -> decode_steady -> sse_flush, with
+                 failover/preempt replay attempts suffixed " a<n>" so a
+                 replayed request reads as child spans under one id,
+                 plus one enclosing span carrying the outcome and total
+                 — a sampled request's whole lifecycle rendered against
+                 the disagg/gateway lanes that produced it.
 """
 from __future__ import annotations
 
@@ -426,6 +436,55 @@ def oracle_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def requests_trace_events(events: List[Dict[str, Any]]
+                          ) -> List[Dict[str, Any]]:
+    """Real spans for kept request traces (observability.requests):
+    each ``kind == "trace"`` event carries its phase list with offsets
+    from the request's start, so every phase renders as an "X" span on
+    the request's own track — replay attempts (failover/preempt) get an
+    " a<n>" suffix so they read as child spans under the one request id.
+    One enclosing span per request carries the outcome and totals."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.get("kind") != "trace":
+            continue
+        ts = float(ev.get("ts", 0.0))
+        rid = str(ev.get("request_id", "?"))
+        tid = rid[:12]
+        total_ms = float(ev.get("total_ms", 0.0) or 0.0)
+        # the conductor stamps ts at completion; phases carry offsets
+        # from the request's start, so anchor the lane at ts - total
+        t_start = ts - total_ms / 1e3
+        out.append({
+            "name": f"request {ev.get('outcome', '?')}",
+            "cat": "request", "ph": "X", "ts": t_start * 1e6,
+            "dur": max(0.0, total_ms) * 1e3,
+            "pid": "requests", "tid": tid,
+            "args": {"request_id": rid,
+                     "outcome": ev.get("outcome"),
+                     "attempts": ev.get("attempts", 1),
+                     "preempts": ev.get("preempts", 0),
+                     "total_ms": round(total_ms, 3)},
+        })
+        for ph in ev.get("phases", []) or []:
+            name = str(ph.get("phase", "phase"))
+            attempt = int(ph.get("attempt", 1) or 1)
+            if attempt > 1:
+                name += f" a{attempt}"
+            dur_ms = float(ph.get("dur_ms", 0.0) or 0.0)
+            t_ms = ph.get("t_ms")
+            t0 = t_start + (float(t_ms) / 1e3 if t_ms is not None
+                            else 0.0)
+            args = {k: v for k, v in ph.items()
+                    if k not in ("phase", "t_ms") and v is not None}
+            out.append({
+                "name": name, "cat": "request_phase", "ph": "X",
+                "ts": t0 * 1e6, "dur": max(0.0, dur_ms) * 1e3,
+                "pid": "requests", "tid": tid, "args": args,
+            })
+    return out
+
+
 def task_trace_events(task_events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
     """Chrome-trace events for conductor task events — the ONE rendering
@@ -468,6 +527,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         lora_events: Optional[
                             List[Dict[str, Any]]] = None,
                         gateway_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        requesttrace_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -497,6 +558,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(lora_trace_events(lora_events))
     if gateway_events:
         trace.extend(gateway_trace_events(gateway_events))
+    if requesttrace_events:
+        trace.extend(requests_trace_events(requesttrace_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -561,8 +624,14 @@ def merged_timeline(filename: Optional[str] = None,
         gev = w.conductor.call("get_gateway_events", limit, timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-gateway conductor
         gev = []
+    try:
+        rtev = w.conductor.call("get_requesttrace_events", limit,
+                                timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-requesttrace conductor
+        rtev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
-                                pev, oev, dev, orev, asev, lev, gev)
+                                pev, oev, dev, orev, asev, lev, gev,
+                                rtev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

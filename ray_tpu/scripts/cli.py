@@ -705,6 +705,101 @@ def cmd_gateway(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_requests(args) -> None:
+    """`ray_tpu requests` — per-request flight-recorder view
+    (observability/requests.py): retention totals, the cluster-wide
+    slowest requests with their per-phase latency breakdowns, and the
+    p99-attribution report naming the phase that owns the tail —
+    from the same aggregate every other surface (state API,
+    /api/requesttrace, Prometheus, `requests` timeline lane) reads.
+    `--trace <id>` replays one kept request's full span log."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    if args.trace:
+        trc = state.request_trace(args.trace)
+        if trc is None:
+            print(f"no kept trace for request {args.trace!r} "
+                  f"(sampled out, aged out, or never recorded)")
+            return
+        if args.json:
+            print(json.dumps(trc, indent=2, default=str))
+            return
+        print(f"{trc.get('request_id')}: outcome={trc.get('outcome')} "
+              f"total={trc.get('total_ms', 0.0):.1f}ms "
+              f"attempts={trc.get('attempts', 1)} "
+              f"preempts={trc.get('preempts', 0)} "
+              f"source={trc.get('source')} "
+              f"class={trc.get('class', '-')} "
+              f"tenant={trc.get('tenant', '-')}")
+        for ph in trc.get("phases") or []:
+            extra = " ".join(
+                f"{k}={v}" for k, v in sorted(ph.items())
+                if k not in ("phase", "t_ms", "dur_ms", "attempt")
+                and v is not None)
+            print(f"  [a{ph.get('attempt', 1)}] "
+                  f"{ph.get('phase'):<18} +{ph.get('t_ms', 0.0):9.1f}ms "
+                  f"dur={ph.get('dur_ms', 0.0):9.2f}ms"
+                  + (f"  {extra}" if extra else ""))
+        for ph in trc.get("remote_phases") or []:
+            print(f"  [a{ph.get('attempt', 1)}] "
+                  f"{ph.get('phase'):<18} (remote) "
+                  f"dur={ph.get('dur_ms', 0.0):9.2f}ms "
+                  f"server={ph.get('server', '-')}")
+        return
+    st = state.requesttrace_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    if not st.get("stores"):
+        print("no request-trace telemetry recorded (serve traffic "
+              "with RAY_TPU_REQTRACE=1 — the default — first)")
+        return
+    totals = st.get("totals") or {}
+    out_txt = " ".join(
+        f"{k}:{v}"
+        for k, v in sorted((totals.get("outcomes") or {}).items())) \
+        or "none"
+    print(f"totals: stores={totals.get('stores', 0)} "
+          f"completed={totals.get('completed', 0)} "
+          f"kept={totals.get('kept', 0)} "
+          f"dropped={totals.get('dropped', 0)} "
+          f"replayed={totals.get('replayed_requests', 0)} "
+          f"preempted={totals.get('preempted_requests', 0)} "
+          f"slowest={totals.get('slowest_ms', 0.0):.1f}ms "
+          f"outcomes=({out_txt})")
+    attr = st.get("attribution") or {}
+    if attr.get("n"):
+        owner = attr.get("tail_owner")
+        share = attr.get("tail_share")
+        print(f"p99 attribution over {attr['n']} requests: "
+              f"p50={attr.get('p50_total_ms', 0.0):.1f}ms "
+              f"p99={attr.get('p99_total_ms', 0.0):.1f}ms tail_owner="
+              + (f"{owner} ({share:.0%} of the gap)"
+                 if owner else "none"))
+        for ph, row in sorted((attr.get("phases") or {}).items(),
+                              key=lambda kv: -kv[1]["delta_ms"]):
+            print(f"    {ph:<18} p50={row['p50_ms']:9.2f}ms "
+                  f"p99={row['p99_ms']:9.2f}ms "
+                  f"delta={row['delta_ms']:+9.2f}ms")
+    k = max(1, int(args.slowest))
+    for rec in (st.get("slowest") or [])[:k]:
+        pm = rec.get("phase_ms") or {}
+        ph_txt = " ".join(f"{p}={pm[p]:.1f}" for p in sorted(
+            pm, key=lambda p: -pm[p]))
+        print(f"  {rec.get('request_id')}: "
+              f"{rec.get('total_ms', 0.0):.1f}ms "
+              f"outcome={rec.get('outcome')} "
+              f"attempts={rec.get('attempts', 1)}"
+              + (f"  [{ph_txt}]" if ph_txt else ""))
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_requesttrace_events",
+                                  args.events, timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_lora(args) -> None:
     """`ray_tpu lora` — multi-tenant LoRA serving view
     (serve/lora.py): per-pool adapter-paging counters and residents,
@@ -1286,6 +1381,24 @@ def main(argv=None) -> None:
                          "disconnect markers)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_gateway)
+
+    sp = sub.add_parser("requests",
+                        help="per-request flight recorder: slowest "
+                             "requests with per-phase breakdowns, "
+                             "p99 tail attribution, single-trace "
+                             "replay by request id")
+    sp.add_argument("--slowest", type=int, default=10,
+                    help="print the K slowest kept requests "
+                         "(default 10)")
+    sp.add_argument("--trace",
+                    help="replay ONE kept request's phase spans by "
+                         "request id")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N request-trace events "
+                         "(kept-trace + remote-phase records)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_requests)
 
     sp = sub.add_parser("lora",
                         help="multi-tenant LoRA serving: adapter-pool "

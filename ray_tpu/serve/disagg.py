@@ -87,6 +87,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_tpu.exceptions import ActorError, WorkerCrashedError
+from ray_tpu.observability import requests as reqtrace
 
 from .autoscale import SlidingWindow, default_target_p99_ms
 from .handle import RequestShedError, shed_counter
@@ -702,6 +703,7 @@ class DecodeServer:
 
         if self._chaos is not None:
             self._chaos.on_request()  # may os._exit (kill_replica)
+        t_fetch0 = time.perf_counter()
         desc = rec.get("kv")
         if desc is not None:
             w = _worker()
@@ -717,6 +719,7 @@ class DecodeServer:
             kv_k, kv_v = rec["kv_inline"]
             acc = {"chunks_local": 2, "chunks_fetched": 0,
                    "fetched_bytes": 0, "shm_bytes": 0, "rpc_bytes": 0}
+        fetch_ms = (time.perf_counter() - t_fetch0) * 1e3
         nbytes = int(kv_k.nbytes + kv_v.nbytes)
         # adopt (which VALIDATES length bounds and KV layout) before any
         # accounting: a rejected adoption must not leave transfers >
@@ -745,6 +748,24 @@ class DecodeServer:
                        "shm_bytes": acc["shm_bytes"],
                        "rpc_bytes": acc["rpc_bytes"],
                        "outcome": rec.get("outcome")})
+        # flight recorder: in-process routers have the request trace
+        # active on THIS thread (the open kv_transfer span absorbs the
+        # fetch breakdown); an actor-mode replica has no thread-local
+        # and pushes the breakdown as a remote child phase instead
+        rt = rec.get("_reqtrace")
+        if reqtrace.current_trace() is not None:
+            reqtrace.annotate(kv_fetch_ms=round(fetch_ms, 3),
+                              kv_bytes=nbytes,
+                              shm_bytes=int(acc["shm_bytes"]),
+                              rpc_bytes=int(acc["rpc_bytes"]),
+                              chunks_local=int(acc["chunks_local"]))
+        elif isinstance(rt, dict) and rt.get("request_id"):
+            reqtrace.push_remote_phase(
+                rt["request_id"], "kv_transfer_remote", fetch_ms,
+                attempt=int(rt.get("attempt", 1)),
+                server=self.server_id, kv_bytes=nbytes,
+                shm_bytes=int(acc["shm_bytes"]),
+                rpc_bytes=int(acc["rpc_bytes"]))
         return stream
 
     def stream_from(self, rec: Dict[str, Any], max_new_tokens: int,
@@ -838,6 +859,14 @@ class DecodeServer:
             with self._lock:
                 self._streams.pop(hid, None)
             self.publish_telemetry()
+            # per-request speculation accounting rides the final pull
+            # so the router's decode_steady span can carry
+            # accept/reject counts without an extra round trip
+            return {"tokens": toks, "done": True,
+                    "spec_proposed": int(getattr(req, "spec_proposed",
+                                                 0)),
+                    "spec_accepted": int(getattr(req, "spec_accepted",
+                                                 0))}
         return {"tokens": toks, "done": done}
 
     def cancel_decode(self, hid: str,
@@ -1883,34 +1912,64 @@ class DisaggRouter:
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         deadline = (None if deadline_s is None
                     else time.perf_counter() + float(deadline_s))
-        self._check_deadline(deadline, tenant)  # arrived already expired
-        # rep_box[0] is the decode replica currently holding this
-        # request's reservation — failover swaps it, and release-on-
-        # exit must decrement whichever replica holds it NOW (releasing
-        # the original after a swap would steal another request's
-        # reservation and leak the survivor's)
-        rep_box = [self._admit_or_shed(tenant, deadline, priority)]
-        t_admit = time.perf_counter()
-        pslot = self._preempt_register(priority, tenant)
-        ok = False
+        # flight recorder: adopt the gateway's trace when one is active
+        # on this thread; mint our own for direct callers (and then we
+        # own the finish). Either way the trace rides the thread-local
+        # so every tier hop below stamps phases without plumbing.
+        tr = reqtrace.current_trace()
+        owned = tr is None
+        if owned:
+            tr = reqtrace.start_trace(source="router", tenant=tenant,
+                                      cls=priority)
         try:
-            if not self._disagg_mode:
-                out = self._generate_colocated(
-                    prompt, max_new_tokens, eos_token, timeout_s,
-                    deadline, on_first_token, token_sleep_s, t_admit,
-                    tenant, pslot, on_tokens, cancel_event, rep_box)
-            else:
-                out = self._generate_disagg(
-                    rep_box, prompt, max_new_tokens, eos_token,
-                    timeout_s, deadline, on_first_token, token_sleep_s,
-                    t_admit, tenant, pslot, on_tokens, cancel_event)
-            ok = True
-            return out
-        finally:
-            self._preempt_unregister(pslot)
-            self._complete(rep_box[0], ok, tenant=tenant,
-                           wall_ms=(time.perf_counter() - t_admit)
-                           * 1e3)
+            with reqtrace.activate(tr):
+                self._check_deadline(deadline, tenant)  # arrived expired
+                # rep_box[0] is the decode replica currently holding
+                # this request's reservation — failover swaps it, and
+                # release-on-exit must decrement whichever replica
+                # holds it NOW (releasing the original after a swap
+                # would steal another request's reservation and leak
+                # the survivor's)
+                with reqtrace.phase("queue_reserve"):
+                    rep_box = [self._admit_or_shed(tenant, deadline,
+                                                   priority)]
+                t_admit = time.perf_counter()
+                pslot = self._preempt_register(priority, tenant)
+                ok = False
+                try:
+                    if not self._disagg_mode:
+                        out = self._generate_colocated(
+                            prompt, max_new_tokens, eos_token,
+                            timeout_s, deadline, on_first_token,
+                            token_sleep_s, t_admit, tenant, pslot,
+                            on_tokens, cancel_event, rep_box)
+                    else:
+                        out = self._generate_disagg(
+                            rep_box, prompt, max_new_tokens, eos_token,
+                            timeout_s, deadline, on_first_token,
+                            token_sleep_s, t_admit, tenant, pslot,
+                            on_tokens, cancel_event)
+                    ok = True
+                    if owned and tr is not None:
+                        tr.finish("ok", tokens=len(out))
+                    return out
+                finally:
+                    self._preempt_unregister(pslot)
+                    self._complete(rep_box[0], ok, tenant=tenant,
+                                   wall_ms=(time.perf_counter()
+                                            - t_admit) * 1e3)
+        except RequestShedError as e:
+            if owned and tr is not None:
+                cause = getattr(e, "cause", None)
+                outcome = {"deadline": "deadline",
+                           "disconnect": "disconnect",
+                           "preempt": "preempt"}.get(cause, "shed")
+                tr.finish(outcome, cause=cause)
+            raise
+        except BaseException as e:
+            if owned and tr is not None:
+                tr.finish("error", cause=type(e).__name__)
+            raise
 
     def _record_tenant_ttft(self, tenant: Optional[str],
                             ttft_ms: float) -> None:
@@ -1936,6 +1995,7 @@ class DisaggRouter:
         history: List[int] = []
         first_emitted = False
         had_preempt = False
+        tr = reqtrace.current_trace()
         while True:
             remaining = max_new_tokens - len(history)
             if remaining <= 0:
@@ -1964,8 +2024,17 @@ class DisaggRouter:
                     pslot.cancel_fn = (
                         lambda s=stream: self._colocated.cancel_slot(
                             s, "preempt"))
+            t_dec = time.perf_counter()
+            t_first_tok: Optional[float] = None
+            n_attempt_toks = 0
             try:
                 for tok in stream:
+                    if t_first_tok is None:
+                        t_first_tok = time.perf_counter()
+                        if tr is not None:
+                            tr.add_phase("decode_first_token",
+                                         (t_first_tok - t_dec) * 1e3)
+                    n_attempt_toks += 1
                     if not first_emitted:
                         first_emitted = True
                         ttft = (time.perf_counter() - t_admit) * 1e3
@@ -1988,6 +2057,14 @@ class DisaggRouter:
                 # deadline/disconnect shed mid-stream: cancel the
                 # engine slot so the abandoned request stops burning
                 # ticks (freed + pins released at the tick boundary)
+                if tr is not None:
+                    tr.add_phase(
+                        "decode_steady" if t_first_tok is not None
+                        else "decode_first_token",
+                        (time.perf_counter()
+                         - (t_first_tok or t_dec)) * 1e3,
+                        tokens=n_attempt_toks,
+                        error=getattr(e, "cause", None) or "shed")
                 cancel = getattr(self._colocated, "cancel_slot", None)
                 if callable(cancel):
                     cancel(stream, getattr(e, "cause", None))
@@ -1996,6 +2073,10 @@ class DisaggRouter:
                 if pslot is not None:
                     with self._lock:
                         pslot.cancel_fn = None
+            if tr is not None and t_first_tok is not None:
+                tr.add_phase("decode_steady",
+                             (time.perf_counter() - t_first_tok) * 1e3,
+                             tokens=n_attempt_toks)
             if pslot is not None and pslot.preempted \
                     and len(history) < max_new_tokens \
                     and not (eos_token is not None and history
@@ -2005,6 +2086,8 @@ class DisaggRouter:
                 with self._lock:
                     pslot.preempted = False
                 had_preempt = True
+                if tr is not None:
+                    tr.mark_preempt()
                 time.sleep(0.1)  # let the preemptor actually land
                 continue
             break
@@ -2038,6 +2121,7 @@ class DisaggRouter:
         fail_detected: Optional[float] = None
         had_failover = False
         had_preempt = False
+        tr = reqtrace.current_trace()
 
         def _preempt_resume() -> bool:
             """True exactly once per fired preemption: the stream
@@ -2053,6 +2137,10 @@ class DisaggRouter:
             with self._lock:
                 pslot.preempted = False
             had_preempt = True
+            if tr is not None:
+                # the replay's phases become a child span set under
+                # the same request id, tagged with the new attempt
+                tr.mark_preempt()
             time.sleep(0.1)  # let the preemptor actually land
             return True
 
@@ -2082,8 +2170,10 @@ class DisaggRouter:
                 pf.inflight += 1
             self._pf_inflight_win.add(self._pf_inflight)
             try:
-                rec = self._tier_call(pf, "prefill", "prefill",
-                                      replay.tolist(), tenant)
+                with reqtrace.phase("prefill", replica=pf.rid,
+                                    prompt_tokens=int(replay.size)):
+                    rec = self._tier_call(pf, "prefill", "prefill",
+                                          replay.tolist(), tenant)
             except Exception as e:  # noqa: BLE001 — dead or broken
                 if _is_pool_exhausted(e):
                     raise self._shed_pool_exhausted("prefill", tenant,
@@ -2093,6 +2183,8 @@ class DisaggRouter:
                 had_failover = True
                 self._attempt_failed("prefill", pf.rid, attempt, e,
                                      tenant)
+                if tr is not None:
+                    tr.begin_attempt()
                 continue
             finally:
                 with self._lock:
@@ -2136,10 +2228,23 @@ class DisaggRouter:
             if token_sleep_s > 0:
                 chunk = max(1, min(chunk,
                                    int(120.0 / token_sleep_s) or 1))
+            t_dec: Optional[float] = None
+            t_first_tok: Optional[float] = None
+            n_attempt_toks = 0
+            spec_attrs: Dict[str, int] = {}
             try:
-                hid = self._tier_call(rep, "decode", "start_decode",
-                                      rec, remaining, eos_token,
-                                      timeout_s)
+                if tr is not None:
+                    # remote decode tiers (actor mode) push their KV
+                    # adoption breakdown to the conductor as a child
+                    # phase under this id; local tiers annotate the
+                    # open kv_transfer span directly
+                    rec["_reqtrace"] = {"request_id": tr.request_id,
+                                        "attempt": attempt}
+                with reqtrace.phase("kv_transfer", replica=rep.rid):
+                    hid = self._tier_call(rep, "decode", "start_decode",
+                                          rec, remaining, eos_token,
+                                          timeout_s)
+                t_dec = time.perf_counter()
                 if pslot is not None:
                     # arm preemption for the LIVE stream only: an
                     # interactive arrival cancels exactly this handle
@@ -2158,6 +2263,13 @@ class DisaggRouter:
                         min(2.0, max(0.1, timeout_s / 4)))
                     toks = out.get("tokens") or []
                     if toks:
+                        if t_first_tok is None:
+                            t_first_tok = time.perf_counter()
+                            if tr is not None:
+                                tr.add_phase("decode_first_token",
+                                             (t_first_tok - t_dec)
+                                             * 1e3, replica=rep.rid)
+                        n_attempt_toks += len(toks)
                         history.extend(int(t) for t in toks)
                         if pslot is not None:
                             pslot.tokens = len(history)
@@ -2172,6 +2284,16 @@ class DisaggRouter:
                         if token_sleep_s > 0:
                             time.sleep(token_sleep_s * len(toks))
                     if out.get("done"):
+                        if tr is not None:
+                            for k in ("spec_proposed", "spec_accepted"):
+                                if out.get(k) is not None:
+                                    spec_attrs[k] = int(out[k])
+                            tr.add_phase(
+                                "decode_steady",
+                                (time.perf_counter()
+                                 - (t_first_tok or t_dec)) * 1e3,
+                                replica=rep.rid, tokens=n_attempt_toks,
+                                **spec_attrs)
                         self._ack_transfer(pf, rec)
                         if _preempt_resume():
                             # the cancel landed mid-pull: this "done"
@@ -2194,6 +2316,17 @@ class DisaggRouter:
                         # abandon the stream: the engine frees the slot
                         # on its own; the transfer is still acked so
                         # the sender's chunk refs never leak
+                        if tr is not None and t_dec is not None:
+                            tr.add_phase(
+                                "decode_steady"
+                                if t_first_tok is not None
+                                else "decode_first_token",
+                                (time.perf_counter()
+                                 - (t_first_tok or t_dec)) * 1e3,
+                                replica=rep.rid,
+                                tokens=n_attempt_toks,
+                                error=getattr(e, "cause", None)
+                                or "shed")
                         try:
                             self._tier_call(rep, "decode",
                                             "cancel_decode", hid,
@@ -2210,6 +2343,16 @@ class DisaggRouter:
             except RequestShedError:
                 raise
             except Exception as e:  # noqa: BLE001 — death or stall
+                if tr is not None and t_dec is not None:
+                    # the failed attempt's partial decode IS a child
+                    # span — the failover breakdown needs it
+                    tr.add_phase(
+                        "decode_steady" if t_first_tok is not None
+                        else "decode_first_token",
+                        (time.perf_counter()
+                         - (t_first_tok or t_dec)) * 1e3,
+                        replica=rep.rid, tokens=n_attempt_toks,
+                        error=type(e).__name__)
                 if _preempt_resume():
                     # not a fault: the pull handle vanished because an
                     # interactive request took the slot (cancel_decode
@@ -2241,6 +2384,8 @@ class DisaggRouter:
                 self._ack_transfer(pf, rec)
                 self._attempt_failed("decode", rep.rid, attempt, e,
                                      tenant)
+                if tr is not None:
+                    tr.begin_attempt()
                 rep_box[0] = self._reserve_survivor(rep, deadline,
                                                     tenant)
                 continue

@@ -65,10 +65,12 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.observability import requests as reqtrace
+
 from .autoscale import SlidingWindow
 from .handle import RequestShedError
 from .qos import (CLASSES, INTERACTIVE, QosGate, gateway_metrics,
-                  push_gateway_event, push_gateway_stats)
+                  push_gateway_event, push_gateway_stats, shed_outcome)
 
 _GW_SEQ = itertools.count()
 
@@ -398,6 +400,16 @@ class GatewayServer:
         cls = "-"
         tenant: Optional[str] = None
         admitted = False
+        # the request id is minted BEFORE parsing so even a 400 carries
+        # a correlatable X-Request-Id (the middleware stamps whatever
+        # this handler left in request["req_id"]); an incoming W3C
+        # traceparent bridges the caller's trace id into the flight
+        # recorder
+        t_req = time.perf_counter()
+        req_id = (f"cmpl-{uuid.uuid4().hex[:24]}" if route != "chat"
+                  else f"chatcmpl-{uuid.uuid4().hex[:24]}")
+        request["req_id"] = req_id
+        tp_in = request.headers.get("traceparent")
         try:
             try:
                 body = json.loads((await request.read()) or b"")
@@ -467,6 +479,18 @@ class GatewayServer:
                 except RequestShedError as e:
                     status = self._shed_status(e)
                     self._count(route, cls, status)
+                    # a gate-shed request still leaves a trace — shed
+                    # outcomes are always retained, so the tail report
+                    # sees admission rejections, not just completions
+                    tr = reqtrace.start_trace(
+                        req_id, source="gateway", traceparent=tp_in,
+                        tenant=tenant, cls=cls, t0=t_req)
+                    if tr is not None:
+                        tr.add_phase(
+                            "qos_admission",
+                            (time.perf_counter() - t_req) * 1e3)
+                        tr.finish("shed",
+                                  cause=getattr(e, "cause", None))
                     return web.json_response(
                         self._error_body(str(e), "rate_limit_error",
                                          getattr(e, "cause", None)),
@@ -479,15 +503,21 @@ class GatewayServer:
                     request.transport.abort()
                 self._count(route, cls, 499)
                 raise ConnectionResetError("chaos drop_connection")
-            req_id = (f"cmpl-{uuid.uuid4().hex[:24]}" if route != "chat"
-                      else f"chatcmpl-{uuid.uuid4().hex[:24]}")
             created = int(time.time())
+            # the flight-recorder trace: t0 backdated to handler entry
+            # so qos_admission covers parse + auth + classify + admit
+            tr = reqtrace.start_trace(
+                req_id, source="gateway", traceparent=tp_in,
+                tenant=tenant, cls=cls, t0=t_req)
+            if tr is not None:
+                tr.add_phase("qos_admission",
+                             (time.perf_counter() - t_req) * 1e3)
             ctx = dict(route=route, cls=cls, tenant=tenant,
                        router=router, model=model or "",
                        prompt_tokens=prompt_tokens,
                        max_tokens=max_tokens, deadline_s=deadline_s,
                        token_sleep_s=token_sleep_s,
-                       req_id=req_id, created=created)
+                       req_id=req_id, created=created, trace=tr)
             if body.get("stream"):
                 return await self._stream_response(request, ctx)
             return await self._block_response(request, ctx)
@@ -525,14 +555,19 @@ class GatewayServer:
         loop = asyncio.get_running_loop()
         route, cls = ctx["route"], ctx["cls"]
         router = ctx["router"]
+        tr = ctx.get("trace")
         cancel_event = threading.Event()
         t0 = time.perf_counter()
         kwargs = self._generate_kwargs(ctx)
         kwargs["cancel_event"] = cancel_event
 
         def work():
-            return router.generate(ctx["prompt_tokens"],
-                                   ctx["max_tokens"], **kwargs)
+            # activate on the EXECUTOR thread: the router's generate —
+            # and every in-process tier hop under it — stamps phases
+            # onto this request's trace through the thread-local
+            with reqtrace.activate(tr):
+                return router.generate(ctx["prompt_tokens"],
+                                       ctx["max_tokens"], **kwargs)
 
         try:
             toks = await loop.run_in_executor(self._pool, work)
@@ -543,10 +578,15 @@ class GatewayServer:
             push_gateway_event({"kind": "disconnect",
                                 "gateway": self.gateway_id,
                                 "class": cls, "phase": "waiting"})
+            if tr is not None:
+                tr.finish("disconnect", cause="client_gone")
             raise
         except RequestShedError as e:
             status = self._shed_status(e)
             self._count(route, cls, status)
+            if tr is not None:
+                outcome, cause = shed_outcome(e)
+                tr.finish(outcome, cause=cause)
             return web.json_response(
                 self._error_body(str(e), "rate_limit_error"
                                  if status == 429 else "overloaded",
@@ -554,10 +594,14 @@ class GatewayServer:
                 status=status, headers=self._shed_headers(e))
         except ValueError as e:
             self._count(route, cls, 400)
+            if tr is not None:
+                tr.finish("error", cause=type(e).__name__)
             return web.json_response(self._error_body(
                 str(e), "invalid_request_error", None), status=400)
         except Exception as e:  # noqa: BLE001 — surface as 500
             self._count(route, cls, 500)
+            if tr is not None:
+                tr.finish("error", cause=type(e).__name__)
             return web.json_response(self._error_body(
                 f"{type(e).__name__}: {e}", "api_error", None),
                 status=500)
@@ -567,6 +611,8 @@ class GatewayServer:
                   and toks[-1] == int(self._eos_token) else "length")
         self._count_done(cls, len(toks), streamed=False)
         self._count(route, cls, 200)
+        if tr is not None:
+            tr.finish("ok", tokens=len(toks))
         return web.json_response(self._completion_payload(
             route, ctx["req_id"], ctx["created"], ctx["model"], text,
             finish, len(ctx["prompt_tokens"]), len(toks)))
@@ -587,9 +633,16 @@ class GatewayServer:
         loop = asyncio.get_running_loop()
         route, cls = ctx["route"], ctx["cls"]
         router = ctx["router"]
+        tr = ctx.get("trace")
         cancel_event = threading.Event()
         q: asyncio.Queue = asyncio.Queue()
         t0 = time.perf_counter()
+        # sse_flush accounting: wall time spent inside resp.write —
+        # concurrent with decode (the executor keeps generating while
+        # the loop flushes), so the phase is marked concurrent and
+        # excluded from the phase-sum invariant
+        flush_s = 0.0
+        flush_n = 0
 
         def _put(item):
             try:
@@ -603,11 +656,20 @@ class GatewayServer:
 
         def work():
             try:
-                out = router.generate(ctx["prompt_tokens"],
-                                      ctx["max_tokens"], **kwargs)
+                with reqtrace.activate(tr):
+                    out = router.generate(ctx["prompt_tokens"],
+                                          ctx["max_tokens"], **kwargs)
                 _put(("done", out))
             except BaseException as e:  # noqa: BLE001 — relayed
                 _put(("error", e))
+
+        def _finish(outcome, cause=None, **attrs):
+            if tr is None:
+                return
+            if flush_s > 0.0:
+                tr.add_phase("sse_flush", flush_s * 1e3,
+                             concurrent=True, writes=flush_n)
+            tr.finish(outcome, cause=cause, **attrs)
 
         # the status line is written lazily at the FIRST frame: a
         # request the router sheds before producing anything (capacity,
@@ -617,6 +679,9 @@ class GatewayServer:
         resp = web.StreamResponse(status=200)
         resp.headers["Content-Type"] = "text/event-stream"
         resp.headers["Cache-Control"] = "no-cache"
+        # set pre-prepare: once the SSE status line is on the wire the
+        # middleware can no longer add headers
+        resp.headers["X-Request-Id"] = ctx["req_id"]
         resp.enable_chunked_encoding()
         prepared = False
 
@@ -651,12 +716,15 @@ class GatewayServer:
                     text = self._codec.decode(got)
                     delta, sent_text = text[len(sent_text):], text
                     try:
+                        t_w = time.perf_counter()
                         await _prepare_once()
                         await resp.write(_sse_frame(
                             self._completion_payload(
                                 route, ctx["req_id"], ctx["created"],
                                 ctx["model"], delta, None, 0, 0,
                                 chunk=True, first_chunk=first)))
+                        flush_s += time.perf_counter() - t_w
+                        flush_n += 1
                     except _CLIENT_GONE:
                         disconnected = True
                         break
@@ -676,6 +744,7 @@ class GatewayServer:
                               and toks[-1] == int(self._eos_token)
                               else "length")
                     try:
+                        t_w = time.perf_counter()
                         await _prepare_once()
                         await resp.write(_sse_frame(
                             self._completion_payload(
@@ -684,11 +753,14 @@ class GatewayServer:
                                 chunk=True)))
                         await resp.write(_sse_frame(b"[DONE]"))
                         await resp.write_eof()
+                        flush_s += time.perf_counter() - t_w
+                        flush_n += 1
                     except _CLIENT_GONE:
                         disconnected = True
                         break
                     self._count_done(cls, len(toks), streamed=True)
                     self._count(route, cls, 200)
+                    _finish("ok", tokens=len(toks), streamed=True)
                     return resp
                 else:  # error relayed from the executor
                     failed = payload
@@ -699,6 +771,8 @@ class GatewayServer:
             push_gateway_event({"kind": "disconnect",
                                 "gateway": self.gateway_id,
                                 "class": cls, "phase": "streaming"})
+            _finish("disconnect", cause="client_gone",
+                    tokens_sent=len(got))
             raise
         if disconnected:
             cancel_event.set()
@@ -707,16 +781,23 @@ class GatewayServer:
                                 "gateway": self.gateway_id,
                                 "class": cls, "phase": "streaming",
                                 "tokens_sent": len(got)})
+            _finish("disconnect", cause="client_gone",
+                    tokens_sent=len(got))
             return resp
         if isinstance(failed, RequestShedError):
             status = self._shed_status(failed)
             err_type = ("rate_limit_error" if status == 429
                         else "overloaded")
             headers = self._shed_headers(failed)
+            outcome, cause = shed_outcome(failed)
+            _finish(outcome, cause=cause)
         elif isinstance(failed, ValueError):
             status, err_type, headers = 400, "invalid_request_error", {}
+            _finish("error", cause=type(failed).__name__)
         else:
             status, err_type, headers = 500, "api_error", {}
+            _finish("error", cause=type(failed).__name__
+                    if failed is not None else None)
         self._count(route, cls, status)
         body = self._error_body(str(failed), err_type,
                                 getattr(failed, "cause", None))
@@ -765,7 +846,30 @@ class GatewayServer:
             return web.json_response(json.loads(
                 json.dumps(self.stats(), default=str)))
 
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        @web.middleware
+        async def request_id_mw(request, handler):
+            # every response — 2xx, 4xx/5xx error bodies, /v1/models,
+            # healthz — carries X-Request-Id. The completion handlers
+            # mint a route-prefixed id into request["req_id"]; anything
+            # else (or an early rejection before the mint) gets a
+            # req- fallback so clients can always quote an id back.
+            try:
+                resp = await handler(request)
+            except web.HTTPException as e:
+                rid = request.get("req_id") or \
+                    f"req-{uuid.uuid4().hex[:24]}"
+                e.headers.setdefault("X-Request-Id", rid)
+                raise
+            rid = request.get("req_id") or \
+                f"req-{uuid.uuid4().hex[:24]}"
+            # SSE StreamResponses set the header pre-prepare in the
+            # handler; a prepared response's headers are on the wire
+            if not getattr(resp, "prepared", False):
+                resp.headers.setdefault("X-Request-Id", rid)
+            return resp
+
+        app = web.Application(client_max_size=64 * 1024 * 1024,
+                              middlewares=[request_id_mw])
         app.router.add_post("/v1/completions", completions)
         app.router.add_post("/v1/chat/completions", chat)
         app.router.add_get("/v1/models", models)

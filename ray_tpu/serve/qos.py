@@ -44,6 +44,21 @@ INTERACTIVE = "interactive"
 BATCH = "batch"
 CLASSES = (INTERACTIVE, BATCH)
 
+
+def shed_outcome(e: RequestShedError) -> tuple:
+    """Map a shed's cause onto the flight recorder's outcome
+    vocabulary (observability/requests.py): deadline, disconnect and
+    preempt each get their own tail-retention class; everything else
+    (rate_limit / quota / capacity / failover) is a plain ``shed``.
+    ONE mapping shared by the gateway and the router so the same shed
+    never lands under two outcome names on different surfaces."""
+    cause = getattr(e, "cause", None)
+    outcome = {"deadline": "deadline",
+               "disconnect": "disconnect",
+               "preempt": "preempt",
+               "preempted": "preempt"}.get(cause, "shed")
+    return outcome, cause
+
 # ------------------------------------------------------------- telemetry
 
 _metrics: Optional[Dict[str, Any]] = None
@@ -328,4 +343,4 @@ class QosGate:
 
 __all__ = ["BATCH", "CLASSES", "INTERACTIVE", "QosGate", "TenantPolicy",
            "TokenBucket", "gateway_metrics", "push_gateway_event",
-           "push_gateway_stats"]
+           "push_gateway_stats", "shed_outcome"]

@@ -171,7 +171,19 @@ class ChunkFetcher:
         was_local = self._worker.store.contains(oid)
         ref = ObjectRef(oid, locator=tuple(entry["locator"]),
                         owner=tuple(entry["locator"]))
+        t_pull = time.perf_counter()
         arr = self._get_with_retries(ref)
+        # flight recorder: when a request trace is active on this
+        # thread (KV adoption under the router's kv_transfer span) the
+        # per-pull wall time accumulates onto the open phase — the
+        # chaos delay_chunk_fetch stretch lands HERE, which is what
+        # lets the p99 report name kv_transfer as the tail owner
+        # (function-level import: util must not import observability
+        # at module scope)
+        from ray_tpu.observability.requests import annotate
+
+        annotate(pull_ms=round((time.perf_counter() - t_pull) * 1e3, 3),
+                 pulls=1)
         nbytes = int(entry.get("nbytes", arr.nbytes))
         # entries predating the machine field read as same-host (shm was
         # the only deployment shape those versions supported)

@@ -253,6 +253,30 @@ def gateway_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def requesttrace_status() -> Dict[str, Any]:
+    """Per-request flight-recorder view (observability/requests.py):
+    per-store retention counters (completed/kept/dropped, outcomes,
+    replayed + preempted requests), the cluster-wide slowest-request
+    list with per-phase breakdowns, and the p99-attribution report
+    that diffs per-phase time between the p50 and p99 cohorts and
+    names the phase that owns the tail. The CLI analog is `python -m
+    ray_tpu requests`; the dashboard serves it at /api/requesttrace;
+    kept traces render as real spans in the merged timeline's
+    `requests` lane."""
+    return _conductor().conductor.call("get_requesttrace_status",
+                                       timeout=10.0)
+
+
+def request_trace(request_id: str) -> Optional[Dict[str, Any]]:
+    """One request's full kept trace by id (None when it was sampled
+    out or has aged past the retention budget): outcome, attempts,
+    per-phase spans tagged with their attempt number — failover and
+    preemption replays read as child spans under the same id — plus
+    any remote child phases actor-mode tiers pushed."""
+    return _conductor().conductor.call("get_request_trace",
+                                       str(request_id), timeout=10.0)
+
+
 def servefault_status() -> Dict[str, Any]:
     """Serving-plane fault-tolerance view (serve/disagg.py failover +
     serve/autoscale.py self-healing): per-router failover counts by

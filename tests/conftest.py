@@ -104,6 +104,16 @@ def pytest_configure(config):
         "telemetry surface check runs on a module-scoped "
         "log_to_driver=0 cluster — select with `-m gateway`")
     config.addinivalue_line(
+        "markers", "requesttrace: per-request flight-recorder "
+        "scenarios (observability/requests.py: phase-stamped trace "
+        "spans through gateway/QoS/router/prefill/KV-transfer/decode, "
+        "tail-based retention, p99 phase attribution, "
+        "failover/preempt replay nesting, one-set-of-numbers across "
+        "state API == CLI == dashboard == Prometheus == timeline); "
+        "everything is tier-1-safe on CPU, cluster tests run on a "
+        "module-scoped cluster with log_to_driver=0 — select with "
+        "`-m requesttrace`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "

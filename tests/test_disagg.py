@@ -269,6 +269,12 @@ def test_load_harness_smoke_records_and_sheds(model):
     assert rec["errors"] == 0
     assert rec["shed"] >= 1 and rec["shed_rate"] > 0
     assert rec["ttft_p50_ms"] is not None
+    # the flight recorder's report rides along in every bench record:
+    # p99 attribution + the top slowest requests' phase breakdowns
+    rt = rec.get("request_trace")
+    assert rt is not None and rt["n_traced"] >= rec["completed"]
+    assert "tail_owner" in rt["p99_attribution"]
+    assert rt["slowest"] and rt["slowest"][0]["phase_ms"]
     # shedding engaged BEFORE queue depth became unbounded
     assert router.stats()["max_pending"] <= 2 + 1
     # arrival schedules are well-formed for every shape

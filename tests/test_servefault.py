@@ -481,7 +481,16 @@ def test_drain_death_race_reaps_the_drain_record(model):
 def test_generic_replica_drain_rejects_with_cause():
     """serve/replica.py: a request dispatched to a replica that began
     its grace drain sheds with cause `draining` instead of racing the
-    actor's death."""
+    actor's death.
+
+    NB: runs the drain on a FRESH loop via asyncio.run —
+    `asyncio.get_event_loop()` raises RuntimeError when an earlier test
+    in the session detached the main thread's loop, which made this
+    test fail under full-suite runs while passing standalone. The
+    drain deadline is load-tolerant (prepare_for_shutdown returns as
+    soon as the inflight==0 condition holds, so a generous timeout
+    costs nothing on an idle replica but absorbs scheduler stalls on a
+    loaded machine)."""
     import asyncio
 
     import cloudpickle
@@ -492,8 +501,8 @@ def test_generic_replica_drain_rejects_with_cause():
         "r0", "dep", "app", cloudpickle.dumps(lambda x: x),
         cloudpickle.dumps(((), {})))
     assert replica.handle_request({"call_method": None}, [41], {}) == 41
-    asyncio.get_event_loop().run_until_complete(
-        replica.prepare_for_shutdown(timeout_s=0.2))
+    drained = asyncio.run(replica.prepare_for_shutdown(timeout_s=10.0))
+    assert drained in (True, None)  # idle replica: drain completes
     with pytest.raises(RequestShedError) as ei:
         replica.handle_request({"call_method": None}, [41], {})
     assert ei.value.cause == "draining"

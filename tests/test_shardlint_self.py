@@ -295,6 +295,68 @@ def test_sync_io_in_gateway_handler_rule_fires():
             if f.rule == "sync-io-in-gateway-handler"] == []
 
 
+def test_requesttrace_modules_are_lint_covered():
+    """The flight recorder (observability/requests.py) and the traced
+    modules its rule activates in (serve/disagg.py, serve/gateway.py,
+    bench_serve.py) are inside the self-lint set, carry zero error
+    findings, and — context discipline — zero
+    `unpropagated-request-context` findings after suppressions: every
+    cross-tier serve dispatch in a traced module records its hop."""
+    for rel in (os.path.join("observability", "requests.py"),
+                os.path.join("observability", "timeline.py"),
+                os.path.join("serve", "disagg.py"),
+                os.path.join("serve", "gateway.py"),
+                "bench_serve.py"):
+        path = os.path.join(PACKAGE_ROOT, rel)
+        assert os.path.exists(path), rel
+        findings = lint_path(path)
+        assert errors(findings) == [], rel
+        dropped = [f for f in findings
+                   if f.rule == "unpropagated-request-context"]
+        assert dropped == [], (rel, [str(f) for f in dropped])
+
+
+def test_unpropagated_request_context_rule_fires():
+    """The rule catches a seeded violation: a module importing the
+    request-trace API that dispatches a cross-tier serve call
+    (_tier_call/"prefill", _call/"start_decode") from a function scope
+    that never touches the trace — and honors suppressions, leaves
+    trace-recording scopes alone, and stays silent in modules that
+    never import the trace API."""
+    from ray_tpu.analysis.astlint import lint_source
+
+    src = (
+        "from ray_tpu.observability import requests as reqtrace\n"
+        "def blind_prefill(self, pf, ids):\n"
+        "    return self._tier_call(pf, 'prefill', 'prefill', ids)\n"
+        "def blind_decode(target, rec):\n"
+        "    return _call(target, 'start_decode', rec)\n"
+        "def traced_prefill(self, pf, ids):\n"
+        "    with reqtrace.phase('prefill'):\n"
+        "        return self._tier_call(pf, 'prefill', 'prefill', ids)\n"
+        "def probe(self, pf):\n"
+        "    return self._tier_call(pf, 'prefill', 'describe')\n"
+    )
+    found = [f for f in lint_source(src, "seeded.py")
+             if f.rule == "unpropagated-request-context"]
+    assert len(found) == 2, [str(f) for f in found]
+    assert all(f.severity == "info" for f in found)
+    assert {f.location for f in found} == {"seeded.py:3", "seeded.py:5"}
+    # a justified suppression silences it
+    suppressed = src.replace(
+        "    return _call(target, 'start_decode', rec)",
+        "    return _call(target, 'start_decode', rec)"
+        "  # shardlint: disable=unpropagated-request-context")
+    left = [f for f in lint_source(suppressed, "seeded.py")
+            if f.rule == "unpropagated-request-context"]
+    assert len(left) == 1
+    # ...and the rule is inert without the trace API in scope
+    other = ("def blind_prefill(self, pf, ids):\n"
+             "    return self._tier_call(pf, 'prefill', 'prefill', ids)\n")
+    assert [f for f in lint_source(other, "other.py")
+            if f.rule == "unpropagated-request-context"] == []
+
+
 def test_driver_entry_is_clean_too():
     repo_root = os.path.dirname(PACKAGE_ROOT)
     entry = os.path.join(repo_root, "__graft_entry__.py")
@@ -337,7 +399,7 @@ def test_surface_parity_covers_every_subsystem():
     stems = set(discover_subsystems(tree))
     assert {"kvcache", "weight", "online", "pipeline", "autoscale",
             "servefault", "speculation", "gateway",
-            "resilience"} <= stems, stems
+            "resilience", "requesttrace"} <= stems, stems
     assert check_surface_parity(PACKAGE_ROOT) == []
 
 
