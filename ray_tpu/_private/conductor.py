@@ -255,6 +255,21 @@ class ConductorHandler:
         self._disagg_stats: Dict[str, Dict[str, Any]] = {}
         self._disagg_events: List[Dict[str, Any]] = []
 
+        # Global KV plane (serve/kvplane.py): replicas push tier-2
+        # arena / tier-3 adoption snapshots + spill/adopt/directory
+        # markers, and the PREFIX DIRECTORY lives here — (namespace,
+        # digest-chain) -> holder + chunk descriptor, metadata only
+        # (the weight-fabric registry pattern: atomic commit, TTL reap,
+        # keep-last-K GC). KV payload bytes never land here; they ride
+        # the chunk fabric between replicas.
+        self._kvplane_stats: Dict[str, Dict[str, Any]] = {}
+        self._kvplane_events: List[Dict[str, Any]] = []
+        self._kvplane_dir: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._kvplane_dir_counters: Dict[str, int] = {
+            k: 0 for k in ("publishes", "republishes", "lookups",
+                           "directory_hits", "directory_misses",
+                           "reaped", "gced", "unpublished")}
+
         # Serving autoscaler (serve/autoscale.py): policy loops push
         # status snapshots (targets, decisions, replica-seconds) +
         # scale_up/scale_down/drain markers; the conductor only
@@ -1924,6 +1939,247 @@ class ConductorHandler:
                           ) -> List[Dict[str, Any]]:
         with self._lock:
             return self._disagg_events[-limit:]
+
+    # ------------------------------------------------- global KV plane
+    # Replicas (serve/kvplane.py HostArena owners, routers) push tier-2
+    # arena / tier-3 adoption snapshots and spill/adopt/directory
+    # markers here, and the cluster-wide PREFIX DIRECTORY lives here:
+    # (namespace, digest-chain) -> holder + chunk descriptor — metadata
+    # only, the weight-fabric registry pattern (atomic commit, TTL
+    # reap, keep-last-K GC). util.state.kvplane_status(), `ray_tpu
+    # kvplane`, and the dashboard /api/kvplane all read the same
+    # aggregate so every surface reports one set of numbers.
+
+    _KVPLANE_EVENTS_KEPT = 10_000
+    _KVPLANE_STATS_KEPT = 256
+    _KVPLANE_DIR_KEPT = 4096
+    _KVPLANE_GAUGE_FRESH_S = 15.0
+    _KVPLANE_TOTAL_KEYS = (
+        "spills", "spill_bytes", "tier2_hits", "tier2_probes",
+        "tier2_reused_tokens", "tier2_fetched_bytes",
+        "arena_evictions", "tier3_publishes", "tier3_adopts",
+        "tier3_adopted_blocks", "tier3_reused_tokens",
+        "tier3_fetched_bytes", "directory_hits", "directory_misses",
+        "directory_fallbacks")
+
+    def report_kvplane_stats(self, worker_id: str, component_id: str,
+                             stats: Dict[str, Any]) -> None:
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            self._kvplane_stats[str(component_id)] = dict(
+                stats, worker_id=worker_id,
+                component_id=str(component_id), ts=time.time())
+            while len(self._kvplane_stats) > self._KVPLANE_STATS_KEPT:
+                oldest = min(self._kvplane_stats,
+                             key=lambda k:
+                             self._kvplane_stats[k].get("ts", 0.0))
+                del self._kvplane_stats[oldest]
+
+    def get_kvplane_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            comps = {k: dict(v) for k, v in self._kvplane_stats.items()}
+        now = time.time()
+        totals: Dict[str, Any] = {k: 0 for k in self._KVPLANE_TOTAL_KEYS}
+        for st in comps.values():
+            for k in self._KVPLANE_TOTAL_KEYS:
+                v = st.get(k)
+                if isinstance(v, (int, float)):
+                    totals[k] += v
+        # live gauges: only snapshots fresh enough to describe a living
+        # replica count (the disagg queue-depth discipline)
+        totals["arena_entries"] = sum(
+            int(c.get("entries", 0)) for c in comps.values()
+            if now - float(c.get("ts", 0.0))
+            <= self._KVPLANE_GAUGE_FRESH_S)
+        totals["arena_bytes"] = sum(
+            int(c.get("bytes", 0)) for c in comps.values()
+            if now - float(c.get("ts", 0.0))
+            <= self._KVPLANE_GAUGE_FRESH_S)
+        probes = totals["tier2_probes"]
+        totals["tier2_hit_rate"] = (totals["tier2_hits"] / probes
+                                    if probes else 0.0)
+        looks = (totals["directory_hits"]
+                 + totals["directory_misses"])
+        totals["directory_hit_rate"] = (totals["directory_hits"] / looks
+                                        if looks else 0.0)
+        return {"components": comps, "totals": totals}
+
+    def get_kvplane_status(self) -> Dict[str, Any]:
+        """One aggregate for every kvplane surface: per-component
+        snapshots + cluster totals + the prefix directory's summary
+        (entries, bytes, per-namespace counts, commit/reap/GC
+        counters). Directory entry payloads stay out: descriptors are
+        metadata, but a status call is a human surface."""
+        out = self.get_kvplane_stats()
+        with self._lock:
+            per_ns: Dict[str, int] = {}
+            total_bytes = 0
+            for (ns, _d), e in self._kvplane_dir.items():
+                per_ns[ns] = per_ns.get(ns, 0) + 1
+                total_bytes += int(e.get("nbytes", 0))
+            out["directory"] = {
+                "entries": len(self._kvplane_dir),
+                "nbytes": total_bytes,
+                "namespaces": per_ns,
+                "counters": dict(self._kvplane_dir_counters)}
+        return out
+
+    def report_kvplane_event(self, event: Dict[str, Any]) -> None:
+        """spill / tier2_hit / tier3_publish / tier3_adopt /
+        directory_hit instant markers for the merged timeline's kvplane
+        lane."""
+        if not isinstance(event, dict):
+            return
+        with self._lock:
+            event = dict(event)
+            event.setdefault("ts", time.time())
+            self._kvplane_events.append(event)
+            if len(self._kvplane_events) > self._KVPLANE_EVENTS_KEPT:
+                del self._kvplane_events[
+                    :len(self._kvplane_events)
+                    - self._KVPLANE_EVENTS_KEPT]
+
+    def get_kvplane_events(self, limit: int = 10_000
+                           ) -> List[Dict[str, Any]]:
+        with self._lock:
+            return self._kvplane_events[-limit:]
+
+    # ---- prefix directory (the weight-fabric registry pattern) ----
+
+    def _kvplane_ttl_s(self) -> float:
+        from ray_tpu.util import envknobs
+
+        return envknobs.get_float("RAY_TPU_KVPLANE_T3_TTL_S", 600.0)
+
+    def kvplane_publish(self, namespace: str, digest: str,
+                        meta: Dict[str, Any]) -> Dict[str, Any]:
+        """Atomic metadata-only commit of one published prefix: the
+        entry is visible to lookups the instant it lands, or not at
+        all. A digest already committed returns ``status: already`` —
+        the FIRST holder keeps serving, the late publisher drops its
+        refs (no torn ownership). Error dicts, never raises (the
+        weights_publish_fragment contract)."""
+        if not isinstance(meta, dict) or not meta.get("holder"):
+            return {"error": "kvplane_publish needs a holder in meta"}
+        if not digest:
+            return {"error": "kvplane_publish needs a digest"}
+        key = (str(namespace or ""), str(digest))
+        now = time.time()
+        with self._lock:
+            existing = self._kvplane_dir.get(key)
+            if existing is not None:
+                existing["republished"] = now
+                self._kvplane_dir_counters["republishes"] += 1
+                return {"status": "already",
+                        "holder": existing.get("holder")}
+            entry = dict(meta, namespace=key[0], digest=key[1],
+                         ts=now, started=time.monotonic(),
+                         last_hit=None, hits=0)
+            self._kvplane_dir[key] = entry
+            self._kvplane_dir_counters["publishes"] += 1
+            # overall bound: oldest by recency (last hit, else commit)
+            # — a runaway publisher cannot grow the directory forever
+            while len(self._kvplane_dir) > self._KVPLANE_DIR_KEPT:
+                oldest = min(
+                    self._kvplane_dir,
+                    key=lambda k: (self._kvplane_dir[k].get("last_hit")
+                                   or self._kvplane_dir[k]["ts"]))
+                del self._kvplane_dir[oldest]
+                self._kvplane_dir_counters["gced"] += 1
+            ev = {"kind": "tier3_publish", "namespace": key[0],
+                  "digest": key[1][:16], "holder": meta.get("holder"),
+                  "tokens": meta.get("tokens"),
+                  "nbytes": meta.get("nbytes"), "ts": now}
+            self._kvplane_events.append(ev)
+        self.publish("kvplane", {"event": "publish", "digest": key[1],
+                                 "namespace": key[0],
+                                 "holder": meta.get("holder")})
+        return {"status": "committed"}
+
+    def kvplane_lookup(self, namespace: str,
+                       digests: List[str]) -> Optional[Dict[str, Any]]:
+        """Longest registered prefix among `digests` (caller orders
+        longest-first — models/kvcache.prefix_digests' order). Expired
+        entries (TTL over the monotonic commit clock) are treated as
+        misses and dropped lazily."""
+        ns = str(namespace or "")
+        ttl = self._kvplane_ttl_s()
+        now_m = time.monotonic()
+        with self._lock:
+            self._kvplane_dir_counters["lookups"] += 1
+            for d in list(digests or [])[:64]:
+                key = (ns, str(d))
+                e = self._kvplane_dir.get(key)
+                if e is None:
+                    continue
+                if ttl > 0 and now_m - e.get("started", now_m) > ttl:
+                    del self._kvplane_dir[key]
+                    self._kvplane_dir_counters["reaped"] += 1
+                    continue
+                e["last_hit"] = time.time()
+                e["hits"] = int(e.get("hits", 0)) + 1
+                self._kvplane_dir_counters["directory_hits"] += 1
+                return {k: v for k, v in e.items() if k != "started"}
+            self._kvplane_dir_counters["directory_misses"] += 1
+        return None
+
+    def kvplane_unpublish(self, namespace: str, digest: str) -> bool:
+        """Holder-side retraction (replica draining / arena teardown
+        drops its refs — the descriptor would dangle)."""
+        key = (str(namespace or ""), str(digest))
+        with self._lock:
+            e = self._kvplane_dir.pop(key, None)
+            if e is not None:
+                self._kvplane_dir_counters["unpublished"] += 1
+        return e is not None
+
+    def kvplane_reap(self, max_age_s: Optional[float] = None) -> int:
+        """Drop directory entries older than `max_age_s` (default: the
+        RAY_TPU_KVPLANE_T3_TTL_S knob) on the monotonic commit clock —
+        a published prefix nobody re-publishes eventually stops being
+        routable, bounding how stale a holder claim can get."""
+        ttl = self._kvplane_ttl_s() if max_age_s is None \
+            else float(max_age_s)
+        now_m = time.monotonic()
+        reaped = []
+        with self._lock:
+            for key, e in list(self._kvplane_dir.items()):
+                if now_m - e.get("started", now_m) >= ttl:
+                    del self._kvplane_dir[key]
+                    self._kvplane_dir_counters["reaped"] += 1
+                    reaped.append(key)
+            if reaped:
+                self._kvplane_events.append(
+                    {"kind": "reap", "entries": len(reaped),
+                     "ts": time.time()})
+        return len(reaped)
+
+    def kvplane_gc(self, keep: int,
+                   namespace: Optional[str] = None) -> int:
+        """Keep only the newest `keep` entries (by recency: last hit,
+        else commit time) — per namespace, or over the whole directory
+        when namespace is None. The operator keep-last-K analog of
+        weights_gc."""
+        keep = max(0, int(keep))
+        dropped = 0
+        with self._lock:
+            keys = [k for k in self._kvplane_dir
+                    if namespace is None or k[0] == str(namespace or "")]
+            if len(keys) > keep:
+                keys.sort(key=lambda k:
+                          (self._kvplane_dir[k].get("last_hit")
+                           or self._kvplane_dir[k]["ts"]),
+                          reverse=True)
+                for k in keys[keep:]:
+                    del self._kvplane_dir[k]
+                    self._kvplane_dir_counters["gced"] += 1
+                    dropped += 1
+            if dropped:
+                self._kvplane_events.append(
+                    {"kind": "gc", "entries": dropped,
+                     "ts": time.time()})
+        return dropped
 
     # ------------------------------------------------ HTTP front door
     # Gateway replicas (serve/gateway.py) push request counters by

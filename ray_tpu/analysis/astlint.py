@@ -68,6 +68,19 @@ so broken or dependency-heavy modules still lint):
   p99-attribution report. Advisory: dispatches that are genuinely
   requestless (warmup, health probes) suppress with a justification.
 
+- unregistered-prefix-publish (info): in KV-plane-aware modules
+  (anything importing serve.kvplane or models.kvcache), an
+  ``<cache>.export_prefix(...)`` call in a function scope that never
+  registers the result — no ``kvplane_publish`` conductor commit and
+  no ``publish_prefix`` helper call in the same scope. An exported
+  prefix pushed into the chunk fabric without the directory commit is
+  invisible to every other replica (nothing can ever look it up) while
+  its chunk refs pin host memory until the holder dies — the worst of
+  both tiers. The sanctioned path is serve/kvplane.publish_prefix,
+  which pairs the export with the atomic directory commit. Advisory:
+  genuinely local exports (tests, offline serialization) suppress with
+  a justification comment.
+
 Suppression: append `# shardlint: ok` to the flagged line, or
 `# shardlint: disable=<rule-id>` to suppress one rule on that line.
 """
@@ -597,6 +610,70 @@ def _lint_unpropagated_request_context(tree: ast.AST, aliases: _Aliases,
     return findings
 
 
+# ------------------------------------------- unregistered-prefix-publish
+
+
+def _scope_registers_prefix(fn: ast.AST) -> bool:
+    """Does this scope commit to the prefix directory — a
+    ``"kvplane_publish"`` conductor-call literal, or a call through the
+    sanctioned ``publish_prefix`` helper (which commits internally)?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and \
+                node.value == "kvplane_publish":
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if name == "publish_prefix":
+                return True
+    return False
+
+
+def _lint_unregistered_prefix_publish(tree: ast.AST, aliases: _Aliases,
+                                      path: str) -> List[Finding]:
+    """Active only in KV-plane-aware modules — anything importing
+    serve.kvplane (the tiered plane) or models.kvcache (the cache whose
+    export_prefix produces the publishable payload). There, an
+    ``export_prefix(...)`` whose scope never commits the result to the
+    conductor's prefix directory publishes chunk-fabric objects nobody
+    can ever discover: the refs pin host memory, the prefix serves no
+    one."""
+    kvp_aware = any(
+        mod.endswith("kvplane") or mod.endswith("kvcache")
+        for mod, _name in aliases.from_imports.values()
+    ) or any(mod.endswith((".kvplane", ".kvcache"))
+             or mod in ("kvplane", "kvcache")
+             for mod in aliases.module_alias.values()) or any(
+        name in ("kvplane", "kvcache")
+        for name in aliases.from_imports)
+    if not kvp_aware:
+        return []
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = [c for c in _iter_scope_calls(fn)
+                 if isinstance(c.func, ast.Attribute)
+                 and c.func.attr == "export_prefix"]
+        if not calls or _scope_registers_prefix(fn):
+            continue
+        for call in calls:
+            findings.append(Finding(
+                "unregistered-prefix-publish", INFO,
+                f"{path}:{call.lineno}",
+                f"export_prefix in '{fn.name}' with no directory "
+                "commit in scope — the exported prefix enters the "
+                "chunk fabric unregistered: no replica can ever look "
+                "it up, and its refs pin host memory until the holder "
+                "dies",
+                "publish through serve/kvplane.publish_prefix (export "
+                "+ atomic kvplane_publish commit), or suppress with a "
+                "justification when the export is genuinely local "
+                "(tests, offline serialization)"))
+    return findings
+
+
 # ---------------------------------------------------------------- drivers
 
 
@@ -615,6 +692,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Finding]:
     findings += _lint_sync_io_in_gateway_handler(tree, aliases, path)
     findings += _lint_undonated_pool_write(tree, aliases, path)
     findings += _lint_unpropagated_request_context(tree, aliases, path)
+    findings += _lint_unregistered_prefix_publish(tree, aliases, path)
     # the per-file halves of the cross-module invariant engine
     # (shardlint v2): lock-discipline races and the donation auditor
     from . import invariants

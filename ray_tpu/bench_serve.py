@@ -327,6 +327,15 @@ def _tier_factories(params, config, args, use_cluster: bool,
     kw = dict(kv_block_size=args.block_size,
               kv_pool_blocks=args.pool_blocks, kv_int8=kv_int8,
               retain=retain, chaos=chaos_spec, **lora_kw)
+    # --kvplane legs pin the tiered KV plane on/off per run (None =
+    # leave the replica on its env-knob default); the arena bound makes
+    # the tier-2 spill capacity an explicit part of the record
+    kvplane = getattr(args, "_kvplane", None)
+    if kvplane is not None:
+        kw["kvplane"] = bool(kvplane)
+        if kvplane and getattr(args, "kvplane_arena_mb", 0):
+            kw["kvplane_arena_bytes"] = int(
+                args.kvplane_arena_mb) * (1 << 20)
     if use_cluster:
         import ray_tpu
 
@@ -1258,6 +1267,277 @@ def _spec_record(params, config, args, prompts, load_kw,
     return out
 
 
+def _kvplane_prompts(config, *, n_distinct: int = 8,
+                     block_size: int = 16, sys_blocks: int = 2,
+                     tail_blocks: int = 4,
+                     seed: int = 0) -> List[List[int]]:
+    """make_prompts with DEEP distinct tails: each prompt carries
+    `tail_blocks` full blocks of its own past the shared system prefix,
+    so the distinct-block working set (sys_blocks + n_distinct *
+    tail_blocks) can be sized past one replica's HBM pool — the
+    pressure that makes the tiered plane's spill path load-bearing."""
+    rng = np.random.default_rng(seed)
+    sys_prompt = rng.integers(1, config.vocab_size,
+                              sys_blocks * block_size).tolist()
+    return [sys_prompt + rng.integers(
+        1, config.vocab_size,
+        tail_blocks * block_size + int(rng.integers(2, block_size))
+        ).tolist() for _ in range(n_distinct)]
+
+
+# per-replica kvplane counters the record aggregates (monotone only —
+# gauges like arena entries/bytes don't survive warm-up subtraction)
+_KVP_COUNTERS = (
+    "spills", "spill_bytes", "tier2_hits", "tier2_probes",
+    "tier2_reused_tokens", "tier2_fetched_bytes", "arena_evictions",
+    "tier3_publishes", "tier3_adopts", "tier3_adopted_blocks",
+    "tier3_reused_tokens", "tier3_fetched_bytes", "evict_storms",
+    "storm_evicted_blocks")
+
+
+def _kvp_totals(prefill_targets) -> Dict[str, int]:
+    """Tier counters summed over EVERY prefill replica the leg ever
+    created (a cold-swapped replica leaves the router but its spill
+    and publish history still belongs to the run's accounting), plus
+    the engine-level reused_tokens total (tier-1 hits AND arena
+    re-adopts AND tier-3 imports all land there — it is the
+    cross-leg comparable 'prefill work the caches absorbed')."""
+    from ray_tpu.serve.disagg import _call
+
+    tot = {k: 0 for k in _KVP_COUNTERS}
+    tot["reused_tokens"] = 0
+    for t in prefill_targets:
+        try:
+            kvp = _call(t, "kvplane_stats")  # shardlint: disable=unsupervised-actor-call
+            st = _call(t, "stats")  # shardlint: disable=unsupervised-actor-call
+        except Exception:  # noqa: BLE001 — replica mid-teardown
+            continue
+        for k in _KVP_COUNTERS:
+            tot[k] += int(kvp.get(k, 0))
+        tot["reused_tokens"] += int(st.get("reused_tokens", 0))
+    return tot
+
+
+def _kvplane_reset_directory() -> None:
+    """Reap every prefix-directory entry between legs (TTL 0 reaps
+    unconditionally): a later leg's lookups must not ride the previous
+    leg's publishes — its holders are gone, and a stale fallback hint
+    would smear tier-3 traffic across the per-leg attribution."""
+    import ray_tpu
+
+    w = ray_tpu._private.worker.global_worker
+    if w is None or getattr(w, "conductor", None) is None:
+        return
+    try:
+        w.conductor.call("kvplane_reap", 0.0, timeout=5.0)
+    except Exception:  # noqa: BLE001 — best-effort hygiene
+        pass
+
+
+def _kvplane_run(params, config, args, prompts, load_kw, *,
+                 kvplane: bool, chaos_spec: Optional[str] = None,
+                 cold_swap: bool = False,
+                 pool_blocks: Optional[int] = None):
+    """One leg of the --kvplane comparison: replay the SAME open-loop
+    Zipf schedule with the tiered plane pinned on or off (and, for the
+    HBM-reference leg, `pool_blocks` overriding the deliberately small
+    pool). Returns (record, per-request outputs). With `cold_swap`,
+    after the measured run the entire prefill tier is RETIRED from the
+    router (replicas stay alive so their published tier-3 chunks do)
+    and replaced with cold replicas, then every distinct prompt
+    replays once: the directory's holders are gone, so each lookup
+    degrades to a fallback hint and the cold replica re-adopts the
+    prefix from the object store — the tier-3 persistence story,
+    measured."""
+    from ray_tpu.serve.disagg import DisaggRouter, _call
+
+    pf_n = max(2, args.prefill_replicas)
+    dec_n = args.decode_replicas
+    prev_pool = args.pool_blocks
+    if pool_blocks is not None:
+        args.pool_blocks = pool_blocks
+    args._kvplane = kvplane
+    try:
+        prefill_factory, decode_factory, kill = _tier_factories(
+            params, config, args, True, chaos_spec)
+        prefill = [prefill_factory() for _ in range(pf_n)]
+        decode = [decode_factory() for _ in range(dec_n)]
+        all_prefill = list(prefill)
+        router = DisaggRouter(decode=decode, prefill=prefill,
+                              max_queue_depth=args.queue_depth,
+                              affinity_tokens=args.block_size)
+        outputs: Dict[int, List[int]] = {}
+        try:
+            _warm(router, prompts)
+            # measurement starts HERE (chaos `at=request:N` counts
+            # measured traffic only, counters subtract the warm-up)
+            for r in router.tier_replicas("prefill"):
+                try:
+                    _call(r["target"], "reset_chaos_counts")  # shardlint: disable=unsupervised-actor-call
+                except Exception:  # noqa: BLE001 — pre-reset replica
+                    pass
+            warm_rt = router.stats()
+            warm_kvp = _kvp_totals(all_prefill)
+            rec = run_load(router, prompts, outputs=outputs, **load_kw)
+            st = router.stats()
+            rec["router"] = {k: st[k] - warm_rt[k] for k in
+                             ("dispatched", "completed", "shed",
+                              "directory_hits", "directory_misses",
+                              "directory_fallbacks")}
+            rec["router"]["max_pending"] = st["max_pending"]
+            # tier counters cover exactly the measured run — the cold
+            # replay below is extra work the baseline leg never does,
+            # so it gets its OWN deltas, not a seat in these
+            run_kvp = _kvp_totals(all_prefill)
+            rec["kvplane"] = {k: run_kvp[k] - warm_kvp[k]
+                              for k in run_kvp}
+            rec["kvplane"]["enabled"] = bool(kvplane)
+            rec["kvplane"]["directory"] = router.kvplane_stats()
+            if cold_swap:
+                ref = [router.generate(p, args.max_new)
+                       for p in prompts]
+                pre = _kvp_totals(all_prefill)
+                pre_rt = router.stats()
+                for r in router.tier_replicas("prefill"):
+                    router.remove_dead("prefill", r["rid"])
+                fresh = [prefill_factory() for _ in range(pf_n)]
+                for a in fresh:
+                    router.add_prefill(a)
+                all_prefill.extend(fresh)
+                got = [router.generate(p, args.max_new)
+                       for p in prompts]
+                post = _kvp_totals(all_prefill)
+                post_rt = router.stats()
+                rec["cold_replay"] = {
+                    "prompts": len(prompts),
+                    "bit_identical": got == ref,
+                    "directory_fallbacks":
+                        post_rt["directory_fallbacks"]
+                        - pre_rt["directory_fallbacks"],
+                }
+                for k in ("tier3_adopts", "tier3_adopted_blocks",
+                          "tier3_reused_tokens", "tier3_fetched_bytes"):
+                    rec["cold_replay"][k] = post[k] - pre[k]
+            router.publish_telemetry(force=True)
+        finally:
+            for t in all_prefill:
+                kill(t)
+            for r in router.tier_replicas("decode"):
+                kill(r["target"])
+    finally:
+        args._kvplane = None
+        args.pool_blocks = prev_pool
+    return rec, outputs
+
+
+def _kvplane_record(params, config, args, prompts,
+                    load_kw) -> Dict[str, Any]:
+    """The --kvplane acceptance scenario: a Zipf replay whose distinct-
+    block working set exceeds one replica's HBM pool, run four ways on
+    the SAME schedule — (1) `hbm_reference`: the plane off and a pool
+    big enough to never evict, the engine an unlimited-HBM replica
+    would be; (2) `baseline`: the plane off and the SMALL pool —
+    single-tier, evictions simply lose the prefix; (3) `kvplane`: the
+    small pool with the plane on — spills land in the host arena and
+    come back, the directory routes repeats to holders, and a
+    cold-swapped prefill tier re-adopts everything from the object
+    store; (4) `storm`: the plane on under a scripted evict_storm.
+
+    All legs run int8 pools: the spill/publish wire format IS the int8
+    pool block, so tier-2 re-adopts and tier-3 imports round-trip
+    byte-exactly and every full prefix match — resident, re-adopted,
+    or imported — gathers the same bytes at the same split as the
+    reference's resident hit. That is what lets the verdict demand
+    BIT-IDENTICAL outputs from the tiered legs against the reference
+    (fp pools would quantize on spill: rtol-close, not bit-equal).
+    The verdict gates on strictly more reused tokens than the
+    single-tier baseline absorbed, tier-2 AND tier-3 actually
+    engaging, bit-identical outputs vs the reference everywhere, and
+    zero wrong outputs through the storm."""
+    out: Dict[str, Any] = {}
+    bs = args.block_size
+    blocks = set()
+    for p in prompts:
+        for i in range(len(p) // bs):
+            blocks.add(tuple(p[:(i + 1) * bs]))
+    out["working_set_blocks"] = len(blocks)
+    out["pool_blocks"] = args.pool_blocks
+    ref_pool = len(blocks) + 16  # whole working set + pinning slack
+    out["reference_pool_blocks"] = ref_pool
+
+    ref_rec, ref_out = _kvplane_run(params, config, args, prompts,
+                                    load_kw, kvplane=False,
+                                    pool_blocks=ref_pool)
+    out["hbm_reference"] = ref_rec
+    _kvplane_reset_directory()
+    base_rec, base_out = _kvplane_run(params, config, args, prompts,
+                                      load_kw, kvplane=False)
+    out["baseline"] = base_rec
+    _kvplane_reset_directory()
+    kv_rec, kv_out = _kvplane_run(params, config, args, prompts,
+                                  load_kw, kvplane=True,
+                                  cold_swap=True)
+    kv_rec["vs_reference"] = _outputs_identical(ref_out, kv_out)
+    out["kvplane"] = kv_rec
+    _kvplane_reset_directory()
+    # storm every replica's whole pool early in the measured run —
+    # the arena must hand every evicted block straight back
+    plan = json.dumps([
+        {"action": "evict_storm", "role": "prefill",
+         "blocks": max(int(args.pool_blocks or 1), 1),
+         "at": "request:2", "replica": r}
+        for r in range(max(2, args.prefill_replicas))])
+    storm_rec, storm_out = _kvplane_run(params, config, args, prompts,
+                                        load_kw, kvplane=True,
+                                        chaos_spec=plan)
+    storm_rec["vs_reference"] = _outputs_identical(ref_out, storm_out)
+    out["storm"] = storm_rec
+
+    kvp = kv_rec["kvplane"]
+    cold = kv_rec.get("cold_replay") or {}
+    rtr = kv_rec["router"]
+    probes = (rtr["directory_hits"] + rtr["directory_misses"]
+              + rtr["directory_fallbacks"])
+    verdict = {
+        "working_set_exceeds_pool":
+            out["working_set_blocks"] > int(args.pool_blocks or 0),
+        "pool_pressure": kvp["spills"] > 0,
+        "baseline_reused_tokens":
+            base_rec["kvplane"]["reused_tokens"],
+        "kvplane_reused_tokens": kvp["reused_tokens"],
+        "multi_tier_reuse_gain":
+            kvp["reused_tokens"]
+            > base_rec["kvplane"]["reused_tokens"],
+        "tier2_reused_tokens": kvp["tier2_reused_tokens"],
+        "tier3_reused_tokens": cold.get("tier3_reused_tokens", 0),
+        "directory_hits": rtr["directory_hits"],
+        "directory_hit_rate": (round(rtr["directory_hits"] / probes, 4)
+                               if probes else 0.0),
+        "bit_identical_vs_reference":
+            kv_rec["vs_reference"]["identical"],
+        "cold_replay_bit_identical": bool(cold.get("bit_identical")),
+        "storm_fired": storm_rec["kvplane"]["evict_storms"] >= 1,
+        "storm_zero_wrong":
+            (storm_rec["vs_reference"]["compared"] > 0
+             and storm_rec["vs_reference"]["mismatched"] == 0),
+    }
+    verdict["pass"] = bool(
+        all(_clean_run(r) for r in (ref_rec, base_rec, kv_rec,
+                                    storm_rec))
+        and verdict["working_set_exceeds_pool"]
+        and verdict["pool_pressure"]
+        and verdict["multi_tier_reuse_gain"]
+        and verdict["tier2_reused_tokens"] > 0
+        and verdict["tier3_reused_tokens"] > 0
+        and verdict["directory_hits"] > 0
+        and verdict["bit_identical_vs_reference"]
+        and verdict["cold_replay_bit_identical"]
+        and verdict["storm_fired"]
+        and verdict["storm_zero_wrong"])
+    out["verdict"] = verdict
+    return out
+
+
 def _clean_run(rec: Dict[str, Any]) -> bool:
     """A run may headline/verdict only when every request is accounted
     ok|shed — a hung or errored request silently shrinking the measured
@@ -1415,6 +1695,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="int8 KV blocks (per-block-channel scales, "
                          "doubled default pool); with --speculate adds "
                          "the int8 comparison run to the record")
+    ap.add_argument("--kvplane", action="store_true",
+                    help="tiered-KV-plane acceptance run (implies "
+                         "--cluster): a Zipf replay whose distinct-"
+                         "block working set exceeds one replica's HBM "
+                         "pool, replayed with the plane off (single-"
+                         "tier baseline), on (host-arena spill/"
+                         "re-adopt + prefix-directory routing + a "
+                         "cold-swapped-tier tier-3 replay from the "
+                         "object store), and on under a scripted "
+                         "evict_storm; the verdict gates on strictly "
+                         "more reused tokens than the baseline, "
+                         "tier-2 AND tier-3 engagement, bit-identical "
+                         "outputs everywhere, and zero wrong outputs "
+                         "through the storm")
+    ap.add_argument("--kvplane-arena-mb", type=int, default=64,
+                    help="per-replica host-arena bound in --kvplane "
+                         "mode")
+    ap.add_argument("--kvplane-tail-blocks", type=int, default=4,
+                    help="distinct full blocks per prompt tail in "
+                         "--kvplane mode (sizes the working set past "
+                         "--pool-blocks, default 16 there; the tiny "
+                         "config's 128-token max_seq_len caps "
+                         "sys + tail + --max-new)")
     ap.add_argument("--colocated-baseline", action="store_true",
                     help="also run the single-engine colocated path "
                          "for comparison")
@@ -1461,7 +1764,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     prompts = make_prompts(config, n_distinct=args.distinct,
                            block_size=args.block_size, seed=args.seed)
 
-    use_cluster = args.cluster or args.chaos
+    use_cluster = args.cluster or args.chaos or args.kvplane
     if use_cluster:
         import ray_tpu
 
@@ -1477,10 +1780,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         chaos_need = (args.prefill_replicas + 1
                       + max(2, args.decode_replicas) + 1
                       if args.chaos else 0)
+        # the cold-swap phase holds the retired prefill tier alive
+        # (its tier-3 refs) BESIDE the fresh one
+        kvplane_need = (2 * max(2, args.prefill_replicas)
+                        + args.decode_replicas if args.kvplane else 0)
         ray_tpu.init(num_cpus=max(4, args.prefill_replicas
                                   + args.decode_replicas,
                                   args.max_prefill + args.max_decode,
-                                  sweep_max, chaos_need) + 2,
+                                  sweep_max, chaos_need,
+                                  kvplane_need) + 2,
                      _system_config={"log_to_driver": 0},
                      ignore_reinit_error=True)
     record: Dict[str, Any] = {
@@ -1501,13 +1809,68 @@ def main(argv: Optional[List[str]] = None) -> int:
     # --kv-int8 without --speculate: int8 tiers for whatever mode runs
     args._speculate_k = 0
     args._kv_int8 = bool(args.kv_int8 and not args.speculate)
+    args._kvplane = None
     if args.pool_blocks is None and not (args.speculate
-                                         or args.kv_int8):
+                                         or args.kv_int8
+                                         or args.kvplane):
         # pre-existing modes keep their historical 64-block pool so
         # reruns stay comparable with the recorded BENCH_* baselines;
         # the spec/int8 modes flow None through to resolve_pool_config
         # so the int8 doubling is the real mechanism, not the harness
         args.pool_blocks = 64
+    if args.kvplane:
+        # deep distinct tails + a deliberately small pool: the
+        # working set (sys + n_distinct * tail blocks) must exceed
+        # one replica's HBM pool or no tier below it ever engages
+        # enough distinct tails that each replica's SHARE of the
+        # working set (directory affinity partitions prompts across
+        # holders) still outruns its pool
+        prompts = _kvplane_prompts(
+            config, n_distinct=max(args.distinct, 10),
+            block_size=args.block_size,
+            tail_blocks=args.kvplane_tail_blocks, seed=args.seed)
+        if args.pool_blocks is None:
+            args.pool_blocks = 16
+        # int8 pools: the spill/publish wire format is the raw int8
+        # pool block, so tier-2/tier-3 round trips are byte-exact and
+        # the bit-identical-vs-reference verdict is a hard gate (fp
+        # pools quantize on spill — rtol-close only)
+        args._kv_int8 = True
+        # identity harness, not a tail-latency storm: uniform modest
+        # arrivals bound concurrent prefills per replica, so an arena
+        # re-adopt never loses the pin race for pool blocks (an
+        # alloc-starved re-adopt would shorten the match and change
+        # the split vs the reference)
+        load_kw = dict(load_kw, arrival="uniform",
+                       rate_rps=min(args.rate, 4.0),
+                       slow_client_frac=0.0, token_sleep_s=0.0)
+        record.update(metric="kvplane_tiered_load",
+                      prefill_replicas=max(2, args.prefill_replicas),
+                      pool_blocks=args.pool_blocks,
+                      arena_mb=args.kvplane_arena_mb,
+                      kv_int8=True, rate_rps=load_kw["rate_rps"],
+                      arrival="uniform")
+        try:
+            record.update(_kvplane_record(params, config, args,
+                                          prompts, load_kw))
+            top = record["kvplane"]
+            record.update(value=top["tokens_per_sec"],
+                          unit="tokens/s",
+                          ttft_p50_ms=top["ttft_p50_ms"],
+                          ttft_p99_ms=top["ttft_p99_ms"],
+                          shed_rate=top["shed_rate"],
+                          directory_hit_rate=record["verdict"][
+                              "directory_hit_rate"])
+        finally:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        line = json.dumps(record)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(record, f, indent=1)
+        print(line)
+        return 0 if record.get("verdict", {}).get("pass") else 1
     if args.http:
         record.update(metric="gateway_http_load",
                       max_batch=args.http_max_batch,

@@ -40,6 +40,12 @@ Routes:
                          accounting (shm vs rpc), router shed/queue
                          depth, recent kv_publish/kv_transfer/shed
                          events (serve/disagg.py)
+  /api/kvplane           global KV plane: per-replica host arenas
+                         (tier-2 entries/bytes, spills, re-adopted
+                         tokens), tier-3 publish/adopt traffic, prefix
+                         directory summary + routing outcomes, recent
+                         spill/tier2_hit/tier3_publish/tier3_adopt/
+                         directory_hit events (serve/kvplane.py)
   /api/autoscale         serving autoscaler: per-loop tier targets,
                          scale-up/down decision counts, drain
                          outcomes, replica-seconds, recent scale_up/
@@ -238,6 +244,19 @@ class _ClusterData:
             out["events"] = []
         return out
 
+    def kvplane(self) -> Dict[str, Any]:
+        """Global-KV-plane aggregate (arena tiers, prefix directory,
+        routing outcomes) + the recent spill/tier2_hit/tier3_publish/
+        tier3_adopt/directory_hit event tail (one payload so the SPA's
+        panel needs a single fetch)."""
+        out = self.conductor.call("get_kvplane_status", timeout=10.0)
+        try:
+            out["events"] = self.conductor.call("get_kvplane_events",
+                                                100, timeout=5.0)
+        except Exception:  # noqa: BLE001 — older conductor
+            out["events"] = []
+        return out
+
     def autoscale(self) -> Dict[str, Any]:
         """Serving-autoscaler aggregate + the recent event tail (one
         payload so the SPA's panel needs a single fetch)."""
@@ -425,6 +444,7 @@ class DashboardServer:
         app.router.add_get("/api/pipeline", self._json_route(d.pipeline))
         app.router.add_get("/api/online", self._json_route(d.online))
         app.router.add_get("/api/disagg", self._json_route(d.disagg))
+        app.router.add_get("/api/kvplane", self._json_route(d.kvplane))
         app.router.add_get("/api/autoscale",
                            self._json_route(d.autoscale))
         app.router.add_get("/api/servefault",

@@ -48,6 +48,19 @@ dtype and the suffix prefill / splice / decode path is unchanged; the
 quantization error itself is bounded by the rtol equivalence test in
 tests/test_speculate.py.
 
+**Tiered KV plane** (``serve/kvplane.py``): the pool is tier 1 of a
+three-tier hierarchy. ``attach_arena()`` hooks a host-RAM arena into
+the eviction path — a block evicted under pool pressure spills its
+int8+per-block-channel-scales wire form (``_write_block_q``'s layout)
+to the arena instead of dying, and a later ``lookup()`` whose chain
+walk breaks consults the arena and re-adopts the block through the
+normal insert path (int8 pools round-trip bit-exactly; fp pools
+re-enter within the int8 tolerance contract).
+``export_prefix()``/``import_prefix()`` move whole block-aligned
+prefixes in the same wire format for tier 3 (chunk-fabric objects any
+replica can adopt); ``prefix_digests()`` exposes the chain digests the
+cluster-wide prefix directory is keyed by.
+
 **Drafting from cache** (``propose()``): the index's hash chains store
 EXACT token tuples, so the longest chain extending a request's current
 context IS a free speculative draft — no draft model, no extra
@@ -135,6 +148,28 @@ def _ns_root(namespace: Optional[str]) -> bytes:
     h = hashlib.blake2b(_ROOT_DIGEST, digest_size=16)
     h.update(str(namespace).encode())
     return h.digest()
+
+
+def prefix_digests(tokens, block_size: int,
+                   namespace: Optional[str] = None,
+                   max_blocks: int = 32) -> List[str]:
+    """Chain digests at every full-block boundary of `tokens`, LONGEST
+    FIRST — the keys the cluster-wide prefix directory (conductor
+    ``kvplane_lookup``) matches against. Hex, because the digests cross
+    the RPC plane as JSON-safe metadata. Namespace-scoped exactly like
+    the index itself, so one tenant's directory entries can never match
+    another's prompt."""
+    tokens = np.asarray(tokens).reshape(-1)
+    digest = _ns_root(namespace)
+    out: List[str] = []
+    n_full = min(len(tokens) // block_size, max_blocks)
+    for i in range(n_full):
+        blk = tuple(int(t) for t in
+                    tokens[i * block_size:(i + 1) * block_size])
+        digest = _chain(digest, blk)
+        out.append(digest.hex())
+    out.reverse()
+    return out
 
 
 # --------------------------------------------------------- device ops
@@ -232,6 +267,18 @@ def _cow_extend_block_q(pool_k, pool_v, sk, sv, dst, src, blk_k, blk_v,
     qk, sck = _quantize(merged_k)
     qv, scv = _quantize(merged_v)
     at = (0, dst, 0, 0, 0)
+    return (jax.lax.dynamic_update_slice(pool_k, qk[:, None], at),
+            jax.lax.dynamic_update_slice(pool_v, qv[:, None], at),
+            jax.lax.dynamic_update_slice(sk, sck[:, None], at),
+            jax.lax.dynamic_update_slice(sv, scv[:, None], at))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _write_block_qraw(pool_k, pool_v, sk, sv, bid, qk, qv, sck, scv):
+    """Adopt an already-quantized wire-format block (tier-2/3 re-entry)
+    into the int8 pool VERBATIM — no requantize, so a spill/readopt
+    round trip is bit-exact for int8 pools."""
+    at = (0, bid, 0, 0, 0)
     return (jax.lax.dynamic_update_slice(pool_k, qk[:, None], at),
             jax.lax.dynamic_update_slice(pool_v, qv[:, None], at),
             jax.lax.dynamic_update_slice(sk, sck[:, None], at),
@@ -395,6 +442,9 @@ class PagedKVCache:
         # are already forward-indexed by _partial_index)
         self._children: Dict[bytes, Dict[Tuple[int, ...], int]] = {}
         self._tick = itertools.count(1)
+        # tier-2 host arena (serve/kvplane.HostArena) — None keeps the
+        # historical single-tier behavior bit-identically
+        self._arena: Optional[Any] = None
         self._events: List[Dict[str, Any]] = []
         self._stats: Dict[str, int] = {
             k: 0 for k in ("lookups", "hits", "partial_hits", "misses",
@@ -421,12 +471,32 @@ class PagedKVCache:
             digest = _ns_root(namespace)
             bids: List[int] = []
             matched = 0
+            now0 = next(self._tick)
             while matched + bs <= max_tokens:
                 blk = tuple(int(t) for t in tokens[matched:matched + bs])
                 nxt = _chain(digest, blk)
                 bid = self._full_index.get(nxt)
                 if bid is None or self._blocks[bid].tokens != blk:
-                    break
+                    # tier-2: a block evicted under pool pressure may
+                    # still live in the host arena — re-adopt it through
+                    # the normal insert path and keep walking
+                    bid = None
+                    if self._arena is not None:
+                        payload = self._arena.take_full(nxt, blk)
+                        if payload is not None:
+                            parent = bids[-1] if bids else None
+                            bid = self._adopt_payload_locked(
+                                payload, parent, now0)
+                            if bid is None:
+                                self._arena.give_back(payload)
+                    if bid is None:
+                        break
+                # pin AS WE WALK: an arena adoption further down the
+                # chain may have to evict, and an unpinned match would
+                # be a legal victim
+                b = self._blocks[bid]
+                b.ref += 1
+                b.last_used = now0
                 bids.append(bid)
                 digest = nxt
                 matched += bs
@@ -439,14 +509,24 @@ class PagedKVCache:
                         and tuple(int(t) for t in
                                   tokens[matched:matched + k]) == ptoks):
                     partial_bid, partial_len = bid, k
+            if partial_bid is None and self._arena is not None:
+                payload = self._arena.take_partial(
+                    digest, tokens[matched:], max_tokens - matched)
+                if payload is not None:
+                    parent = bids[-1] if bids else None
+                    bid = self._adopt_payload_locked(payload, parent,
+                                                     now0)
+                    if bid is None:
+                        self._arena.give_back(payload)
+                    else:
+                        partial_bid = bid
+                        partial_len = len(payload["tokens"])
             if partial_bid is not None:
+                b = self._blocks[partial_bid]
+                b.ref += 1
+                b.last_used = now0
                 bids.append(partial_bid)
                 matched += partial_len
-            now = next(self._tick)
-            for bid in bids:
-                b = self._blocks[bid]
-                b.ref += 1
-                b.last_used = now
             plen = len(tokens)
             if matched and plen - matched <= bs:
                 outcome = "hit"
@@ -486,6 +566,182 @@ class PagedKVCache:
                                         bids, match.tokens, self.dtype)
             return _gather_prefix(self._pool_k, self._pool_v, bids,
                                   match.tokens)
+
+    # ------------------------------------------------ tiered KV plane
+
+    def attach_arena(self, arena: Optional[Any]) -> None:
+        """Hook a tier-2 host arena (serve/kvplane.HostArena) into the
+        pool: evictions spill their wire-format payload to
+        ``arena.accept()`` instead of dying, and a broken lookup chain
+        walk consults ``arena.take_full()/take_partial()`` before
+        giving up. ``attach_arena(None)`` detaches (single-tier
+        behavior, bit-identical to pre-kvplane)."""
+        with self._lock:
+            self._arena = arena
+
+    def _payload_locked(self, b: _Block) -> Dict[str, Any]:
+        """One block's tier-2/3 wire-format payload: int8 K/V + f32
+        per-block-channel scales (``_write_block_q``'s layout) plus the
+        index identity needed to re-adopt it. int8 pools hand out their
+        bytes verbatim (lossless round trip); fp pools quantize on
+        spill, re-entering within the int8 tolerance contract."""
+        bid = b.bid
+        if self.int8:
+            qk = np.asarray(self._pool_k[:, bid])
+            qv = np.asarray(self._pool_v[:, bid])
+            sk = np.asarray(self._scale_k[:, bid])
+            sv = np.asarray(self._scale_v[:, bid])
+        else:
+            qk_j, sk_j = _quantize(self._pool_k[:, bid])
+            qv_j, sv_j = _quantize(self._pool_v[:, bid])
+            qk, sk = np.asarray(qk_j), np.asarray(sk_j)
+            qv, sv = np.asarray(qv_j), np.asarray(sv_j)
+        return {"index_key": b.index_key, "tokens": b.tokens,
+                "filled": b.filled, "ns": b.ns,
+                "parent_digest": b.parent_digest,
+                "qk": qk, "qv": qv, "sk": sk, "sv": sv}
+
+    def _adopt_payload_locked(self, payload: Dict[str, Any],
+                              parent: Optional[int],
+                              now: int) -> Optional[int]:
+        """Re-adopt a wire-format payload into the pool through the
+        normal insert path. Returns the new bid, or None when no block
+        could be allocated (the caller gives the payload back to its
+        tier). The adopted block starts UNPINNED — lookup/import pin
+        explicitly."""
+        key = payload.get("index_key")
+        if key is None:
+            return None
+        bid = self._alloc_locked()
+        if bid is None:
+            return None
+        if self.int8:
+            (self._pool_k, self._pool_v, self._scale_k,
+             self._scale_v) = _write_block_qraw(
+                self._pool_k, self._pool_v, self._scale_k,
+                self._scale_v, np.int32(bid), payload["qk"],
+                payload["qv"], payload["sk"], payload["sv"])
+        else:
+            bk = (jnp.asarray(payload["qk"], jnp.float32)
+                  * jnp.asarray(payload["sk"])).astype(self.dtype)
+            bv = (jnp.asarray(payload["qv"], jnp.float32)
+                  * jnp.asarray(payload["sv"])).astype(self.dtype)
+            self._pool_k, self._pool_v = _write_block(
+                self._pool_k, self._pool_v, np.int32(bid), bk, bv)
+        self._insert_locked(bid, key, payload["tokens"],
+                            payload["filled"], parent, now,
+                            payload.get("ns"),
+                            payload.get("parent_digest"))
+        self._blocks[bid].ref = 0
+        return bid
+
+    def export_prefix(self, tokens, namespace: Optional[str] = None,
+                      max_blocks: int = 32
+                      ) -> Optional[Tuple[Dict[str, Any], int, str]]:
+        """Pack the longest cached full-block chain prefix of `tokens`
+        in the tier-3 wire format (stacked int8 blocks + scales + the
+        exact token prefix). Returns ``(packed, n_tokens, digest_hex)``
+        — digest_hex is the chain digest the prefix directory keys the
+        published object by — or None when nothing is cached. Nothing
+        is pinned: tier 3 holds a COPY, eviction of the source blocks
+        is irrelevant."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        payloads: List[Dict[str, Any]] = []
+        with self._lock:
+            digest = _ns_root(namespace)
+            matched = 0
+            while (matched + bs <= len(tokens)
+                   and len(payloads) < max_blocks):
+                blk = tuple(int(t) for t in tokens[matched:matched + bs])
+                nxt = _chain(digest, blk)
+                bid = self._full_index.get(nxt)
+                if bid is None or self._blocks[bid].tokens != blk:
+                    break
+                payloads.append(self._payload_locked(self._blocks[bid]))
+                digest = nxt
+                matched += bs
+        if not payloads:
+            return None
+        packed = {"qk": np.stack([p["qk"] for p in payloads]),
+                  "qv": np.stack([p["qv"] for p in payloads]),
+                  "sk": np.stack([p["sk"] for p in payloads]),
+                  "sv": np.stack([p["sv"] for p in payloads]),
+                  "tokens": np.asarray(tokens[:matched], np.int64)}
+        return packed, matched, digest.hex()
+
+    def import_prefix(self, tokens, packed: Dict[str, Any],
+                      namespace: Optional[str] = None) -> int:
+        """Adopt a tier-3 packed prefix (``export_prefix``'s format,
+        fetched over the chunk fabric) into this pool's index. Blocks
+        already cached are skipped; the rest enter through the normal
+        insert path, unpinned. Returns the number of blocks adopted.
+        The packed token prefix is verified against `tokens` — a
+        digest-directory collision must never seed wrong KV."""
+        tokens = np.asarray(tokens).reshape(-1)
+        bs = self.block_size
+        ptoks = np.asarray(packed["tokens"]).reshape(-1)
+        if len(ptoks) > len(tokens) \
+                or not np.array_equal(tokens[:len(ptoks)], ptoks):
+            return 0
+        nb = int(packed["qk"].shape[0])
+        adopted_bids: List[int] = []
+        with self._lock:
+            digest = _ns_root(namespace)
+            now = next(self._tick)
+            parent: Optional[int] = None
+            for i in range(nb):
+                if (i + 1) * bs > len(ptoks):
+                    break
+                blk = tuple(int(t) for t in
+                            tokens[i * bs:(i + 1) * bs])
+                nxt = _chain(digest, blk)
+                bid = self._full_index.get(nxt)
+                if bid is not None and self._blocks[bid].tokens == blk:
+                    parent, digest = bid, nxt
+                    continue
+                payload = {"index_key": ("full", nxt), "tokens": blk,
+                           "filled": bs, "ns": namespace,
+                           "parent_digest": digest,
+                           "qk": packed["qk"][i], "qv": packed["qv"][i],
+                           "sk": packed["sk"][i], "sv": packed["sv"][i]}
+                bid = self._adopt_payload_locked(payload, parent, now)
+                if bid is None:
+                    break
+                # pin for the loop's duration: a later adoption's alloc
+                # must not evict an earlier adopted leaf
+                self._blocks[bid].ref = 1
+                adopted_bids.append(bid)
+                parent, digest = bid, nxt
+            for bid in adopted_bids:
+                self._blocks[bid].ref = 0
+            util = 1.0 - len(self._free) / self.num_blocks
+        kvcache_metrics()["utilization"].set(util)
+        return len(adopted_bids)
+
+    def force_evict(self, n: int) -> int:
+        """Evict up to `n` unpinned leaf blocks (LRU order) regardless
+        of pool pressure — the ``evict_storm`` chaos op. With an arena
+        attached every victim spills to tier 2, so a storm sheds
+        capacity, never correctness."""
+        evicted = 0
+        with self._lock:
+            for _ in range(int(n)):
+                victim: Optional[_Block] = None
+                for b in self._blocks.values():
+                    if b.ref == 0 and b.children == 0 \
+                            and b.index_key is not None:
+                        if victim is None \
+                                or b.last_used < victim.last_used:
+                            victim = b
+                if victim is None:
+                    break
+                self._evict_locked(victim)
+                self._free.append(victim.bid)
+                evicted += 1
+            util = 1.0 - len(self._free) / self.num_blocks
+        kvcache_metrics()["utilization"].set(util)
+        return evicted
 
     # ----------------------------------------------------------- propose
 
@@ -727,6 +983,14 @@ class PagedKVCache:
         return victim.bid
 
     def _evict_locked(self, b: _Block) -> None:
+        # tier-2 spill BEFORE the index drop: the payload needs the
+        # block's index identity, and accept() only ever touches host
+        # memory (arena dict insert), so holding the lock is safe
+        if self._arena is not None and b.index_key is not None:
+            try:
+                self._arena.accept(self._payload_locked(b))
+            except Exception:  # noqa: BLE001 — spill is best-effort
+                pass
         self._drop_index_locked(b)
         if b.parent_bid is not None and b.parent_bid in self._blocks:
             self._blocks[b.parent_bid].children -= 1

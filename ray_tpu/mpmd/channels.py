@@ -216,7 +216,8 @@ class ActivationChannel:
                 # fetch failed" (not retryable) apart from "take timed
                 # out" (retryable on recv's own budget)
                 rec["desc"] = desc
-                fetcher = chunks.ChunkFetcher(self._worker)
+                fetcher = chunks.ChunkFetcher(self._worker,
+                                              caller="activations")
                 rec["tree"] = chunks.fetch_tree(self._worker, desc,
                                                 fetcher)
                 rec["fetcher"] = fetcher
@@ -274,7 +275,8 @@ class ActivationChannel:
             remaining = max(0.0, timeout - (time.monotonic() - t0))
             desc = self._take_descriptor(step, mb, kind, remaining)
             self.stats.wait_s += time.monotonic() - t0
-            fetcher = chunks.ChunkFetcher(self._worker)
+            fetcher = chunks.ChunkFetcher(self._worker,
+                                          caller="activations")
             tree = chunks.fetch_tree(self._worker, desc, fetcher)
         nbytes = int(desc["total_bytes"])
         self.stats.recv_msgs += 1

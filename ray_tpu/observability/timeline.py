@@ -79,6 +79,14 @@ merges and labels them:
                  that draws the analytic roofline under the measured
                  train-step markers, plus instant validation markers
                  carrying the fitted calibration and residuals.
+- kvplane:       pid = "kvplane",        tid = event kind — instant
+                 markers of the global KV plane (serve/kvplane.py):
+                 HBM->host-arena spills, tier-2 re-adoptions, tier-3
+                 prefix publishes/adoptions through the chunk fabric,
+                 directory-routed requests, eviction storms, and
+                 directory reaps, so cross-tier prefix movement reads
+                 against the kvcache lane's block-level hits and the
+                 disagg lane's transfers.
 - requests:      pid = "requests",       tid = the request id prefix —
                  one REAL "X" span per recorded phase of a kept request
                  trace (observability.requests): qos_admission ->
@@ -340,6 +348,37 @@ def lora_trace_events(events: List[Dict[str, Any]]
     return out
 
 
+def kvplane_trace_events(events: List[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    """Instant markers for global-KV-plane events (spill, tier2_hit,
+    tier3_publish, tier3_adopt, directory_hit, evict_storm, reap) —
+    mirrors the kvcache track under pid "kvplane", so tier demotions
+    and cross-replica adoptions read against the engines' block-level
+    reuse markers and the disagg lane's transfer markers."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        ts = ev.get("ts")
+        if ts is None:
+            continue
+        kind = str(ev.get("kind", "event"))
+        label = kind
+        where = ev.get("replica") or ev.get("holder") or \
+            ev.get("router")
+        if where:
+            label += f":{where}"
+        if ev.get("blocks") is not None:
+            label += f" {ev['blocks']}blk"
+        if ev.get("nbytes") is not None:
+            label += f" {ev['nbytes']}B"
+        out.append({
+            "name": label, "cat": "kvplane", "ph": "i", "s": "g",
+            "ts": ts * 1e6, "pid": "kvplane", "tid": kind,
+            "args": {k: v for k, v in ev.items()
+                     if k != "ts" and v is not None},
+        })
+    return out
+
+
 def gateway_trace_events(events: List[Dict[str, Any]]
                          ) -> List[Dict[str, Any]]:
     """Instant markers for HTTP front-door events (accept, first_byte,
@@ -529,6 +568,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
                         gateway_events: Optional[
                             List[Dict[str, Any]]] = None,
                         requesttrace_events: Optional[
+                            List[Dict[str, Any]]] = None,
+                        kvplane_events: Optional[
                             List[Dict[str, Any]]] = None
                         ) -> List[Dict[str, Any]]:
     """Merge the sources into one sorted event list."""
@@ -560,6 +601,8 @@ def merged_chrome_trace(task_events: List[Dict[str, Any]],
         trace.extend(gateway_trace_events(gateway_events))
     if requesttrace_events:
         trace.extend(requests_trace_events(requesttrace_events))
+    if kvplane_events:
+        trace.extend(kvplane_trace_events(kvplane_events))
     trace.sort(key=lambda e: e.get("ts", 0.0))
     return trace
 
@@ -629,9 +672,14 @@ def merged_timeline(filename: Optional[str] = None,
                                 timeout=30.0)
     except Exception:  # noqa: BLE001 — pre-requesttrace conductor
         rtev = []
+    try:
+        kpev = w.conductor.call("get_kvplane_events", limit,
+                                timeout=30.0)
+    except Exception:  # noqa: BLE001 — pre-kvplane conductor
+        kpev = []
     trace = merged_chrome_trace(events, spans, steps, resil, wev, kvev,
                                 pev, oev, dev, orev, asev, lev, gev,
-                                rtev)
+                                rtev, kpev)
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

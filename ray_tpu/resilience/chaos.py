@@ -50,6 +50,14 @@ training ops; see serve/disagg.py):
 - ``{"action": "delay_chunk_fetch", "ms": M}`` — every ChunkFetcher
   pull sleeps M ms first (consulted out-of-band per fetch, like
   delay_heartbeats), stretching KV-transfer and weight-fetch latency.
+- ``{"action": "evict_storm", "role": "prefill", "blocks": B,
+  "at": "request:N", "replica": R}`` — force-evict B blocks from the
+  matching prefill replica's HBM prefix pool at the start of its N-th
+  request (deterministic cache-pressure injection: with the KV plane
+  attached the storm spills into the tier-2 host arena instead of
+  destroying the prefixes — serve/kvplane.py's chaos test asserts
+  zero wrong outputs). Non-lethal: the replica consults
+  ``take_storm()`` and applies the eviction itself.
 
 ``at_step`` compares against the step number being reported (the
 ``step`` metric when present, else the session's report count, both
@@ -73,7 +81,7 @@ ENV_VAR = "RAY_TPU_CHAOS_PLAN"
 _IN_PROCESS = ("raise", "kill", "preempt")
 _EXTERNAL = ("bounce_conductor",)
 _PASSIVE = ("delay_heartbeats", "delay_chunk_fetch")
-_SERVE = ("kill_replica", "drop_connection")
+_SERVE = ("kill_replica", "drop_connection", "evict_storm")
 
 _AT_RE = re.compile(r"^(token|request):(\d+)$")
 
@@ -94,6 +102,7 @@ class ChaosAction:
     role: Optional[str] = None  # kill_replica: prefill | decode
     at: Optional[str] = None    # kill_replica: "token:K" | "request:N"
     replica: int = 0            # kill_replica: creation index in role
+    blocks: int = 0             # evict_storm: HBM blocks to force-evict
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ChaosAction":
@@ -112,6 +121,19 @@ class ChaosAction:
             if not _AT_RE.match(str(d.get("at", ""))):
                 raise ValueError(
                     "chaos action 'kill_replica' requires "
+                    "at='token:K'|'request:N'")
+        if action == "evict_storm":
+            if d.get("role") not in (None, "prefill"):
+                raise ValueError(
+                    "chaos action 'evict_storm' fires at a prefill "
+                    "replica's prefix pool (role=prefill or omitted)")
+            d = dict(d, role="prefill")
+            if int(d.get("blocks", 0)) < 1:
+                raise ValueError(
+                    "chaos action 'evict_storm' requires blocks>=1")
+            if not _AT_RE.match(str(d.get("at", ""))):
+                raise ValueError(
+                    "chaos action 'evict_storm' requires "
                     "at='token:K'|'request:N'")
         if action == "drop_connection":
             if d.get("role") not in (None, "gateway"):
@@ -134,7 +156,8 @@ class ChaosAction:
                    ms=float(d.get("ms", 0.0)),
                    role=d.get("role"),
                    at=(None if d.get("at") is None else str(d["at"])),
-                   replica=int(d.get("replica", 0)))
+                   replica=int(d.get("replica", 0)),
+                   blocks=int(d.get("blocks", 0)))
 
     def at_spec(self) -> Optional[tuple]:
         """("token"|"request", N) for a kill_replica action."""
@@ -364,20 +387,39 @@ class ServeChaosMonkey:
         self._fired: set = set()
         self._tokens = 0
         self._requests = 0
+        # evict_storm is non-lethal: firing latches the block count
+        # here and the replica applies the eviction itself via
+        # take_storm() (the monkey has no handle on the prefix pool)
+        self._pending_storm = 0
 
     def __bool__(self) -> bool:
         return bool(self.actions)
 
+    def take_storm(self) -> int:
+        """Pop the pending evict_storm block count (0 when none is
+        due). The prefill replica consults this right after
+        ``on_request`` and force-evicts that many HBM blocks."""
+        with self._lock:
+            n, self._pending_storm = self._pending_storm, 0
+        return n
+
     def reset_counts(self) -> None:
-        """Zero the cumulative request/token counters (NOT the fired
-        latches — an already-fired action never re-fires). bench_serve
+        """Zero the cumulative request/token counters. bench_serve
         calls this on every replica at measurement start, so a plan's
         ``at=request:N`` / ``at=token:K`` counts the Nth MEASURED
         request / Kth measured token instead of including warm-up
-        traffic (the PR-12 known limit)."""
+        traffic (the PR-12 known limit). LETHAL latches persist — a
+        fired kill already took its process, the latch only guards
+        in-process test doubles — but non-lethal evict_storm latches
+        re-arm (and any warm-up-fired pending count is dropped): a
+        storm that tripped during warm-up must still fire at the Nth
+        measured request, or the measured run storms nothing."""
         with self._lock:
             self._tokens = 0
             self._requests = 0
+            self._fired -= {i for i, a in enumerate(self.actions)
+                            if a.action == "evict_storm"}
+            self._pending_storm = 0
 
     # ------------------------------------------------------------- firing
 
@@ -413,13 +455,20 @@ class ServeChaosMonkey:
 
             w = worker_mod.global_worker
             if w is not None:
-                w.conductor.notify("report_resilience_event", {
-                    "kind": "chaos", "action": a.action,
-                    "role": self.role, "replica": self.replica,
-                    "at": a.at, "tokens": self._tokens,
-                    "requests": self._requests})
+                ev = {"kind": "chaos", "action": a.action,
+                      "role": self.role, "replica": self.replica,
+                      "at": a.at, "tokens": self._tokens,
+                      "requests": self._requests}
+                if a.action == "evict_storm":
+                    ev["blocks"] = a.blocks
+                w.conductor.notify("report_resilience_event", ev)
         except Exception:  # noqa: BLE001 — telemetry only
             pass
+        if a.action == "evict_storm":
+            # non-lethal: the replica pops the count via take_storm()
+            with self._lock:
+                self._pending_storm += max(0, int(a.blocks))
+            return
         self._exit(137)
 
 

@@ -582,6 +582,73 @@ def cmd_disagg(args) -> None:
         _print_event_tail(events, args.events)
 
 
+def cmd_kvplane(args) -> None:
+    """`ray_tpu kvplane` — global KV plane view (serve/kvplane.py):
+    per-replica host arenas (tier-2 entries/bytes, spills absorbed,
+    re-adopted tokens), tier-3 publish/adopt traffic through the chunk
+    fabric, router directory routing outcomes (hit/fallback/miss), the
+    conductor's prefix-directory summary, plus the cluster totals every
+    other surface (state API, /api/kvplane, Prometheus, timeline
+    markers) reports from the same snapshots."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import state
+
+    st = state.kvplane_status()
+    if args.json:
+        print(json.dumps(st, indent=2, default=str))
+        return
+    comps = st.get("components") or {}
+    if not comps:
+        print("no kvplane telemetry recorded (is a kvplane-enabled "
+              "PrefillServer/DisaggRouter running?)")
+        return
+    t = st.get("totals") or {}
+    print(f"totals: spills={t.get('spills', 0)} "
+          f"({t.get('spill_bytes', 0)}B) "
+          f"tier2_hits={t.get('tier2_hits', 0)}"
+          f"/{t.get('tier2_probes', 0)} "
+          f"({t.get('tier2_hit_rate', 0.0):.2%}) "
+          f"t2_reused_tok={t.get('tier2_reused_tokens', 0)} "
+          f"t3_publishes={t.get('tier3_publishes', 0)} "
+          f"t3_adopts={t.get('tier3_adopts', 0)} "
+          f"t3_reused_tok={t.get('tier3_reused_tokens', 0)} "
+          f"directory_hit_rate={t.get('directory_hit_rate', 0.0):.2%} "
+          f"arena={t.get('arena_entries', 0)} entries "
+          f"({t.get('arena_bytes', 0)}B)")
+    d = st.get("directory") or {}
+    ns = d.get("namespaces") or {}
+    ctr = d.get("counters") or {}
+    print(f"directory: entries={d.get('entries', 0)} "
+          f"({d.get('nbytes', 0)}B) namespaces={len(ns)} "
+          f"publishes={ctr.get('publishes', 0)} "
+          f"lookups={ctr.get('lookups', 0)} "
+          f"reaped={ctr.get('reaped', 0)} "
+          f"gced={ctr.get('gced', 0)} "
+          f"unpublished={ctr.get('unpublished', 0)}")
+    for key, c in sorted(comps.items()):
+        if c.get("role") == "router":
+            print(f"  {key}: directory hits={c.get('directory_hits', 0)} "
+                  f"fallbacks={c.get('directory_fallbacks', 0)} "
+                  f"misses={c.get('directory_misses', 0)}"
+                  + (f" hit_rate={c['directory_hit_rate']:.2%}"
+                     if c.get("directory_hit_rate") is not None else ""))
+        else:
+            print(f"  {key}: arena={c.get('entries', 0)} entries "
+                  f"({c.get('bytes', 0)}B/{c.get('max_bytes', 0)}B) "
+                  f"spills={c.get('spills', 0)} "
+                  f"t2_hits={c.get('tier2_hits', 0)} "
+                  f"t2_reused_tok={c.get('tier2_reused_tokens', 0)} "
+                  f"t3_pub={c.get('tier3_publishes', 0)} "
+                  f"t3_adopt={c.get('tier3_adopts', 0)} "
+                  f"storms={c.get('evict_storms', 0)}")
+    if args.events:
+        w = worker_mod.global_worker
+        events = w.conductor.call("get_kvplane_events", args.events,
+                                  timeout=10.0)
+        _print_event_tail(events, args.events)
+
+
 def cmd_servefault(args) -> None:
     """`ray_tpu servefault` — serving-plane fault-tolerance view
     (serve/disagg.py failover + serve/autoscale.py self-healing):
@@ -1355,6 +1422,19 @@ def main(argv=None) -> None:
                     help="also print the last N disagg events")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_disagg)
+
+    sp = sub.add_parser("kvplane",
+                        help="global KV plane: tiered prefix cache "
+                             "(HBM -> host arena -> object store), "
+                             "spill/re-adopt accounting, prefix "
+                             "directory routing, recent events")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--events", type=int, default=0,
+                    help="also print the last N kvplane events "
+                         "(spill/tier2_hit/tier3_publish/tier3_adopt/"
+                         "directory_hit markers)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_kvplane)
 
     sp = sub.add_parser("servefault",
                         help="serving-plane fault tolerance: request "

@@ -277,6 +277,14 @@ class DisaggPolicy:
     - ``queue_depth_p99``: recent router pending — backlog past the
       decode tier's capacity also reads as slot exhaustion (sheds live
       at that same bound).
+    - ``spec_tokens_per_verify``: measured speculative-decoding
+      acceptance factor (mean tokens emitted per verify step across the
+      decode tier, from the engines' speculation_stats). A tier whose
+      engines emit ~f tokens per step drains a BACKLOG f× faster, so
+      queued demand is discounted by it before the policy sizes the
+      tier — busy slots are not (speculation shortens a stream, it
+      does not free the slot it occupies). Absent (or <= 1) means no
+      discount: behavior is bit-identical to a non-speculative tier.
     """
 
     # scale down only when the recent p99 fits inside one-fewer replicas
@@ -306,7 +314,17 @@ class DisaggPolicy:
         depth_p99 = signals.get("queue_depth_p99")
         cap = max(1, int(signals.get("decode_cap_per_replica", 1)))
         capacity = current * cap
-        if depth_p99 is not None and depth_p99 > capacity:
+        # speculation-aware demand: f tokens emitted per verify step
+        # means each slot drains its queued successor f× sooner, so a
+        # backlog of N requests is N/f slot-windows of work. Only the
+        # QUEUE is discounted — an occupied slot is occupied whatever
+        # its token rate. f <= 1 (or no signal) leaves every number
+        # untouched, so a non-speculative tier is bit-identical.
+        spec = signals.get("spec_tokens_per_verify")
+        factor = max(1.0, float(spec or 0.0))
+        eff_depth = (depth_p99 / factor
+                     if depth_p99 is not None else None)
+        if eff_depth is not None and eff_depth > capacity:
             # PROPORTIONAL scale step for deep backlogs (the PR-11
             # follow-on): ±1 per decision chases a burst one cooldown
             # at a time — when the backlog exceeds 2x one replica's
@@ -314,11 +332,13 @@ class DisaggPolicy:
             # it (ceil(backlog / capacity_per_replica); TierSpec
             # bounds clamp at apply time, hysteresis still gates)
             desired = current + 1
-            if depth_p99 > 2 * cap:
-                desired = max(desired, -(-int(depth_p99) // cap))
+            if eff_depth > 2 * cap:
+                desired = max(desired, -(-int(eff_depth) // cap))
             return desired, (
-                f"backlog p99 {depth_p99:.0f} past tier capacity "
-                f"{capacity}"
+                f"backlog p99 {depth_p99:.0f}"
+                + (f" (/{factor:.2f} speculation -> {eff_depth:.0f})"
+                   if factor > 1.0 else "")
+                + f" past tier capacity {capacity}"
                 + (f" (proportional step -> {desired})"
                    if desired > current + 1 else ""))
         if free_p50 is not None and free_p50 <= 0:
@@ -331,7 +351,7 @@ class DisaggPolicy:
         # to demand == 0, i.e. a truly idle tier may drain to ZERO —
         # the ScalingPolicy's min_replicas floor (1 everywhere except
         # an explicit scale-to-zero tier) clamps it back otherwise.
-        demand = max((v for v in (busy_p99, depth_p99)
+        demand = max((v for v in (busy_p99, eff_depth)
                       if v is not None), default=None)
         if current > 0 and demand is not None \
                 and demand <= self.low_util * (current - 1) * cap:
@@ -575,6 +595,33 @@ class DisaggAutoscaler:
         if reps:
             sig["decode_cap_per_replica"] = max(
                 1, int(sum(r["cap"] for r in reps) / len(reps)))
+        # measured speculation acceptance factor: best-effort stats
+        # probe of the same live replicas; replicas without speculation
+        # (or test doubles without a stats surface) simply contribute
+        # nothing and the policy sees no discount
+        stat_probes = []
+        for r in reps:
+            try:
+                stat_probes.append(_call(r["target"], "stats",  # shardlint: disable=unsupervised-actor-call
+                                         block=False))
+            except Exception:  # noqa: BLE001 — replica mid-restart
+                pass
+        tpv: List[float] = []
+        for v in stat_probes:
+            try:
+                from ray_tpu._private.object_store import ObjectRef
+
+                if isinstance(v, ObjectRef):
+                    import ray_tpu
+
+                    v = ray_tpu.get(v)
+                sp = (v or {}).get("speculation") or {}
+                if int(sp.get("spec_verify_ticks", 0)) > 0:
+                    tpv.append(float(sp.get("tokens_per_verify", 0.0)))
+            except Exception:  # noqa: BLE001 — replica mid-restart
+                pass
+        if tpv:
+            sig["spec_tokens_per_verify"] = sum(tpv) / len(tpv)
         return sig
 
     # --------------------------------------------------------------- tick

@@ -82,7 +82,8 @@ import queue
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, \
+    Tuple
 
 import numpy as np
 
@@ -243,6 +244,18 @@ def _notify_event(event: Dict[str, Any]) -> None:
         pass
 
 
+def _notify_kvplane(event: Dict[str, Any]) -> None:
+    """Best-effort instant marker into the conductor's kvplane event
+    log (the merged timeline's `kvplane` lane)."""
+    w = _worker()
+    if w is None:
+        return
+    try:
+        w.conductor.notify("report_kvplane_event", dict(event))
+    except Exception:  # noqa: BLE001 — cluster shutting down
+        pass
+
+
 def _push_stats(component_id: str, stats: Dict[str, Any]) -> None:
     w = _worker()
     if w is None:
@@ -317,7 +330,9 @@ class PrefillServer:
                  chaos_replica: int = 0,
                  lora: Any = None,
                  lora_pool_slots: Optional[int] = None,
-                 lora_rank_max: Optional[int] = None):
+                 lora_rank_max: Optional[int] = None,
+                 kvplane: Optional[bool] = None,
+                 kvplane_arena_bytes: Optional[int] = None):
         from ray_tpu.models.generate import _model_fns
         from ray_tpu.models.kvcache import (PagedKVCache,
                                             kv_int8_default,
@@ -351,6 +366,19 @@ class PrefillServer:
             PagedKVCache(config, block_size=block_size,
                          num_blocks=pool_blocks, int8=self.kv_int8)
             if prefix_cache else None)
+        # global KV plane (serve/kvplane.py): the tier-2 host arena
+        # catches HBM-evicted blocks instead of letting them die, and
+        # tier 3 publishes cold hot-prompt prefixes to the chunk
+        # fabric under the conductor's prefix directory
+        from .kvplane import HostArena, kvplane_enabled
+        if kvplane is None:
+            kvplane = kvplane_enabled()
+        self.kvplane = bool(kvplane) and self.kv_cache is not None
+        self.arena: Optional[HostArena] = None
+        if self.kvplane:
+            self.arena = HostArena(max_bytes=kvplane_arena_bytes,
+                                   replica=self.server_id)
+            self.kv_cache.attach_arena(self.arena)
         # multi-tenant LoRA (serve/lora.py): prefill runs under each
         # request's tenant adapter, so the prefill tier pages adapters
         # exactly like the decode tier; an adapter hot-swap flushes
@@ -380,6 +408,18 @@ class PrefillServer:
         # transfer_id -> chunk refs; holding them IS the chunks'
         # lifetime (ack() or retention-window reap drops them)
         self._held: "OrderedDict[str, List[Any]]" = OrderedDict()
+        # tier-3 holder state: digest -> (namespace, chunk refs). The
+        # refs ARE the published prefix's lifetime — keep-last-K so one
+        # replica can never pin unbounded fabric bytes; evicting a
+        # digest retracts its directory entry. _t3_known throttles
+        # re-export attempts (committed OR lost to a racing holder).
+        self._t3_refs: "OrderedDict[str, tuple]" = OrderedDict()
+        self._t3_known: "OrderedDict[str, bool]" = OrderedDict()
+        self._t3_keep = 8
+        self._kvp_stats = {k: 0 for k in (
+            "tier3_publishes", "tier3_adopts", "tier3_adopted_blocks",
+            "tier3_reused_tokens", "tier3_fetched_bytes",
+            "evict_storms", "storm_evicted_blocks")}
         self._seq = itertools.count()
         self._stats = {k: 0 for k in (
             "prefills", "prefilled_tokens", "reused_tokens",
@@ -391,18 +431,39 @@ class PrefillServer:
     # ---------------------------------------------------------- data plane
 
     def prefill(self, prompt_tokens,
-                tenant: Optional[str] = None) -> Dict[str, Any]:
+                tenant: Optional[str] = None,
+                kvplane_hint: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
         """Prefill one prompt (suffix-only on a cache hit) and publish
         its KV rows. Returns the transfer record for a DecodeServer.
         `tenant` (multi-tenant LoRA): prefill under that tenant's
         adapter — paged through this server's pool — with the prefix
         cache keyed by (tenant, prompt); the record carries the tag so
-        the decode tier adopts under the same adapter."""
+        the decode tier adopts under the same adapter.
+        `kvplane_hint` (serve/kvplane.py): a prefix-directory entry
+        whose holder the router could not dispatch to — this replica
+        fetches the published prefix over the transfer plane and
+        adopts it BEFORE the cache lookup, so the prefill is
+        suffix-only anyway (a failed fetch just prefills from scratch:
+        tier 3 is an accelerator, not a dependency)."""
         from ray_tpu.models.engine import _prefill_with_cache
         from ray_tpu.util import chunks
 
         if self._chaos is not None:
             self._chaos.on_request()  # may os._exit (kill_replica)
+            storm = self._chaos.take_storm()
+            if storm and self.kv_cache is not None:
+                # scripted eviction storm (chaos evict_storm): with the
+                # arena attached the evicted blocks SPILL to tier 2
+                # instead of dying — the chaos test's whole point
+                evicted = self.kv_cache.force_evict(storm)
+                with self._lock:
+                    self._kvp_stats["evict_storms"] += 1
+                    self._kvp_stats["storm_evicted_blocks"] += evicted
+                _notify_kvplane({"kind": "evict_storm",
+                                 "replica": self.server_id,
+                                 "blocks": evicted,
+                                 "requested": storm})
         prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
         plen = prompt.shape[1]
         if plen < 1:
@@ -417,6 +478,16 @@ class PrefillServer:
             adapter, aver = self.lora_pool.adapter_slice(
                 self.lora_pool.acquire(tenant), with_version=True)
             namespace = self.lora_pool.cache_namespace(tenant, aver)
+        kvp_info: Dict[str, Any] = {}
+        if self.arena is not None:
+            # bracket the prefill: tier-2 re-adoptions inside the cache
+            # lookup accumulate into this request's attribution
+            self.arena.begin_request()
+        if kvplane_hint is not None and self.kvplane \
+                and _worker() is not None:
+            t3 = self._adopt_t3(kvplane_hint, prompt[0], namespace)
+            if t3 is not None:
+                kvp_info["tier3"] = t3
         try:
             ck, cv, table, first, score, outcome, reused, suffix_len = \
                 _prefill_with_cache(self.params, self.config,
@@ -433,6 +504,10 @@ class PrefillServer:
             # pins drop NOW: the KV is exported below, and refcount-0
             # blocks stay cached for the next prompt's lookup
             self.kv_cache.release(table)
+        if self.arena is not None:
+            t2 = self.arena.end_request()
+            if t2.get("blocks"):
+                kvp_info["tier2"] = t2
         # the transfer payload: exactly the prompt's KV rows, host-side
         # (this is the ONLY materialization outside the fill itself —
         # the same single-copy the colocated splice reads on-device)
@@ -453,6 +528,10 @@ class PrefillServer:
         }
         if tenant is not None:
             rec["tenant"] = tenant
+        if kvp_info:
+            # rides the metadata record back to the router, which turns
+            # it into kvplane_tier2/3_fetch flight-recorder phases
+            rec["kvplane"] = kvp_info
         nbytes = int(kv_k.nbytes + kv_v.nbytes)
         w = _worker()
         if w is not None:
@@ -483,8 +562,118 @@ class PrefillServer:
                        "transfer_id": rec["transfer_id"],
                        "bytes": nbytes, "plen": plen,
                        "outcome": outcome})
+        if w is not None and self.kvplane:
+            self._maybe_publish_t3(prompt[0], namespace)
         self.publish_telemetry()
         return rec
+
+    # -------------------------------------------- global KV plane (tier 3)
+
+    def _adopt_t3(self, entry: Dict[str, Any], tokens,
+                  namespace: Optional[str]) -> Optional[Dict[str, Any]]:
+        """Fetch a directory entry's published prefix over the chunk
+        fabric and adopt it into the HBM pool ahead of the lookup.
+        Returns the fetch attribution (for the flight recorder) or
+        None when nothing crossed the wire."""
+        from . import kvplane as kvp
+
+        t0 = time.perf_counter()
+        try:
+            adopted, fst = kvp.fetch_and_adopt(
+                _worker(), self.kv_cache, entry, tokens, namespace)
+        except Exception:  # noqa: BLE001 — never fail the prefill
+            return None
+        ms = (time.perf_counter() - t0) * 1e3
+        fetched = int(fst.get("fetched_bytes", 0))
+        reused = int(adopted) * self.kv_cache.block_size
+        with self._lock:
+            if adopted:
+                self._kvp_stats["tier3_adopts"] += 1
+                self._kvp_stats["tier3_adopted_blocks"] += int(adopted)
+                self._kvp_stats["tier3_reused_tokens"] += reused
+            self._kvp_stats["tier3_fetched_bytes"] += fetched
+        if adopted:
+            _notify_kvplane({"kind": "tier3_adopt",
+                             "replica": self.server_id,
+                             "blocks": int(adopted),
+                             "tokens": reused, "nbytes": fetched,
+                             "namespace": namespace})
+        if not adopted and not fetched:
+            return None
+        return {"blocks": int(adopted), "tokens": reused,
+                "nbytes": fetched, "ms": round(ms, 3)}
+
+    def _maybe_publish_t3(self, tokens, namespace: Optional[str]
+                          ) -> None:
+        """Publish the prompt's longest cached full-block prefix to
+        tier 3 — chunk-fabric objects plus the conductor's prefix
+        directory commit — at most once per digest from this replica.
+        The held refs are the published object's lifetime: keep-last-K,
+        and an evicted digest retracts its directory entry so lookups
+        stop routing to bytes that are gone. Best-effort throughout:
+        tier 3 is an accelerator, never a dependency."""
+        from ray_tpu.models.kvcache import prefix_digests
+
+        from . import kvplane as kvp
+
+        if not kvp.directory_enabled() or self.kv_cache is None:
+            return
+        digs = prefix_digests(tokens, self.kv_cache.block_size,
+                              namespace)
+        if len(digs) < kvp.t3_min_blocks():
+            return  # prompt too short to ever clear the publish floor
+        head = digs[0]  # longest chain — the dedup/throttle key
+        with self._lock:
+            if head in self._t3_known:
+                self._t3_known.move_to_end(head)
+                return
+        w = _worker()
+        if w is None:
+            return
+        try:
+            out = kvp.publish_prefix(w, self.kv_cache, tokens,
+                                     namespace, self.server_id,
+                                     machine=self.machine)
+        except Exception:  # noqa: BLE001 — directory outage
+            return
+        dropped: List[tuple] = []
+        with self._lock:
+            self._t3_known[head] = out is not None
+            while len(self._t3_known) > 4 * self._t3_keep:
+                self._t3_known.popitem(last=False)
+            if out is not None:
+                digest_hex, refs = out
+                self._t3_refs[digest_hex] = (namespace, refs)
+                self._kvp_stats["tier3_publishes"] += 1
+                while len(self._t3_refs) > self._t3_keep:
+                    old_digest, (old_ns, _refs) = \
+                        self._t3_refs.popitem(last=False)
+                    dropped.append((old_digest, old_ns))
+        for old_digest, old_ns in dropped:
+            try:
+                # the refs just died — retract the directory entry so
+                # lookups stop routing fetches at a gone object
+                w.conductor.call("kvplane_unpublish", old_ns or "",
+                                 old_digest, timeout=5.0)
+            except Exception:  # noqa: BLE001 — best-effort retract
+                pass
+
+    def kvplane_stats(self) -> Dict[str, Any]:
+        """This replica's kvplane snapshot (tier-2 arena + tier-3
+        holder counters + per-caller fabric attribution) — one
+        component of the conductor's get_kvplane_stats aggregate."""
+        from ray_tpu.util import chunks
+
+        s: Dict[str, Any] = {"role": "prefill",
+                             "server_id": self.server_id,
+                             "enabled": self.kvplane}
+        if self.arena is not None:
+            s.update(self.arena.stats())
+        with self._lock:
+            s.update(self._kvp_stats)
+            s["t3_held_refs"] = len(self._t3_refs)
+        s["fabric"] = chunks.caller_totals("kvplane")
+        return s
 
     def set_retention(self, retain: int) -> None:
         """Raise the retention window (routers push the decode tier's
@@ -508,7 +697,13 @@ class PrefillServer:
         decode-side placement-affinity input)."""
         return {"server_id": self.server_id, "role": "prefill",
                 "machine": self.machine,
-                "lora": self.lora_pool is not None}
+                "lora": self.lora_pool is not None,
+                "kvplane": self.kvplane,
+                # the router computes directory digests with OUR block
+                # size — digest chains only match when they agree
+                "kv_block_size": (self.kv_cache.block_size
+                                  if self.kv_cache is not None
+                                  else None)}
 
     def publish_adapter(self, tenant: str,
                         adapter: Dict[str, Any]) -> int:
@@ -562,6 +757,20 @@ class PrefillServer:
             if held == 0 or time.monotonic() >= deadline:
                 break
             time.sleep(0.05)
+        # retract this holder's directory entries: the tier-3 refs die
+        # with the replica, so lookups must stop routing fetches here
+        # (a stale entry is only a wasted fetch, but why leave one)
+        w = _worker()
+        with self._lock:
+            t3 = list(self._t3_refs.items())
+            self._t3_refs.clear()
+        if w is not None:
+            for digest_hex, (ns, _refs) in t3:
+                try:
+                    w.conductor.call("kvplane_unpublish", ns or "",
+                                     digest_hex, timeout=5.0)
+                except Exception:  # noqa: BLE001 — conductor gone too
+                    pass
         self.publish_telemetry(force=True)
         return held == 0
 
@@ -604,6 +813,8 @@ class PrefillServer:
         if w is None:
             if self.kv_cache is not None:
                 self.kv_cache.drain_events()
+            if self.arena is not None:
+                self.arena.drain_events()
             return
         try:
             w.conductor.notify("report_kvcache_stats", w.worker_id,
@@ -612,6 +823,12 @@ class PrefillServer:
                 for ev in self.kv_cache.drain_events():
                     ev.setdefault("engine", self.server_id)
                     w.conductor.notify("report_kvcache_event", ev)
+            if self.kvplane:
+                w.conductor.notify("report_kvplane_stats", w.worker_id,
+                                   self.server_id,
+                                   self.kvplane_stats())
+                for ev in self.arena.drain_events():
+                    w.conductor.notify("report_kvplane_event", ev)
         except Exception:  # noqa: BLE001 — cluster shutting down
             pass
 
@@ -711,7 +928,7 @@ class DecodeServer:
                 raise RuntimeError(
                     "a chunk-published transfer needs a live cluster "
                     "(ray_tpu.init) on the decode side")
-            fetcher = chunks.ChunkFetcher(w)
+            fetcher = chunks.ChunkFetcher(w, caller="kv")
             tree = chunks.fetch_tree(w, desc, fetcher)
             kv_k, kv_v = tree["k"], tree["v"]
             acc = fetcher.stats()
@@ -1116,6 +1333,12 @@ class DisaggRouter:
         self.router_id = router_id or \
             f"router-{os.getpid()}-{next(_SERVER_SEQ)}"
         self._lock = threading.Lock()
+        # global KV plane (serve/kvplane.py): prefer the replica that
+        # HAS the prefix (conductor directory) over the one the hash
+        # says probably does; block size learned from prefill describe()
+        from .kvplane import directory_enabled as _kvp_dir_enabled
+        self._kvplane_dir = _kvp_dir_enabled()
+        self._kv_block_size: Optional[int] = None
         self._decode: List[_TierReplica] = [
             self._register(d, "decode") for d in decode]
         self._prefill: List[_TierReplica] = [
@@ -1137,7 +1360,9 @@ class DisaggRouter:
             "dispatched", "completed", "shed", "max_pending",
             "shm_affinity_hits", "shm_affinity_total",
             "tenant_affinity_hits", "tenant_affinity_total",
-            "tier_wakeups", "preemptions", "preempted_requests")}
+            "tier_wakeups", "preemptions", "preempted_requests",
+            "directory_hits", "directory_misses",
+            "directory_fallbacks")}
         # QoS preemption (serve/qos.py classes): batch-class requests
         # register here while in flight; an interactive arrival that
         # finds every slot taken cancels the cheapest one and rides
@@ -1189,6 +1414,10 @@ class DisaggRouter:
         cap = int(info.get("capacity")
                   or (_call(target, "capacity") if tier == "decode"
                       else 0))
+        if tier == "prefill" and self._kv_block_size is None:
+            bs = info.get("kv_block_size")
+            if bs:
+                self._kv_block_size = int(bs)
         return _TierReplica(target, rid, cap, info.get("machine"),
                             bool(info.get("lora")))
 
@@ -1687,9 +1916,39 @@ class DisaggRouter:
 
     # ------------------------------------------------------------- dispatch
 
+    def _directory_entry(self, prompt: np.ndarray,
+                         tenant: Optional[str]
+                         ) -> Optional[Dict[str, Any]]:
+        """Ask the conductor's KV-plane prefix directory who HOLDS this
+        prompt's longest published prefix. Returns None when the lookup
+        was not attempted (directory off, no cluster, block size not
+        yet learned from a prefill replica, or a tenant-tagged request
+        — the tenant namespace folds in the adapter VERSION, which only
+        the replica's adapter pool knows) and ``{}`` when it ran and
+        found nothing; any entry is advisory — a miss always falls back
+        to the affinity hash, bit-identically."""
+        if not self._kvplane_dir or tenant is not None:
+            return None
+        bs = self._kv_block_size
+        w = _worker()
+        if bs is None or w is None:
+            return None
+        from .kvplane import directory_lookup
+        try:
+            # namespace None, not "": the digest chain must be rooted
+            # exactly like the replicas' default-namespace index (the
+            # conductor-side directory key maps None -> "" itself)
+            entry = directory_lookup(w, None, [int(t) for t in prompt],
+                                     bs)
+        except Exception:  # noqa: BLE001 — conductor unreachable
+            return None
+        return entry if entry is not None else {}
+
     def _pick_prefill(self, prompt: np.ndarray,
                       decode_machine: Optional[str],
-                      tenant: Optional[str] = None) -> _TierReplica:
+                      tenant: Optional[str] = None
+                      ) -> Tuple[_TierReplica,
+                                 Optional[Dict[str, Any]]]:
         """Prefix-cache affinity WITHIN the host-local subset: among
         prefill replicas co-located with the chosen decode replica (so
         the KV transfer rides shm, never RPC), the prompt's first cache
@@ -1699,25 +1958,63 @@ class DisaggRouter:
         bit-identity) is unchanged. The TENANT joins the hash beside
         the prompt head: a tenant's prompts land on the replica that
         already holds its adapter (and its namespace-keyed KV) — the
-        tenant-affinity half of the multi-tenant routing policy."""
+        tenant-affinity half of the multi-tenant routing policy.
+
+        With the global KV plane on, the conductor's prefix directory
+        upgrades the hash from "who PROBABLY has it" to "who HAS it":
+        a live holder wins outright; a holder that has left the pool
+        degrades to the hash plus a tier-3 hint the chosen replica can
+        fetch through the transfer plane. Returns ``(replica, hint)``
+        where hint is None except on that fallback path."""
+        dir_entry = self._directory_entry(prompt, tenant)
         head = (tenant,) + tuple(
             int(t) for t in prompt[:self.affinity_tokens])
+        hint: Optional[Dict[str, Any]] = None
+        outcome: Optional[str] = None
         with self._lock:
             cands = [r for r in self._prefill if not r.draining]
             if not cands:  # every prefill draining: keep serving
                 cands = list(self._prefill)
             if not cands:  # every prefill DEAD: caller waits/sheds
                 raise LookupError("no live prefill replica")
-            local = [r for r in cands
-                     if decode_machine is not None
-                     and r.machine == decode_machine]
-            pool = local or cands
-            rep = pool[hash(head) % len(pool)]
+            rep = None
+            if dir_entry:
+                holder = dir_entry.get("holder")
+                by_rid = {r.rid: r for r in cands}
+                if holder in by_rid:
+                    rep = by_rid[holder]
+                    outcome = "hit"
+                    self._stats["directory_hits"] += 1
+                else:
+                    # entry survives its holder (death, drain): route
+                    # by hash but hand the replica the tier-3 pointer
+                    hint = dir_entry
+                    outcome = "fallback"
+                    self._stats["directory_fallbacks"] += 1
+            elif dir_entry is not None:  # lookup ran, found nothing
+                outcome = "miss"
+                self._stats["directory_misses"] += 1
+            if rep is None:
+                local = [r for r in cands
+                         if decode_machine is not None
+                         and r.machine == decode_machine]
+                pool = local or cands
+                rep = pool[hash(head) % len(pool)]
             self._stats["shm_affinity_total"] += 1
             if decode_machine is not None \
                     and rep.machine == decode_machine:
                 self._stats["shm_affinity_hits"] += 1
-        return rep
+        if outcome is not None:
+            from .kvplane import kvplane_metrics
+            kvplane_metrics()["directory"].inc(
+                tags={"outcome": outcome})
+            if outcome == "hit":
+                _notify_kvplane({
+                    "kind": "directory_hit", "router": self.router_id,
+                    "replica": rep.rid,
+                    "digest": dir_entry.get("digest"),
+                    "blocks": dir_entry.get("blocks")})
+        return rep, hint
 
     def _check_deadline(self, deadline: Optional[float],
                         tenant: Optional[str] = None) -> None:
@@ -1787,7 +2084,8 @@ class DisaggRouter:
                               decode_machine: Optional[str],
                               deadline: Optional[float],
                               tenant: Optional[str] = None
-                              ) -> _TierReplica:
+                              ) -> Tuple[_TierReplica,
+                                         Optional[Dict[str, Any]]]:
         """_pick_prefill, waiting out a momentarily-empty tier (every
         prefill replica dead — self-healer replacement in flight — or
         drained to zero: the first LookupError fires the scale-from-
@@ -2163,17 +2461,23 @@ class DisaggRouter:
                 if history else prompt)
             # ---- prefill phase (retryable: nothing emitted from rec
             # until decode pulls it)
-            pf = self._pick_prefill_or_wait(replay, rep.machine,
-                                            deadline, tenant)
+            pf, kv_hint = self._pick_prefill_or_wait(
+                replay, rep.machine, deadline, tenant)
             with self._lock:
                 self._pf_inflight += 1
                 pf.inflight += 1
             self._pf_inflight_win.add(self._pf_inflight)
             try:
+                # the tier-3 hint rides as an extra positional only
+                # when present — pre-kvplane replicas (and test
+                # doubles) keep their two-argument prefill surface
+                pf_args = (replay.tolist(), tenant) \
+                    if kv_hint is None \
+                    else (replay.tolist(), tenant, kv_hint)
                 with reqtrace.phase("prefill", replica=pf.rid,
                                     prompt_tokens=int(replay.size)):
                     rec = self._tier_call(pf, "prefill", "prefill",
-                                          replay.tolist(), tenant)
+                                          *pf_args)
             except Exception as e:  # noqa: BLE001 — dead or broken
                 if _is_pool_exhausted(e):
                     raise self._shed_pool_exhausted("prefill", tenant,
@@ -2192,6 +2496,21 @@ class DisaggRouter:
                     if pf.inflight > 0:
                         pf.inflight -= 1
             try:
+                if tr is not None and rec.get("kvplane"):
+                    # tier-2/3 fetch sub-phases: the flight recorder
+                    # attributes KV-plane time inside the prefill span
+                    for tier_n, ph in (("tier2",
+                                        "kvplane_tier2_fetch"),
+                                       ("tier3",
+                                        "kvplane_tier3_fetch")):
+                        tinfo = rec["kvplane"].get(tier_n)
+                        if tinfo:
+                            tr.add_phase(
+                                ph, float(tinfo.get("ms", 0.0)),
+                                replica=pf.rid,
+                                blocks=int(tinfo.get("blocks", 0)),
+                                tokens=int(tinfo.get("tokens", 0)),
+                                kv_bytes=int(tinfo.get("nbytes", 0)))
                 if not first_emitted:
                     # the first token exists NOW — this is the TTFT
                     # the recent window (and the policy's queueing-
@@ -2486,12 +2805,41 @@ class DisaggRouter:
                 }
         return out
 
+    def kvplane_stats(self) -> Dict[str, Any]:
+        """The router's KV-plane contribution: directory routing
+        outcomes (hit = routed to the holder, fallback = holder gone,
+        hashed + tier-3 hint, miss = nothing published). Rates and
+        totals merge with the replicas' tier stats on the conductor."""
+        with self._lock:
+            s: Dict[str, Any] = {
+                k: self._stats[k] for k in
+                ("directory_hits", "directory_misses",
+                 "directory_fallbacks")}
+        s.update(role="router", router_id=self.router_id,
+                 enabled=self._kvplane_dir,
+                 kv_block_size=self._kv_block_size)
+        probes = (s["directory_hits"] + s["directory_misses"]
+                  + s["directory_fallbacks"])
+        if probes:
+            s["directory_hit_rate"] = round(
+                s["directory_hits"] / probes, 4)
+        return s
+
     def publish_telemetry(self, force: bool = False) -> None:
         now = time.monotonic()
         if not force and now - self._last_push < 0.5:
             return
         self._last_push = now
         _push_stats(self.router_id, self.stats())
+        if self._disagg_mode and self._kvplane_dir:
+            w = _worker()
+            if w is not None:
+                try:
+                    w.conductor.notify("report_kvplane_stats",
+                                       w.worker_id, self.router_id,
+                                       self.kvplane_stats())
+                except Exception:  # noqa: BLE001 — shutting down
+                    pass
         tenants = self.tenant_stats()
         if tenants:
             # the router's tenant counters ride the lora surface too,

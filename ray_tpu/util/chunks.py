@@ -23,12 +23,33 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ray_tpu._private.object_store import ObjectRef
+
+# Per-CALLER fabric accounting across every fetcher in this process:
+# caller label ("weights" / "kv" / "activations" / "kvplane" / ...) ->
+# the same counter set each fetcher keeps. Lets the kvplane surface
+# report tier-3 bytes without aliasing them with weight-fabric traffic
+# riding the same fabric.
+_CALLER_KEYS = ("chunks_local", "chunks_fetched", "fetched_bytes",
+                "shm_bytes", "rpc_bytes", "fetch_retries")
+_caller_totals: Dict[str, Dict[str, int]] = {}
+_caller_lock = threading.Lock()
+
+
+def caller_totals(caller: Optional[str] = None) -> Dict[str, Any]:
+    """Process-wide fetch accounting grouped by caller label — one
+    caller's counter dict, or ``{caller: counters}`` for all of them."""
+    with _caller_lock:
+        if caller is not None:
+            return dict(_caller_totals.get(
+                caller, {k: 0 for k in _CALLER_KEYS}))
+        return {c: dict(v) for c, v in _caller_totals.items()}
 
 # Transient pull failures worth retrying: a timed-out range fetch or a
 # connection hiccup to the owning worker. Owner-side permanent failures
@@ -96,9 +117,13 @@ class ChunkFetcher:
                  on_read: Optional[Callable[[int, bool, bool],
                                             None]] = None,
                  seed_cache: Optional[Dict[str, np.ndarray]] = None,
-                 retries: Optional[int] = None):
+                 retries: Optional[int] = None,
+                 caller: str = "unlabeled"):
         self._worker = worker
         self._timeout = timeout
+        # per-caller attribution: which subsystem's traffic this is
+        # (weights / kv / activations / kvplane) — feeds caller_totals()
+        self.caller = str(caller)
         self._on_read = on_read
         self._machine = local_machine_id()
         # bounded retry-with-backoff on TRANSIENT pull failures (env
@@ -134,7 +159,15 @@ class ChunkFetcher:
                 "fetched_bytes": self.fetched_bytes,
                 "shm_bytes": self.shm_bytes,
                 "rpc_bytes": self.rpc_bytes,
-                "fetch_retries": self.fetch_retries}
+                "fetch_retries": self.fetch_retries,
+                "caller": self.caller}
+
+    def _account_caller(self, **deltas: int) -> None:
+        with _caller_lock:
+            tot = _caller_totals.setdefault(
+                self.caller, {k: 0 for k in _CALLER_KEYS})
+            for k, v in deltas.items():
+                tot[k] += v
 
     def _get_with_retries(self, ref: ObjectRef) -> np.ndarray:
         """One chunk pull with bounded exponential backoff on transient
@@ -155,6 +188,7 @@ class ChunkFetcher:
                     raise
                 attempt += 1
                 self.fetch_retries += 1
+                self._account_caller(fetch_retries=1)
                 time.sleep(min(5.0, 0.1 * 2.0 ** (attempt - 1)))
 
     def __call__(self, entry: Dict[str, Any]) -> np.ndarray:
@@ -164,6 +198,7 @@ class ChunkFetcher:
             if oid in self._seeded:
                 self._seeded.discard(oid)
                 self.chunks_local += 1
+                self._account_caller(chunks_local=1)
                 if self._on_read is not None:
                     self._on_read(int(entry.get("nbytes", arr.nbytes)),
                                   True, True)
@@ -190,13 +225,20 @@ class ChunkFetcher:
         same_host = entry.get("machine", self._machine) == self._machine
         if was_local:
             self.chunks_local += 1
+            self._account_caller(chunks_local=1)
         else:
             self.chunks_fetched += 1
             self.fetched_bytes += nbytes
             if same_host:
                 self.shm_bytes += nbytes
+                self._account_caller(chunks_fetched=1,
+                                     fetched_bytes=nbytes,
+                                     shm_bytes=nbytes)
             else:
                 self.rpc_bytes += nbytes
+                self._account_caller(chunks_fetched=1,
+                                     fetched_bytes=nbytes,
+                                     rpc_bytes=nbytes)
         if self._on_read is not None:
             self._on_read(nbytes, was_local, same_host)
         self._cache[oid] = arr
@@ -240,5 +282,5 @@ def fetch_tree(worker, descriptor: Dict[str, Any],
     return jax.tree.unflatten(treedef, leaves)
 
 
-__all__ = ["ChunkFetcher", "ensure_chunkable", "fetch_tree",
-           "local_machine_id", "put_chunk", "put_tree"]
+__all__ = ["ChunkFetcher", "caller_totals", "ensure_chunkable",
+           "fetch_tree", "local_machine_id", "put_chunk", "put_tree"]

@@ -227,6 +227,22 @@ def disagg_status() -> Dict[str, Any]:
                                        timeout=10.0)
 
 
+def kvplane_status() -> Dict[str, Any]:
+    """Global KV plane view (serve/kvplane.py): per-component
+    snapshots — prefill arenas (tier-2 entries/bytes, spills, hits,
+    re-adopted tokens), tier-3 publish/adopt counters, routers'
+    directory routing outcomes (hit/fallback/miss) — plus cluster
+    totals with tier-2 hit rate and directory hit rate, and the
+    conductor-side prefix directory summary (entries, bytes, per-
+    namespace counts, commit/reap/GC counters). The CLI analog is
+    `python -m ray_tpu kvplane`; the dashboard serves it at
+    /api/kvplane; spill/tier2_hit/tier3_publish/tier3_adopt/
+    directory_hit markers ride the merged timeline's `kvplane`
+    lane."""
+    return _conductor().conductor.call("get_kvplane_status",
+                                       timeout=10.0)
+
+
 def lora_status() -> Dict[str, Any]:
     """Multi-tenant LoRA serving view (serve/lora.py): per-pool
     adapter-paging snapshots (slots, residents, hits/misses/evictions/

@@ -74,7 +74,7 @@ class _ChunkFetcher(chunks.ChunkFetcher):
                     _stats.rpc_bytes += nbytes
 
         super().__init__(worker, timeout=60.0, on_read=on_read,
-                         seed_cache=seed_cache)
+                         seed_cache=seed_cache, caller="weights")
 
 
 class _AccountingReader(_LeafReader):

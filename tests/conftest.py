@@ -114,6 +114,17 @@ def pytest_configure(config):
         "module-scoped cluster with log_to_driver=0 — select with "
         "`-m requesttrace`")
     config.addinivalue_line(
+        "markers", "kvplane: global-KV-plane scenarios "
+        "(serve/kvplane.py tiered prefix cache: HBM -> host-arena "
+        "spill/re-adopt bit-identity, tier-3 chunk-fabric "
+        "publish/adopt, conductor prefix-directory atomic "
+        "commit/TTL-reap/holder-death fallback, namespace isolation "
+        "across tiers, eviction-storm chaos absorption, "
+        "one-set-of-numbers across state API == CLI == dashboard == "
+        "Prometheus == timeline); everything is tier-1-safe on CPU, "
+        "cluster tests run on a module-scoped cluster with "
+        "log_to_driver=0 — select with `-m kvplane`")
+    config.addinivalue_line(
         "markers", "oracle: step-time oracle scenarios "
         "(observability.roofline: ICI/DCN roofline prediction, "
         "flight-recorder validation + calibration fit, bench "

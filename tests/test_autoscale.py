@@ -500,12 +500,14 @@ def test_prefill_affinity_prefers_decode_host(model):
     reps[0].machine = "elsewhere"
     reps[1].machine = "here"
     prompt = np.asarray([1, 2, 3, 4, 5], np.int32)
+    # _pick_prefill returns (replica, tier-3 adoption hint); no prefix
+    # is published here so the hint is always None
     for _ in range(4):
-        assert router._pick_prefill(prompt, "here") is reps[1]
+        assert router._pick_prefill(prompt, "here") == (reps[1], None)
     # no co-located replica -> stable prefix hash over the whole set
-    fallback = router._pick_prefill(prompt, "mars")
-    assert fallback in reps
-    assert router._pick_prefill(prompt, "mars") is fallback
+    fallback, hint = router._pick_prefill(prompt, "mars")
+    assert fallback in reps and hint is None
+    assert router._pick_prefill(prompt, "mars") == (fallback, None)
     st = router.stats()
     assert st["shm_affinity_total"] == 6
     assert st["shm_affinity_hits"] == 4

@@ -206,6 +206,68 @@ def test_unkeyed_tenant_cache_rule_fires():
             if f.rule == "unkeyed-tenant-cache"] == []
 
 
+def test_kvplane_modules_are_lint_covered():
+    """The global KV plane (serve/kvplane.py) and the modules it
+    rewired (models/kvcache.py, serve/disagg.py, _private/conductor.py)
+    are inside the self-lint set and carry zero error findings — and
+    zero unregistered-prefix-publish findings after suppressions
+    (every chunk-fabric prefix export pairs with the conductor's
+    atomic directory commit)."""
+    for rel in (os.path.join("serve", "kvplane.py"),
+                os.path.join("models", "kvcache.py"),
+                os.path.join("serve", "disagg.py"),
+                os.path.join("_private", "conductor.py")):
+        path = os.path.join(PACKAGE_ROOT, rel)
+        assert os.path.exists(path), rel
+        findings = lint_path(path)
+        assert errors(findings) == [], rel
+        unreg = [f for f in findings
+                 if f.rule == "unregistered-prefix-publish"]
+        assert unreg == [], (rel, [str(f) for f in unreg])
+
+
+def test_unregistered_prefix_publish_rule_fires():
+    """The rule catches a seeded violation: a KV-plane-aware module
+    exporting a prefix into the chunk fabric without the conductor's
+    directory commit in scope — and honors the publish_prefix helper,
+    the kvplane_publish literal, suppressions, and stays silent in
+    modules without kvplane/kvcache in scope."""
+    from ray_tpu.analysis.astlint import lint_source
+
+    src = (
+        "from ray_tpu.serve import kvplane\n"
+        "def bad(worker, cache, toks):\n"
+        "    packed, n, dig = cache.export_prefix(toks, None, 32)\n"
+        "    return put_tree(worker, packed)  # fabric, no commit\n"
+        "def fine_helper(worker, cache, toks):\n"
+        "    return kvplane.publish_prefix(worker, cache, toks, None, "
+        "'rep')\n"
+        "def fine_commit(worker, cache, toks):\n"
+        "    packed, n, dig = cache.export_prefix(toks, None, 32)\n"
+        "    return worker.conductor.call('kvplane_publish', '', dig, "
+        "{})\n"
+    )
+    found = [f for f in lint_source(src, "seeded.py")
+             if f.rule == "unregistered-prefix-publish"]
+    assert len(found) == 1, [str(f) for f in found]
+    assert found[0].severity == "info"
+    assert ":3" in found[0].location
+    # a justified suppression silences it
+    suppressed = src.replace(
+        "packed, n, dig = cache.export_prefix(toks, None, 32)\n"
+        "    return put_tree",
+        "packed, n, dig = cache.export_prefix(toks, None, 32)"
+        "  # shardlint: disable=unregistered-prefix-publish\n"
+        "    return put_tree")
+    assert [f for f in lint_source(suppressed, "seeded.py")
+            if f.rule == "unregistered-prefix-publish"] == []
+    # ...and the rule is inert without kvplane/kvcache in scope
+    other = ("def f(cache, toks):\n"
+             "    return cache.export_prefix(toks, None, 32)\n")
+    assert [f for f in lint_source(other, "other.py")
+            if f.rule == "unregistered-prefix-publish"] == []
+
+
 def test_speculation_modules_are_lint_covered():
     """The speculative-decoding + int8-KV modules (models/engine.py,
     models/kvcache.py, serve/lora.py after the donated-write rework)
@@ -399,7 +461,7 @@ def test_surface_parity_covers_every_subsystem():
     stems = set(discover_subsystems(tree))
     assert {"kvcache", "weight", "online", "pipeline", "autoscale",
             "servefault", "speculation", "gateway",
-            "resilience", "requesttrace"} <= stems, stems
+            "resilience", "requesttrace", "kvplane"} <= stems, stems
     assert check_surface_parity(PACKAGE_ROOT) == []
 
 
